@@ -1,0 +1,31 @@
+"""kubetpu — a TPU-native device-management and topology-aware scheduling framework.
+
+Built from scratch with the capabilities of microsoft/KubeGPU (the reference,
+surveyed in SURVEY.md): a device-plugin layer that enumerates accelerator
+hardware and advertises it as hierarchical resources, a topology-aware
+scheduler that shapes multi-chip pod requests onto the best available
+interconnect topology, and a core harness (scheduler loop + group/gang
+scheduler) that the reference delegated to the external KubeDevice repo.
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``kubetpu.api``         — re-creation of the KubeDevice-API contract
+                            (types, resource translation, logging, plugin
+                            interfaces) the reference compiles against.
+- ``kubetpu.plugintypes`` — shared data model: resource name constants,
+                            sorted topology trees, and the new ICI torus
+                            mesh model for TPU slices.
+- ``kubetpu.tpuinfo``     — C++ hardware probe behind an exec-JSON boundary
+                            (analog of nvmlinfo, reference
+                            nvidiagpuplugin/nvmlinfo/main.go).
+- ``kubetpu.device``      — node-agent device managers (TPU and NVIDIA)
+                            implementing ``api.device.Device``.
+- ``kubetpu.scheduler``   — topology-aware scheduler plugins implementing
+                            ``api.devicescheduler.DeviceScheduler``.
+- ``kubetpu.core``        — stand-in for the KubeDevice core: scheduler
+                            loop, group (gang) scheduler, AllocateFrom fill.
+- ``kubetpu.jobs``        — JAX integration: turn a chip allocation into a
+                            ``jax.sharding.Mesh`` and run sharded training.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Re-creation of the KubeDevice-API surface the reference compiles against.
+
+The reference repo (microsoft/KubeGPU) imports
+``github.com/Microsoft/KubeDevice-API/pkg/{types,utils,resource,device,
+devicescheduler}`` which is *not* vendored there (SURVEY.md §1, "the missing
+layer"). This package re-creates that contract from its usage sites, cited
+per symbol in the submodules.
+"""
+
+from kubetpu.api import types, utils, resource, device, devicescheduler  # noqa: F401

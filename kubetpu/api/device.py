@@ -1,0 +1,93 @@
+"""Device-plugin interface of the KubeDevice-API contract.
+
+Reference: ``device.Device`` implemented by the NVIDIA manager
+(``nvidiagpuplugin/gpu/nvidia/nvidia_gpu_manager.go:35-47,185-241``), loaded
+by the CRI shim via ``plugin.Open`` + ``CreateDevicePlugin`` symbol lookup
+(``nvidiagpuplugin/plugin/nvidiagpu.go:8-10``, ``cmd/main.go:23``).
+
+The Go ``--buildmode=plugin`` shared-object mechanism becomes a Python
+module-factory contract here (SURVEY.md §7): a plugin module exports
+``create_device_plugin() -> Device``; ``create_device_from_plugin`` loads it
+by import path or file path.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo
+
+
+@dataclass
+class Mount:
+    """A volume mount handed to the container runtime (reference:
+    ``device.Mount``, used in the Allocate return tuple)."""
+
+    name: str
+    host_path: str
+    container_path: str
+    read_only: bool = True
+
+
+# Allocate's return tuple: (mounts, device nodes, env vars)
+# Reference returns ([]devtypes.Mount, []string, map[string]string, error)
+# (nvidia_gpu_manager.go:216-241).
+AllocateResult = Tuple[List[Mount], List[str], Dict[str, str]]
+
+
+class Device(ABC):
+    """A node-agent device manager (reference: KubeDevice-API ``device.Device``,
+    surface inferred at SURVEY.md §1: New/Start/UpdateNodeInfo/Allocate/GetName)."""
+
+    @abstractmethod
+    def new(self) -> None:
+        """Initialize internal state (reference New, nvidia_gpu_manager.go:40-47)."""
+
+    @abstractmethod
+    def start(self) -> None:
+        """Probe hardware; must not raise on probe failure — the node degrades
+        to zero devices instead (reference Start, nvidia_gpu_manager.go:185-188)."""
+
+    @abstractmethod
+    def update_node_info(self, node_info: NodeInfo) -> None:
+        """Advertise capacity/allocatable, scalar + grouped topology keys
+        (reference UpdateNodeInfo, nvidia_gpu_manager.go:191-213)."""
+
+    @abstractmethod
+    def allocate(self, pod: PodInfo, container: ContainerInfo) -> AllocateResult:
+        """Turn ``container.allocate_from`` into device nodes + env for the
+        container runtime (reference Allocate, nvidia_gpu_manager.go:216-241)."""
+
+    @abstractmethod
+    def get_name(self) -> str:
+        """Plugin name, e.g. "tpu" (reference GetName)."""
+
+
+def create_device_from_plugin(path: str) -> Device:
+    """Load a device plugin and call its ``create_device_plugin`` factory.
+
+    *path* is either a dotted module path (``kubetpu.device.plugin``) or a
+    filesystem path to a ``.py`` file — the analog of
+    ``device.CreateDeviceFromPlugin("/usr/local/KubeExt/devices/...so")``
+    (reference ``cmd/main.go:23``).
+    """
+    mod = _load_module(path)
+    factory = getattr(mod, "create_device_plugin", None)
+    if factory is None:
+        raise AttributeError(f"plugin {path!r} exports no create_device_plugin")
+    return factory()
+
+
+def _load_module(path: str):
+    if path.endswith(".py"):
+        spec = importlib.util.spec_from_file_location("kubetpu_plugin_" + str(abs(hash(path))), path)
+        if spec is None or spec.loader is None:
+            raise ImportError(f"cannot load plugin from {path!r}")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    return importlib.import_module(path)

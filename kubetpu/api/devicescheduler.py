@@ -1,0 +1,88 @@
+"""Scheduler-plugin interface of the KubeDevice-API contract.
+
+Reference: ``devicescheduler.DeviceScheduler`` implemented by
+``gpuschedulerplugin/gpu_scheduler.go:21-71`` and loaded via
+``CreateDeviceSchedulerPlugin`` (``gpuschedulerplugin/plugin/gpuscheduler.go``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import List, Tuple
+
+from kubetpu.api.types import NodeInfo, PodInfo
+
+
+@dataclass
+class PredicateFailureReason:
+    """Why a pod does not fit a node (reference:
+    ``devicescheduler.PredicateFailureReason``, gpu_scheduler.go:34)."""
+
+    resource_name: str = ""
+    requested: int = 0
+    used: int = 0
+    capacity: int = 0
+    message: str = ""
+
+
+# (fits, failure reasons, score) — reference PodFitsDevice return triple.
+FitResult = Tuple[bool, List[PredicateFailureReason], float]
+
+
+class DeviceScheduler(ABC):
+    """A device-specific scheduler plugin (reference surface:
+    AddNode/RemoveNode/PodFitsDevice/PodAllocate/TakePodResources/
+    ReturnPodResources/GetName/UsingGroupScheduler, gpu_scheduler.go)."""
+
+    @abstractmethod
+    def add_node(self, node_name: str, node_info: NodeInfo) -> None: ...
+
+    @abstractmethod
+    def remove_node(self, node_name: str) -> None: ...
+
+    @abstractmethod
+    def pod_fits_device(
+        self, node_info: NodeInfo, pod_info: PodInfo, fill_allocate_from: bool
+    ) -> FitResult: ...
+
+    @abstractmethod
+    def pod_allocate(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
+        """Raise on failure (reference returns error, gpu_scheduler.go:46-55)."""
+
+    @abstractmethod
+    def take_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None: ...
+
+    @abstractmethod
+    def return_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None: ...
+
+    @abstractmethod
+    def get_name(self) -> str: ...
+
+    @abstractmethod
+    def using_group_scheduler(self) -> bool:
+        """True to delegate bin-packing/AllocateFrom fill to the core group
+        scheduler (reference gpu_scheduler.go:69-71; kubetpu implements that
+        group scheduler in ``kubetpu.core``)."""
+
+
+def create_device_scheduler_from_plugin(path: str) -> DeviceScheduler:
+    """Load a scheduler plugin module and call its
+    ``create_device_scheduler_plugin`` factory (analog of the Go
+    ``plugin.Open`` + symbol lookup, ``Makefile:12``)."""
+    if path.endswith(".py"):
+        spec = importlib.util.spec_from_file_location(
+            "kubetpu_sched_plugin_" + str(abs(hash(path))), path
+        )
+        if spec is None or spec.loader is None:
+            raise ImportError(f"cannot load plugin from {path!r}")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(path)
+    factory = getattr(mod, "create_device_scheduler_plugin", None)
+    if factory is None:
+        raise AttributeError(f"plugin {path!r} exports no create_device_scheduler_plugin")
+    return factory()

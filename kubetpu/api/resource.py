@@ -1,0 +1,125 @@
+"""Resource-key translation: wrap flat per-device request keys into the
+hierarchical grouping a node advertises.
+
+Re-creation of ``resource.TranslateResource(nodeRes, contReq, group, base)``
+from the (non-vendored) KubeDevice-API, whose semantics are pinned by its two
+call sites in the reference: stage-2 ``TranslateResource(node, req, "gpugrp0",
+"gpu")`` and stage-3 ``TranslateResource(node, req, "gpugrp1", "gpugrp0")``
+(``gpuschedulerplugin/gpu.go:55-58``) — "rewrites request keys one hierarchy
+level up to match the node's advertised grouping" (SURVEY.md §1).
+
+Grammar: a grouped key looks like
+
+    resource/group/[<grp1>/<j>/][<grp0>/<i>/]<base>/<id>/<suffix...>
+
+Wrapping inserts ``<group>/<idx>/`` immediately before the ``<base>/``
+segment. Synthetic group indices pack the requested base ids (in sorted
+order) into groups shaped like the node's advertised grouping (groups taken
+largest-first), so the rewritten request can bin-pack onto the node.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+from kubetpu.api.types import ResourceList
+
+
+# Compiled-regex cache: the scheduler hot path (SURVEY.md §3.3) calls this
+# per pod x node; per-call re.compile would dominate (SURVEY.md §7 "hard
+# parts", reference compiles regexes inside the call at gpu.go:18,131,275).
+_RE_CACHE: Dict[Tuple[str, str], "re.Pattern[str]"] = {}
+
+
+def _seg_re(group_name: str, base_name: str) -> "re.Pattern[str]":
+    key = (group_name, base_name)
+    pat = _RE_CACHE.get(key)
+    if pat is None:
+        # captures: 1 = everything before <base>/<id>, 2 = base id, 3 = rest
+        pat = re.compile(r"^(.*?)" + re.escape(base_name) + r"/([^/]+)/(.*)$")
+        _RE_CACHE[key] = pat
+    return pat
+
+
+def _group_sizes(node_resources: ResourceList, group_name: str, base_name: str) -> List[int]:
+    """Sizes (in distinct base ids) of each ``<group_name>`` group the node
+    advertises, sorted descending — the packing template."""
+    pat = _RE_CACHE.get(("grpsz", group_name, base_name))  # type: ignore[call-overload]
+    if pat is None:
+        pat = re.compile(
+            r"/" + re.escape(group_name) + r"/([^/]+)/.*" + re.escape(base_name) + r"/([^/]+)/"
+        )
+        _RE_CACHE[("grpsz", group_name, base_name)] = pat  # type: ignore[index]
+    groups: Dict[str, set] = {}
+    for res in node_resources:
+        m = pat.search(res)
+        if m:
+            groups.setdefault(m.group(1), set()).add(m.group(2))
+    return sorted((len(v) for v in groups.values()), reverse=True)
+
+
+def translate_resource(
+    node_resources: ResourceList,
+    container_requests: ResourceList,
+    group_name: str,
+    base_name: str,
+) -> Tuple[bool, ResourceList]:
+    """Wrap request keys containing ``<base_name>/`` but not ``<group_name>/``
+    into synthetic ``<group_name>/<idx>/`` groups matching the node's shape.
+
+    Returns ``(modified, new_requests)`` mirroring the reference call sites
+    (``gpu.go:55-58``). No-op when the node does not advertise the grouping
+    or every request key is already grouped.
+    """
+    sizes = _group_sizes(node_resources, group_name, base_name)
+    if not sizes:
+        return False, container_requests
+
+    base_pat = _seg_re(group_name, base_name)
+    group_seg = group_name + "/"
+
+    # Collect base ids needing a wrap; keys already grouped pass through.
+    to_wrap: Dict[str, List[str]] = {}  # base id -> request keys
+    passthrough: ResourceList = {}
+    for key, val in container_requests.items():
+        m = base_pat.match(key)
+        if m and group_seg not in m.group(1):
+            to_wrap.setdefault(m.group(2), []).append(key)
+        else:
+            passthrough[key] = val
+
+    if not to_wrap:
+        return False, container_requests
+
+    # Pack sorted base ids into synthetic groups, largest node group first.
+    assignment: Dict[str, int] = {}
+    gi, filled = 0, 0
+    for base_id in sorted(to_wrap):
+        cap = sizes[gi % len(sizes)]
+        if filled >= cap:
+            gi, filled = gi + 1, 0
+            cap = sizes[gi % len(sizes)]
+        assignment[base_id] = gi
+        filled += 1
+
+    new_requests: ResourceList = dict(passthrough)
+    for base_id, keys in to_wrap.items():
+        idx = assignment[base_id]
+        for key in keys:
+            m = base_pat.match(key)
+            assert m is not None
+            wrapped = (
+                m.group(1)
+                + group_name
+                + "/"
+                + str(idx)
+                + "/"
+                + base_name
+                + "/"
+                + m.group(2)
+                + "/"
+                + m.group(3)
+            )
+            new_requests[wrapped] = container_requests[key]
+    return True, new_requests

@@ -1,0 +1,135 @@
+"""Core shared types of the KubeDevice-API contract.
+
+Semantics inferred from the reference's usage sites (SURVEY.md §1):
+``types.ResourceList`` iteration/assignment (reference
+``gpuschedulerplugin/gpu.go:16-34``), ``types.NodeInfo`` construction
+(``nvidiagpuplugin/gpu/nvidia/nvidia_gpu_manager.go:200-203``),
+``types.PodInfo``/``ContainerInfo`` shapes (``gpuschedulerplugin/gpu.go:75-123``),
+``DeviceGroupPrefix == "resource/group"`` (cross-check of
+``gpuschedulerplugin/gpu.go:286`` against literal expected keys in
+``gpuschedulerplugin/gpu_test.go:79-81``), and ``AddGroupResource``
+(``nvidia_gpu_manager.go:206-209``).
+
+Resource names form the system's wire format. The grouped-resource grammar is
+
+    resource/group/<grp1name>/<j>/<grp0name>/<i>/<res>/<id>/<suffix>
+
+e.g. ``resource/group/tpugrp1/0/tpugrp0/0/tpu/0/cards``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+# A resource name, e.g. "kubedevice/tpu" or
+# "resource/group/tpugrp1/0/tpugrp0/0/tpu/4/cards".
+ResourceName = str
+
+# Map resource name -> integer quantity (reference: types.ResourceList,
+# map[ResourceName]int64).
+ResourceList = Dict[ResourceName, int]
+
+# Map "from" request key -> "to" node-resource key, filled by the group
+# scheduler at allocation time (reference: types.ResourceLocation, usage at
+# nvidia_gpu_manager_test.go:38-47).
+ResourceLocation = Dict[ResourceName, ResourceName]
+
+# Namespace prefix for grouped/topology-shaped resources (reference:
+# types.DeviceGroupPrefix, value proven by gpu_test.go:79-81).
+DeviceGroupPrefix: ResourceName = "resource/group"
+
+
+def add_group_resource(reslist: ResourceList, suffix: str, val: int) -> None:
+    """Insert ``DeviceGroupPrefix + "/" + suffix -> val`` into *reslist*.
+
+    Reference: ``types.AddGroupResource`` call sites
+    ``nvidia_gpu_manager.go:206-209`` vs. expected keys
+    ``nvidia_gpu_manager_test.go:125-126``.
+    """
+    reslist[DeviceGroupPrefix + "/" + suffix] = val
+
+
+@dataclass
+class ContainerInfo:
+    """Per-container resource requests and allocation results.
+
+    Reference: ``types.ContainerInfo{Requests, KubeRequests, DevRequests,
+    AllocateFrom}`` (usage ``gpuschedulerplugin/gpu.go:75-92``,
+    ``nvidia_gpu_manager.go:221-227``).
+
+    - ``requests``:      device-native requests (e.g. ``kubedevice/tpu: 4``).
+    - ``kube_requests``: requests as seen by vanilla Kubernetes.
+    - ``dev_requests``:  topology-shaped requests produced by the scheduler
+                         plugin's translation.
+    - ``allocate_from``: request-key -> node-resource-key mapping filled by
+                         the group scheduler; consumed by ``Device.allocate``.
+    """
+
+    requests: ResourceList = field(default_factory=dict)
+    kube_requests: ResourceList = field(default_factory=dict)
+    dev_requests: ResourceList = field(default_factory=dict)
+    allocate_from: ResourceLocation = field(default_factory=dict)
+
+    def copy(self) -> "ContainerInfo":
+        return ContainerInfo(
+            requests=dict(self.requests),
+            kube_requests=dict(self.kube_requests),
+            dev_requests=dict(self.dev_requests),
+            allocate_from=dict(self.allocate_from),
+        )
+
+
+@dataclass
+class PodInfo:
+    """Pod-level requests plus its containers.
+
+    Reference: ``types.PodInfo{Name, Requests, InitContainers,
+    RunningContainers}`` (usage ``gpuschedulerplugin/gpu.go:94-123``,
+    ``gpu_test.go:61-71``, ``nvidia_gpu_manager.go:228``).
+    """
+
+    name: str = ""
+    node_name: str = ""
+    requests: ResourceList = field(default_factory=dict)
+    init_containers: Dict[str, ContainerInfo] = field(default_factory=dict)
+    running_containers: Dict[str, ContainerInfo] = field(default_factory=dict)
+
+    def copy(self) -> "PodInfo":
+        return PodInfo(
+            name=self.name,
+            node_name=self.node_name,
+            requests=dict(self.requests),
+            init_containers={k: v.copy() for k, v in self.init_containers.items()},
+            running_containers={k: v.copy() for k, v in self.running_containers.items()},
+        )
+
+
+@dataclass
+class NodeInfo:
+    """A node's advertised resources, device-native and kube-native.
+
+    Reference: ``types.NodeInfo{Capacity, Allocatable, KubeCap, KubeAlloc}``
+    + ``types.NewNodeInfo()`` (usage ``nvidia_gpu_manager.go:200-203``,
+    ``cmd/main.go:37``).
+    """
+
+    name: str = ""
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+    kube_cap: ResourceList = field(default_factory=dict)
+    kube_alloc: ResourceList = field(default_factory=dict)
+
+    def copy(self) -> "NodeInfo":
+        return NodeInfo(
+            name=self.name,
+            capacity=dict(self.capacity),
+            allocatable=dict(self.allocatable),
+            kube_cap=dict(self.kube_cap),
+            kube_alloc=dict(self.kube_alloc),
+        )
+
+
+def new_node_info(name: str = "") -> NodeInfo:
+    """Reference: ``types.NewNodeInfo()`` (``cmd/main.go:37``)."""
+    return NodeInfo(name=name)

@@ -1,0 +1,32 @@
+"""Shared plugin data model (analog of reference ``gpuplugintypes``).
+
+Resource-name constants (reference ``gpuplugintypes/types.go:5-7`` defines
+``ResourceGPU = "nvidia.com/gpu"``; ``ResourceTPU`` is the new TPU resource
+per BASELINE.json's north star), the canonical topology-tree node
+(``types.go:9-13``), tree utilities (``typeutils.go``), and the ICI torus
+mesh model that is new in the TPU build (SURVEY.md §7 step 2).
+"""
+
+from kubetpu.plugintypes.treetypes import ResourceGPU, ResourceTPU, SortedTreeNode
+from kubetpu.plugintypes.treeutils import (
+    add_node_to_sorted_tree_node,
+    add_to_sorted_tree_node,
+    add_to_sorted_tree_node_with_score,
+    compare_tree_node,
+    format_tree_node,
+    log_tree_node,
+    print_tree_node,
+)
+
+__all__ = [
+    "ResourceGPU",
+    "ResourceTPU",
+    "SortedTreeNode",
+    "add_node_to_sorted_tree_node",
+    "add_to_sorted_tree_node",
+    "add_to_sorted_tree_node_with_score",
+    "compare_tree_node",
+    "format_tree_node",
+    "log_tree_node",
+    "print_tree_node",
+]

@@ -1,0 +1,321 @@
+"""ICI torus mesh model: chip coordinates, slice shapes, contiguity scoring.
+
+This is the TPU build's replacement for the reference's NVLink P2P link-level
+model (``nvidia_gpu_manager.go:157-180``): where KubeGPU encodes "things that
+communicate fast" as a 2-level tree keyed by P2P link type, a TPU slice is a
+2D/3D torus of chips joined by ICI links, and locality is *geometric* —
+a 2x2 block and a 1x4 line both group 4 chips but have different bisection
+bandwidth, which a tree cannot express (SURVEY.md §7 "hard parts").
+
+The model:
+
+- A slice topology (e.g. ``v5e-8``) is a mesh shape (2, 4) with per-dimension
+  wraparound flags, tiled by hosts in ``host_shape`` blocks.
+- An *allocation* is a set of chip coordinates; its ICI-contiguity score is
+  the number of ICI links internal to the set divided by the maximum internal
+  links any equally-sized ideal rectangular block achieves (1.0 = perfectly
+  contiguous rectangle, approaching 0 = scattered chips).
+- ``find_contiguous_block`` enumerates rectangular sub-slices (all
+  factorizations x all torus placements) to place an n-chip gang on the best
+  available block — the geometric generalization of the reference's greedy
+  tree walk (``gpu.go:247-271``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+Coord = Tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class TpuTopology:
+    """A TPU slice topology.
+
+    ``mesh_shape`` — chips per torus dimension (2D for v5e/v5p-small, 3D for
+    v4-style slices). ``wrap`` — whether ICI wraparound links exist per
+    dimension (full-torus dimensions wrap). ``host_shape`` — the block of
+    chips owned by one host; hosts tile the mesh in row-major blocks.
+    """
+
+    name: str
+    generation: str
+    mesh_shape: Tuple[int, ...]
+    wrap: Tuple[bool, ...]
+    host_shape: Tuple[int, ...]
+    hbm_bytes_per_chip: int = 16 * 1024**3  # v5e: 16 GiB HBM per chip
+
+    @property
+    def num_chips(self) -> int:
+        n = 1
+        for d in self.mesh_shape:
+            n *= d
+        return n
+
+    @property
+    def chips_per_host(self) -> int:
+        n = 1
+        for d in self.host_shape:
+            n *= d
+        return n
+
+    @property
+    def num_hosts(self) -> int:
+        return self.num_chips // self.chips_per_host
+
+    def coords(self) -> List[Coord]:
+        """All chip coordinates in row-major order."""
+        return [c for c in itertools.product(*(range(d) for d in self.mesh_shape))]
+
+    def chip_index(self, coord: Coord) -> int:
+        """Row-major linear index of a coordinate."""
+        idx = 0
+        for c, d in zip(coord, self.mesh_shape):
+            idx = idx * d + c
+        return idx
+
+    def index_coord(self, index: int) -> Coord:
+        out: List[int] = []
+        for d in reversed(self.mesh_shape):
+            out.append(index % d)
+            index //= d
+        return tuple(reversed(out))
+
+    def host_of(self, coord: Coord) -> int:
+        """Host index owning a chip: hosts tile the mesh in row-major
+        ``host_shape`` blocks (v5e-64 = 8x8 chips = 8 hosts of 2x4)."""
+        hosts_per_dim = [m // h for m, h in zip(self.mesh_shape, self.host_shape)]
+        idx = 0
+        for c, h, n in zip(coord, self.host_shape, hosts_per_dim):
+            idx = idx * n + (c // h)
+        return idx
+
+    def host_coords(self, host: int) -> List[Coord]:
+        """Chip coordinates belonging to a host block."""
+        hosts_per_dim = [m // h for m, h in zip(self.mesh_shape, self.host_shape)]
+        block: List[int] = []
+        for n in reversed(hosts_per_dim):
+            block.append(host % n)
+            host //= n
+        block.reverse()
+        origin = [b * h for b, h in zip(block, self.host_shape)]
+        ranges = [range(o, o + h) for o, h in zip(origin, self.host_shape)]
+        return [c for c in itertools.product(*ranges)]
+
+    def neighbors(self, coord: Coord) -> List[Coord]:
+        """ICI neighbors of a chip (±1 per dimension, wrapping where the
+        torus wraps)."""
+        out: List[Coord] = []
+        for dim, (c, d, w) in enumerate(zip(coord, self.mesh_shape, self.wrap)):
+            for delta in (-1, 1):
+                nc = c + delta
+                if w:
+                    nc %= d
+                elif nc < 0 or nc >= d:
+                    continue
+                if d == 1:
+                    continue
+                n = list(coord)
+                n[dim] = nc
+                out.append(tuple(n))
+        return out
+
+
+def _mk(name: str, gen: str, shape: Tuple[int, ...], host: Tuple[int, ...],
+        wrap: Optional[Tuple[bool, ...]] = None, hbm: int = 16 * 1024**3) -> TpuTopology:
+    if wrap is None:
+        # Wraparound links exist on dimensions that span the full torus
+        # (v5e wraps at 16; 3D v4-style slices wrap on dims >= 4).
+        wrap = tuple((d >= 16) if len(shape) == 2 else (d >= 4) for d in shape)
+    return TpuTopology(name=name, generation=gen, mesh_shape=shape, wrap=wrap,
+                       host_shape=host, hbm_bytes_per_chip=hbm)
+
+
+# Registry of known slice topologies. v5e shapes follow the SURVEY.md §7
+# model: one v5e host owns a 2x4 block of 8 chips; v5e-64 = 8 hosts on an
+# 8x8 mesh; v5e-256 = a full 16x16 torus pod.
+TOPOLOGIES: Dict[str, TpuTopology] = {
+    t.name: t
+    for t in [
+        _mk("v5e-1", "v5e", (1, 1), (1, 1)),
+        _mk("v5e-4", "v5e", (2, 2), (2, 2)),
+        _mk("v5e-8", "v5e", (2, 4), (2, 4)),
+        _mk("v5e-16", "v5e", (4, 4), (2, 4)),
+        _mk("v5e-32", "v5e", (4, 8), (2, 4)),
+        _mk("v5e-64", "v5e", (8, 8), (2, 4)),
+        _mk("v5e-128", "v5e", (8, 16), (2, 4)),
+        _mk("v5e-256", "v5e", (16, 16), (2, 4)),
+        _mk("v4-8", "v4", (2, 2, 2), (2, 2, 1), hbm=32 * 1024**3),
+        _mk("v4-16", "v4", (2, 2, 4), (2, 2, 1), hbm=32 * 1024**3),
+        _mk("v4-32", "v4", (2, 2, 8), (2, 2, 1), hbm=32 * 1024**3),
+        _mk("v4-64", "v4", (4, 4, 4), (2, 2, 1), hbm=32 * 1024**3),
+        _mk("v5p-8", "v5p", (2, 2, 2), (2, 2, 1), hbm=95 * 1024**3),
+    ]
+}
+
+
+def internal_links(coords: Iterable[Coord], topo: TpuTopology) -> int:
+    """Number of ICI links with both endpoints inside *coords*."""
+    cset = set(coords)
+    links = 0
+    for c in cset:
+        for n in topo.neighbors(c):
+            if n in cset:
+                links += 1
+    return links // 2  # each link counted from both endpoints
+
+
+def factorizations(n: int, ndims: int) -> List[Tuple[int, ...]]:
+    """All dimension tuples with product *n*, most compact (near-square/cube)
+    first — compactness = smaller sum of dims = more internal ICI links."""
+    shapes: Set[Tuple[int, ...]] = set()
+
+    def rec(remaining: int, dims: Tuple[int, ...]) -> None:
+        if len(dims) == ndims - 1:
+            shapes.add(dims + (remaining,))
+            return
+        d = 1
+        while d <= remaining:
+            if remaining % d == 0:
+                rec(remaining // d, dims + (d,))
+            d += 1
+
+    rec(n, ())
+    return sorted(shapes, key=lambda s: (sum(s), s))
+
+
+def _fill_cells(n: int, fill_axis: int, cross: Sequence[int], ndims: int) -> List[Coord]:
+    """First *n* coordinates of a slab: full cross-sections of shape *cross*
+    stacked along *fill_axis* (the most-compact achievable packing of a
+    non-rectangular count)."""
+    cross_axes = [a for a in range(ndims) if a != fill_axis]
+    cells: List[Coord] = []
+    layer = 0
+    while len(cells) < n:
+        for rest in itertools.product(*(range(c) for c in cross)):
+            coord = [0] * ndims
+            coord[fill_axis] = layer
+            for a, v in zip(cross_axes, rest):
+                coord[a] = v
+            cells.append(tuple(coord))
+            if len(cells) == n:
+                break
+        layer += 1
+    return cells
+
+
+def max_internal_links(n: int, topo: TpuTopology) -> int:
+    """Best internal link count achievable by n chips in this topology —
+    the denominator of the contiguity score.
+
+    Enumerates achievable compact packings (full cross-section slabs stacked
+    along each axis, the last slab possibly partial) anchored at the origin
+    and counts their real links, so the ideal is always attainable on this
+    mesh — a pure formula (e.g. the 2n - 2*sqrt(n) polyomino bound) can be
+    unattainable on narrow meshes and would make perfect allocations score
+    below 1.0.
+    """
+    if n <= 1:
+        return 0
+    ndims = len(topo.mesh_shape)
+    best = 0
+    for fill_axis in range(ndims):
+        cross_limits = [topo.mesh_shape[a] for a in range(ndims) if a != fill_axis]
+        for cross in itertools.product(*(range(1, c + 1) for c in cross_limits)):
+            cross_n = 1
+            for c in cross:
+                cross_n *= c
+            layers = -(-n // cross_n)  # ceil
+            if layers > topo.mesh_shape[fill_axis]:
+                continue
+            cells = _fill_cells(n, fill_axis, cross, ndims)
+            best = max(best, internal_links(cells, topo))
+    if best == 0:
+        best = n - 1  # degenerate mesh smaller than n: treat a line as ideal
+    return best
+
+
+def contiguity_score(coords: Iterable[Coord], topo: TpuTopology) -> float:
+    """ICI-contiguity in [0, 1]: internal links / ideal-block links.
+    1.0 for a perfect rectangular sub-slice; single chips score 1.0."""
+    cset = set(coords)
+    n = len(cset)
+    if n <= 1:
+        return 1.0
+    ideal = max_internal_links(n, topo)
+    if ideal == 0:
+        return 1.0
+    return min(1.0, internal_links(cset, topo) / float(ideal))
+
+
+def enumerate_blocks(topo: TpuTopology, shape: Sequence[int]) -> List[List[Coord]]:
+    """All placements of a rectangular block of *shape* on the torus
+    (origins slide with wraparound only on wrapping dimensions)."""
+    origins_per_dim: List[range] = []
+    for d, m, w in zip(shape, topo.mesh_shape, topo.wrap):
+        if d > m:
+            return []
+        origins_per_dim.append(range(m) if (w and d < m) else range(m - d + 1))
+    out: List[List[Coord]] = []
+    for origin in itertools.product(*origins_per_dim):
+        block = [
+            tuple((o + off) % m for o, off, m in zip(origin, offsets, topo.mesh_shape))
+            for offsets in itertools.product(*(range(d) for d in shape))
+        ]
+        out.append(block)
+    return out
+
+
+def find_contiguous_block(
+    free: Set[Coord], n: int, topo: TpuTopology
+) -> Optional[Tuple[List[Coord], float]]:
+    """Place an n-chip gang on the best free block: try rectangular shapes
+    most-compact-first; fall back to greedy compact growth when no exact
+    rectangle is free. Returns (sorted coords, contiguity score) or None if
+    fewer than n chips are free."""
+    if n <= 0:
+        return [], 1.0
+    if len(free) < n:
+        return None
+    for shape in factorizations(n, len(topo.mesh_shape)):
+        for block in enumerate_blocks(topo, shape):
+            if all(c in free for c in block):
+                return sorted(block), contiguity_score(block, topo)
+    # No exact rectangle free: greedy frontier growth from each free chip,
+    # preferring candidates with the most already-chosen neighbors.
+    best: Optional[List[Coord]] = None
+    best_score = -1.0
+    for seed in sorted(free):
+        chosen: Set[Coord] = {seed}
+        while len(chosen) < n:
+            frontier: Dict[Coord, int] = {}
+            for c in chosen:
+                for nb in topo.neighbors(c):
+                    if nb in free and nb not in chosen:
+                        frontier[nb] = frontier.get(nb, 0) + 1
+            if not frontier:
+                # disconnected region — take nearest remaining free chips
+                remaining = sorted(free - chosen)
+                chosen.update(remaining[: n - len(chosen)])
+                break
+            pick = max(sorted(frontier), key=lambda c: frontier[c])
+            chosen.add(pick)
+        if len(chosen) == n:
+            s = contiguity_score(chosen, topo)
+            if s > best_score:
+                best, best_score = sorted(chosen), s
+    if best is None:
+        return None
+    return best, best_score
+
+
+def slice_score(topo: TpuTopology, free: FrozenSet[Coord]) -> float:
+    """A node-level desirability score for tree tie-breaking: how contiguous
+    the node's free chips are (denser/more-connected free space ranks
+    higher, the ICI analog of the reference's depth/density tree score,
+    ``gpu.go:180-190``)."""
+    if not free:
+        return 0.0
+    return contiguity_score(free, topo) * len(free)

@@ -1,0 +1,36 @@
+"""Resource constants and the canonical topology-tree node.
+
+Reference: ``gpuplugintypes/types.go:5-13`` — ``ResourceGPU =
+"nvidia.com/gpu"`` and ``SortedTreeNode{Val int, Score float64, Child
+[]*SortedTreeNode}`` with children kept in descending (Val, Score) order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+# The TPU scalar resource name (BASELINE.json north star: pod specs request
+# "kubedevice/tpu" and schedule onto TPU-VM nodes).
+ResourceTPU = "kubedevice/tpu"
+
+# The NVIDIA scalar resource name, kept for heterogeneous clusters
+# (reference gpuplugintypes/types.go:6).
+ResourceGPU = "nvidia.com/gpu"
+
+
+@dataclass
+class SortedTreeNode:
+    """A node in the hierarchical-topology tree.
+
+    ``val`` is the leaf-count (devices) under this node; ``score`` is a
+    tie-breaker — in the TPU build it carries the ICI-contiguity score of
+    the sub-slice this node represents (generalizing the reference, where it
+    carried the subtree's tree-score, ``gpu.go:152``). ``children`` are
+    maintained in descending ``(val, score)`` order by the insertion helpers
+    in ``treeutils``.
+    """
+
+    val: int = 0
+    score: float = 0.0
+    children: List["SortedTreeNode"] = field(default_factory=list)

@@ -1,0 +1,78 @@
+"""Sorted-tree utilities: ordered insertion, structural compare, print/log.
+
+Reference: ``gpuplugintypes/typeutils.go`` — ordered insertion keeping
+children in descending (Val, Score) order (``:10-40``), recursive structural
+equality (``:75-93``), print/log helpers (``:42-72``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kubetpu.api import utils
+from kubetpu.plugintypes.treetypes import SortedTreeNode
+
+
+def _insertion_point(node: SortedTreeNode, val: int, score: float) -> int:
+    """First index whose child sorts strictly below (val, score); children
+    stay in descending order (reference findNodeInsertionPoint,
+    typeutils.go:10-23)."""
+    for index, child in enumerate(node.children):
+        if child.val < val or (child.val == val and child.score < score):
+            return index
+    return len(node.children)
+
+
+def add_to_sorted_tree_node_with_score(
+    node: SortedTreeNode, val: int, score: float
+) -> SortedTreeNode:
+    """Insert a new child with (val, score); returns the new child
+    (reference AddToSortedTreeNodeWithScore, typeutils.go:27-31)."""
+    child = SortedTreeNode(val=val, score=score)
+    node.children.insert(_insertion_point(node, val, score), child)
+    return child
+
+
+def add_to_sorted_tree_node(node: SortedTreeNode, val: int) -> SortedTreeNode:
+    """Reference AddToSortedTreeNode (typeutils.go:38-40)."""
+    return add_to_sorted_tree_node_with_score(node, val, 0.0)
+
+
+def add_node_to_sorted_tree_node(node: SortedTreeNode, to_add: SortedTreeNode) -> None:
+    """Insert an existing subtree as a child in sorted position
+    (reference AddNodeToSortedTreeNode, typeutils.go:33-36)."""
+    node.children.insert(_insertion_point(node, to_add.val, to_add.score), to_add)
+
+
+def format_tree_node(node: SortedTreeNode, level: int = 0) -> str:
+    """Indented multi-line rendering (reference printTreeNode/logTreeNode,
+    typeutils.go:42-65)."""
+    lines = ["%s%d" % (" " * (3 * level), node.val)]
+    for child in node.children:
+        lines.append(format_tree_node(child, level + 1))
+    return "\n".join(lines)
+
+
+def print_tree_node(node: SortedTreeNode) -> None:
+    """Reference PrintTreeNode (typeutils.go:52-54)."""
+    print(format_tree_node(node))
+
+
+def log_tree_node(loglevel: int, node: SortedTreeNode) -> None:
+    """Gated tree dump (reference LogTreeNode, typeutils.go:66-72)."""
+    if utils.logb(loglevel):
+        utils.logf(loglevel, "%s", format_tree_node(node))
+
+
+def compare_tree_node(n1: Optional[SortedTreeNode], n2: Optional[SortedTreeNode]) -> bool:
+    """Structural equality on (val, child shape); scores are tie-breakers and
+    deliberately not compared (reference CompareTreeNode, typeutils.go:75-93)."""
+    if n1 is None and n2 is None:
+        return True
+    if n1 is None or n2 is None:
+        return False
+    if n1.val != n2.val:
+        return False
+    if len(n1.children) != len(n2.children):
+        return False
+    return all(compare_tree_node(a, b) for a, b in zip(n1.children, n2.children))

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+JAX-dependent tests run on a virtual 8-device CPU mesh (multi-chip TPU
+hardware is unavailable in CI; sharding semantics are identical), so the env
+must be set before any ``import jax`` — hence here, at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,127 @@
+"""Tests for kubetpu.api — the KubeDevice-API re-creation (SURVEY.md §1)."""
+
+from kubetpu.api import resource, types, utils
+from kubetpu.api.types import DeviceGroupPrefix, add_group_resource, new_node_info
+
+
+def test_device_group_prefix_value():
+    # Pinned by the reference's expected literal keys (gpu_test.go:79-81).
+    assert DeviceGroupPrefix == "resource/group"
+
+
+def test_add_group_resource():
+    rl = {}
+    add_group_resource(rl, "tpu/0/cards", 1)
+    add_group_resource(rl, "tpugrp1/0/tpugrp0/1/tpu/3/cards", 1)
+    assert rl == {
+        "resource/group/tpu/0/cards": 1,
+        "resource/group/tpugrp1/0/tpugrp0/1/tpu/3/cards": 1,
+    }
+
+
+def test_node_info_copy_is_deep_enough():
+    n = new_node_info("n0")
+    n.capacity["kubedevice/tpu"] = 8
+    c = n.copy()
+    c.capacity["kubedevice/tpu"] = 4
+    assert n.capacity["kubedevice/tpu"] == 8
+
+
+def test_container_pod_copy():
+    cont = types.ContainerInfo(requests={"kubedevice/tpu": 4})
+    pod = types.PodInfo(name="p", running_containers={"c": cont})
+    p2 = pod.copy()
+    p2.running_containers["c"].requests["kubedevice/tpu"] = 1
+    assert pod.running_containers["c"].requests["kubedevice/tpu"] == 4
+
+
+def test_sorted_string_keys():
+    assert utils.sorted_string_keys({"b": 1, "a": 2, "c": 3}) == ["a", "b", "c"]
+
+
+def test_logb_levels():
+    old = utils.get_log_level()
+    try:
+        utils.set_log_level(3)
+        assert utils.logb(3) and utils.logb(0)
+        assert not utils.logb(4)
+    finally:
+        utils.set_log_level(old)
+
+
+def test_translate_resource_wraps_flat_keys():
+    # Node advertises 2 tpugrp0 groups of 2 chips each.
+    node = {
+        "resource/group/tpugrp0/0/tpu/A/cards": 1,
+        "resource/group/tpugrp0/0/tpu/B/cards": 1,
+        "resource/group/tpugrp0/1/tpu/C/cards": 1,
+        "resource/group/tpugrp0/1/tpu/D/cards": 1,
+    }
+    req = {
+        "resource/group/tpu/0/cards": 1,
+        "resource/group/tpu/1/cards": 1,
+        "resource/group/tpu/2/cards": 1,
+    }
+    modified, out = resource.translate_resource(node, req, "tpugrp0", "tpu")
+    assert modified
+    # 3 chips packed into groups of 2 -> group 0 gets chips 0,1; group 1 gets 2.
+    assert out == {
+        "resource/group/tpugrp0/0/tpu/0/cards": 1,
+        "resource/group/tpugrp0/0/tpu/1/cards": 1,
+        "resource/group/tpugrp0/1/tpu/2/cards": 1,
+    }
+
+
+def test_translate_resource_noop_when_node_flat():
+    node = {"resource/group/tpu/A/cards": 1}
+    req = {"resource/group/tpu/0/cards": 1}
+    modified, out = resource.translate_resource(node, req, "tpugrp0", "tpu")
+    assert not modified and out is req
+
+
+def test_translate_resource_noop_when_already_grouped():
+    node = {"resource/group/tpugrp0/0/tpu/A/cards": 1}
+    req = {"resource/group/tpugrp0/0/tpu/0/cards": 1}
+    modified, out = resource.translate_resource(node, req, "tpugrp0", "tpu")
+    assert not modified and out is req
+
+
+def test_translate_resource_second_level():
+    # Stage-3 analog: wrap tpugrp0 groups into tpugrp1.
+    node = {
+        "resource/group/tpugrp1/0/tpugrp0/0/tpu/A/cards": 1,
+        "resource/group/tpugrp1/0/tpugrp0/1/tpu/B/cards": 1,
+        "resource/group/tpugrp1/1/tpugrp0/2/tpu/C/cards": 1,
+        "resource/group/tpugrp1/1/tpugrp0/3/tpu/D/cards": 1,
+    }
+    req = {
+        "resource/group/tpugrp0/0/tpu/0/cards": 1,
+        "resource/group/tpugrp0/1/tpu/1/cards": 1,
+    }
+    modified, out = resource.translate_resource(node, req, "tpugrp1", "tpugrp0")
+    assert modified
+    assert out == {
+        "resource/group/tpugrp1/0/tpugrp0/0/tpu/0/cards": 1,
+        "resource/group/tpugrp1/0/tpugrp0/1/tpu/1/cards": 1,
+    }
+
+
+def test_plugin_loading_roundtrip(tmp_path):
+    # The Python analog of plugin.Open + CreateDevicePlugin symbol lookup
+    # (reference cmd/main.go:23): load a module by path, call its factory.
+    plug = tmp_path / "myplugin.py"
+    plug.write_text(
+        "from kubetpu.api.device import Device\n"
+        "class Fake(Device):\n"
+        "    def new(self): pass\n"
+        "    def start(self): pass\n"
+        "    def update_node_info(self, node_info): pass\n"
+        "    def allocate(self, pod, container): return ([], [], {})\n"
+        "    def get_name(self): return 'fakedev'\n"
+        "def create_device_plugin():\n"
+        "    return Fake()\n"
+    )
+    from kubetpu.api.device import create_device_from_plugin
+
+    dev = create_device_from_plugin(str(plug))
+    assert dev.get_name() == "fakedev"

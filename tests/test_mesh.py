@@ -1,0 +1,129 @@
+"""Tests for the ICI torus mesh model (kubetpu/plugintypes/mesh.py) — the
+TPU replacement for NVLink tree locality (SURVEY.md §7 step 2)."""
+
+import pytest
+
+from kubetpu.plugintypes import mesh
+from kubetpu.plugintypes.mesh import TOPOLOGIES, contiguity_score, find_contiguous_block
+
+
+def test_registry_shapes():
+    v5e8 = TOPOLOGIES["v5e-8"]
+    assert v5e8.mesh_shape == (2, 4)
+    assert v5e8.num_chips == 8
+    assert v5e8.num_hosts == 1
+    v5e64 = TOPOLOGIES["v5e-64"]
+    assert v5e64.num_chips == 64
+    assert v5e64.num_hosts == 8
+    v5e256 = TOPOLOGIES["v5e-256"]
+    assert v5e256.wrap == (True, True)  # full 16x16 torus wraps
+
+
+def test_chip_index_roundtrip():
+    t = TOPOLOGIES["v5e-64"]
+    for i, c in enumerate(t.coords()):
+        assert t.chip_index(c) == i
+        assert t.index_coord(i) == c
+
+
+def test_host_blocks_partition_mesh():
+    t = TOPOLOGIES["v5e-64"]
+    seen = set()
+    for h in range(t.num_hosts):
+        coords = t.host_coords(h)
+        assert len(coords) == 8
+        for c in coords:
+            assert t.host_of(c) == h
+            seen.add(c)
+    assert len(seen) == 64
+
+
+def test_neighbors_wrap_and_edges():
+    t = TOPOLOGIES["v5e-8"]  # 2x4, no wrap
+    assert set(t.neighbors((0, 0))) == {(1, 0), (0, 1)}
+    t256 = TOPOLOGIES["v5e-256"]  # 16x16 torus
+    assert (0, 15) in t256.neighbors((0, 0))
+    assert (15, 0) in t256.neighbors((0, 0))
+
+
+def test_contiguity_square_beats_line():
+    # The SURVEY §7 "hard part": 2x2 block vs 1x4 line of 4 chips must NOT
+    # look identical. 2x2 has 4 internal links, 1x4 has 3.
+    t = TOPOLOGIES["v5e-16"]
+    square = [(0, 0), (0, 1), (1, 0), (1, 1)]
+    line = [(0, 0), (0, 1), (0, 2), (0, 3)]
+    assert contiguity_score(square, t) == 1.0
+    assert contiguity_score(line, t) == pytest.approx(3 / 4)
+    scattered = [(0, 0), (0, 2), (2, 0), (2, 2)]
+    assert contiguity_score(scattered, t) == 0.0
+
+
+def test_contiguity_singletons():
+    t = TOPOLOGIES["v5e-8"]
+    assert contiguity_score([(0, 0)], t) == 1.0
+    assert contiguity_score([], t) == 1.0
+
+
+def test_find_block_exact_rectangle():
+    t = TOPOLOGIES["v5e-8"]
+    free = set(t.coords())
+    got = find_contiguous_block(free, 4, t)
+    assert got is not None
+    coords, score = got
+    assert len(coords) == 4 and score == 1.0
+    assert set(coords) == {(0, 0), (0, 1), (1, 0), (1, 1)}  # 2x2, not 1x4
+
+
+def test_find_block_avoids_taken_chips():
+    t = TOPOLOGIES["v5e-8"]
+    free = set(t.coords()) - {(0, 0), (1, 0)}  # left column taken
+    got = find_contiguous_block(free, 4, t)
+    assert got is not None
+    coords, score = got
+    assert score == 1.0
+    assert set(coords).isdisjoint({(0, 0), (1, 0)})
+
+
+def test_find_block_fallback_non_rectangular():
+    t = TOPOLOGIES["v5e-8"]
+    # Free: an L of 3 chips + 1 isolated; ask for 3 -> the connected L wins.
+    free = {(0, 0), (0, 1), (1, 0), (1, 3)}
+    got = find_contiguous_block(free, 3, t)
+    assert got is not None
+    coords, score = got
+    assert set(coords) == {(0, 0), (0, 1), (1, 0)}
+    assert score == pytest.approx(2 / 2)  # ideal 3-chip block in 2x4 = line of 2 links
+
+
+def test_find_block_insufficient():
+    t = TOPOLOGIES["v5e-8"]
+    assert find_contiguous_block({(0, 0)}, 2, t) is None
+    assert find_contiguous_block(set(), 1, t) is None
+    assert find_contiguous_block(set(), 0, t) == ([], 1.0)
+
+
+def test_find_block_full_pod_gang():
+    # The north-star shape: 256 chips on a v5e-256 pod.
+    t = TOPOLOGIES["v5e-256"]
+    got = find_contiguous_block(set(t.coords()), 256, t)
+    assert got is not None
+    coords, score = got
+    assert len(coords) == 256 and score == 1.0
+
+
+def test_wraparound_rectangle_placement():
+    t = TOPOLOGIES["v5e-256"]
+    # Occupy a middle band so only a wrapped block fits in columns.
+    free = {c for c in t.coords() if c[1] in (0, 1, 14, 15)}
+    got = find_contiguous_block(free, 64, t)
+    assert got is not None
+    coords, score = got
+    assert len(coords) == 64
+    assert score == 1.0  # 16x4 wrapped around the column seam
+
+
+def test_max_internal_links_wrap_bonus():
+    t = TOPOLOGIES["v5e-256"]
+    # Full torus: every chip has 4 links -> 512 total.
+    assert mesh.max_internal_links(256, t) == 512
+    assert contiguity_score(set(t.coords()), t) == 1.0

@@ -1,0 +1,64 @@
+"""Port of the reference tree test (gpuplugintypes/typeutils_test.go:7-34):
+ordered insertion must keep children in descending order, verified by
+structural compare against a hand-written expected tree."""
+
+from kubetpu.plugintypes import (
+    SortedTreeNode,
+    add_node_to_sorted_tree_node,
+    add_to_sorted_tree_node,
+    add_to_sorted_tree_node_with_score,
+    compare_tree_node,
+    format_tree_node,
+)
+
+
+def test_sorted_tree_node_descending_insert():
+    root = SortedTreeNode(val=10)
+    child0 = add_to_sorted_tree_node(root, 4)
+    child1 = add_to_sorted_tree_node(root, 8)
+    add_to_sorted_tree_node(child0, 3)
+    add_to_sorted_tree_node(child0, 1)
+    add_to_sorted_tree_node(child1, 1)
+    add_to_sorted_tree_node(child1, 4)
+    add_to_sorted_tree_node(child1, 3)
+
+    expected = SortedTreeNode(
+        val=10,
+        children=[
+            SortedTreeNode(val=8, children=[
+                SortedTreeNode(val=4), SortedTreeNode(val=3), SortedTreeNode(val=1)]),
+            SortedTreeNode(val=4, children=[
+                SortedTreeNode(val=3), SortedTreeNode(val=1)]),
+        ],
+    )
+    assert compare_tree_node(root, expected)
+
+
+def test_score_breaks_ties():
+    root = SortedTreeNode(val=4)
+    add_to_sorted_tree_node_with_score(root, 2, 0.5)
+    add_to_sorted_tree_node_with_score(root, 2, 0.9)
+    add_to_sorted_tree_node_with_score(root, 2, 0.1)
+    assert [c.score for c in root.children] == [0.9, 0.5, 0.1]
+
+
+def test_add_node_keeps_subtree():
+    root = SortedTreeNode(val=8)
+    sub = SortedTreeNode(val=4, children=[SortedTreeNode(val=2)])
+    add_node_to_sorted_tree_node(root, sub)
+    add_node_to_sorted_tree_node(root, SortedTreeNode(val=6))
+    assert root.children[0].val == 6
+    assert root.children[1].children[0].val == 2
+
+
+def test_compare_tree_node_none_and_shape():
+    assert compare_tree_node(None, None)
+    assert not compare_tree_node(SortedTreeNode(val=1), None)
+    a = SortedTreeNode(val=2, children=[SortedTreeNode(val=1)])
+    b = SortedTreeNode(val=2, children=[SortedTreeNode(val=1), SortedTreeNode(val=1)])
+    assert not compare_tree_node(a, b)
+
+
+def test_format_tree_node_indents():
+    root = SortedTreeNode(val=2, children=[SortedTreeNode(val=1)])
+    assert format_tree_node(root) == "2\n   1"
