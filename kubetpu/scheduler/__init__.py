@@ -1,0 +1,22 @@
+"""Topology-aware scheduler plugins (analog of reference
+``gpuschedulerplugin``): request translation, node topology-shape caching,
+auto topology generation, and the TPU/GPU DeviceScheduler implementations.
+"""
+
+from kubetpu.scheduler.deviceclass import GPU, TPU, DeviceClass
+from kubetpu.scheduler.gpu_scheduler import GpuScheduler, GPUTopologyGeneration
+from kubetpu.scheduler.tpu_scheduler import TpuScheduler, TPUTopologyGeneration
+from kubetpu.scheduler.treecache import NodeTreeCache, add_to_node, compute_tree_score
+
+__all__ = [
+    "GPU",
+    "TPU",
+    "DeviceClass",
+    "GpuScheduler",
+    "GPUTopologyGeneration",
+    "TpuScheduler",
+    "TPUTopologyGeneration",
+    "NodeTreeCache",
+    "add_to_node",
+    "compute_tree_score",
+]

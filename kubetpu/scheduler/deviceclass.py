@@ -1,0 +1,88 @@
+"""Device classes: the naming scheme a device family uses in the grouped
+resource grammar.
+
+The reference hardcodes the NVIDIA names ("gpu", "gpugrp0", "gpugrp1",
+"nvidia.com/gpu", "gpu/gpu-generate-topology") throughout
+``gpuschedulerplugin/gpu.go``; kubetpu parameterizes them so the identical
+translation/tree machinery serves both the TPU and NVIDIA device families in
+a heterogeneous cluster (BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Pattern
+
+from kubetpu.api.types import DeviceGroupPrefix
+from kubetpu.plugintypes import ResourceGPU, ResourceTPU
+
+
+@dataclass(frozen=True)
+class DeviceClass:
+    """Names a device family uses in resource keys.
+
+    Grouped keys look like
+    ``resource/group/<grp1>/<j>/<grp0>/<i>/<base>/<id>/cards``.
+    """
+
+    resource_name: str  # scalar resource, e.g. "kubedevice/tpu"
+    base: str           # leaf segment, e.g. "tpu"
+    grp0: str           # level-0 group segment, e.g. "tpugrp0"
+    grp1: str           # level-1 group segment, e.g. "tpugrp1"
+    grp_prefix: str     # common group-segment prefix, e.g. "tpugrp"
+    topology_gen_key: str  # per-pod auto-topology knob pseudo-resource
+
+    # Precompiled hot-path regexes (the reference recompiles these inside
+    # per-call functions, gpu.go:18,131,275 — flagged as a p50 hazard in
+    # SURVEY.md §7; kubetpu compiles once per device class).
+    cards_re: Pattern = field(init=False, repr=False, compare=False)
+    any_base_re: Pattern = field(init=False, repr=False, compare=False)
+    alloc_re: Pattern = field(init=False, repr=False, compare=False)
+
+    def __post_init__(self):
+        object.__setattr__(
+            self,
+            "cards_re",
+            # reference: regexp `<DeviceGroupPrefix>.*/gpu/(.*?)/cards` (gpu.go:18)
+            re.compile(re.escape(DeviceGroupPrefix) + r".*/" + re.escape(self.base) + r"/(.*?)/cards"),
+        )
+        object.__setattr__(
+            self,
+            "any_base_re",
+            # reference: regexp `.*/gpu/.*` (gpu.go:275) — strips old topology requests
+            re.compile(r".*/" + re.escape(self.base) + r"/.*"),
+        )
+        object.__setattr__(
+            self,
+            "alloc_re",
+            # reference: regexp `<prefix>/gpugrp1/.*/gpugrp0/.*/gpu/(.*?)/cards`
+            # (nvidia_gpu_manager.go:225)
+            re.compile(
+                re.escape(DeviceGroupPrefix)
+                + "/" + re.escape(self.grp1) + "/.*/"
+                + re.escape(self.grp0) + "/.*/"
+                + re.escape(self.base) + "/(.*?)/cards"
+            ),
+        )
+
+
+# The TPU device family (BASELINE.json: pod specs request "kubedevice/tpu").
+TPU = DeviceClass(
+    resource_name=ResourceTPU,
+    base="tpu",
+    grp0="tpugrp0",
+    grp1="tpugrp1",
+    grp_prefix="tpugrp",
+    topology_gen_key="tpu/tpu-generate-topology",
+)
+
+# The NVIDIA device family (reference names, gpu_scheduler.go:12-15).
+GPU = DeviceClass(
+    resource_name=ResourceGPU,
+    base="gpu",
+    grp0="gpugrp0",
+    grp1="gpugrp1",
+    grp_prefix="gpugrp",
+    topology_gen_key="gpu/gpu-generate-topology",
+)

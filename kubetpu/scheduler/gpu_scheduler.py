@@ -1,0 +1,93 @@
+"""The NVIDIA GPU scheduler plugin — tree-ranked, for heterogeneous
+clusters (BASELINE config 5).
+
+A faithful functional mirror of the reference ``NvidiaGPUScheduler``
+(``gpuschedulerplugin/gpu_scheduler.go``): request translation to the node's
+2-level NVLink grouping, topology-shape caching, auto-topology via the best
+cached tree. Kept tree-scored (depth/density), since NVLink locality has no
+torus geometry.
+"""
+
+from __future__ import annotations
+
+from kubetpu.api import utils
+from kubetpu.api.devicescheduler import DeviceScheduler, FitResult, PredicateFailureReason
+from kubetpu.api.types import DeviceGroupPrefix, NodeInfo, PodInfo
+from kubetpu.scheduler.deviceclass import GPU
+from kubetpu.scheduler.translate import translate_device_resources, translate_pod_device_resources
+from kubetpu.scheduler.treecache import NodeTreeCache, compute_tree_score
+
+# reference GPUTopologyGeneration (gpu_scheduler.go:12-15)
+GPUTopologyGeneration = GPU.topology_gen_key
+
+
+class GpuScheduler(DeviceScheduler):
+    def __init__(self) -> None:
+        self._cache = NodeTreeCache(GPU.grp_prefix, "cards", levels=1)
+
+    def add_node(self, node_name: str, node_info: NodeInfo) -> None:
+        """Force translation to two levels via a synthetic grouped 1-GPU
+        node list (reference AddNode, gpu_scheduler.go:21-28)."""
+        synthetic = {
+            DeviceGroupPrefix + "/gpugrp1/A/gpugrp0/B/gpu/GPU0/cards": 1,
+        }
+        node_info.allocatable = translate_device_resources(
+            GPU,
+            node_info.kube_alloc.get(GPU.resource_name, 0),
+            synthetic,
+            node_info.allocatable,
+        )
+        utils.logf(4, "AllocAddNode: %s", node_info.allocatable)
+        self._cache.add_resources(node_name, node_info.allocatable)
+
+    def remove_node(self, node_name: str) -> None:
+        self._cache.remove_node(node_name)
+
+    def pod_fits_device(
+        self, node_info: NodeInfo, pod_info: PodInfo, fill_allocate_from: bool
+    ) -> FitResult:
+        err, found = translate_pod_device_resources(GPU, self._cache, node_info, pod_info)
+        if err is not None or not found:
+            return False, [], 0.0
+        # Rank by this node's tree score so denser NVLink grouping wins ties
+        # (the reference returns 0.0 and lets the core's group scheduler
+        # decide, gpu_scheduler.go:34-44; kubetpu surfaces the score).
+        n = 0
+        for cont in pod_info.running_containers.values():
+            n += cont.requests.get(GPU.resource_name, 0)
+        for cont in pod_info.init_containers.values():
+            n = max(n, cont.requests.get(GPU.resource_name, 0))
+        free = node_info.allocatable.get(GPU.resource_name, 0)
+        if free < n:
+            reason = PredicateFailureReason(
+                resource_name=GPU.resource_name,
+                requested=int(n),
+                capacity=int(free),
+                message="insufficient free GPUs",
+            )
+            return False, [reason], 0.0
+        tree = self._cache.node_tree(node_info.name)
+        score = compute_tree_score(tree) if tree is not None else 0.0
+        return True, [], score
+
+    def pod_allocate(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
+        err, found = translate_pod_device_resources(GPU, self._cache, node_info, pod_info)
+        if err is not None:
+            raise RuntimeError(err)
+        if not found:
+            raise RuntimeError("translate_pod_device_resources found no translation")
+
+    def take_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
+        """No-op (reference gpu_scheduler.go:57-59)."""
+
+    def return_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
+        """No-op (reference gpu_scheduler.go:61-63)."""
+
+    def get_name(self) -> str:
+        return "nvidiagpu"
+
+    def using_group_scheduler(self) -> bool:
+        return True
+
+    def cache_shapes(self):
+        return self._cache.shapes()

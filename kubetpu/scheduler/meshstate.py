@@ -1,0 +1,89 @@
+"""Per-node ICI mesh state, reconstructed from advertised resources.
+
+The TPU device manager advertises one geometry key per node,
+
+    resource/group/tpu-slice/<topology-name>/<host-index>: 1
+
+alongside the per-chip grouped card keys. Chip local id <-> torus coordinate
+is a fixed bijection (row-major within the host's block), so the scheduler
+can reconstruct full geometry from the ResourceList alone — state is always
+derivable from what the node advertises, never cached scheduler-side
+(mirrors the reference's stateless rebuild-from-probe contract, SURVEY.md
+§5.4). Multi-host slices share <topology-name>; each host advertises its own
+<host-index>, giving gang placement a global coordinate frame.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional, Set
+
+from kubetpu.api.types import DeviceGroupPrefix, ResourceList
+from kubetpu.plugintypes.mesh import TOPOLOGIES, Coord, TpuTopology
+
+# resource/group/tpu-slice/<topology-name>/<host-index>
+SLICE_KEY_RE = re.compile(
+    re.escape(DeviceGroupPrefix) + r"/tpu-slice/([^/]+)/(\d+)$"
+)
+# any grouped per-chip cards key: .../tpu/<localid>/cards
+CHIP_CARDS_RE = re.compile(r".*/tpu/(\d+)/cards$")
+
+
+def slice_resource_key(topology_name: str, host_index: int) -> str:
+    """The geometry advertisement key for a host of a slice."""
+    return DeviceGroupPrefix + "/tpu-slice/" + topology_name + "/" + str(host_index)
+
+
+@dataclass
+class NodeMeshState:
+    """Geometry of one TPU host-node within its slice."""
+
+    topo: TpuTopology
+    host_index: int
+    chip_coord: Dict[int, Coord]   # local chip id -> global torus coord
+    coord_chip: Dict[Coord, int]   # inverse
+    chip_key: Dict[int, str]       # local chip id -> advertised cards key
+    free: Set[Coord]               # coords whose cards key is allocatable
+
+    @property
+    def slice_name(self) -> str:
+        return self.topo.name
+
+
+def parse_mesh_state(node_resources: ResourceList) -> Optional[NodeMeshState]:
+    """Reconstruct a node's mesh geometry from its (current) allocatable
+    ResourceList; None if the node advertises no TPU slice."""
+    topo: Optional[TpuTopology] = None
+    host_index = 0
+    for key in node_resources:
+        m = SLICE_KEY_RE.match(key)
+        if m:
+            topo = TOPOLOGIES.get(m.group(1))
+            host_index = int(m.group(2))
+            break
+    if topo is None:
+        return None
+
+    host_coords = topo.host_coords(host_index)
+    chip_coord = {i: c for i, c in enumerate(host_coords)}
+    coord_chip = {c: i for i, c in chip_coord.items()}
+
+    chip_key: Dict[int, str] = {}
+    free: Set[Coord] = set()
+    for key, val in node_resources.items():
+        m = CHIP_CARDS_RE.match(key)
+        if m:
+            local = int(m.group(1))
+            if local in chip_coord:
+                chip_key[local] = key
+                if val >= 1:
+                    free.add(chip_coord[local])
+    return NodeMeshState(
+        topo=topo,
+        host_index=host_index,
+        chip_coord=chip_coord,
+        coord_chip=coord_chip,
+        chip_key=chip_key,
+        free=free,
+    )

@@ -1,0 +1,23 @@
+"""Scheduler plugin entry shims.
+
+Analog of the reference's ``--buildmode=plugin`` entry files
+(``gpuschedulerplugin/plugin/gpuscheduler.go:8-11``): the factory symbols the
+core looks up after loading a plugin module via
+``kubetpu.api.devicescheduler.create_device_scheduler_from_plugin``.
+"""
+
+from __future__ import annotations
+
+from kubetpu.api.devicescheduler import DeviceScheduler
+from kubetpu.scheduler.gpu_scheduler import GpuScheduler
+from kubetpu.scheduler.tpu_scheduler import TpuScheduler
+
+
+def create_device_scheduler_plugin() -> DeviceScheduler:
+    """The TPU scheduler factory (the default plugin this repo ships)."""
+    return TpuScheduler()
+
+
+def create_gpu_device_scheduler_plugin() -> DeviceScheduler:
+    """The NVIDIA scheduler factory, for heterogeneous clusters."""
+    return GpuScheduler()

@@ -1,0 +1,101 @@
+"""Auto topology generation: pick the best cached node shape and rewrite a
+pod's device requests into that shape with synthetic indices.
+
+Reference: ``gpuschedulerplugin/gpu.go:247-324`` — ``assignGPUs`` (greedy
+left-to-right tree walk emitting e.g.
+``resource/group/gpugrp1/0/gpugrp0/0/gpu/0/cards``), ``translateToTree``
+(strip old per-device requests, append the synthesized ones), and
+``ConvertToBestGPURequests`` (pod device count = Σ running, max init).
+
+The synthesized key grammar must match the reference byte-for-byte (modulo
+the device-class segment names) — it is the wire format the group scheduler
+bin-packs against (SURVEY.md §7 "the translation grammar is subtle").
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kubetpu.api import utils
+from kubetpu.api.types import ContainerInfo, DeviceGroupPrefix, PodInfo, ResourceList
+from kubetpu.plugintypes import SortedTreeNode, log_tree_node
+from kubetpu.scheduler.deviceclass import DeviceClass
+from kubetpu.scheduler.treecache import NodeTreeCache
+
+
+def assign_devices(
+    node: SortedTreeNode,
+    prefix: str,
+    resource_grp: str,
+    resource: str,
+    suffix: str,
+    level: int,
+    num_left: List[int],
+) -> ResourceList:
+    """Greedy left-to-right tree walk emitting topology-shaped request keys
+    with synthetic indices (reference assignGPUs, gpu.go:247-271).
+
+    *num_left* is a 1-element list standing in for the reference's ``*int``.
+    """
+    res_list: ResourceList = {}
+    if level == 0:
+        to_take = min(node.val, num_left[0])
+        for i in range(to_take):
+            res_list[prefix + "/" + resource + "/" + str(i) + "/" + suffix] = 1
+        num_left[0] -= to_take
+    else:
+        for i, child in enumerate(node.children):
+            new_prefix = prefix + str(level - 1) + "/" + str(i)
+            if level - 1 != 0:
+                new_prefix += "/" + resource_grp
+            res_list.update(
+                assign_devices(child, new_prefix, resource_grp, resource, suffix, level - 1, num_left)
+            )
+    return res_list
+
+
+def translate_to_tree(dc: DeviceClass, node: SortedTreeNode, cont: ContainerInfo) -> None:
+    """Strip the container's existing per-device topology requests and
+    append ones synthesized against *node* (reference translateToTree,
+    gpu.go:273-291)."""
+    cont.dev_requests = {
+        k: v for k, v in cont.dev_requests.items() if not dc.any_base_re.match(k)
+    }
+    num_left = [int(cont.requests.get(dc.resource_name, 0))]
+    res_list = assign_devices(
+        node,
+        DeviceGroupPrefix + "/" + dc.grp_prefix,
+        dc.grp_prefix,
+        dc.base,
+        "cards",
+        2,
+        num_left,
+    )
+    cont.dev_requests.update(res_list)
+
+
+def convert_to_best_requests(
+    dc: DeviceClass,
+    cache: NodeTreeCache,
+    pod_info: PodInfo,
+    best_tree: Optional[SortedTreeNode] = None,
+) -> bool:
+    """Rewrite every container against the best cached shape holding the
+    pod's total device count: running containers sum, init containers max
+    (reference ConvertToBestGPURequests, gpu.go:294-324)."""
+    num = 0
+    for cont in pod_info.running_containers.values():
+        num += cont.requests.get(dc.resource_name, 0)
+    for cont in pod_info.init_containers.values():
+        num = max(num, cont.requests.get(dc.resource_name, 0))
+    if best_tree is None:
+        best_tree = cache.find_best_tree(int(num))
+    if best_tree is None:
+        return False
+    utils.logf(5, "Best tree")
+    log_tree_node(5, best_tree)
+    for key in utils.sorted_string_keys(pod_info.running_containers):
+        translate_to_tree(dc, best_tree, pod_info.running_containers[key])
+    for key in utils.sorted_string_keys(pod_info.init_containers):
+        translate_to_tree(dc, best_tree, pod_info.init_containers[key])
+    return True

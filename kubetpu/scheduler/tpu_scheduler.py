@@ -1,0 +1,137 @@
+"""The TPU topology-aware scheduler plugin.
+
+Mirrors the reference's ``NvidiaGPUScheduler`` surface
+(``gpuschedulerplugin/gpu_scheduler.go:21-71``) with the TPU generalization
+BASELINE.json names: placements are ranked by **ICI-mesh adjacency** — the
+fit score is the contiguity the pod's chips can achieve on this node's free
+torus coordinates — instead of the tree-depth score alone. Translation and
+the tree cache still speak the reference's grouped-key grammar, so GPU-style
+nodes and TPU nodes coexist (BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Tuple
+
+from kubetpu.api import utils
+from kubetpu.api.devicescheduler import DeviceScheduler, FitResult, PredicateFailureReason
+from kubetpu.api.types import DeviceGroupPrefix, NodeInfo, PodInfo
+from kubetpu.plugintypes.mesh import find_contiguous_block
+from kubetpu.scheduler import meshstate
+from kubetpu.scheduler.deviceclass import TPU, DeviceClass
+from kubetpu.scheduler.translate import translate_device_resources, translate_pod_device_resources
+from kubetpu.scheduler.treecache import NodeTreeCache
+
+# Per-pod auto-topology knob, rides the pod's Requests untouched (reference
+# GPUTopologyGeneration = "gpu/gpu-generate-topology", gpu_scheduler.go:12-15).
+TPUTopologyGeneration = TPU.topology_gen_key
+
+
+def pod_device_count(dc: DeviceClass, pod_info: PodInfo) -> int:
+    """Total devices a pod needs: running containers sum, init max
+    (reference ConvertToBestGPURequests counting, gpu.go:294-303)."""
+    num = 0
+    for cont in pod_info.running_containers.values():
+        num += cont.requests.get(dc.resource_name, cont.kube_requests.get(dc.resource_name, 0))
+    for cont in pod_info.init_containers.values():
+        num = max(num, cont.requests.get(dc.resource_name, cont.kube_requests.get(dc.resource_name, 0)))
+    return int(num)
+
+
+class TpuScheduler(DeviceScheduler):
+    """DeviceScheduler for the TPU family with ICI-adjacency ranking."""
+
+    def __init__(self) -> None:
+        self._cache = NodeTreeCache(TPU.grp_prefix, "cards", levels=1)
+        self._lock = threading.Lock()
+
+    # -- node lifecycle -----------------------------------------------------
+
+    def add_node(self, node_name: str, node_info: NodeInfo) -> None:
+        """Normalize the node's allocatable to the 2-level grouped form by
+        translating against a synthetic fully-grouped 1-device list, then
+        cache its topology shape (reference AddNode trick,
+        gpu_scheduler.go:21-28)."""
+        synthetic = {
+            DeviceGroupPrefix + "/tpugrp1/A/tpugrp0/B/tpu/TPU0/cards": 1,
+        }
+        node_info.allocatable = translate_device_resources(
+            TPU,
+            node_info.kube_alloc.get(TPU.resource_name, 0),
+            synthetic,
+            node_info.allocatable,
+        )
+        utils.logf(4, "AllocAddNode: %s", node_info.allocatable)
+        self._cache.add_resources(node_name, node_info.allocatable)
+
+    def remove_node(self, node_name: str) -> None:
+        self._cache.remove_node(node_name)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _mesh_fit(self, node_info: NodeInfo, n: int) -> Tuple[bool, float]:
+        """(fits, ICI score) of placing an n-chip gang on this node's free
+        coords — the ICI-mesh generalization of tree ranking."""
+        state = meshstate.parse_mesh_state(node_info.allocatable)
+        if state is None:
+            # Not a TPU-mesh node (e.g. GPU-style grouping): neutral score,
+            # scalar capacity decides.
+            free = node_info.allocatable.get(TPU.resource_name, 0)
+            return free >= n, 0.0
+        if n == 0:
+            return True, 1.0
+        placed = find_contiguous_block(state.free, n, state.topo)
+        if placed is None:
+            return False, 0.0
+        _, score = placed
+        return True, score
+
+    def pod_fits_device(
+        self, node_info: NodeInfo, pod_info: PodInfo, fill_allocate_from: bool
+    ) -> FitResult:
+        """Translate the pod's requests (reference PodFitsDevice,
+        gpu_scheduler.go:34-44), then rank by achievable ICI contiguity."""
+        err, found = translate_pod_device_resources(TPU, self._cache, node_info, pod_info)
+        if err is not None or not found:
+            return False, [], 0.0
+        n = pod_device_count(TPU, pod_info)
+        if n == 0:
+            return True, [], 0.0
+        fits, score = self._mesh_fit(node_info, n)
+        if not fits:
+            reason = PredicateFailureReason(
+                resource_name=TPU.resource_name,
+                requested=n,
+                capacity=node_info.allocatable.get(TPU.resource_name, 0),
+                message="insufficient free ICI-contiguous TPU chips",
+            )
+            return False, [reason], 0.0
+        return True, [], score
+
+    def pod_allocate(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
+        err, found = translate_pod_device_resources(TPU, self._cache, node_info, pod_info)
+        if err is not None:
+            raise RuntimeError(err)
+        if not found:
+            raise RuntimeError("translate_pod_device_resources found no translation")
+
+    def take_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
+        """No-op: the core harness owns usage accounting (reference
+        gpu_scheduler.go:57-59 is likewise a no-op)."""
+
+    def return_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
+        """No-op (reference gpu_scheduler.go:61-63)."""
+
+    def get_name(self) -> str:
+        return "tpu"
+
+    def using_group_scheduler(self) -> bool:
+        """Delegate bin-packing/AllocateFrom fill to the core group scheduler
+        (reference gpu_scheduler.go:69-71; kubetpu's is kubetpu.core)."""
+        return True
+
+    # -- diagnostics --------------------------------------------------------
+
+    def cache_shapes(self):
+        return self._cache.shapes()

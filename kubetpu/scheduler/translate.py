@@ -1,0 +1,127 @@
+"""Request translation: rewrite a container's device requests into the
+hierarchical, topology-shaped form a node advertises.
+
+Reference: the 3-stage rewrite of ``gpuschedulerplugin/gpu.go:16-127`` —
+(1) expand the scalar device count into per-card keys, guarded by "does the
+node advertise grouped cards"; (2) wrap into level-0 groups; (3) wrap into
+level-1 groups. Plus ``SetGPUReqs`` (max-merge of kube-native and
+device-native counts) and the per-pod orchestrator
+``TranslatePodGPUResources`` with the auto-topology knob.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from kubetpu.api import utils
+from kubetpu.api.resource import translate_resource
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo, ResourceList, add_group_resource
+from kubetpu.scheduler.deviceclass import DeviceClass
+from kubetpu.scheduler.topology_gen import convert_to_best_requests
+from kubetpu.scheduler.treecache import NodeTreeCache
+
+
+def translate_device_resources(
+    dc: DeviceClass,
+    needed: int,
+    node_resources: ResourceList,
+    container_requests: ResourceList,
+) -> ResourceList:
+    """3-stage translation of a container's requests to the max level the
+    node advertises (reference TranslateGPUResources, gpu.go:16-66)."""
+    # Stage 1: expand scalar count into per-card keys — only when the node
+    # advertises grouped cards at all (gpu.go:18-30).
+    need_translation = any(dc.cards_re.search(res) for res in node_resources)
+    if not need_translation:
+        return container_requests
+
+    have = 0
+    max_index = -1
+    for res in container_requests:
+        m = dc.cards_re.search(res)
+        if m:
+            have += 1
+            try:
+                max_index = max(max_index, int(m.group(1)))
+            except ValueError:
+                pass
+    for i in range(int(needed) - have):
+        add_group_resource(container_requests, dc.base + "/" + str(max_index + i + 1) + "/cards", 1)
+
+    # Stages 2-3: wrap one hierarchy level at a time (gpu.go:55-58).
+    modified2, container_requests = translate_resource(
+        node_resources, container_requests, dc.grp0, dc.base
+    )
+    modified3, container_requests = translate_resource(
+        node_resources, container_requests, dc.grp1, dc.grp0
+    )
+    if modified2 or modified3:
+        utils.logf(3, "New resources: %s", container_requests)
+    return container_requests
+
+
+def translate_device_container_resources(
+    dc: DeviceClass, alloc: ResourceList, cont: ContainerInfo
+) -> ResourceList:
+    """Reference TranslateGPUContainerResources (gpu.go:75-78)."""
+    needed = cont.requests.get(dc.resource_name, 0)
+    return translate_device_resources(dc, needed, alloc, cont.dev_requests)
+
+
+def set_device_reqs(dc: DeviceClass, cont: ContainerInfo) -> None:
+    """Merge kube-native and device-native scalar counts via max
+    (reference SetGPUReqs, gpu.go:80-92)."""
+    dev = cont.requests.get(dc.resource_name)
+    kube = cont.kube_requests.get(dc.resource_name)
+    if dev is not None and kube is not None:
+        cont.requests[dc.resource_name] = max(dev, kube)
+    elif dev is not None:
+        pass
+    elif kube is not None:
+        cont.requests[dc.resource_name] = kube
+    else:
+        cont.requests[dc.resource_name] = 0
+
+
+def translate_pod_device_resources(
+    dc: DeviceClass,
+    cache: NodeTreeCache,
+    node_info: NodeInfo,
+    pod_info: PodInfo,
+    best_tree=None,
+) -> Tuple[Optional[str], bool]:
+    """Per-pod orchestrator (reference TranslatePodGPUResources,
+    gpu.go:94-127). Returns (error message or None, translation found).
+
+    Auto-topology when the knob is absent or 1; flat node-shaped translation
+    when 0; error otherwise. *best_tree* optionally pins the target shape
+    (used by the TPU scheduler to translate against THIS node's shape rather
+    than the globally-best cached shape).
+    """
+    for cont in pod_info.init_containers.values():
+        set_device_reqs(dc, cont)
+    for cont in pod_info.running_containers.values():
+        set_device_reqs(dc, cont)
+
+    req = pod_info.requests.get(dc.topology_gen_key)
+    found = True
+    if req is None or req == 1:  # auto-generate best topology by default
+        found = convert_to_best_requests(dc, cache, pod_info, best_tree=best_tree)
+        if found:
+            utils.logf(4, "Auto-generated topology using best tree: %s", pod_info)
+            return None, True
+
+    if not found or req == 0:  # zero implies flat (no grouping)
+        for name, cont in pod_info.init_containers.items():
+            cont.dev_requests = translate_device_container_resources(
+                dc, node_info.allocatable, cont
+            )
+        for name, cont in pod_info.running_containers.items():
+            cont.dev_requests = translate_device_container_resources(
+                dc, node_info.allocatable, cont
+            )
+        utils.logf(4, "Auto-generated topology using no topology: %s", pod_info)
+        return None, True
+
+    utils.errorf("Invalid topology generation request %s", req)
+    return "invalid topology generation request", False
