@@ -1,0 +1,27 @@
+# kubetpu build (analog of the reference Makefile: two plugins + two CLIs;
+# here the plugins are Python modules, so the native artifact is tpuinfo).
+BUILD_DIR ?= _output
+CXX ?= g++
+CXXFLAGS ?= -O2 -Wall -Wextra -std=c++17
+
+.PHONY: all
+all: tpuinfo
+
+.PHONY: tpuinfo
+tpuinfo: $(BUILD_DIR)/tpuinfo
+
+$(BUILD_DIR)/tpuinfo: kubetpu/tpuinfo/tpuinfo.cc
+	@mkdir -p $(BUILD_DIR)
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
+.PHONY: test
+test: tpuinfo
+	python -m pytest tests/ -x -q
+
+.PHONY: bench
+bench: tpuinfo
+	python bench.py
+
+.PHONY: clean
+clean:
+	rm -rf $(BUILD_DIR)/*

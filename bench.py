@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""kubetpu benchmark: the BASELINE north-star metric.
+
+Gang-schedules a 256-chip job (32 pods x 8 chips) onto a v5e-256 pod
+(32 fake host-nodes, full fidelity through advertisement -> translation ->
+geometric fill -> accounting -> rollback-capable gang placement) and reports
+the p50 end-to-end gang schedule latency against the <100 ms BASELINE
+target. Also verifies the placement is ICI-contiguous (score 1.0) — a fast
+but wrong placement doesn't count.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+vs_baseline = target_ms / p50_ms (>1.0 means faster than the 100 ms target).
+"""
+
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from kubetpu.api.types import ContainerInfo, PodInfo  # noqa: E402
+from kubetpu.core import Cluster  # noqa: E402
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager  # noqa: E402
+from kubetpu.plugintypes import ResourceTPU  # noqa: E402
+
+TARGET_MS = 100.0
+NUM_HOSTS = 32  # v5e-256 = 32 hosts x 8 chips
+ROUNDS = 20
+
+
+def build_cluster() -> Cluster:
+    cluster = Cluster()
+    for host in range(NUM_HOSTS):
+        mgr = new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-256", host_index=host))
+        cluster.register_node(f"v5e256-h{host:02d}", device=mgr)
+    return cluster
+
+
+def gang():
+    return [
+        PodInfo(
+            name=f"w{i:02d}",
+            running_containers={"main": ContainerInfo(requests={ResourceTPU: 8})},
+        )
+        for i in range(NUM_HOSTS)
+    ]
+
+
+def main() -> int:
+    cluster = build_cluster()
+    latencies_ms = []
+    for round_idx in range(ROUNDS):
+        pods = gang()
+        t0 = time.perf_counter()
+        placed = cluster.schedule_gang(pods)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        contiguity = cluster.gang_contiguity(placed)
+        if contiguity != 1.0:
+            print(
+                json.dumps(
+                    {
+                        "metric": "256-chip gang schedule p50 latency",
+                        "value": -1.0,
+                        "unit": "ms",
+                        "vs_baseline": 0.0,
+                        "error": f"non-contiguous placement (score {contiguity})",
+                    }
+                )
+            )
+            return 1
+        latencies_ms.append(dt_ms)
+        for p in placed:
+            cluster.release(p.name)
+
+    p50 = statistics.median(latencies_ms)
+    print(
+        json.dumps(
+            {
+                "metric": "256-chip gang schedule p50 latency",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(TARGET_MS / p50, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""The KubeDevice-core stand-in (SURVEY.md §7 step 6): scheduling loop,
+group/gang scheduler with AllocateFrom fill, usage accounting, latency
+metrics. The reference delegates all of this to the external
+github.com/Microsoft/KubeDevice repo; kubetpu ships it."""
+
+from kubetpu.core.cluster import Cluster, ClusterNode, SchedulingError
+from kubetpu.core.group_scheduler import (
+    fill_allocate_from,
+    return_pod_resources,
+    take_pod_resources,
+)
+from kubetpu.core.metrics import LatencyRecorder
+
+__all__ = [
+    "Cluster",
+    "ClusterNode",
+    "SchedulingError",
+    "fill_allocate_from",
+    "return_pod_resources",
+    "take_pod_resources",
+    "LatencyRecorder",
+]

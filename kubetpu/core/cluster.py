@@ -1,0 +1,331 @@
+"""The cluster scheduling harness — kubetpu's stand-in for the external
+KubeDevice core the reference depends on but does not ship (SURVEY.md §7
+step 6): node registry, the per-pod predicate/score/allocate loop, the
+group-scheduler fill, usage accounting, and gang (all-or-nothing)
+scheduling for multi-host slices.
+
+Flow per pod (mirrors the reference's documented call stack, SURVEY.md §3.3):
+
+    schedule(pod)
+      for each node: plugin.pod_fits_device(node, pod') -> (fits, _, score)
+      pick best (score, then node name — node names sort hosts in slice
+        order, so equal-score gang members fill contiguous host blocks)
+      plugin.pod_allocate(node, pod')           # re-translate on the winner
+      group_scheduler.fill_allocate_from        # geometric / structural fill
+      group_scheduler.take_pod_resources        # accounting
+      device.allocate(pod, container)           # at container start (CRI)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from kubetpu.api import utils
+from kubetpu.api.device import AllocateResult, Device
+from kubetpu.api.devicescheduler import DeviceScheduler
+from kubetpu.api.types import NodeInfo, PodInfo, new_node_info
+from kubetpu.core import group_scheduler
+from kubetpu.core.metrics import LatencyRecorder
+from kubetpu.scheduler import meshstate
+from kubetpu.scheduler.gpu_scheduler import GpuScheduler
+from kubetpu.scheduler.tpu_scheduler import TpuScheduler
+
+
+class SchedulingError(Exception):
+    """Pod (or gang) cannot be placed."""
+
+
+@dataclass
+class ClusterNode:
+    info: NodeInfo
+    device: Optional[Device] = None
+    pods: Dict[str, PodInfo] = field(default_factory=dict)
+
+
+class Cluster:
+    """Node registry + scheduling loop over the device-scheduler plugins."""
+
+    def __init__(self, schedulers: Optional[Sequence[DeviceScheduler]] = None):
+        self.schedulers: List[DeviceScheduler] = (
+            list(schedulers) if schedulers is not None else [TpuScheduler(), GpuScheduler()]
+        )
+        self.nodes: Dict[str, ClusterNode] = {}
+        self.metrics = LatencyRecorder()
+
+    # -- node lifecycle -----------------------------------------------------
+
+    def register_node(
+        self,
+        name: str,
+        device: Optional[Device] = None,
+        node_info: Optional[NodeInfo] = None,
+    ) -> NodeInfo:
+        """Register a node from its device manager's advertisement (or a
+        prebuilt NodeInfo), and AddNode it into every scheduler plugin."""
+        info = node_info if node_info is not None else new_node_info(name)
+        info.name = name
+        if device is not None:
+            device.update_node_info(info)
+        for s in self.schedulers:
+            s.add_node(name, info)
+        self.nodes[name] = ClusterNode(info=info, device=device)
+        return info
+
+    def remove_node(self, name: str) -> None:
+        for s in self.schedulers:
+            s.remove_node(name)
+        self.nodes.pop(name, None)
+
+    # -- per-pod scheduling (the hot path) ----------------------------------
+
+    def schedule(
+        self, pod: PodInfo, node_filter: Optional[Callable[[str], bool]] = None
+    ) -> PodInfo:
+        """Place one pod; returns the placed copy (with node_name and
+        AllocateFrom filled). Raises SchedulingError when nothing fits."""
+        t0 = time.perf_counter()
+        try:
+            return self._schedule_inner(pod, node_filter)
+        finally:
+            self.metrics.record("schedule_pod", time.perf_counter() - t0)
+
+    def _schedule_inner(
+        self, pod: PodInfo, node_filter: Optional[Callable[[str], bool]]
+    ) -> PodInfo:
+        candidates: List[tuple] = []  # (-score, name, pod_copy)
+        for name in utils.sorted_string_keys(self.nodes):
+            if node_filter is not None and not node_filter(name):
+                continue
+            node = self.nodes[name]
+            pod_copy = pod.copy()
+            fits = True
+            score = 0.0
+            for s in self.schedulers:
+                ok, _reasons, sc = s.pod_fits_device(node.info, pod_copy, False)
+                if not ok:
+                    fits = False
+                    break
+                score += sc
+            if fits:
+                candidates.append((-score, name, pod_copy))
+        if not candidates:
+            raise SchedulingError(f"pod {pod.name!r}: no node fits")
+
+        # Best score first; if the group-scheduler fill disagrees with the
+        # fit (e.g. stale scalar vs. actual free cards), demote the node and
+        # try the next candidate instead of rejecting the pod.
+        for neg_score, name, pod_copy in sorted(candidates, key=lambda c: (c[0], c[1])):
+            node = self.nodes[name]
+            for s in self.schedulers:
+                s.pod_allocate(node.info, pod_copy)
+            if not group_scheduler.fill_allocate_from(node.info, pod_copy):
+                utils.logf(3, "pod %s: fill failed on %s, trying next node", pod.name, name)
+                continue
+            group_scheduler.take_pod_resources(node.info, pod_copy)
+            for s in self.schedulers:
+                s.take_pod_resources(node.info, pod_copy)
+            pod_copy.node_name = name
+            node.pods[pod_copy.name] = pod_copy
+            utils.logf(3, "scheduled pod %s on %s (score %.3f)", pod.name, name, -neg_score)
+            return pod_copy
+        raise SchedulingError(f"pod {pod.name!r}: fill failed on every fitting node")
+
+    def release(self, pod_name: str) -> None:
+        """Return a pod's resources (pod deletion)."""
+        for node in self.nodes.values():
+            placed = node.pods.pop(pod_name, None)
+            if placed is not None:
+                group_scheduler.return_pod_resources(node.info, placed)
+                for s in self.schedulers:
+                    s.return_pod_resources(node.info, placed)
+                return
+        raise KeyError(pod_name)
+
+    # -- container start (CRI step) -----------------------------------------
+
+    def allocate(self, pod_name: str) -> Dict[str, AllocateResult]:
+        """Run the device manager's Allocate for each container of a placed
+        pod — the container-start injection step (SURVEY.md §3.4)."""
+        for node in self.nodes.values():
+            placed = node.pods.get(pod_name)
+            if placed is None:
+                continue
+            if node.device is None:
+                raise RuntimeError(f"node {node.info.name} has no device manager")
+            out: Dict[str, AllocateResult] = {}
+            for cname, cont in sorted(placed.init_containers.items()):
+                out[cname] = node.device.allocate(placed, cont)
+            for cname, cont in sorted(placed.running_containers.items()):
+                out[cname] = node.device.allocate(placed, cont)
+            return out
+        raise KeyError(pod_name)
+
+    # -- gang scheduling ----------------------------------------------------
+
+    def schedule_gang(self, pods: Sequence[PodInfo]) -> List[PodInfo]:
+        """All-or-nothing placement of a gang (one pod per host of a
+        multi-host job): either every pod lands or none does.
+
+        The reference punts gang semantics to the external core's group
+        scheduler (``UsingGroupScheduler``, gpu_scheduler.go:69-71); kubetpu
+        implements them: try to keep the gang on a single slice (nodes that
+        advertise the same tpu-slice topology), hosts in index order so the
+        chosen host blocks tile a contiguous torus region; roll back fully
+        on any failure.
+        """
+        t0 = time.perf_counter()
+        try:
+            slices = self._tpu_slices()
+            for slice_nodes in slices.values():
+                # Best case: assign pods to a *geometrically contiguous set of
+                # host blocks* (a 2-host gang on a v5e-64 should get two
+                # vertically adjacent hosts forming a 4x4 square, not a 2x8
+                # strip).
+                ordered_hosts = self._contiguous_hosts(slice_nodes, len(pods))
+                if ordered_hosts is not None:
+                    try:
+                        return self._try_gang_pinned(pods, ordered_hosts)
+                    except SchedulingError:
+                        pass
+                members = set(slice_nodes)
+                try:
+                    return self._try_gang(pods, lambda n: n in members)
+                except SchedulingError:
+                    continue
+            # fall back: anywhere
+            return self._try_gang(pods, None)
+        finally:
+            self.metrics.record("schedule_gang", time.perf_counter() - t0)
+
+    def _contiguous_hosts(self, slice_nodes: List[str], k: int) -> Optional[List[str]]:
+        """Pick k host-nodes of one slice whose blocks tile a contiguous
+        region of the torus, via rectangle search on the *host grid*."""
+        if k > len(slice_nodes):
+            return None
+        from kubetpu.plugintypes.mesh import (
+            TpuTopology,
+            enumerate_blocks,
+            factorizations,
+            find_contiguous_block,
+            internal_links,
+        )
+
+        states = {}
+        for name in slice_nodes:
+            st = meshstate.parse_mesh_state(self.nodes[name].info.allocatable)
+            if st is None:
+                return None
+            states[name] = st
+        topo = next(iter(states.values())).topo
+        hosts_per_dim = tuple(m // h for m, h in zip(topo.mesh_shape, topo.host_shape))
+        host_grid = TpuTopology(
+            name=topo.name + "-hostgrid",
+            generation=topo.generation,
+            mesh_shape=hosts_per_dim,
+            wrap=topo.wrap,
+            host_shape=tuple(1 for _ in hosts_per_dim),
+        )
+        # host index <-> host-grid coordinate (row-major, mesh.py host_of)
+        free_host_coords = {}
+        for name, st in states.items():
+            if st.free:  # host has free chips at all
+                free_host_coords[host_grid.index_coord(st.host_index)] = name
+
+        # Rank host-grid rectangle shapes by the CHIP-level links of the
+        # resulting region, not host-grid compactness: host blocks are
+        # anisotropic (2x4), so 2 hosts stacked along x give a 4x4 chip
+        # square while 2 along y give a 2x8 strip.
+        def chip_links(shape):
+            import itertools as _it
+
+            region = [
+                tuple(c for c in coord)
+                for coord in _it.product(
+                    *(range(s * h) for s, h in zip(shape, topo.host_shape))
+                )
+            ]
+            return internal_links(region, topo)
+
+        shapes = [
+            s
+            for s in factorizations(k, len(hosts_per_dim))
+            if all(d <= m for d, m in zip(s, hosts_per_dim))
+        ]
+        shapes.sort(key=lambda s: (-chip_links(s), s))
+        free_set = set(free_host_coords)
+        for shape in shapes:
+            for block in enumerate_blocks(host_grid, shape):
+                if all(c in free_set for c in block):
+                    return [free_host_coords[c] for c in sorted(block)]
+        # no exact host rectangle: fall back to greedy host-grid growth
+        placed = find_contiguous_block(free_set, k, host_grid)
+        if placed is None:
+            return None
+        coords, _score = placed
+        return [free_host_coords[c] for c in coords]
+
+    def _try_gang_pinned(
+        self, pods: Sequence[PodInfo], ordered_hosts: List[str]
+    ) -> List[PodInfo]:
+        """Schedule pod i on host i exactly, rolling back on any failure."""
+        placed: List[PodInfo] = []
+        try:
+            for pod, host in zip(pods, ordered_hosts):
+                placed.append(self.schedule(pod, lambda n, h=host: n == h))
+        except SchedulingError:
+            for p in placed:
+                self.release(p.name)
+            raise
+        return placed
+
+    def _try_gang(
+        self, pods: Sequence[PodInfo], node_filter: Optional[Callable[[str], bool]]
+    ) -> List[PodInfo]:
+        placed: List[PodInfo] = []
+        try:
+            for pod in pods:
+                placed.append(self.schedule(pod, node_filter))
+        except SchedulingError:
+            for p in placed:  # rollback — all-or-nothing
+                self.release(p.name)
+            raise
+        return placed
+
+    def _tpu_slices(self) -> Dict[str, List[str]]:
+        """Slice name -> node names sorted by host index."""
+        slices: Dict[str, List[tuple]] = {}
+        for name, node in self.nodes.items():
+            state = meshstate.parse_mesh_state(node.info.allocatable)
+            if state is not None:
+                slices.setdefault(state.slice_name, []).append((state.host_index, name))
+        return {
+            s: [n for _, n in sorted(members)] for s, members in sorted(slices.items())
+        }
+
+    # -- introspection ------------------------------------------------------
+
+    def gang_contiguity(self, pods: Sequence[PodInfo]) -> float:
+        """ICI-contiguity of the union of a placed gang's chips in the global
+        slice frame — the BASELINE 'ICI-contiguity score' metric."""
+        coords = []
+        topo = None
+        for pod in pods:
+            node = self.nodes[pod.node_name]
+            state = meshstate.parse_mesh_state(node.info.capacity)
+            if state is None:
+                continue
+            topo = state.topo
+            for cont in pod.running_containers.values():
+                for to_key in cont.allocate_from.values():
+                    m = meshstate.CHIP_CARDS_RE.match(to_key)
+                    if m:
+                        local = int(m.group(1))
+                        if local in state.chip_coord:
+                            coords.append(state.chip_coord[local])
+        if topo is None or not coords:
+            return 0.0
+        from kubetpu.plugintypes.mesh import contiguity_score
+
+        return contiguity_score(coords, topo)

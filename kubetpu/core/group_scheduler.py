@@ -1,0 +1,215 @@
+"""The group scheduler: bin-pack a pod's topology-shaped DevRequests onto a
+node's allocatable resources, filling each container's AllocateFrom map.
+
+This is the component the reference *delegates* to the external KubeDevice
+core via ``UsingGroupScheduler() == true`` (``gpu_scheduler.go:69-71``) and
+never ships — its contract is pinned only by the from->to AllocateFrom shape
+the device-manager test builds by hand (``nvidia_gpu_manager_test.go:38-47``:
+request key -> node resource key). kubetpu implements it:
+
+- **TPU-mesh nodes**: placement is geometric — the pod's chips are chosen
+  with ``find_contiguous_block`` on the node's free torus coordinates, so
+  AllocateFrom lands on an ICI-contiguous sub-slice regardless of how the
+  synthetic request grouping was shaped.
+- **Tree nodes (GPU)**: placement is structural — request groups map onto
+  node groups best-fit (smallest sufficient group first, preserving large
+  groups for later pods), devices within a group in sorted order.
+
+Pod sizing follows the reference's counting (``gpu.go:294-303``): running
+containers get *distinct* devices (sum); init containers run sequentially
+before them and *reuse* the pod's device pool (max), so a pod's pool is
+``max(sum(running), max(init))`` devices.
+
+``take``/``return`` do the usage accounting (the reference core's job): the
+pool's keys and the scalar resource are decremented on the node's
+allocatable and restored on release.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from kubetpu.api import utils
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo
+from kubetpu.plugintypes import ResourceGPU, ResourceTPU
+from kubetpu.plugintypes.mesh import find_contiguous_block
+from kubetpu.scheduler import meshstate
+
+# any 2-level cards key: captures (grp1seg, grp1id, grp0seg, grp0id, baseseg, devid)
+_CARDS_KEY_RE = re.compile(
+    r"^resource/group/([a-z]+grp1)/([^/]+)/([a-z]+grp0)/([^/]+)/([a-z]+)/([^/]+)/cards$"
+)
+
+_SCALAR_BY_BASE = {"tpu": ResourceTPU, "gpu": ResourceGPU}
+
+
+def _cards_request_keys(cont: ContainerInfo, base: str) -> Optional[List[str]]:
+    """Container's cards request keys of a base segment, sorted. Returns
+    None for unsupported quantity>1 keys: AllocateFrom is a from->to map, so
+    one request key can only bind one device (cards are advertised and
+    translated with quantity 1; a >1 quantity would silently lose devices)."""
+    out: List[str] = []
+    for key, val in cont.dev_requests.items():
+        m = _CARDS_KEY_RE.match(key)
+        if m and m.group(5) == base:
+            if val > 1:
+                utils.errorf("unsupported cards request quantity %d for %s", val, key)
+                return None
+            out.append(key)
+    return sorted(out)
+
+
+def _request_bases(pod_info: PodInfo) -> Set[str]:
+    bases: Set[str] = set()
+    for cont in list(pod_info.init_containers.values()) + list(
+        pod_info.running_containers.values()
+    ):
+        for key in cont.dev_requests:
+            m = _CARDS_KEY_RE.match(key)
+            if m:
+                bases.add(m.group(5))
+    return bases
+
+
+def _free_node_cards(node_info: NodeInfo, base: str) -> List[str]:
+    """Node's allocatable cards keys for a base segment, sorted."""
+    out = []
+    for key, val in node_info.allocatable.items():
+        m = _CARDS_KEY_RE.match(key)
+        if m and m.group(5) == base and val >= 1:
+            out.append(key)
+    return sorted(out)
+
+
+def _pick_pool_tree(n: int, free_keys: List[str]) -> Optional[List[str]]:
+    """Choose n node keys structurally: whole groups best-fit (smallest
+    sufficient group first), spilling across the largest groups when no
+    single group holds the remainder."""
+    if n > len(free_keys):
+        return None
+    groups: Dict[Tuple[str, str], List[str]] = {}
+    for key in free_keys:
+        m = _CARDS_KEY_RE.match(key)
+        assert m
+        groups.setdefault((m.group(2), m.group(4)), []).append(key)
+    pool: List[str] = []
+    remaining = n
+    avail = {g: sorted(keys) for g, keys in groups.items()}
+    while remaining > 0:
+        fitting = sorted(
+            (g for g in avail if len(avail[g]) >= remaining),
+            key=lambda g: (len(avail[g]), g),
+        )
+        if fitting:
+            g = fitting[0]
+            pool.extend(avail[g][:remaining])
+            remaining = 0
+        else:
+            g = sorted(avail, key=lambda g: (-len(avail[g]), g))[0]
+            pool.extend(avail[g])
+            remaining -= len(avail[g])
+            del avail[g]
+    return pool
+
+
+def _pick_pool_mesh(n: int, state: meshstate.NodeMeshState) -> Optional[List[str]]:
+    """Choose n node keys geometrically: an ICI-contiguous block."""
+    placed = find_contiguous_block(state.free, n, state.topo)
+    if placed is None:
+        return None
+    coords, score = placed
+    utils.logf(4, "geometric fill: %d chips, contiguity %.3f", n, score)
+    keys: List[str] = []
+    for c in coords:
+        local = state.coord_chip.get(c)
+        key = state.chip_key.get(local) if local is not None else None
+        if key is None:
+            return None
+        keys.append(key)
+    return sorted(keys)
+
+
+def fill_allocate_from(node_info: NodeInfo, pod_info: PodInfo) -> bool:
+    """Fill every container's AllocateFrom from the node's allocatable;
+    all-or-nothing per pod (no partial state on failure)."""
+    state = meshstate.parse_mesh_state(node_info.allocatable)
+    running = [
+        pod_info.running_containers[k]
+        for k in utils.sorted_string_keys(pod_info.running_containers)
+    ]
+    inits = [
+        pod_info.init_containers[k]
+        for k in utils.sorted_string_keys(pod_info.init_containers)
+    ]
+
+    tentative: List[Tuple[ContainerInfo, str, str]] = []
+    for base in sorted(_request_bases(pod_info)):
+        running_reqs = []
+        for cont in running:
+            keys = _cards_request_keys(cont, base)
+            if keys is None:
+                return False
+            running_reqs.extend((cont, key) for key in keys)
+        init_keys = []
+        for cont in inits:
+            keys = _cards_request_keys(cont, base)
+            if keys is None:
+                return False
+            init_keys.append((cont, keys))
+        init_maxes = [len(keys) for _, keys in init_keys]
+        pool_n = max([len(running_reqs)] + init_maxes) if (running_reqs or init_maxes) else 0
+        if pool_n == 0:
+            continue
+
+        if base == "tpu" and state is not None:
+            pool = _pick_pool_mesh(pool_n, state)
+        else:
+            pool = _pick_pool_tree(pool_n, _free_node_cards(node_info, base))
+        if pool is None:
+            return False
+
+        # running containers: distinct devices from the pool, in order
+        for (cont, req_key), node_key in zip(running_reqs, pool):
+            tentative.append((cont, req_key, node_key))
+        # init containers: run sequentially before running ones -> reuse the
+        # front of the pool
+        for cont, keys in init_keys:
+            for req_key, node_key in zip(keys, pool):
+                tentative.append((cont, req_key, node_key))
+
+    for cont, from_key, to_key in tentative:
+        cont.allocate_from[from_key] = to_key
+    return True
+
+
+def take_pod_resources(node_info: NodeInfo, pod_info: PodInfo) -> None:
+    """Decrement the node's allocatable by the pod's held pool (running
+    containers; init containers reuse it) — the accounting the external
+    core performed for the reference."""
+    _account(node_info, pod_info, sign=-1)
+
+
+def return_pod_resources(node_info: NodeInfo, pod_info: PodInfo) -> None:
+    _account(node_info, pod_info, sign=+1)
+
+
+def _pod_held_keys(pod_info: PodInfo) -> Set[str]:
+    held: Set[str] = set()
+    for cont in pod_info.running_containers.values():
+        held.update(cont.allocate_from.values())
+    for cont in pod_info.init_containers.values():
+        held.update(cont.allocate_from.values())  # usually a subset
+    return held
+
+
+def _account(node_info: NodeInfo, pod_info: PodInfo, sign: int) -> None:
+    for to_key in _pod_held_keys(pod_info):
+        m = _CARDS_KEY_RE.match(to_key)
+        if not m:
+            continue
+        node_info.allocatable[to_key] = node_info.allocatable.get(to_key, 0) + sign
+        scalar = _SCALAR_BY_BASE.get(m.group(5))
+        if scalar is not None:
+            node_info.allocatable[scalar] = node_info.allocatable.get(scalar, 0) + sign
+            node_info.kube_alloc[scalar] = node_info.kube_alloc.get(scalar, 0) + sign

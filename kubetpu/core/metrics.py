@@ -1,0 +1,48 @@
+"""Scheduling latency metrics.
+
+The reference has no tracing/profiling hooks (SURVEY.md §5.1); kubetpu adds
+latency histograms around the per-pod scheduling hot path because the
+BASELINE north-star metric is pod-schedule p50 < 100 ms for 256-chip gangs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+
+class LatencyRecorder:
+    """Collects per-operation latencies (seconds) and reports percentiles."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._samples: Dict[str, List[float]] = {}
+
+    def record(self, op: str, seconds: float) -> None:
+        with self._lock:
+            self._samples.setdefault(op, []).append(seconds)
+
+    def count(self, op: str) -> int:
+        with self._lock:
+            return len(self._samples.get(op, []))
+
+    def percentile(self, op: str, p: float) -> float:
+        """p in [0, 100]; returns seconds (0.0 if no samples)."""
+        with self._lock:
+            samples = sorted(self._samples.get(op, []))
+        if not samples:
+            return 0.0
+        idx = min(len(samples) - 1, max(0, int(round(p / 100.0 * (len(samples) - 1)))))
+        return samples[idx]
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            ops = list(self._samples)
+        return {
+            op: {
+                "count": self.count(op),
+                "p50_ms": self.percentile(op, 50) * 1e3,
+                "p99_ms": self.percentile(op, 99) * 1e3,
+            }
+            for op in ops
+        }

@@ -1,0 +1,20 @@
+"""Node-agent device managers (analog of reference ``nvidiagpuplugin``):
+the TPU manager (probe via native ``tpuinfo``, geometric ICI naming,
+``/dev/accel*`` + libtpu env injection) and the NVIDIA manager
+(``kubetpu.device.nvidia``) for heterogeneous clusters."""
+
+from kubetpu.device.tpu_manager import (
+    TpuDevManager,
+    new_fake_tpu_dev_manager,
+    new_tpu_dev_manager,
+)
+from kubetpu.device.tpu_plugin import FakeTpuPlugin, TpuPlugin, make_fake_tpus_info
+
+__all__ = [
+    "TpuDevManager",
+    "new_fake_tpu_dev_manager",
+    "new_tpu_dev_manager",
+    "FakeTpuPlugin",
+    "TpuPlugin",
+    "make_fake_tpus_info",
+]

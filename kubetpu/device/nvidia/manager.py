@@ -1,0 +1,199 @@
+"""NVIDIA GPU device manager: probe, P2P topology grouping, advertisement,
+allocation. Functional mirror of the reference ``NvidiaGPUManager``
+(``nvidiagpuplugin/gpu/nvidia/nvidia_gpu_manager.go``), kept for
+heterogeneous GPU+TPU clusters.
+
+Unlike the TPU manager's geometric naming, GPU grouping is *link-typed*: a
+greedy pass per level where the first ungrouped GPU founds a group and
+absorbs every GPU reachable over an allowed P2P link type — pass 0 with
+links {6,5,4} (same-board / single-switch / multi-switch) -> ``gpugrp0``,
+pass 1 with {6..1} (adds hostbridge / same-CPU / cross-CPU) -> ``gpugrp1``
+(reference topologyDiscovery, ``:63-91``, link-level semantics documented at
+``:158-176``).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from kubetpu.api import utils
+from kubetpu.api.device import AllocateResult, Device
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo, add_group_resource
+from kubetpu.device.nvidia import types as nvtypes
+from kubetpu.device.nvidia.plugin import NvidiaDockerPlugin, NvidiaFakePlugin, NvidiaPlugin
+from kubetpu.plugintypes import ResourceGPU
+from kubetpu.scheduler.deviceclass import GPU
+
+_CLI_TOKEN_RE = re.compile(r"(.*?)=(.*)")
+
+
+class NvidiaGPUManager(Device):
+    def __init__(self, plugin: Optional[NvidiaPlugin] = None):
+        self._lock = threading.Lock()
+        self._plugin: NvidiaPlugin = plugin if plugin is not None else NvidiaDockerPlugin()
+        self.gpus: Dict[str, nvtypes.GpuInfo] = {}
+        self.path_to_id: Dict[str, str] = {}
+        self.bus_id_to_id: Dict[str, str] = {}
+        self.index_to_id: List[str] = []
+        self.num_gpus = 0
+
+    # -- Device lifecycle ---------------------------------------------------
+
+    def new(self) -> None:
+        self.gpus = {}
+
+    def start(self) -> None:
+        try:
+            self.update_gpu_info()
+        except Exception as e:  # noqa: BLE001 — degrade to zero GPUs (:185-188)
+            utils.logf(0, "initial GPU probe failed (%s); starting with 0 GPUs", e)
+
+    def get_name(self) -> str:
+        return "nvidiagpu"
+
+    # -- topology discovery (reference :63-91) ------------------------------
+
+    def _topology_discovery(self, links: Sequence[int], level: int) -> None:
+        link_set = set(links)
+        for gpu in self.gpus.values():
+            gpu.topo_done = False
+        link_id = 0
+        for gid in self.index_to_id:
+            gpu = self.gpus[gid]
+            if not gpu.found or gpu.topo_done:
+                continue
+            prefix = f"gpugrp{level}/{link_id}"
+            link_id += 1
+            gpu.name = prefix + "/" + gpu.name
+            gpu.topo_done = True
+            for topolink in gpu.topology:
+                if topolink.link in link_set:
+                    other_id = self.bus_id_to_id.get(topolink.bus_id)
+                    if other_id is None:
+                        continue
+                    other = self.gpus[other_id]
+                    if other.found and not other.topo_done:
+                        other.name = prefix + "/" + other.name
+                        other.topo_done = True
+
+    # -- probing (reference UpdateGPUInfo, :94-183) -------------------------
+
+    def update_gpu_info(self) -> None:
+        with self._lock:
+            body = self._plugin.get_gpu_info()
+            utils.logf(5, "get_gpu_info returns %s", body)
+            info = nvtypes.parse_gpus_info(body)
+            # unit conversion: HTTP/fake backends report MiB / MB (:125-130)
+            for g in info.gpus:
+                g.memory.global_mib *= 1024 * 1024  # now bytes
+                g.pci.bandwidth *= 1000 * 1000
+
+            for gpu in self.gpus.values():
+                gpu.found = False
+            self.path_to_id = {}
+            self.bus_id_to_id = {}
+            self.index_to_id = [""] * len(info.gpus)
+            for index, found in enumerate(info.gpus):
+                prev = self.gpus.get(found.id)
+                if prev is not None:
+                    found.in_use = prev.in_use
+                found.found = True
+                found.index = index
+                found.name = "gpu/" + found.id
+                self.gpus[found.id] = found
+                self.path_to_id[found.path] = found.id
+                self.bus_id_to_id[found.pci.bus_id] = found.id
+                self.index_to_id[index] = found.id
+            self.num_gpus = len(info.gpus)
+
+            self._topology_discovery([6, 5, 4], 0)
+            self._topology_discovery([6, 5, 4, 3, 2, 1], 1)
+
+    # -- advertisement (reference UpdateNodeInfo, :191-213) ------------------
+
+    def update_node_info(self, node_info: NodeInfo) -> None:
+        try:
+            self.update_gpu_info()
+        except Exception as e:  # noqa: BLE001
+            utils.logf(0, "update_gpu_info error %s, setting GPUs to zero", e)
+            self.num_gpus = 0
+            raise
+        utils.logf(4, "NumGPUs found = %d", self.num_gpus)
+        # Count only found GPUs (deliberate divergence from the reference's
+        # len(ngm.gpus) overcount — see tpu_manager.update_node_info).
+        n = sum(1 for g in self.gpus.values() if g.found)
+        for reslist in (node_info.capacity, node_info.allocatable,
+                        node_info.kube_cap, node_info.kube_alloc):
+            reslist[ResourceGPU] = n
+        for gpu in self.gpus.values():
+            if not gpu.found:
+                continue
+            for reslist in (node_info.capacity, node_info.allocatable):
+                add_group_resource(reslist, gpu.name + "/memory", gpu.memory.global_mib)
+                add_group_resource(reslist, gpu.name + "/cards", 1)
+
+    # -- allocation ---------------------------------------------------------
+
+    def allocate(self, pod: PodInfo, container: ContainerInfo) -> AllocateResult:
+        """nvidia-docker2 path: UUIDs -> NVIDIA_VISIBLE_DEVICES env
+        (reference Allocate, :216-241)."""
+        with self._lock:
+            if not container.allocate_from:
+                return [], [], {}
+            gpu_list: List[str] = []
+            for res in container.allocate_from.values():
+                utils.logf(4, "PodName: %s -- searching for device UID: %s", pod.name, res)
+                m = GPU.alloc_re.search(res)
+                if m:
+                    gpu_list.append(m.group(1))
+            return [], [], {"NVIDIA_VISIBLE_DEVICES": ",".join(gpu_list)}
+
+    def allocate_old(self, pod: PodInfo, container: ContainerInfo) -> AllocateResult:
+        """Legacy nvidia-docker v1 path: device paths + control devices
+        parsed from the daemon's CLI string (reference AllocateOld,
+        :244-304)."""
+        with self._lock:
+            if not container.allocate_from:
+                return [], [], {}
+            gpu_list: List[str] = []
+            indices: List[int] = []
+            for res in container.allocate_from.values():
+                m = GPU.alloc_re.search(res)
+                if not m:
+                    continue
+                gid = m.group(1)
+                gpu = self.gpus.get(gid)
+                if gpu is None:
+                    continue
+                indices.append(gpu.index)
+                if gpu.found:
+                    gpu_list.append(gpu.path)
+            body = self._plugin.get_gpu_command_line(indices).decode()
+            utils.logf(4, "PodName: %s command line from plugin: %s", pod.name, body)
+            for token in body.split(" "):
+                m = _CLI_TOKEN_RE.match(token)
+                if m and m.group(1) == "--device":
+                    val = m.group(2)
+                    if val not in self.path_to_id:
+                        gpu_list.append(val)  # /dev/nvidiactl, /dev/nvidia-uvm, ...
+            return [], gpu_list, {}
+
+
+def new_nvidia_gpu_manager() -> Device:
+    """Production manager over the nvidia-docker daemon (reference
+    NewNvidiaGPUManager wires the NVML path; kubetpu targets TPU-VMs, so the
+    HTTP backend is the default GPU probe)."""
+    mgr = NvidiaGPUManager()
+    mgr.new()
+    return mgr
+
+
+def new_fake_nvidia_gpu_manager(
+    info: nvtypes.GpusInfo, volume: str = "", volume_driver: str = ""
+) -> Device:
+    """Reference NewFakeNvidiaGPUManager (nvidia_fake_plugin.go:30-41)."""
+    mgr = NvidiaGPUManager(plugin=NvidiaFakePlugin(info, volume, volume_driver))
+    mgr.new()
+    return mgr

@@ -1,0 +1,65 @@
+"""GPU probe backends: the injectable ``NvidiaPlugin`` interface
+(reference ``nvidia_plugin.go:7-10``), the legacy nvidia-docker v1 HTTP
+daemon client (``nvidia_docker_plugin.go``), and the fake test backend
+(``nvidia_fake_plugin.go``)."""
+
+from __future__ import annotations
+
+import os
+import urllib.request
+from abc import ABC, abstractmethod
+from typing import List
+
+from kubetpu.device.nvidia import types as nvtypes
+
+
+class NvidiaPlugin(ABC):
+    @abstractmethod
+    def get_gpu_info(self) -> bytes: ...
+
+    @abstractmethod
+    def get_gpu_command_line(self, device_indices: List[int]) -> bytes:
+        """The legacy docker CLI fragment naming --device flags
+        (reference GetGPUCommandLine)."""
+
+
+class NvidiaDockerPlugin(NvidiaPlugin):
+    """Client of the nvidia-docker v1 daemon REST API (reference
+    nvidia_docker_plugin.go:21-27). Base URL configurable (the reference
+    hardcodes localhost:3476 — SURVEY.md §5.6)."""
+
+    def __init__(self, base_url: str | None = None):
+        self.base_url = base_url or os.environ.get(
+            "KUBETPU_NVIDIA_DOCKER_URL", "http://localhost:3476"
+        )
+
+    def _get(self, path: str) -> bytes:
+        with urllib.request.urlopen(self.base_url + path, timeout=10) as resp:
+            return resp.read()
+
+    def get_gpu_info(self) -> bytes:
+        return self._get("/v1.0/gpu/info/json")
+
+    def get_gpu_command_line(self, device_indices: List[int]) -> bytes:
+        dev = "+".join(str(i) for i in device_indices)
+        return self._get("/v1.0/docker/cli?dev=" + dev)
+
+
+class NvidiaFakePlugin(NvidiaPlugin):
+    """Canned GpusInfo + synthesized docker CLI string (reference
+    nvidia_fake_plugin.go:10-28) — the key to testing without hardware."""
+
+    def __init__(self, info: nvtypes.GpusInfo, volume: str = "", volume_driver: str = ""):
+        self._info = info
+        self._volume = volume
+        self._volume_driver = volume_driver
+
+    def get_gpu_info(self) -> bytes:
+        return nvtypes.dump_gpus_info(self._info).encode()
+
+    def get_gpu_command_line(self, device_indices: List[int]) -> bytes:
+        cli = f"--volume-driver={self._volume_driver} --volume={self._volume}"
+        cli += " --device=/dev/nvidiactl --device=/dev/nvidia-uvm --device=/dev/nvidia-uvm-tools"
+        for idx in device_indices:
+            cli += " --device=" + self._info.gpus[idx].path
+        return cli.encode()

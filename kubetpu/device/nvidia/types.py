@@ -1,0 +1,106 @@
+"""GPU info JSON schema (the NVML wire format of the reference,
+``nvidiagpuplugin/gpu/nvgputypes/types.go:8-43``): UUID/Model/Path, HBM in
+MiB, PCI bus id, and the per-device P2P ``Topology`` list of (BusID, Link)
+pairs. Field names match the reference schema — it is a wire format shared
+with nvidia tooling, not code."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class MemoryInfo:
+    global_mib: int = 0  # reference Memory.Global arrives in MiB over HTTP/JSON
+
+
+@dataclass
+class PciInfo:
+    bus_id: str = ""
+    bandwidth: int = 0
+
+
+@dataclass
+class TopologyInfo:
+    bus_id: str = ""
+    link: int = 0  # P2P link level 1..6 (nvidia_gpu_manager.go:158-176)
+
+
+@dataclass
+class GpuInfo:
+    id: str = ""
+    model: str = ""
+    path: str = ""
+    memory: MemoryInfo = field(default_factory=MemoryInfo)
+    pci: PciInfo = field(default_factory=PciInfo)
+    topology: List[TopologyInfo] = field(default_factory=list)
+    # runtime-only (reference json:"-" fields):
+    found: bool = False
+    index: int = 0
+    in_use: bool = False
+    topo_done: bool = False
+    name: str = ""
+
+
+@dataclass
+class VersionInfo:
+    driver: str = ""
+    cuda: str = ""
+
+
+@dataclass
+class GpusInfo:
+    version: VersionInfo = field(default_factory=VersionInfo)
+    gpus: List[GpuInfo] = field(default_factory=list)
+
+
+def parse_gpus_info(data: bytes | str) -> GpusInfo:
+    obj = json.loads(data)
+    version = VersionInfo(
+        driver=obj.get("Version", {}).get("Driver", ""),
+        cuda=obj.get("Version", {}).get("CUDA", ""),
+    )
+    gpus: List[GpuInfo] = []
+    for dev in obj.get("Devices", []) or []:
+        topo = [
+            TopologyInfo(bus_id=t.get("BusID", ""), link=int(t.get("Link", 0)))
+            for t in (dev.get("Topology") or [])
+        ]
+        gpus.append(
+            GpuInfo(
+                id=dev.get("UUID", ""),
+                model=dev.get("Model", ""),
+                path=dev.get("Path", ""),
+                memory=MemoryInfo(global_mib=int((dev.get("Memory") or {}).get("Global", 0))),
+                pci=PciInfo(
+                    bus_id=(dev.get("PCI") or {}).get("BusID", ""),
+                    bandwidth=int((dev.get("PCI") or {}).get("Bandwidth", 0)),
+                ),
+                topology=topo,
+            )
+        )
+    return GpusInfo(version=version, gpus=gpus)
+
+
+def dump_gpus_info(info: GpusInfo) -> str:
+    return json.dumps(
+        {
+            "Version": {"Driver": info.version.driver, "CUDA": info.version.cuda},
+            "Devices": [
+                {
+                    "UUID": g.id,
+                    "Model": g.model,
+                    "Path": g.path,
+                    "Memory": {"Global": g.memory.global_mib},
+                    "PCI": {"BusID": g.pci.bus_id, "Bandwidth": g.pci.bandwidth},
+                    "Topology": [
+                        {"BusID": t.bus_id, "Link": t.link} for t in g.topology
+                    ]
+                    or None,
+                }
+                for g in info.gpus
+            ],
+        }
+    )

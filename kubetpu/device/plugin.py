@@ -1,0 +1,12 @@
+"""Device plugin entry shim (analog of reference
+``nvidiagpuplugin/plugin/nvidiagpu.go:8-10``): the factory symbol the core
+looks up via ``kubetpu.api.device.create_device_from_plugin``."""
+
+from __future__ import annotations
+
+from kubetpu.api.device import Device
+from kubetpu.device.tpu_manager import new_tpu_dev_manager
+
+
+def create_device_plugin() -> Device:
+    return new_tpu_dev_manager()

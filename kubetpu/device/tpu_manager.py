@@ -1,0 +1,238 @@
+"""TPU device manager: probe, topology naming, advertisement, allocation.
+
+The node-agent ``Device`` implementation — analog of the reference's
+``NvidiaGPUManager`` (``nvidiagpuplugin/gpu/nvidia/nvidia_gpu_manager.go``):
+
+- probe via the native ``tpuinfo`` subprocess with a 5-minute cache
+  (reference ``:110-121``) or an injected fake backend;
+- mark-and-reassign discovery that preserves ``in_use`` across refreshes and
+  tolerates disappearing chips (reference ``:132-155``);
+- topology naming: where the reference greedily groups GPUs by NVLink P2P
+  link level (``:63-91``), TPU chips are named *geometrically* from their
+  torus coordinates — ``tpugrp1/<host>/tpugrp0/<2x2-block>/tpu/<idx>`` —
+  because ICI locality is a coordinate property, not a link-type property;
+- ``update_node_info`` advertises the scalar resource, per-chip grouped
+  cards/memory keys, and the ``tpu-slice`` geometry key (reference
+  ``:191-213``);
+- ``allocate`` turns AllocateFrom into ``/dev/accel*`` device nodes plus the
+  libtpu environment contract (``TPU_VISIBLE_DEVICES``, chip-bounds and
+  process-bounds variables) instead of ``NVIDIA_VISIBLE_DEVICES``
+  (reference ``:216-241``; SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubetpu.api import utils
+from kubetpu.api.device import AllocateResult, Device, Mount
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo, add_group_resource
+from kubetpu.device import types as tputypes
+from kubetpu.device.tpu_plugin import TpuPlugin
+from kubetpu.plugintypes import ResourceTPU
+from kubetpu.plugintypes.mesh import TOPOLOGIES, TpuTopology
+from kubetpu.scheduler.deviceclass import TPU
+from kubetpu.scheduler.meshstate import slice_resource_key
+
+# Probe refresh period (reference nvmlLastGetTime 5-minute cache, :110-121).
+PROBE_CACHE_SECONDS = 5 * 60.0
+
+
+def local_block_index(topo: TpuTopology, host_index: int, coord: Tuple[int, ...]) -> int:
+    """The level-0 group of a chip: aligned 2-per-dimension sub-blocks of
+    the host's block (a v5e 2x4 host has two 2x2 blocks). Geometric analog
+    of the reference's pass-0 link grouping (nvidia_gpu_manager.go:178)."""
+    host_origin = topo.host_coords(host_index)[0]
+    blocks_per_dim = [(h + 1) // 2 for h in topo.host_shape]
+    idx = 0
+    for c, o, n in zip(coord, host_origin, blocks_per_dim):
+        idx = idx * n + min((c - o) // 2, n - 1)
+    return idx
+
+
+class TpuDevManager(Device):
+    """Manages the local TPU chips (analog of NvidiaGPUManager)."""
+
+    def __init__(self, plugin: Optional[TpuPlugin] = None, tpuinfo_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._plugin = plugin          # None => exec the native probe
+        self._tpuinfo_path = tpuinfo_path
+        self.tpus: Dict[str, tputypes.TpuChipInfo] = {}
+        self.path_to_id: Dict[str, str] = {}
+        self.index_to_id: Dict[int, str] = {}
+        self.num_tpus = 0
+        self.topology: Optional[TpuTopology] = None
+        self.host_index = 0
+        self.topology_name = ""
+        self._info: Optional[tputypes.TpusInfo] = None
+        self._last_probe_time = 0.0
+
+    # -- Device lifecycle ---------------------------------------------------
+
+    def new(self) -> None:
+        self.tpus = {}
+
+    def start(self) -> None:
+        """Probe errors are deliberately swallowed: the node degrades to zero
+        chips (reference Start, nvidia_gpu_manager.go:185-188)."""
+        try:
+            self.update_tpu_info()
+        except Exception as e:  # noqa: BLE001 — graceful-degradation contract
+            utils.logf(0, "initial TPU probe failed (%s); starting with 0 chips", e)
+
+    def get_name(self) -> str:
+        return "tpu"
+
+    # -- probing ------------------------------------------------------------
+
+    def _fetch(self) -> tputypes.TpusInfo:
+        if self._plugin is not None:
+            return tputypes.parse_tpus_info(self._plugin.get_tpu_info())
+        now = time.monotonic()
+        if self._info is None or (now - self._last_probe_time) > PROBE_CACHE_SECONDS:
+            self._info = tputypes.get_devices(self._tpuinfo_path)
+            self._last_probe_time = now
+        return self._info
+
+    def update_tpu_info(self) -> None:
+        """Refresh chip state: mark-and-reassign preserving in_use, then
+        geometric topology naming (reference UpdateGPUInfo, :94-183)."""
+        with self._lock:
+            info = self._fetch()
+            utils.logf(5, "TPUInfo: %s", info)
+
+            self.topology = TOPOLOGIES.get(info.topology.type)
+            self.topology_name = info.topology.type
+            self.host_index = info.topology.host_index
+
+            # mark-and-sweep: if num_tpus != len(tpus) afterwards, some chips
+            # have gone missing (reference comment at :152-154).
+            for chip in self.tpus.values():
+                chip.found = False
+            self.path_to_id = {}
+            self.index_to_id = {}
+            for chip_found in info.tpus:
+                prev = self.tpus.get(chip_found.id)
+                if prev is not None:
+                    chip_found.in_use = prev.in_use
+                chip_found.found = True
+                chip_found.name = self._topology_name_for(chip_found)
+                self.tpus[chip_found.id] = chip_found
+                self.path_to_id[chip_found.path] = chip_found.id
+                self.index_to_id[chip_found.index] = chip_found.id
+            self.num_tpus = len(info.tpus)
+
+    def _topology_name_for(self, chip: tputypes.TpuChipInfo) -> str:
+        """``tpugrp1/<host>/tpugrp0/<block>/tpu/<index>`` from coordinates;
+        chips without geometry degrade to per-chip degenerate groups (the
+        reference's topology-less K80 behavior)."""
+        if self.topology is not None and chip.coords:
+            blk = local_block_index(self.topology, self.host_index, chip.coords)
+            return f"tpugrp1/{self.host_index}/tpugrp0/{blk}/tpu/{chip.index}"
+        return f"tpugrp1/{chip.index}/tpugrp0/{chip.index}/tpu/{chip.index}"
+
+    # -- advertisement ------------------------------------------------------
+
+    def update_node_info(self, node_info: NodeInfo) -> None:
+        """Advertise scalar + grouped + geometry resources (reference
+        UpdateNodeInfo, :191-213)."""
+        try:
+            self.update_tpu_info()
+        except Exception as e:  # noqa: BLE001
+            utils.logf(0, "update_tpu_info error %s, setting TPUs to zero", e)
+            self.num_tpus = 0
+            raise
+        utils.logf(4, "NumTPUs found = %d", self.num_tpus)
+        # Count only currently-found chips: the map retains disappeared chips
+        # (found=False) and advertising them as scalar capacity would admit
+        # pods the fill step cannot satisfy. (The reference counts
+        # len(ngm.gpus) here, nvidia_gpu_manager.go:199 — a latent
+        # overcount; kubetpu deliberately diverges.)
+        n = sum(1 for c in self.tpus.values() if c.found)
+        for reslist in (node_info.capacity, node_info.allocatable,
+                        node_info.kube_cap, node_info.kube_alloc):
+            reslist[ResourceTPU] = n
+        for chip in self.tpus.values():
+            if not chip.found:
+                continue
+            for reslist in (node_info.capacity, node_info.allocatable):
+                add_group_resource(reslist, chip.name + "/cards", 1)
+                add_group_resource(reslist, chip.name + "/memory", chip.memory.global_bytes)
+        if self.topology is not None:
+            for reslist in (node_info.capacity, node_info.allocatable):
+                reslist[slice_resource_key(self.topology_name, self.host_index)] = 1
+
+    # -- allocation ---------------------------------------------------------
+
+    def allocate(self, pod: PodInfo, container: ContainerInfo) -> AllocateResult:
+        """AllocateFrom -> device nodes + libtpu env (reference Allocate,
+        :216-241, which emits NVIDIA_VISIBLE_DEVICES)."""
+        with self._lock:
+            if not container.allocate_from:
+                return [], [], {}
+            indices: List[int] = []
+            devices: List[str] = []
+            for res in container.allocate_from.values():
+                utils.logf(4, "PodName: %s -- searching for device: %s", pod.name, res)
+                m = TPU.alloc_re.search(res)
+                if not m:
+                    continue
+                idx = int(m.group(1))
+                indices.append(idx)
+                chip_id = self.index_to_id.get(idx)
+                if chip_id is not None and self.tpus[chip_id].found:
+                    devices.append(self.tpus[chip_id].path)
+            indices.sort()
+            devices.sort()
+            env = {
+                "TPU_VISIBLE_DEVICES": ",".join(str(i) for i in indices),
+                "TPU_SKIP_MDS_QUERY": "true",
+                "TPU_WORKER_ID": str(self.host_index),
+            }
+            env.update(self._bounds_env(indices))
+            return [], devices, env
+
+    def _bounds_env(self, indices: List[int]) -> Dict[str, str]:
+        """Chip-bounds variables for sub-host slices: the bounding box of the
+        allocated chips' coordinates, padded to 3 dims (the libtpu
+        TPU_CHIPS_PER_PROCESS_BOUNDS / TPU_PROCESS_BOUNDS contract)."""
+        if self.topology is None or not indices:
+            return {}
+        coords = []
+        for idx in indices:
+            chip_id = self.index_to_id.get(idx)
+            if chip_id is not None and self.tpus[chip_id].coords:
+                coords.append(self.tpus[chip_id].coords)
+        if not coords:
+            return {}
+        ndims = len(coords[0])
+        extent = [
+            max(c[d] for c in coords) - min(c[d] for c in coords) + 1
+            for d in range(ndims)
+        ]
+        while len(extent) < 3:
+            extent.append(1)
+        return {
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": ",".join(str(e) for e in extent),
+            "TPU_PROCESS_BOUNDS": "1,1,1",
+        }
+
+
+def new_tpu_dev_manager() -> Device:
+    """Production manager: probes via the native tpuinfo binary (analog of
+    NewNvidiaGPUManager, :35-38)."""
+    mgr = TpuDevManager()
+    mgr.new()
+    return mgr
+
+
+def new_fake_tpu_dev_manager(info: tputypes.TpusInfo) -> Device:
+    """Test/fake-device manager (analog of NewFakeNvidiaGPUManager,
+    nvidia_fake_plugin.go:30-41)."""
+    from kubetpu.device.tpu_plugin import FakeTpuPlugin
+
+    mgr = TpuDevManager(plugin=FakeTpuPlugin(info))
+    mgr.new()
+    return mgr

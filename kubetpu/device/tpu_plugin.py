@@ -1,0 +1,68 @@
+"""TPU probe-backend interface + fake backend.
+
+Mirrors the reference's injectable backend pattern (``NvidiaPlugin``
+interface, ``nvidia_plugin.go:7-10``; ``NvidiaFakePlugin``,
+``nvidia_fake_plugin.go``): the manager's hardware probe is an interface, so
+the full node-agent logic is testable with canned topologies and no
+hardware — the fixture strategy SURVEY.md §4 names as the pattern to
+replicate (BASELINE config 1's "fake-device mode").
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from kubetpu.device import types as tputypes
+from kubetpu.plugintypes.mesh import TOPOLOGIES
+
+
+class TpuPlugin(ABC):
+    """Backend serving raw tpuinfo JSON (analog of NvidiaPlugin.GetGPUInfo)."""
+
+    @abstractmethod
+    def get_tpu_info(self) -> bytes: ...
+
+
+class FakeTpuPlugin(TpuPlugin):
+    """Serves a canned TpusInfo (analog of NvidiaFakePlugin)."""
+
+    def __init__(self, info: tputypes.TpusInfo):
+        self._info = info
+
+    def get_tpu_info(self) -> bytes:
+        return tputypes.dump_tpus_info(self._info).encode()
+
+
+def make_fake_tpus_info(
+    topology_name: str = "v5e-8",
+    host_index: int = 0,
+    missing_chips: tuple = (),
+) -> tputypes.TpusInfo:
+    """Build a realistic canned host: one chip per local index of the host's
+    block, /dev/accel<i> paths, per-generation HBM — the TPU analog of the
+    reference's TITAN X / K80 JSON fixtures
+    (nvidia_gpu_manager_test.go:16-17). ``missing_chips`` simulates failed
+    or absent devices (fault injection, SURVEY.md §5.3)."""
+    topo = TOPOLOGIES[topology_name]
+    host_coords = topo.host_coords(host_index)
+    chips = []
+    for local, coord in enumerate(host_coords):
+        if local in missing_chips:
+            continue
+        chips.append(
+            tputypes.TpuChipInfo(
+                id=f"TPU-{topology_name}-h{host_index}-c{local}",
+                model=f"TPU {topo.generation}",
+                path=f"/dev/accel{local}",
+                index=local,
+                memory=tputypes.MemoryInfo(global_bytes=topo.hbm_bytes_per_chip),
+                coords=coord,
+            )
+        )
+    return tputypes.TpusInfo(
+        version=tputypes.VersionInfo(runtime="fake", libtpu="0.0.0-fake"),
+        topology=tputypes.TopologyInfo(
+            type=topology_name, host_index=host_index, num_hosts=topo.num_hosts
+        ),
+        tpus=chips,
+    )

@@ -1,0 +1,150 @@
+"""TPU device info JSON schema + the exec-subprocess probe client.
+
+Analog of the reference's ``nvidiagpuplugin/gpu/nvgputypes/types.go``: a JSON
+wire schema emitted by the native probe binary (``tpuinfo``, the nvmlinfo
+analog) and a client that shells out to it — the same deliberate process
+boundary isolating native hardware-query code from the long-running agent
+(reference ``types.go:45-58`` exec's ``/usr/local/bin/nvmlinfo json``).
+
+Schema (chip coordinates replace the NVLink P2P matrix):
+
+    {
+      "Version":  {"Runtime": "...", "Libtpu": "..."},
+      "Topology": {"Type": "v5e-8", "HostIndex": 0, "NumHosts": 1},
+      "Devices":  [{"ID": "...", "Model": "TPU v5e", "Path": "/dev/accel0",
+                    "Index": 0, "Memory": {"Global": <bytes>},
+                    "Coords": [x, y]}]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+def default_tpuinfo_path() -> str:
+    """Probe binary location. Configurable (SURVEY.md §5.6 flags the
+    reference's hardcoded /usr/local/bin/nvmlinfo as build debt)."""
+    env = os.environ.get("KUBETPU_TPUINFO_PATH")
+    if env:
+        return env
+    repo_local = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "_output", "tpuinfo")
+    if os.path.exists(repo_local):
+        return repo_local
+    return "/usr/local/bin/tpuinfo"
+
+
+@dataclass
+class MemoryInfo:
+    global_bytes: int = 0  # HBM per chip, bytes (reference Memory.Global)
+
+
+@dataclass
+class TpuChipInfo:
+    """One TPU chip (analog of reference GpuInfo, nvgputypes/types.go:22-34).
+
+    JSON fields: ID/Model/Path/Index/Memory/Coords. The trailing fields are
+    runtime-only manager state, never serialized (reference's ``json:"-"``
+    fields Found/Index/InUse/TopoDone/Name).
+    """
+
+    id: str = ""
+    model: str = ""
+    path: str = ""
+    index: int = 0
+    memory: MemoryInfo = field(default_factory=MemoryInfo)
+    coords: Tuple[int, ...] = ()
+    # runtime-only:
+    found: bool = False
+    in_use: bool = False
+    name: str = ""
+
+
+@dataclass
+class TopologyInfo:
+    type: str = ""       # slice topology name, e.g. "v5e-8"
+    host_index: int = 0  # this host's index within the slice
+    num_hosts: int = 1
+
+
+@dataclass
+class VersionInfo:
+    runtime: str = ""
+    libtpu: str = ""
+
+
+@dataclass
+class TpusInfo:
+    """Analog of reference GpusInfo (nvgputypes/types.go:40-43)."""
+
+    version: VersionInfo = field(default_factory=VersionInfo)
+    topology: TopologyInfo = field(default_factory=TopologyInfo)
+    tpus: List[TpuChipInfo] = field(default_factory=list)
+
+
+def parse_tpus_info(data: bytes | str) -> TpusInfo:
+    """Decode the tpuinfo JSON wire format."""
+    obj = json.loads(data)
+    version = VersionInfo(
+        runtime=obj.get("Version", {}).get("Runtime", ""),
+        libtpu=obj.get("Version", {}).get("Libtpu", ""),
+    )
+    topo = obj.get("Topology", {}) or {}
+    topology = TopologyInfo(
+        type=topo.get("Type", ""),
+        host_index=int(topo.get("HostIndex", 0)),
+        num_hosts=int(topo.get("NumHosts", 1)),
+    )
+    chips: List[TpuChipInfo] = []
+    for dev in obj.get("Devices", []) or []:
+        chips.append(
+            TpuChipInfo(
+                id=dev.get("ID", ""),
+                model=dev.get("Model", ""),
+                path=dev.get("Path", ""),
+                index=int(dev.get("Index", 0)),
+                memory=MemoryInfo(global_bytes=int((dev.get("Memory") or {}).get("Global", 0))),
+                coords=tuple(dev.get("Coords", []) or []),
+            )
+        )
+    return TpusInfo(version=version, topology=topology, tpus=chips)
+
+
+def dump_tpus_info(info: TpusInfo) -> str:
+    """Encode to the wire format (used by fakes and the pure-python probe)."""
+    return json.dumps(
+        {
+            "Version": {"Runtime": info.version.runtime, "Libtpu": info.version.libtpu},
+            "Topology": {
+                "Type": info.topology.type,
+                "HostIndex": info.topology.host_index,
+                "NumHosts": info.topology.num_hosts,
+            },
+            "Devices": [
+                {
+                    "ID": c.id,
+                    "Model": c.model,
+                    "Path": c.path,
+                    "Index": c.index,
+                    "Memory": {"Global": c.memory.global_bytes},
+                    "Coords": list(c.coords),
+                }
+                for c in info.tpus
+            ],
+        }
+    )
+
+
+def get_devices(tpuinfo_path: Optional[str] = None, timeout: float = 30.0) -> TpusInfo:
+    """Exec the native probe and parse its JSON — the process boundary of
+    reference GetDevices (nvgputypes/types.go:45-58)."""
+    path = tpuinfo_path or default_tpuinfo_path()
+    output = subprocess.run(
+        [path, "json"], capture_output=True, timeout=timeout, check=True
+    ).stdout
+    return parse_tpus_info(output)

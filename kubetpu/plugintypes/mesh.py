@@ -23,6 +23,7 @@ The model:
 
 from __future__ import annotations
 
+import functools
 import itertools
 from dataclasses import dataclass
 from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
@@ -167,6 +168,7 @@ def internal_links(coords: Iterable[Coord], topo: TpuTopology) -> int:
     return links // 2  # each link counted from both endpoints
 
 
+@functools.lru_cache(maxsize=4096)
 def factorizations(n: int, ndims: int) -> List[Tuple[int, ...]]:
     """All dimension tuples with product *n*, most compact (near-square/cube)
     first — compactness = smaller sum of dims = more internal ICI links."""
@@ -206,9 +208,11 @@ def _fill_cells(n: int, fill_axis: int, cross: Sequence[int], ndims: int) -> Lis
     return cells
 
 
+@functools.lru_cache(maxsize=4096)
 def max_internal_links(n: int, topo: TpuTopology) -> int:
     """Best internal link count achievable by n chips in this topology —
-    the denominator of the contiguity score.
+    the denominator of the contiguity score. Pure in (n, topo) and on the
+    scheduling hot path, hence cached.
 
     Enumerates achievable compact packings (full cross-section slabs stacked
     along each axis, the last slab possibly partial) anchored at the origin
@@ -268,6 +272,40 @@ def enumerate_blocks(topo: TpuTopology, shape: Sequence[int]) -> List[List[Coord
     return out
 
 
+@functools.lru_cache(maxsize=4096)
+def _rect_offsets(shape: Tuple[int, ...]) -> Tuple[Coord, ...]:
+    return tuple(itertools.product(*(range(d) for d in shape)))
+
+
+def _place_rect(
+    free: Set[Coord], shape: Sequence[int], topo: TpuTopology
+) -> Optional[List[Coord]]:
+    """First free placement of a rectangular block (origins slide with
+    wraparound only on wrapping dimensions). Early-aborts per candidate on
+    the first non-free cell — this is the schedule-latency hot path."""
+    origins_per_dim: List[range] = []
+    for d, m, w in zip(shape, topo.mesh_shape, topo.wrap):
+        if d > m:
+            return None
+        origins_per_dim.append(range(m) if (w and d < m) else range(m - d + 1))
+    offsets = _rect_offsets(tuple(shape))
+    mesh = topo.mesh_shape
+    for origin in itertools.product(*origins_per_dim):
+        if origin not in free:  # the all-zero offset cell
+            continue
+        block: List[Coord] = []
+        ok = True
+        for off in offsets:
+            cell = tuple((o + f) % m for o, f, m in zip(origin, off, mesh))
+            if cell not in free:
+                ok = False
+                break
+            block.append(cell)
+        if ok:
+            return block
+    return None
+
+
 def find_contiguous_block(
     free: Set[Coord], n: int, topo: TpuTopology
 ) -> Optional[Tuple[List[Coord], float]]:
@@ -280,9 +318,9 @@ def find_contiguous_block(
     if len(free) < n:
         return None
     for shape in factorizations(n, len(topo.mesh_shape)):
-        for block in enumerate_blocks(topo, shape):
-            if all(c in free for c in block):
-                return sorted(block), contiguity_score(block, topo)
+        block = _place_rect(free, shape, topo)
+        if block is not None:
+            return sorted(block), contiguity_score(block, topo)
     # No exact rectangle free: greedy frontier growth from each free chip,
     # preferring candidates with the most already-chosen neighbors.
     best: Optional[List[Coord]] = None

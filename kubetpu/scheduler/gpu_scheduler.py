@@ -14,7 +14,11 @@ from kubetpu.api import utils
 from kubetpu.api.devicescheduler import DeviceScheduler, FitResult, PredicateFailureReason
 from kubetpu.api.types import DeviceGroupPrefix, NodeInfo, PodInfo
 from kubetpu.scheduler.deviceclass import GPU
-from kubetpu.scheduler.translate import translate_device_resources, translate_pod_device_resources
+from kubetpu.scheduler.translate import (
+    pod_device_count,
+    translate_device_resources,
+    translate_pod_device_resources,
+)
 from kubetpu.scheduler.treecache import NodeTreeCache, compute_tree_score
 
 # reference GPUTopologyGeneration (gpu_scheduler.go:12-15)
@@ -49,14 +53,12 @@ class GpuScheduler(DeviceScheduler):
         err, found = translate_pod_device_resources(GPU, self._cache, node_info, pod_info)
         if err is not None or not found:
             return False, [], 0.0
-        # Rank by this node's tree score so denser NVLink grouping wins ties
-        # (the reference returns 0.0 and lets the core's group scheduler
-        # decide, gpu_scheduler.go:34-44; kubetpu surfaces the score).
-        n = 0
-        for cont in pod_info.running_containers.values():
-            n += cont.requests.get(GPU.resource_name, 0)
-        for cont in pod_info.init_containers.values():
-            n = max(n, cont.requests.get(GPU.resource_name, 0))
+        n = pod_device_count(GPU, pod_info)
+        if n == 0:
+            # No GPUs requested: fit trivially, contribute nothing to the
+            # cross-scheduler score sum (a TPU pod's ranking must not be
+            # steered by NVLink tree density).
+            return True, [], 0.0
         free = node_info.allocatable.get(GPU.resource_name, 0)
         if free < n:
             reason = PredicateFailureReason(
@@ -66,6 +68,9 @@ class GpuScheduler(DeviceScheduler):
                 message="insufficient free GPUs",
             )
             return False, [reason], 0.0
+        # Rank by this node's tree score so denser NVLink grouping wins ties
+        # (the reference returns 0.0 and lets the core's group scheduler
+        # decide, gpu_scheduler.go:34-44; kubetpu surfaces the score).
         tree = self._cache.node_tree(node_info.name)
         score = compute_tree_score(tree) if tree is not None else 0.0
         return True, [], score

@@ -11,16 +11,19 @@ nodes and TPU nodes coexist (BASELINE config 5).
 
 from __future__ import annotations
 
-import threading
-from typing import List, Tuple
+from typing import Tuple
 
 from kubetpu.api import utils
 from kubetpu.api.devicescheduler import DeviceScheduler, FitResult, PredicateFailureReason
 from kubetpu.api.types import DeviceGroupPrefix, NodeInfo, PodInfo
 from kubetpu.plugintypes.mesh import find_contiguous_block
 from kubetpu.scheduler import meshstate
-from kubetpu.scheduler.deviceclass import TPU, DeviceClass
-from kubetpu.scheduler.translate import translate_device_resources, translate_pod_device_resources
+from kubetpu.scheduler.deviceclass import TPU
+from kubetpu.scheduler.translate import (
+    pod_device_count,
+    translate_device_resources,
+    translate_pod_device_resources,
+)
 from kubetpu.scheduler.treecache import NodeTreeCache
 
 # Per-pod auto-topology knob, rides the pod's Requests untouched (reference
@@ -28,23 +31,11 @@ from kubetpu.scheduler.treecache import NodeTreeCache
 TPUTopologyGeneration = TPU.topology_gen_key
 
 
-def pod_device_count(dc: DeviceClass, pod_info: PodInfo) -> int:
-    """Total devices a pod needs: running containers sum, init max
-    (reference ConvertToBestGPURequests counting, gpu.go:294-303)."""
-    num = 0
-    for cont in pod_info.running_containers.values():
-        num += cont.requests.get(dc.resource_name, cont.kube_requests.get(dc.resource_name, 0))
-    for cont in pod_info.init_containers.values():
-        num = max(num, cont.requests.get(dc.resource_name, cont.kube_requests.get(dc.resource_name, 0)))
-    return int(num)
-
-
 class TpuScheduler(DeviceScheduler):
     """DeviceScheduler for the TPU family with ICI-adjacency ranking."""
 
     def __init__(self) -> None:
         self._cache = NodeTreeCache(TPU.grp_prefix, "cards", levels=1)
-        self._lock = threading.Lock()
 
     # -- node lifecycle -----------------------------------------------------
 

@@ -21,6 +21,19 @@ from kubetpu.scheduler.topology_gen import convert_to_best_requests
 from kubetpu.scheduler.treecache import NodeTreeCache
 
 
+def pod_device_count(dc: DeviceClass, pod_info: PodInfo) -> int:
+    """Total devices a pod needs: running containers sum, init containers
+    max (reference ConvertToBestGPURequests counting, gpu.go:294-303).
+    Callers run this after ``set_device_reqs``, so ``requests`` already holds
+    the kube/device max-merge."""
+    num = 0
+    for cont in pod_info.running_containers.values():
+        num += cont.requests.get(dc.resource_name, 0)
+    for cont in pod_info.init_containers.values():
+        num = max(num, cont.requests.get(dc.resource_name, 0))
+    return int(num)
+
+
 def translate_device_resources(
     dc: DeviceClass,
     needed: int,

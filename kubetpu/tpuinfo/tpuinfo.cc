@@ -1,0 +1,296 @@
+// tpuinfo — native TPU hardware enumerator.
+//
+// The TPU analog of the reference's nvmlinfo binary
+// (nvidiagpuplugin/nvmlinfo/main.go): a short-lived native process that
+// probes local accelerator hardware and prints one JSON object on stdout,
+// isolating hardware-query code from the long-running Python node agent
+// behind the same exec-JSON process boundary the reference uses
+// (nvgputypes/types.go:45-58).
+//
+// Probe sources, in order:
+//   1. /dev/accel*    — TPU device nodes on a TPU-VM (count + paths)
+//   2. environment    — TPU_ACCELERATOR_TYPE (e.g. "v5litepod-8"),
+//                       TPU_WORKER_ID / TPU_HOST_INDEX (host index within a
+//                       multi-host slice); the libtpu runtime env contract
+//   3. /sys/class/accel*/... model names where present
+//
+// Chip torus coordinates are the fixed row-major bijection from (topology,
+// host index, local chip index) — the same model kubetpu's Python mesh layer
+// uses — so the probe needs no libtpu RPC to emit geometry.
+//
+// Modes:
+//   tpuinfo json                   probe hardware, print JSON
+//   tpuinfo --fake v5e-8 [opts]    print a canned topology (fixture mode,
+//                                  the analog of the reference's fake
+//                                  plugin JSON, nvidia_gpu_manager_test.go)
+//       opts: --host N     host index within the slice (default 0)
+//             --missing A,B simulate failed local chips
+//   tpuinfo                        human-readable device dump
+//
+// Wire schema (kubetpu/device/types.py parse_tpus_info):
+//   {"Version":{"Runtime":...,"Libtpu":...},
+//    "Topology":{"Type":...,"HostIndex":N,"NumHosts":N},
+//    "Devices":[{"ID":...,"Model":...,"Path":...,"Index":N,
+//                "Memory":{"Global":BYTES},"Coords":[x,y(,z)]}]}
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Topology {
+  const char* name;        // kubetpu topology name
+  const char* accel_type;  // GCE accelerator-type alias
+  int mesh[3];             // mesh shape (z==0 -> 2D)
+  int host[3];             // host block shape
+  long long hbm_bytes;     // HBM per chip
+  const char* model;
+};
+
+constexpr long long GiB = 1024LL * 1024 * 1024;
+
+// Mirrors kubetpu/plugintypes/mesh.py TOPOLOGIES (v5e hosts own a 2x4
+// block of 8 chips per SURVEY.md §7 step 2).
+const Topology kTopologies[] = {
+    {"v5e-1", "v5litepod-1", {1, 1, 0}, {1, 1, 0}, 16 * GiB, "TPU v5e"},
+    {"v5e-4", "v5litepod-4", {2, 2, 0}, {2, 2, 0}, 16 * GiB, "TPU v5e"},
+    {"v5e-8", "v5litepod-8", {2, 4, 0}, {2, 4, 0}, 16 * GiB, "TPU v5e"},
+    {"v5e-16", "v5litepod-16", {4, 4, 0}, {2, 4, 0}, 16 * GiB, "TPU v5e"},
+    {"v5e-32", "v5litepod-32", {4, 8, 0}, {2, 4, 0}, 16 * GiB, "TPU v5e"},
+    {"v5e-64", "v5litepod-64", {8, 8, 0}, {2, 4, 0}, 16 * GiB, "TPU v5e"},
+    {"v5e-128", "v5litepod-128", {8, 16, 0}, {2, 4, 0}, 16 * GiB, "TPU v5e"},
+    {"v5e-256", "v5litepod-256", {16, 16, 0}, {2, 4, 0}, 16 * GiB, "TPU v5e"},
+    {"v4-8", "v4-8", {2, 2, 2}, {2, 2, 1}, 32 * GiB, "TPU v4"},
+    {"v4-16", "v4-16", {2, 2, 4}, {2, 2, 1}, 32 * GiB, "TPU v4"},
+    {"v4-32", "v4-32", {2, 2, 8}, {2, 2, 1}, 32 * GiB, "TPU v4"},
+    {"v4-64", "v4-64", {4, 4, 4}, {2, 2, 1}, 32 * GiB, "TPU v4"},
+    {"v5p-8", "v5p-8", {2, 2, 2}, {2, 2, 1}, 95 * GiB, "TPU v5p"},
+};
+
+const Topology* FindTopology(const std::string& name) {
+  for (const auto& t : kTopologies) {
+    if (name == t.name || name == t.accel_type) return &t;
+  }
+  return nullptr;
+}
+
+int Dims(const Topology& t) { return t.mesh[2] == 0 ? 2 : 3; }
+
+int ChipsPerHost(const Topology& t) {
+  int n = 1;
+  for (int d = 0; d < Dims(t); d++) n *= t.host[d];
+  return n;
+}
+
+int NumHosts(const Topology& t) {
+  int n = 1;
+  for (int d = 0; d < Dims(t); d++) n *= t.mesh[d] / t.host[d];
+  return n;
+}
+
+// Global coords of local chip `idx` on host `host_index`: hosts tile the
+// mesh in row-major blocks; local ids are row-major within the block
+// (mesh.py TpuTopology.host_coords).
+void ChipCoords(const Topology& t, int host_index, int idx, int out[3]) {
+  int dims = Dims(t);
+  int hosts_per_dim[3], block[3], local[3];
+  for (int d = 0; d < dims; d++) hosts_per_dim[d] = t.mesh[d] / t.host[d];
+  for (int d = dims - 1; d >= 0; d--) {
+    block[d] = host_index % hosts_per_dim[d];
+    host_index /= hosts_per_dim[d];
+  }
+  for (int d = dims - 1; d >= 0; d--) {
+    local[d] = idx % t.host[d];
+    idx /= t.host[d];
+  }
+  for (int d = 0; d < dims; d++) out[d] = block[d] * t.host[d] + local[d];
+}
+
+struct Chip {
+  std::string id;
+  std::string path;
+  int index;
+  int coords[3];
+  int ndims;
+};
+
+struct ProbeResult {
+  const Topology* topo = nullptr;
+  int host_index = 0;
+  std::string runtime;
+  std::string libtpu;
+  std::vector<Chip> chips;
+};
+
+std::string EnvOr(const char* key, const char* fallback) {
+  const char* v = getenv(key);
+  return v ? std::string(v) : std::string(fallback);
+}
+
+// Enumerate /dev/accel<N> device nodes.
+std::vector<int> ScanAccelDevices() {
+  std::vector<int> found;
+  DIR* dir = opendir("/dev");
+  if (!dir) return found;
+  while (dirent* ent = readdir(dir)) {
+    if (strncmp(ent->d_name, "accel", 5) == 0) {
+      char* end = nullptr;
+      long idx = strtol(ent->d_name + 5, &end, 10);
+      if (end && *end == '\0') found.push_back(static_cast<int>(idx));
+    }
+  }
+  closedir(dir);
+  return found;
+}
+
+ProbeResult ProbeHardware() {
+  ProbeResult r;
+  std::string accel_type = EnvOr("TPU_ACCELERATOR_TYPE", "");
+  r.topo = FindTopology(accel_type);
+  r.host_index = atoi(EnvOr("TPU_HOST_INDEX", EnvOr("TPU_WORKER_ID", "0").c_str()).c_str());
+  r.runtime = EnvOr("TPU_RUNTIME_VERSION", "");
+  r.libtpu = EnvOr("TPU_LIBRARY_VERSION", "");
+
+  std::vector<int> devs = ScanAccelDevices();
+  if (r.topo == nullptr && !devs.empty()) {
+    // No accelerator-type env: infer a single-host topology from the count.
+    char guess[32];
+    snprintf(guess, sizeof(guess), "v5e-%zu", devs.size());
+    r.topo = FindTopology(guess);
+  }
+  for (int idx : devs) {
+    Chip c;
+    char buf[64];
+    snprintf(buf, sizeof(buf), "/dev/accel%d", idx);
+    c.path = buf;
+    c.index = idx;
+    if (r.topo) {
+      snprintf(buf, sizeof(buf), "TPU-%s-h%d-c%d", r.topo->name, r.host_index, idx);
+      c.id = buf;
+      c.ndims = Dims(*r.topo);
+      ChipCoords(*r.topo, r.host_index, idx, c.coords);
+    } else {
+      snprintf(buf, sizeof(buf), "TPU-unknown-c%d", idx);
+      c.id = buf;
+      c.ndims = 0;
+    }
+    r.chips.push_back(c);
+  }
+  return r;
+}
+
+ProbeResult FakeProbe(const std::string& topo_name, int host_index,
+                      const std::vector<int>& missing) {
+  ProbeResult r;
+  r.topo = FindTopology(topo_name);
+  if (!r.topo) {
+    fprintf(stderr, "tpuinfo: unknown topology %s\n", topo_name.c_str());
+    exit(2);
+  }
+  r.host_index = host_index;
+  r.runtime = "fake";
+  r.libtpu = "0.0.0-fake";
+  for (int i = 0; i < ChipsPerHost(*r.topo); i++) {
+    bool skip = false;
+    for (int m : missing)
+      if (m == i) skip = true;
+    if (skip) continue;
+    Chip c;
+    char buf[64];
+    snprintf(buf, sizeof(buf), "TPU-%s-h%d-c%d", r.topo->name, host_index, i);
+    c.id = buf;
+    snprintf(buf, sizeof(buf), "/dev/accel%d", i);
+    c.path = buf;
+    c.index = i;
+    c.ndims = Dims(*r.topo);
+    ChipCoords(*r.topo, host_index, i, c.coords);
+    r.chips.push_back(c);
+  }
+  return r;
+}
+
+void PrintJson(const ProbeResult& r) {
+  printf("{\"Version\":{\"Runtime\":\"%s\",\"Libtpu\":\"%s\"},", r.runtime.c_str(),
+         r.libtpu.c_str());
+  printf("\"Topology\":{\"Type\":\"%s\",\"HostIndex\":%d,\"NumHosts\":%d},",
+         r.topo ? r.topo->name : "", r.host_index, r.topo ? NumHosts(*r.topo) : 1);
+  printf("\"Devices\":[");
+  for (size_t i = 0; i < r.chips.size(); i++) {
+    const Chip& c = r.chips[i];
+    if (i) printf(",");
+    printf("{\"ID\":\"%s\",\"Model\":\"%s\",\"Path\":\"%s\",\"Index\":%d,", c.id.c_str(),
+           r.topo ? r.topo->model : "TPU", c.path.c_str(), c.index);
+    printf("\"Memory\":{\"Global\":%lld},", r.topo ? r.topo->hbm_bytes : 0LL);
+    printf("\"Coords\":[");
+    for (int d = 0; d < c.ndims; d++) {
+      if (d) printf(",");
+      printf("%d", c.coords[d]);
+    }
+    printf("]}");
+  }
+  printf("]}\n");
+}
+
+void PrintHuman(const ProbeResult& r) {
+  printf("Topology: %s host %d/%d\n", r.topo ? r.topo->name : "(unknown)", r.host_index,
+         r.topo ? NumHosts(*r.topo) : 1);
+  printf("Chips: %zu\n", r.chips.size());
+  for (const Chip& c : r.chips) {
+    printf("  [%d] %s %s coords=(", c.index, c.id.c_str(), c.path.c_str());
+    for (int d = 0; d < c.ndims; d++) printf(d ? ",%d" : "%d", c.coords[d]);
+    printf(")\n");
+  }
+}
+
+std::vector<int> ParseIntList(const std::string& s) {
+  std::vector<int> out;
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t comma = s.find(',', pos);
+    if (comma == std::string::npos) comma = s.size();
+    out.push_back(atoi(s.substr(pos, comma - pos).c_str()));
+    pos = comma + 1;
+  }
+  return out;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  bool json = false;
+  bool human = false;
+  std::string fake_topo;
+  int host_index = 0;
+  std::vector<int> missing;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    if (arg == "json") {
+      json = true;
+    } else if (arg == "--fake" && i + 1 < argc) {
+      fake_topo = argv[++i];
+      json = true;
+    } else if (arg == "--host" && i + 1 < argc) {
+      host_index = atoi(argv[++i]);
+    } else if (arg == "--missing" && i + 1 < argc) {
+      missing = ParseIntList(argv[++i]);
+    } else if (arg == "--human") {
+      human = true;
+    } else {
+      fprintf(stderr,
+              "usage: tpuinfo [json] [--fake TOPO [--host N] [--missing A,B]] [--human]\n");
+      return 2;
+    }
+  }
+
+  ProbeResult r =
+      fake_topo.empty() ? ProbeHardware() : FakeProbe(fake_topo, host_index, missing);
+  if (json && !human)
+    PrintJson(r);
+  else
+    PrintHuman(r);
+  return 0;
+}

@@ -1,0 +1,203 @@
+"""End-to-end tests of the core harness over the five BASELINE evaluation
+configs (BASELINE.md): fake-device managers -> advertisement -> scheduling ->
+group-scheduler fill -> accounting -> device allocation."""
+
+import pytest
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.core import Cluster, SchedulingError
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceGPU, ResourceTPU
+
+
+def tpu_pod(name, chips, **extra_requests):
+    return PodInfo(
+        name=name,
+        requests=dict(extra_requests),
+        running_containers={"main": ContainerInfo(requests={ResourceTPU: chips})},
+    )
+
+
+def v5e8_cluster(num_nodes=1):
+    cluster = Cluster()
+    for i in range(num_nodes):
+        mgr = new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+        cluster.register_node(f"v5e8-n{i}", device=mgr)
+    return cluster
+
+
+# -- config 1: single-pod 1-device request, fake-device mode ----------------
+
+
+def test_config1_single_device():
+    cluster = v5e8_cluster()
+    placed = cluster.schedule(tpu_pod("p1", 1))
+    assert placed.node_name == "v5e8-n0"
+    af = placed.running_containers["main"].allocate_from
+    assert len(af) == 1
+    results = cluster.allocate("p1")
+    mounts, devices, env = results["main"]
+    assert len(devices) == 1 and devices[0].startswith("/dev/accel")
+    assert env["TPU_VISIBLE_DEVICES"] == devices[0].removeprefix("/dev/accel")
+
+
+# -- config 2: 4-chip ICI-contiguous placement on one v5e-8 host ------------
+
+
+def test_config2_contiguous_quad():
+    cluster = v5e8_cluster()
+    cluster.schedule(tpu_pod("quad", 4))
+    _, devices, env = cluster.allocate("quad")["main"]
+    assert len(devices) == 4
+    # a 2x2 sub-slice, not a 1x4 line: bounding box 2,2,1
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+    node = cluster.nodes["v5e8-n0"].info
+    assert node.allocatable[ResourceTPU] == 4  # accounting took 4 chips
+
+
+def test_config2_flat_topology_knob():
+    # tpu/tpu-generate-topology=0 forces the flat (no auto-topology) path
+    # (reference knob semantics, gpu_scheduler.go:12-15).
+    cluster = v5e8_cluster()
+    pod = tpu_pod("flat", 4, **{"tpu/tpu-generate-topology": 0})
+    placed = cluster.schedule(pod)
+    assert len(placed.running_containers["main"].allocate_from) == 4
+
+
+def test_invalid_topology_knob_rejected():
+    cluster = v5e8_cluster()
+    pod = tpu_pod("bad", 2, **{"tpu/tpu-generate-topology": 7})
+    with pytest.raises(SchedulingError):
+        cluster.schedule(pod)
+
+
+# -- config 3: multi-pod bin-packing on one v5e-8 host ----------------------
+
+
+def test_config3_bin_packing():
+    cluster = v5e8_cluster()
+    for name, chips in [("a", 4), ("b", 2), ("c", 1), ("d", 1)]:
+        cluster.schedule(tpu_pod(name, chips))
+    node = cluster.nodes["v5e8-n0"].info
+    assert node.allocatable[ResourceTPU] == 0
+    # distinct chips across pods
+    used = set()
+    for pod in cluster.nodes["v5e8-n0"].pods.values():
+        for cont in pod.running_containers.values():
+            for to in cont.allocate_from.values():
+                assert to not in used
+                used.add(to)
+    assert len(used) == 8
+
+    with pytest.raises(SchedulingError):
+        cluster.schedule(tpu_pod("overflow", 1))
+
+    cluster.release("b")
+    assert cluster.nodes["v5e8-n0"].info.allocatable[ResourceTPU] == 2
+    cluster.schedule(tpu_pod("after-release", 2))
+
+
+def test_config3_two_nodes_prefers_contiguous():
+    cluster = v5e8_cluster(num_nodes=2)
+    cluster.schedule(tpu_pod("warm", 4))          # fills a 2x2 on n0
+    placed = cluster.schedule(tpu_pod("fresh", 8))  # whole host only fits n1
+    assert placed.node_name == "v5e8-n1"
+
+
+# -- config 4: gang-scheduled multi-host job (v5e-64, 8 hosts) --------------
+
+
+def v5e64_cluster():
+    cluster = Cluster()
+    for host in range(8):
+        mgr = new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=host))
+        cluster.register_node(f"v5e64-h{host}", device=mgr)
+    return cluster
+
+
+def test_config4_gang_all_hosts():
+    cluster = v5e64_cluster()
+    pods = [tpu_pod(f"w{i}", 8) for i in range(8)]
+    placed = cluster.schedule_gang(pods)
+    assert sorted(p.node_name for p in placed) == sorted(f"v5e64-h{i}" for i in range(8))
+    assert cluster.gang_contiguity(placed) == 1.0
+    # every worker got its own host's env
+    for p in placed:
+        _, devices, env = cluster.allocate(p.name)["main"]
+        assert len(devices) == 8
+        assert env["TPU_WORKER_ID"] == p.node_name.removeprefix("v5e64-h")
+
+
+def test_config4_two_host_gang_is_square():
+    # 2 hosts out of 8: geometric host selection must give a 4x4 square
+    # (two vertically-adjacent 2x4 blocks), not a 2x8 strip.
+    cluster = v5e64_cluster()
+    placed = cluster.schedule_gang([tpu_pod("w0", 8), tpu_pod("w1", 8)])
+    assert cluster.gang_contiguity(placed) == 1.0
+
+
+def test_config4_gang_all_or_nothing():
+    cluster = v5e64_cluster()
+    pods = [tpu_pod(f"w{i}", 8) for i in range(9)]  # 9 > 8 hosts
+    with pytest.raises(SchedulingError):
+        cluster.schedule_gang(pods)
+    # rollback left no residue
+    for node in cluster.nodes.values():
+        assert node.info.allocatable[ResourceTPU] == 8
+        assert not node.pods
+
+
+# -- config 5: heterogeneous GPU + TPU cluster ------------------------------
+
+
+def gpu_pod(name, gpus):
+    return PodInfo(
+        name=name,
+        running_containers={"main": ContainerInfo(requests={ResourceGPU: gpus})},
+    )
+
+
+def test_config5_heterogeneous():
+    from tests.test_device_nvidia import titan_box
+    from kubetpu.device.nvidia import new_fake_nvidia_gpu_manager
+
+    cluster = Cluster()
+    cluster.register_node(
+        "tpu-node", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    cluster.register_node(
+        "gpu-node", device=new_fake_nvidia_gpu_manager(titan_box(), "vol", "drv")
+    )
+
+    t = cluster.schedule(tpu_pod("tpujob", 4))
+    g = cluster.schedule(gpu_pod("gpujob", 4))
+    assert t.node_name == "tpu-node"
+    assert g.node_name == "gpu-node"
+
+    _, _, tenv = cluster.allocate("tpujob")["main"]
+    assert "TPU_VISIBLE_DEVICES" in tenv
+    _, _, genv = cluster.allocate("gpujob")["main"]
+    assert len(genv["NVIDIA_VISIBLE_DEVICES"].split(",")) == 4
+    # GPU fill respected NVLink grouping: 4 GPUs from one socket's groups
+    got = sorted(genv["NVIDIA_VISIBLE_DEVICES"].split(","))
+    assert got == [f"GPU{i:02d}" for i in range(4)] or got == [
+        f"GPU{i:02d}" for i in range(4, 8)
+    ]
+
+    assert cluster.nodes["gpu-node"].info.allocatable[ResourceGPU] == 4
+    assert cluster.nodes["tpu-node"].info.allocatable[ResourceTPU] == 4
+
+
+def test_init_containers_reuse_pool():
+    cluster = v5e8_cluster()
+    pod = PodInfo(
+        name="with-init",
+        init_containers={"init": ContainerInfo(requests={ResourceTPU: 2})},
+        running_containers={"main": ContainerInfo(requests={ResourceTPU: 4})},
+    )
+    placed = cluster.schedule(pod)
+    main_chips = set(placed.running_containers["main"].allocate_from.values())
+    init_chips = set(placed.init_containers["init"].allocate_from.values())
+    assert len(main_chips) == 4
+    assert init_chips <= main_chips  # init reuses the pod's pool
+    assert cluster.nodes["v5e8-n0"].info.allocatable[ResourceTPU] == 4

@@ -1,0 +1,130 @@
+"""NVIDIA manager tests — port of the reference's device-manager test
+scenarios (nvidia_gpu_manager_test.go:100-150) with programmatically-built
+fixtures: an 8-GPU two-socket box with a realistic P2P matrix (pairs on a
+single switch, socket-mates over hostbridge) and a 4-GPU cloud box with no
+topology. Expected grouping: grp0 = i/2, grp1 = i/4 for the 8-GPU box;
+degenerate per-GPU groups for the topology-less box (SURVEY.md §4 item 3)."""
+
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo
+from kubetpu.device.nvidia import new_fake_nvidia_gpu_manager
+from kubetpu.device.nvidia.types import (
+    GpuInfo,
+    GpusInfo,
+    MemoryInfo,
+    PciInfo,
+    TopologyInfo,
+    VersionInfo,
+)
+from kubetpu.plugintypes import ResourceGPU
+
+
+def titan_box():
+    """8 GPUs, 2 sockets of 4; within a socket: pairs at link 5 (single
+    switch), others at link 3 (hostbridge). No cross-socket links listed."""
+    bus = [f"0000:{i:02X}:00.0" for i in range(8)]
+    gpus = []
+    for i in range(8):
+        socket = i // 4
+        topo = []
+        for j in range(socket * 4, socket * 4 + 4):
+            if j == i:
+                continue
+            link = 5 if j // 2 == i // 2 else 3
+            topo.append(TopologyInfo(bus_id=bus[j], link=link))
+        gpus.append(
+            GpuInfo(
+                id=f"GPU{i:02d}",
+                model="Fake TITAN X",
+                path=f"/dev/nvidia{i}",
+                memory=MemoryInfo(global_mib=12238),
+                pci=PciInfo(bus_id=bus[i], bandwidth=15760),
+                topology=topo,
+            )
+        )
+    return GpusInfo(version=VersionInfo(driver="375.20", cuda="8.0"), gpus=gpus)
+
+
+def k80_box():
+    """4 GPUs, no P2P topology (cloud box)."""
+    gpus = [
+        GpuInfo(
+            id=f"K80-{i}",
+            model="Fake K80",
+            path=f"/dev/nvidia{i}",
+            memory=MemoryInfo(global_mib=11439),
+            pci=PciInfo(bus_id=f"{0x7000 + i:04X}:00:00.0", bandwidth=15760),
+            topology=[],
+        )
+        for i in range(4)
+    ]
+    return GpusInfo(version=VersionInfo(driver="384.111", cuda="9.0"), gpus=gpus)
+
+
+def test_titan_box_two_level_grouping():
+    info = titan_box()
+    mgr = new_fake_nvidia_gpu_manager(info, "vol", "drv")
+    node = NodeInfo(name="gpu-node")
+    mgr.update_node_info(node)
+
+    expected = {ResourceGPU: 8}
+    for i in range(8):
+        prefix = f"resource/group/gpugrp1/{i // 4}/gpugrp0/{i // 2}/gpu/GPU{i:02d}"
+        expected[prefix + "/cards"] = 1
+        expected[prefix + "/memory"] = 12238 * 1024 * 1024
+    assert node.capacity == expected
+    assert node.allocatable == expected
+
+
+def test_k80_box_degenerate_grouping():
+    info = k80_box()
+    mgr = new_fake_nvidia_gpu_manager(info, "vol", "drv")
+    node = NodeInfo(name="k80-node")
+    mgr.update_node_info(node)
+
+    expected = {ResourceGPU: 4}
+    for i in range(4):
+        prefix = f"resource/group/gpugrp1/{i}/gpugrp0/{i}/gpu/K80-{i}"
+        expected[prefix + "/cards"] = 1
+        expected[prefix + "/memory"] = 11439 * 1024 * 1024
+    assert node.capacity == expected
+
+
+def test_allocate_env_path():
+    info = titan_box()
+    mgr = new_fake_nvidia_gpu_manager(info, "vol", "drv")
+    mgr.start()
+    cont = ContainerInfo()
+    for frm, to in [(0, 2), (1, 5)]:
+        cont.allocate_from[f"resource/group/gpu/{frm}/cards"] = (
+            f"resource/group/gpugrp1/{to // 4}/gpugrp0/{to // 2}/gpu/GPU{to:02d}/cards"
+        )
+    _, _, env = mgr.allocate(PodInfo(name="p"), cont)
+    assert sorted(env["NVIDIA_VISIBLE_DEVICES"].split(",")) == ["GPU02", "GPU05"]
+
+
+def test_allocate_old_devices_and_control_nodes():
+    # Port of the reference TestAlloc's AllocateOld leg (alloc = {4:2, 3:0, 5:1}).
+    info = k80_box()
+    mgr = new_fake_nvidia_gpu_manager(info, "vol", "drv")
+    mgr.start()
+    cont = ContainerInfo()
+    alloc = {4: 2, 3: 0, 5: 1}
+    for frm, to in alloc.items():
+        cont.allocate_from[f"resource/group/gpu/{frm}/cards"] = (
+            f"resource/group/gpugrp1/{to}/gpugrp0/{to}/gpu/K80-{to}/cards"
+        )
+    _, devices, _ = mgr.allocate_old(PodInfo(name="TestPod"), cont)
+    expected = ["/dev/nvidiactl", "/dev/nvidia-uvm", "/dev/nvidia-uvm-tools"] + [
+        info.gpus[to].path for to in alloc.values()
+    ]
+    assert sorted(devices) == sorted(expected)
+
+
+def test_json_roundtrip_preserves_wire_format():
+    from kubetpu.device.nvidia.types import dump_gpus_info, parse_gpus_info
+
+    info = titan_box()
+    again = parse_gpus_info(dump_gpus_info(info))
+    assert [g.id for g in again.gpus] == [g.id for g in info.gpus]
+    assert again.gpus[0].topology[0].link == 5
+    assert again.version.driver == "375.20"

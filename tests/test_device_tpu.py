@@ -1,0 +1,117 @@
+"""TPU device manager tests — the fake-backend fixture strategy of the
+reference (nvidia_gpu_manager_test.go, SURVEY.md §4 item 3) applied to TPU:
+canned v5e topologies, no hardware."""
+
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceTPU
+from kubetpu.plugintypes.mesh import TOPOLOGIES
+
+
+def _expected_chip_prefix(i):
+    # v5e-8 host 2x4 tiles into two 2x2 blocks: block = y//2 for local
+    # row-major ids (0,1,4,5 -> block 0; 2,3,6,7 -> block 1).
+    topo = TOPOLOGIES["v5e-8"]
+    x, y = topo.host_coords(0)[i]
+    blk = (x // 2) * 2 + (y // 2)
+    return f"resource/group/tpugrp1/0/tpugrp0/{blk}/tpu/{i}"
+
+
+def test_update_node_info_advertises_v5e8():
+    info = make_fake_tpus_info("v5e-8")
+    mgr = new_fake_tpu_dev_manager(info)
+    node = NodeInfo(name="n0")
+    mgr.update_node_info(node)
+
+    hbm = TOPOLOGIES["v5e-8"].hbm_bytes_per_chip
+    expected = {ResourceTPU: 8, "resource/group/tpu-slice/v5e-8/0": 1}
+    for i in range(8):
+        expected[_expected_chip_prefix(i) + "/cards"] = 1
+        expected[_expected_chip_prefix(i) + "/memory"] = hbm
+    assert node.capacity == expected
+    assert node.allocatable == expected
+    assert node.kube_cap == {ResourceTPU: 8}
+    assert node.kube_alloc == {ResourceTPU: 8}
+
+
+def test_missing_chip_degrades_gracefully():
+    # chip 3 absent (failed device) -> 7 chips advertised, no chip-3 keys
+    # (the reference's disappearing-device contract, SURVEY.md §5.3).
+    info = make_fake_tpus_info("v5e-8", missing_chips=(3,))
+    mgr = new_fake_tpu_dev_manager(info)
+    node = NodeInfo(name="n0")
+    mgr.update_node_info(node)
+    assert node.capacity[ResourceTPU] == 7
+    assert not any("/tpu/3/" in k for k in node.capacity)
+
+
+def test_in_use_survives_rediscovery():
+    info = make_fake_tpus_info("v5e-8")
+    mgr = new_fake_tpu_dev_manager(info)
+    mgr.start()
+    some_id = next(iter(mgr.tpus))
+    mgr.tpus[some_id].in_use = True
+    mgr.update_tpu_info()  # re-probe (reference :142-145)
+    assert mgr.tpus[some_id].in_use
+
+
+def test_allocate_emits_devices_and_libtpu_env():
+    info = make_fake_tpus_info("v5e-8")
+    mgr = new_fake_tpu_dev_manager(info)
+    mgr.start()
+
+    cont = ContainerInfo()
+    # AllocateFrom: flat request key -> node's advertised chip key
+    for frm, to in [(0, 0), (1, 1), (2, 4), (3, 5)]:
+        cont.allocate_from[f"resource/group/tpu/{frm}/cards"] = (
+            _expected_chip_prefix(to) + "/cards"
+        )
+    mounts, devices, env = mgr.allocate(PodInfo(name="p"), cont)
+    assert devices == ["/dev/accel0", "/dev/accel1", "/dev/accel4", "/dev/accel5"]
+    assert env["TPU_VISIBLE_DEVICES"] == "0,1,4,5"
+    # chips (0,0),(0,1),(1,0),(1,1): a 2x2 sub-slice bounding box
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+    assert env["TPU_WORKER_ID"] == "0"
+
+
+def test_allocate_empty_allocate_from():
+    mgr = new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    mgr.start()
+    assert mgr.allocate(PodInfo(), ContainerInfo()) == ([], [], {})
+
+
+def test_multi_host_slice_host_index():
+    # host 3 of a v5e-64 slice advertises its own host index and global
+    # coordinates (the gang scheduler's global frame).
+    info = make_fake_tpus_info("v5e-64", host_index=3)
+    mgr = new_fake_tpu_dev_manager(info)
+    node = NodeInfo(name="host3")
+    mgr.update_node_info(node)
+    assert node.capacity["resource/group/tpu-slice/v5e-64/3"] == 1
+    assert node.capacity[ResourceTPU] == 8
+    assert any(k.startswith("resource/group/tpugrp1/3/") for k in node.capacity)
+    _, _, env = _alloc_all(mgr)
+    assert env["TPU_WORKER_ID"] == "3"
+
+
+def _alloc_all(mgr):
+    cont = ContainerInfo()
+    for chip in mgr.tpus.values():
+        cont.allocate_from[f"resource/group/tpu/{chip.index}/cards"] = (
+            "resource/group/" + chip.name + "/cards"
+        )
+    return mgr.allocate(PodInfo(name="p"), cont)
+
+
+def test_probe_failure_starts_with_zero_chips():
+    class BoomPlugin:
+        def get_tpu_info(self):
+            raise RuntimeError("libtpu exploded")
+
+    from kubetpu.device.tpu_manager import TpuDevManager
+
+    mgr = TpuDevManager(plugin=BoomPlugin())
+    mgr.new()
+    mgr.start()  # must not raise (reference Start, :185-188)
+    assert mgr.num_tpus == 0

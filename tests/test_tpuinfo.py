@@ -1,0 +1,101 @@
+"""Integration tests for the native tpuinfo probe: build the C++ binary,
+run it through the exec-JSON boundary (kubetpu.device.types.get_devices),
+and check it agrees with the in-process fake fixtures."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO, "_output", "tpuinfo")
+
+
+@pytest.fixture(scope="module")
+def tpuinfo_binary():
+    if not os.path.exists(BINARY):
+        if shutil.which("g++") is None:
+            pytest.skip("no g++ toolchain")
+        subprocess.run(["make", "-C", REPO, "tpuinfo"], check=True, capture_output=True)
+    return BINARY
+
+
+def test_fake_json_parses_and_matches_python_fixture(tpuinfo_binary):
+    from kubetpu.device import make_fake_tpus_info
+    from kubetpu.device.types import parse_tpus_info
+
+    out = subprocess.run(
+        [tpuinfo_binary, "--fake", "v5e-8"], capture_output=True, check=True
+    ).stdout
+    native = parse_tpus_info(out)
+    python = make_fake_tpus_info("v5e-8")
+    assert native.topology.type == python.topology.type == "v5e-8"
+    assert [c.coords for c in native.tpus] == [c.coords for c in python.tpus]
+    assert [c.path for c in native.tpus] == [c.path for c in python.tpus]
+    assert [c.id for c in native.tpus] == [c.id for c in python.tpus]
+    assert native.tpus[0].memory.global_bytes == 16 * 1024**3
+
+
+def test_fake_multi_host_and_missing(tpuinfo_binary):
+    from kubetpu.device.types import parse_tpus_info
+
+    out = subprocess.run(
+        [tpuinfo_binary, "--fake", "v5e-64", "--host", "3", "--missing", "2,5"],
+        capture_output=True,
+        check=True,
+    ).stdout
+    info = parse_tpus_info(out)
+    assert info.topology.host_index == 3
+    assert info.topology.num_hosts == 8
+    assert len(info.tpus) == 6
+    assert all(c.index not in (2, 5) for c in info.tpus)
+    # host 3 of an 8x8 mesh owns the block at origin (2, 4)
+    assert info.tpus[0].coords == (2, 4)
+
+
+def test_exec_boundary_via_client(tpuinfo_binary, monkeypatch, tmp_path):
+    """Drive get_devices() through a wrapper that makes the 'hardware' probe
+    deterministic: the binary in fake mode behind KUBETPU_TPUINFO_PATH."""
+    from kubetpu.device import types as tputypes
+
+    wrapper = tmp_path / "tpuinfo"
+    wrapper.write_text(f"#!/bin/sh\nexec {tpuinfo_binary} --fake v5e-4\n")
+    wrapper.chmod(0o755)
+    monkeypatch.setenv("KUBETPU_TPUINFO_PATH", str(wrapper))
+    info = tputypes.get_devices()
+    assert info.topology.type == "v5e-4"
+    assert len(info.tpus) == 4
+
+
+def test_manager_over_native_probe(tpuinfo_binary, monkeypatch, tmp_path):
+    """Full node-agent path over the real exec boundary: native probe ->
+    manager -> advertisement."""
+    from kubetpu.api.types import NodeInfo
+    from kubetpu.device.tpu_manager import TpuDevManager
+    from kubetpu.plugintypes import ResourceTPU
+
+    wrapper = tmp_path / "tpuinfo"
+    wrapper.write_text(f"#!/bin/sh\nexec {tpuinfo_binary} --fake v5e-8\n")
+    wrapper.chmod(0o755)
+    mgr = TpuDevManager(tpuinfo_path=str(wrapper))
+    mgr.new()
+    node = NodeInfo(name="n")
+    mgr.update_node_info(node)
+    assert node.capacity[ResourceTPU] == 8
+    assert node.capacity["resource/group/tpu-slice/v5e-8/0"] == 1
+
+
+def test_human_mode_runs(tpuinfo_binary):
+    out = subprocess.run(
+        [tpuinfo_binary, "--fake", "v5e-8", "--human"], capture_output=True, check=True
+    ).stdout.decode()
+    assert "Topology: v5e-8" in out and "/dev/accel0" in out
+
+
+def test_bad_topology_errors(tpuinfo_binary):
+    proc = subprocess.run(
+        [tpuinfo_binary, "--fake", "v9x-999"], capture_output=True
+    )
+    assert proc.returncode == 2
+    assert b"unknown topology" in proc.stderr
