@@ -1,0 +1,76 @@
+"""Allocation -> jax.sharding.Mesh: the bridge from kubetpu's scheduler to
+a running JAX job.
+
+The scheduler places a gang on an ICI-contiguous block of chips and the
+device manager exports the libtpu env (``TPU_VISIBLE_DEVICES``, bounds,
+worker id). Inside the job, this module turns that allocation into a device
+mesh whose axis order respects the physical torus: the tensor-parallel axis
+rides the innermost (fastest-varying, physically adjacent) chips, sequence
+parallelism the next ring, data parallelism the outermost — so the
+highest-bandwidth collectives map to nearest-neighbor ICI hops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from kubetpu.plugintypes.mesh import Coord
+
+DEFAULT_AXES = ("dp", "sp", "tp")
+
+
+def factor_axes(n_devices: int, axes: Sequence[str] = DEFAULT_AXES) -> Dict[str, int]:
+    """Split n devices over the mesh axes, balanced: prime factors assigned
+    round-robin starting at the innermost axis (tp gets the first factor so
+    its collectives ride adjacent chips). n=8 -> dp=2, sp=2, tp=2."""
+    sizes = {a: 1 for a in axes}
+    factors: List[int] = []
+    rest, d = n_devices, 2
+    while rest > 1:
+        while rest % d == 0:
+            factors.append(d)
+            rest //= d
+        d += 1
+    cycle = list(reversed(list(axes)))  # innermost first
+    for i, f in enumerate(factors):
+        sizes[cycle[i % len(cycle)]] *= f
+    return sizes
+
+
+def make_mesh(
+    axis_sizes: Dict[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    """Mesh over the first prod(sizes) local devices, row-major."""
+    names = tuple(axis_sizes)
+    shape = tuple(axis_sizes[a] for a in names)
+    n = int(np.prod(shape))
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices for mesh {axis_sizes}, have {len(devs)}")
+    return Mesh(np.array(devs[:n]).reshape(shape), names)
+
+
+def mesh_from_allocation(
+    coords: Sequence[Coord],
+    axis_sizes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh for a scheduled allocation (the coords the gang landed
+    on, e.g. from ``Cluster.allocate`` + meshstate), ordering devices so that
+    mesh-adjacent ranks are torus-adjacent chips: devices are laid out in
+    row-major order of their sorted coordinate block, and the innermost mesh
+    axis walks the innermost torus dimension."""
+    n = len(coords)
+    if axis_sizes is None:
+        axis_sizes = factor_axes(n)
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < n:
+        raise ValueError(f"allocation has {n} chips but only {len(devs)} devices visible")
+    ordered = [devs[i] for i in np.lexsort(np.array([list(c) for c in coords]).T[::-1])]
+    names = tuple(axis_sizes)
+    shape = tuple(axis_sizes[a] for a in names)
+    return Mesh(np.array(ordered).reshape(shape), names)

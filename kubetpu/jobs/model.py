@@ -1,0 +1,179 @@
+"""Flagship model: a decoder-only transformer, TPU-first.
+
+This is the demonstration workload of the framework: the thing kubetpu's
+scheduler *arranges hardware for* (the reference's analog is the NCCL jobs
+whose bandwidth its NVLink scoring proxies, SURVEY.md §2 "parallelism"
+note). Design choices are XLA/TPU-native, not ported from anywhere:
+
+- llama-style block: RMSNorm, rotary embeddings, SwiGLU MLP;
+- layer parameters are *stacked* on a leading axis and the forward pass is
+  one ``lax.scan`` over layers — a single traced block body, fast compiles,
+  and clean ``jax.checkpoint`` rematerialisation;
+- matmuls stay large and fused (einsum), bfloat16-friendly;
+- the attention core is pluggable so the sequence-parallel ring attention
+  (``kubetpu.jobs.ring_attention``) drops in under ``shard_map`` without
+  touching the model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+# attention core signature: (q, k, v) with shapes (B, S, H, D) -> (B, S, H, D)
+AttnFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.float32  # bfloat16 on TPU
+    remat: bool = False      # jax.checkpoint the scanned block
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Parameter pytree; per-layer tensors stacked on a leading L axis."""
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+    d, h, hd, f, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+
+    def norm(key, *shape):
+        return jax.random.normal(key, shape, cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    scale = d ** -0.5
+    params: Params = {
+        "embed": norm(k_embed, cfg.vocab, d) * scale,
+        "blocks": {
+            "ln1": jnp.ones((L, d), cfg.dtype),
+            "ln2": jnp.ones((L, d), cfg.dtype),
+            "wq": norm(ks[0], L, d, h, hd) * scale,
+            "wk": norm(ks[1], L, d, h, hd) * scale,
+            "wv": norm(ks[2], L, d, h, hd) * scale,
+            "wo": norm(ks[3], L, h, hd, d) * (h * hd) ** -0.5,
+            "w_gate": norm(ks[4], L, d, f) * scale,
+            "w_up": norm(ks[5], L, d, f) * scale,
+            "w_down": norm(ks[6], L, f, d) * f ** -0.5,
+        },
+        "ln_f": jnp.ones((d,), cfg.dtype),
+        "head": norm(k_out, d, cfg.vocab) * scale,
+    }
+    return params
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary position embedding. x: (B, S, H, D), positions: (S,) or (B, S)."""
+    d_half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., S, d_half)
+    if angles.ndim == 2:  # (S, d_half) -> broadcast over batch
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :d_half], x[..., d_half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def dense_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Reference attention core: full causal softmax. (B, S, H, D) in/out."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(
+    cfg: ModelConfig,
+    attn_fn: AttnFn,
+    positions: jnp.ndarray,
+    x: jnp.ndarray,
+    layer: Params,
+) -> jnp.ndarray:
+    """One transformer block (the lax.scan body)."""
+    h = rms_norm(x, layer["ln1"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    attn = attn_fn(q, k, v)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
+
+    h = rms_norm(x, layer["ln2"])
+    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+    x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
+    return x
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: ModelConfig,
+    attn_fn: Optional[AttnFn] = None,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Logits for next-token prediction. tokens: (B, S) int32 -> (B, S, V).
+
+    ``positions`` defaults to 0..S-1; sequence-parallel callers pass global
+    positions for their shard.
+    """
+    if attn_fn is None:
+        attn_fn = dense_causal_attention
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+
+    x = params["embed"][tokens]  # (B, S, D) gather rides the MXU-free path
+    body = partial(_block, cfg, attn_fn, positions)
+
+    def scan_body(carry, layer):
+        return body(carry, layer), None
+
+    if cfg.remat:
+        scan_body = jax.checkpoint(scan_body)
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+
+    x = rms_norm(x, params["ln_f"])
+    return jnp.einsum("bsd,dv->bsv", x, params["head"])
+
+
+def next_token_loss(
+    params: Params,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    cfg: ModelConfig,
+    attn_fn: Optional[AttnFn] = None,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Mean causal LM cross-entropy.
+
+    ``targets`` is ``tokens`` shifted by one (the data pipeline's job): with
+    the sequence axis sharded for sequence parallelism, an in-model
+    ``[:, 1:]`` shift would need a cross-shard halo exchange for nothing.
+    """
+    logits = forward(params, tokens, cfg, attn_fn, positions).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

@@ -1,0 +1,104 @@
+"""Ring attention: sequence-parallel causal attention over a device ring.
+
+Long-context first-class support: the sequence axis is sharded across the
+``sp`` mesh axis; each device holds one contiguous block of queries and
+rotates the key/value blocks around the ring with ``lax.ppermute`` (one ICI
+hop per step), accumulating a numerically-stable flash-style softmax
+(running max + normalizer). Peak activation memory per chip stays
+O(S/sp_size) while computing exact full causal attention — no approximation.
+
+This is the TPU-native shape of the idea (jax collectives over ICI inside
+``shard_map``), not a port: rotation is a static ``fori_loop`` of
+``sp_size`` steps so XLA overlaps each hop's ppermute with the current
+block's matmuls.
+
+Causal structure across blocks (device i holds global query block i):
+- source block j <  i : fully visible (no mask)
+- source block j == i : local causal mask
+- source block j >  i : fully masked (contributes nothing; with static
+  control flow we still run the matmul — uniform steps beat a data-dependent
+  branch on TPU)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Per-device body (runs under shard_map). q/k/v: (B, S_local, H, D)."""
+    sp_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = d ** -0.5
+
+    q32 = q.astype(jnp.float32)
+    local_pos = jnp.arange(s_local)
+
+    def step(t, carry):
+        o, m, l, k_blk, v_blk = carry
+        # after t rotations (shift +1 each step) we hold block (my_idx - t)
+        src_idx = (my_idx - t) % sp_size
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        # blockwise causal mask in global positions
+        q_pos = my_idx * s_local + local_pos
+        k_pos = src_idx * s_local + local_pos
+        mask = q_pos[:, None] >= k_pos[None, :]  # (S_local, S_local)
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))  # (B, H, Q)
+        # exp under explicit mask: avoids exp(NEG_INF - NEG_INF) = 1 garbage
+        # on blocks where nothing is visible yet
+        p = jnp.where(
+            mask[None, None], jnp.exp(scores - m_new[..., None]), 0.0
+        )
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+
+        # rotate k/v one hop around the ring (ICI neighbor exchange)
+        perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o_new, m_new, l_new, k_next, v_next
+
+    o = jnp.zeros((b, h, s_local, d), jnp.float32)
+    m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, sp_size, step, (o, m, l, k, v))
+
+    out = o / l[..., None]  # every query row sees at least itself (causal)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, S_local, H, D)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp", dp_axis: str = "dp",
+                        tp_axis: str = "tp"):
+    """An attention core (q, k, v) -> out with the sequence axis sharded over
+    *axis_name*, drop-in for ``model.forward``'s ``attn_fn``.
+
+    Specs: activations (B, S, H, D) are sharded (dp, sp, tp, -) — batch over
+    data parallelism, sequence over the ring, heads over tensor parallelism.
+    """
+    specs = P(dp_axis, axis_name, tp_axis, None)
+    local = partial(_ring_attention_local, axis_name=axis_name)
+    return jax.shard_map(
+        lambda q, k, v: local(q, k, v),
+        mesh=mesh,
+        in_specs=(specs, specs, specs),
+        out_specs=specs,
+        check_vma=False,
+    )

@@ -3,14 +3,20 @@
 JAX-dependent tests run on a virtual 8-device CPU mesh (multi-chip TPU
 hardware is unavailable in CI; sharding semantics are identical), so the env
 must be set before any ``import jax`` — hence here, at conftest import time.
+The environment may pin JAX to a hardware platform via a sitecustomize that
+updates jax.config directly, so the config is re-forced after import too.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
