@@ -1,0 +1,158 @@
+"""``schedsim`` — the benchmark driver over the BASELINE evaluation configs.
+
+Runs each of the five BASELINE.md configurations in fake-device mode against
+the real scheduling stack and prints per-config results (placement, latency
+percentiles, ICI-contiguity) as JSON lines. ``bench.py`` at the repo root is
+the single-headline-number version of config 4 scaled to v5e-256.
+
+    python -m kubetpu.cli.schedsim [--config N] [--rounds R]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.core import Cluster, SchedulingError
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceGPU, ResourceTPU
+
+
+def _tpu_pod(name, chips):
+    return PodInfo(name=name, running_containers={"main": ContainerInfo(requests={ResourceTPU: chips})})
+
+
+def _v5e8_cluster():
+    c = Cluster()
+    c.register_node("v5e8-n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")))
+    return c
+
+
+def config1():
+    """single-pod 1-device request (fake-device mode)"""
+    c = _v5e8_cluster()
+    t0 = time.perf_counter()
+    placed = c.schedule(_tpu_pod("p", 1))
+    ms = (time.perf_counter() - t0) * 1e3
+    return {"placed": placed.node_name, "latency_ms": round(ms, 3)}
+
+
+def config2():
+    """single-pod 4-chip, ICI-contiguous on one v5e-8 host"""
+    c = _v5e8_cluster()
+    placed = c.schedule(_tpu_pod("quad", 4))
+    _, _, env = c.allocate("quad")["main"]
+    return {
+        "placed": placed.node_name,
+        "bounds": env["TPU_CHIPS_PER_PROCESS_BOUNDS"],
+        "contiguity": c.gang_contiguity([placed]),
+    }
+
+
+def config3():
+    """multi-pod bin-packing on one v5e-8 host (mixed 1/2/4-chip pods)"""
+    c = _v5e8_cluster()
+    sizes = [4, 2, 1, 1]
+    for i, n in enumerate(sizes):
+        c.schedule(_tpu_pod(f"p{i}", n))
+    free = c.nodes["v5e8-n0"].info.allocatable[ResourceTPU]
+    return {"pods": len(sizes), "free_after": free, "packed": free == 0}
+
+
+def config4(rounds=5):
+    """gang-scheduled multi-host job (v5e-64, 8 hosts, all-or-nothing)"""
+    c = Cluster()
+    for h in range(8):
+        c.register_node(
+            f"h{h}", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=h))
+        )
+    lat = []
+    contig = None
+    for r in range(rounds):
+        pods = [_tpu_pod(f"r{r}w{i}", 8) for i in range(8)]
+        t0 = time.perf_counter()
+        placed = c.schedule_gang(pods)
+        lat.append((time.perf_counter() - t0) * 1e3)
+        contig = c.gang_contiguity(placed)
+        for p in placed:
+            c.release(p.name)
+    lat.sort()
+    return {
+        "gang_p50_ms": round(lat[len(lat) // 2], 3),
+        "contiguity": contig,
+        "all_or_nothing": _rollback_clean(c),
+    }
+
+
+def _rollback_clean(c: Cluster) -> bool:
+    pods = [_tpu_pod(f"x{i}", 8) for i in range(9)]  # 9 > 8 hosts
+    try:
+        c.schedule_gang(pods)
+        return False
+    except SchedulingError:
+        pass
+    return all(
+        n.info.allocatable[ResourceTPU] == 8 and not n.pods for n in c.nodes.values()
+    )
+
+
+def config5():
+    """heterogeneous cluster: mixed NVIDIA-GPU + TPU nodes"""
+    from kubetpu.device.nvidia import new_fake_nvidia_gpu_manager
+    from kubetpu.device.nvidia.types import (
+        GpuInfo, GpusInfo, MemoryInfo, PciInfo, TopologyInfo, VersionInfo,
+    )
+
+    bus = [f"0000:{i:02X}:00.0" for i in range(8)]
+    gpus = []
+    for i in range(8):
+        socket = i // 4
+        topo = [
+            TopologyInfo(bus_id=bus[j], link=5 if j // 2 == i // 2 else 3)
+            for j in range(socket * 4, socket * 4 + 4)
+            if j != i
+        ]
+        gpus.append(GpuInfo(id=f"GPU{i:02d}", model="Fake", path=f"/dev/nvidia{i}",
+                            memory=MemoryInfo(global_mib=12238),
+                            pci=PciInfo(bus_id=bus[i], bandwidth=15760), topology=topo))
+    info = GpusInfo(version=VersionInfo(driver="fake", cuda=""), gpus=gpus)
+
+    c = Cluster()
+    c.register_node("tpu-node", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")))
+    c.register_node("gpu-node", device=new_fake_nvidia_gpu_manager(info, "v", "d"))
+    t = c.schedule(_tpu_pod("tjob", 4))
+    g = c.schedule(PodInfo(name="gjob",
+                           running_containers={"main": ContainerInfo(requests={ResourceGPU: 4})}))
+    return {
+        "tpu_pod_on": t.node_name,
+        "gpu_pod_on": g.node_name,
+        "co_scheduled": t.node_name != g.node_name,
+    }
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="schedsim", description=__doc__)
+    ap.add_argument("--config", type=int, choices=sorted(CONFIGS), default=None)
+    ap.add_argument("--rounds", type=int, default=5)
+    args = ap.parse_args(argv)
+    selected = [args.config] if args.config else sorted(CONFIGS)
+    ok = True
+    for n in selected:
+        fn = CONFIGS[n]
+        try:
+            result = fn(args.rounds) if n == 4 else fn()
+            print(json.dumps({"config": n, "desc": fn.__doc__, **result}))
+        except Exception as e:  # noqa: BLE001
+            ok = False
+            print(json.dumps({"config": n, "error": str(e)}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""``tpudevs`` — device-plugin lifecycle smoke CLI.
+
+Analog of the reference's ``nvidiadevs`` (``nvidiagpuplugin/cmd/main.go``):
+``--plugin=false`` probes hardware directly through the exec-JSON client;
+``--plugin=true`` loads the device plugin module by its factory contract and
+drives the full New -> Start -> UpdateNodeInfo lifecycle, printing the
+resulting NodeInfo — doubling as the plugin-loading smoke test.
+
+    python -m kubetpu.cli.tpudevs [--plugin] [--plugin-path P] [--fake TOPO]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubetpu.api.device import create_device_from_plugin
+from kubetpu.api.types import new_node_info
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpudevs", description=__doc__)
+    ap.add_argument("--plugin", action="store_true",
+                    help="load the device plugin and drive the full lifecycle")
+    ap.add_argument("--plugin-path", default="kubetpu.device.plugin",
+                    help="device plugin module (dotted path or .py file)")
+    ap.add_argument("--fake", metavar="TOPO", default=None,
+                    help="use a fake backend with this topology (e.g. v5e-8)")
+    ap.add_argument("--host", type=int, default=0, help="fake host index")
+    args = ap.parse_args(argv)
+
+    if not args.plugin:
+        print("Not using plugin")
+        if args.fake:
+            from kubetpu.device import make_fake_tpus_info
+            from kubetpu.device.types import dump_tpus_info
+
+            print(dump_tpus_info(make_fake_tpus_info(args.fake, args.host)))
+            return 0
+        from kubetpu.device import types as tputypes
+
+        try:
+            info = tputypes.get_devices()
+        except Exception as e:  # noqa: BLE001
+            print(f"Err: {e} Devices: none")
+            return 1
+        print(f"Err: None Devices: {tputypes.dump_tpus_info(info)}")
+        return 0
+
+    print("Using plugin")
+    if args.fake:
+        from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+
+        dev = new_fake_tpu_dev_manager(make_fake_tpus_info(args.fake, args.host))
+    else:
+        dev = create_device_from_plugin(args.plugin_path)
+        dev.new()
+    dev.start()
+    node_info = new_node_info("local")
+    try:
+        dev.update_node_info(node_info)
+    except Exception as e:  # noqa: BLE001
+        print(f"UpdateNodeInfo encounters error {e}")
+        return 1
+    print("NodeInfo:")
+    print(json.dumps({
+        "name": node_info.name,
+        "capacity": node_info.capacity,
+        "allocatable": node_info.allocatable,
+        "kube_cap": node_info.kube_cap,
+        "kube_alloc": node_info.kube_alloc,
+    }, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
