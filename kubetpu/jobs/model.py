@@ -40,6 +40,7 @@ class ModelConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.float32  # bfloat16 on TPU
     remat: bool = False      # jax.checkpoint the scanned block
+    n_experts: int = 0       # 0 = dense SwiGLU; >0 = top-1 MoE in every block
 
     @property
     def head_dim(self) -> int:
@@ -56,19 +57,38 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
 
     ks = jax.random.split(k_layers, 7)
     scale = d ** -0.5
+    blocks: Params = {
+        "ln1": jnp.ones((L, d), cfg.dtype),
+        "ln2": jnp.ones((L, d), cfg.dtype),
+        "wq": norm(ks[0], L, d, h, hd) * scale,
+        "wk": norm(ks[1], L, d, h, hd) * scale,
+        "wv": norm(ks[2], L, d, h, hd) * scale,
+        "wo": norm(ks[3], L, h, hd, d) * (h * hd) ** -0.5,
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        # fold_in rather than widening the split: dense-model init stays
+        # bit-identical for a given seed whether or not MoE exists
+        k_router = jax.random.fold_in(k_layers, 7)
+        blocks.update(
+            {
+                "moe_router": norm(k_router, L, d, E) * scale,
+                "w_gate": norm(ks[4], L, E, d, f) * scale,
+                "w_up": norm(ks[5], L, E, d, f) * scale,
+                "w_down": norm(ks[6], L, E, f, d) * f ** -0.5,
+            }
+        )
+    else:
+        blocks.update(
+            {
+                "w_gate": norm(ks[4], L, d, f) * scale,
+                "w_up": norm(ks[5], L, d, f) * scale,
+                "w_down": norm(ks[6], L, f, d) * f ** -0.5,
+            }
+        )
     params: Params = {
         "embed": norm(k_embed, cfg.vocab, d) * scale,
-        "blocks": {
-            "ln1": jnp.ones((L, d), cfg.dtype),
-            "ln2": jnp.ones((L, d), cfg.dtype),
-            "wq": norm(ks[0], L, d, h, hd) * scale,
-            "wk": norm(ks[1], L, d, h, hd) * scale,
-            "wv": norm(ks[2], L, d, h, hd) * scale,
-            "wo": norm(ks[3], L, h, hd, d) * (h * hd) ** -0.5,
-            "w_gate": norm(ks[4], L, d, f) * scale,
-            "w_up": norm(ks[5], L, d, f) * scale,
-            "w_down": norm(ks[6], L, f, d) * f ** -0.5,
-        },
+        "blocks": blocks,
         "ln_f": jnp.ones((d,), cfg.dtype),
         "head": norm(k_out, d, cfg.vocab) * scale,
     }
@@ -122,10 +142,36 @@ def _block(
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
 
     h = rms_norm(x, layer["ln2"])
-    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
-    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
-    x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
+    if cfg.n_experts > 0:
+        x = x + _moe_mlp(h, layer)
+    else:
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+        x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
     return x
+
+
+def _moe_mlp(h: jnp.ndarray, layer: Params) -> jnp.ndarray:
+    """Top-1 mixture-of-experts SwiGLU with dense dispatch.
+
+    Dense dispatch (one-hot einsum instead of capacity-based all_to_all)
+    keeps the routing entirely in large einsums the MXU likes and lets
+    GSPMD shard the expert axis over ``ep`` with zero manual collectives;
+    the E-times activation cost is the standard demo trade-off — a
+    capacity-bucketed all_to_all dispatch is the production upgrade path.
+    Gradients reach the router through the top-1 probability weighting.
+    """
+    router = jnp.einsum("bsd,de->bse", h, layer["moe_router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(router, axis=-1)
+    top1 = jnp.argmax(probs, axis=-1)                        # (B, S)
+    one_hot = jax.nn.one_hot(top1, probs.shape[-1], dtype=h.dtype)  # (B, S, E)
+    weight = jnp.sum(probs * one_hot, axis=-1, keepdims=True).astype(h.dtype)
+
+    expert_in = jnp.einsum("bse,bsd->ebsd", one_hot, h)      # zeros off-route
+    gate = jax.nn.silu(jnp.einsum("ebsd,edf->ebsf", expert_in, layer["w_gate"]))
+    up = jnp.einsum("ebsd,edf->ebsf", expert_in, layer["w_up"])
+    out = jnp.einsum("ebsf,efd->ebsd", gate * up, layer["w_down"])
+    return jnp.einsum("ebsd,bse->bsd", out, one_hot) * weight
 
 
 def forward(
@@ -159,6 +205,14 @@ def forward(
     return jnp.einsum("bsd,dv->bsv", x, params["head"])
 
 
+def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean token-level cross-entropy in float32 — the shared loss tail of
+    the plain and pipelined training paths."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
 def next_token_loss(
     params: Params,
     tokens: jnp.ndarray,
@@ -173,7 +227,5 @@ def next_token_loss(
     the sequence axis sharded for sequence parallelism, an in-model
     ``[:, 1:]`` shift would need a cross-shard halo exchange for nothing.
     """
-    logits = forward(params, tokens, cfg, attn_fn, positions).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    logits = forward(params, tokens, cfg, attn_fn, positions)
+    return token_cross_entropy(logits, targets)

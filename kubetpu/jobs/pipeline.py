@@ -1,0 +1,186 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a ``pp``
+mesh axis, composing with dp/sp/tp/ep.
+
+TPU-native shape of the idea: the stacked layer axis of the model's
+parameters is sharded over ``pp`` (each stage holds ``n_layers / pp``
+contiguous blocks); a *partial-manual* ``shard_map`` runs the classic GPipe
+schedule — ``M + pp - 1`` uniform ticks, each tick computing one stage on
+one microbatch and rotating activations one ICI hop forward with
+``lax.ppermute``. Batch (dp) and heads/ff/experts (tp/ep) stay automatic
+GSPMD *inside* the manual region.
+
+Sequence parallelism composes by making the region manual over {pp, sp}
+jointly: nested shard_maps cannot rebind a parent's manual axes, so the
+ring-attention body runs *directly* inside the region (its ``sp``
+collectives bind the region's manual axis) and RoPE positions arrive as a
+``P('sp')``-sharded operand so each device rotates with its global
+positions.
+
+Uniform static control flow (a ``lax.fori_loop`` over ticks, bubble ticks
+included as masked work) is deliberate: TPUs want every device executing
+the same program; the (pp-1)/M bubble is the standard GPipe cost,
+amortized by more microbatches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubetpu.jobs import model as model_lib
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.ring_attention import _ring_attention_local
+from kubetpu.jobs.train import (
+    TrainState,
+    _filter_spec,
+    batch_spec,
+    init_state,
+    make_optimizer,
+    param_specs,
+)
+
+
+def _stage_forward(cfg: ModelConfig, attn_fn, positions, blocks_local, x):
+    """Run this stage's contiguous chunk of blocks (a lax.scan, as in the
+    non-pipelined forward)."""
+    body = partial(model_lib._block, cfg, attn_fn, positions)
+
+    def scan_body(carry, layer):
+        return body(carry, layer), None
+
+    if cfg.remat:
+        scan_body = jax.checkpoint(scan_body)
+    x, _ = jax.lax.scan(scan_body, x, blocks_local)
+    return x
+
+
+def make_pipeline_forward(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+    use_ring: bool = True,
+):
+    """(params, tokens (M*B, S)) -> logits (M*B, S, V) through the pipeline.
+
+    Embedding and head are replicated (cheap) and run outside the manual
+    region; only the block stack is staged.
+    """
+    axis_name, sp_axis = "pp", "sp"
+    manual_axes = {axis_name} | ({sp_axis} if use_ring else set())
+    seq_spec = sp_axis if use_ring else None
+
+    def region(blocks, h_stack, positions):
+        pp_size = jax.lax.psum(1, axis_name)
+        my_idx = jax.lax.axis_index(axis_name)
+        last = pp_size - 1
+        m, b, s, d = h_stack.shape  # s is the sp-local length under use_ring
+        ticks = n_microbatches + pp_size - 1
+        attn = (
+            partial(_ring_attention_local, axis_name=sp_axis)
+            if use_ring
+            else model_lib.dense_causal_attention
+        )
+        stage = partial(_stage_forward, cfg, attn, positions, blocks)
+
+        def tick(t, carry):
+            recv, out_stack = carry
+            mb_in = jnp.clip(t, 0, m - 1)
+            inject = jax.lax.dynamic_index_in_dim(h_stack, mb_in, 0, keepdims=False)
+            x_in = jnp.where(my_idx == 0, inject, recv)
+            y = stage(x_in)
+            # the last stage finishes microbatch t - (pp-1) on this tick
+            mb_out = jnp.clip(t - last, 0, m - 1)
+            valid = jnp.logical_and(my_idx == last, t >= last)
+            cur = jax.lax.dynamic_index_in_dim(out_stack, mb_out, 0, keepdims=False)
+            out_stack = jax.lax.dynamic_update_index_in_dim(
+                out_stack, jnp.where(valid, y, cur), mb_out, 0
+            )
+            # rotate activations one hop toward the next stage
+            perm = [(i, (i + 1) % pp_size) for i in range(pp_size)]
+            recv = jax.lax.ppermute(y, axis_name, perm)
+            return recv, out_stack
+
+        recv0 = jnp.zeros((b, s, d), h_stack.dtype)
+        out0 = jnp.zeros_like(h_stack)
+        _, out_stack = jax.lax.fori_loop(0, ticks, tick, (recv0, out0))
+        # only the last stage holds real outputs; psum over pp replicates
+        # them so the region's output is uniform across pp (out_spec None)
+        mask = (my_idx == last).astype(out_stack.dtype)
+        return jax.lax.psum(out_stack * mask, axis_name)
+
+    region_sm = jax.shard_map(
+        region,
+        mesh=mesh,
+        in_specs=(
+            _blocks_pp_specs(cfg),
+            P(None, None, seq_spec, None),
+            P(seq_spec),
+        ),
+        out_specs=P(None, None, seq_spec, None),
+        axis_names=manual_axes,
+        check_vma=False,
+    )
+
+    def forward(params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+        mb, seq = tokens.shape
+        assert mb % n_microbatches == 0, (mb, n_microbatches)
+        b = mb // n_microbatches
+        positions = jnp.arange(seq, dtype=jnp.int32)
+
+        h = params["embed"][tokens]                        # (M*B, S, D)
+        h_stack = h.reshape(n_microbatches, b, seq, -1)    # (M, B, S, D)
+        out_stack = region_sm(params["blocks"], h_stack, positions)
+
+        x = out_stack.reshape(mb, seq, -1)
+        x = model_lib.rms_norm(x, params["ln_f"])
+        return jnp.einsum("bsd,dv->bsv", x, params["head"])
+
+    return forward
+
+
+def _blocks_pp_specs(cfg: ModelConfig):
+    """In-specs for the block stack inside the manual region: only the
+    leading (layer, "pp") axis is manual; tp/ep shardings stay automatic."""
+    full = param_specs(cfg, pp=True)["blocks"]
+
+    def keep_pp(spec):
+        return P(*(a if a == "pp" else None for a in spec))
+
+    return jax.tree.map(keep_pp, full, is_leaf=lambda x: isinstance(x, P))
+
+
+def make_pipeline_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+    optimizer=None,
+    use_ring: bool = True,
+):
+    """Full pipelined training step: GPipe forward/backward + adamw."""
+    optimizer = optimizer or make_optimizer()
+    fwd = make_pipeline_forward(cfg, mesh, n_microbatches, use_ring=use_ring)
+
+    def loss_fn(params, tokens, targets):
+        return model_lib.token_cross_entropy(fwd(params, tokens), targets)
+
+    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+
+    def train_step(state: TrainState, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(new_params, new_opt, state.step + 1), loss
+
+    return jax.jit(train_step, in_shardings=(None, bspec, bspec), donate_argnums=(0,))
+
+
+def init_pipeline_state(
+    rng: jax.Array, cfg: ModelConfig, mesh: Mesh, optimizer=None
+) -> Tuple[TrainState, Any]:
+    """train.init_state with the layer axis sharded over pp."""
+    return init_state(rng, cfg, mesh, optimizer, pp=True)

@@ -85,20 +85,23 @@ def _ring_attention_local(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, S_local, H, D)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = "sp", dp_axis: str = "dp",
-                        tp_axis: str = "tp"):
+def make_ring_attention(mesh: "Mesh | None", axis_name: str = "sp"):
     """An attention core (q, k, v) -> out with the sequence axis sharded over
     *axis_name*, drop-in for ``model.forward``'s ``attn_fn``.
 
-    Specs: activations (B, S, H, D) are sharded (dp, sp, tp, -) — batch over
-    data parallelism, sequence over the ring, heads over tensor parallelism.
+    Partial-manual shard_map: only the ``sp`` axis is manual (the ring);
+    batch/head shardings over dp/tp stay automatic GSPMD inside the region,
+    so the same core composes under the plain GSPMD train step *and* inside
+    the pipeline's pp-manual region — pass ``mesh=None`` when nesting inside
+    another shard_map so the context (abstract) mesh is used.
     """
-    specs = P(dp_axis, axis_name, tp_axis, None)
+    specs = P(None, axis_name, None, None)
     local = partial(_ring_attention_local, axis_name=axis_name)
     return jax.shard_map(
         lambda q, k, v: local(q, k, v),
         mesh=mesh,
         in_specs=(specs, specs, specs),
         out_specs=specs,
+        axis_names={axis_name},
         check_vma=False,
     )

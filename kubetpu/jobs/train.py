@@ -28,23 +28,40 @@ from kubetpu.jobs.model import ModelConfig, Params
 from kubetpu.jobs.ring_attention import make_ring_attention
 
 
-def param_specs(cfg: ModelConfig) -> Params:
-    """PartitionSpec pytree matching init_params: heads/ff/vocab on tp."""
+def param_specs(cfg: ModelConfig, pp: bool = False) -> Params:
+    """PartitionSpec pytree matching init_params: heads/ff/vocab on tp,
+    experts on ep, and (when *pp*) the stacked layer axis on pp."""
+    L = "pp" if pp else None
+    blocks = {
+        "ln1": P(L, None),                  # (L, D)
+        "ln2": P(L, None),
+        "wq": P(L, None, "tp", None),       # (L, D, H, hd): heads on tp
+        "wk": P(L, None, "tp", None),
+        "wv": P(L, None, "tp", None),
+        "wo": P(L, "tp", None, None),       # (L, H, hd, D)
+    }
+    if cfg.n_experts > 0:
+        blocks.update(
+            {
+                "moe_router": P(L, None, None),      # (L, D, E)
+                "w_gate": P(L, "ep", None, "tp"),    # (L, E, D, F)
+                "w_up": P(L, "ep", None, "tp"),
+                "w_down": P(L, "ep", "tp", None),    # (L, E, F, D)
+            }
+        )
+    else:
+        blocks.update(
+            {
+                "w_gate": P(L, None, "tp"),          # (L, D, F): ff on tp
+                "w_up": P(L, None, "tp"),
+                "w_down": P(L, "tp", None),          # (L, F, D)
+            }
+        )
     return {
-        "embed": P(None, None),           # (V, D) replicated (small)
-        "blocks": {
-            "ln1": P(None, None),          # (L, D)
-            "ln2": P(None, None),
-            "wq": P(None, None, "tp", None),    # (L, D, H, hd): heads on tp
-            "wk": P(None, None, "tp", None),
-            "wv": P(None, None, "tp", None),
-            "wo": P(None, "tp", None, None),    # (L, H, hd, D)
-            "w_gate": P(None, None, "tp"),      # (L, D, F): ff on tp
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),      # (L, F, D)
-        },
+        "embed": P(None, None),             # (V, D) replicated (small)
+        "blocks": blocks,
         "ln_f": P(None),
-        "head": P(None, "tp"),             # (D, V): vocab on tp
+        "head": P(None, "tp"),              # (D, V): vocab on tp
     }
 
 
@@ -63,21 +80,30 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01):
     return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
 
 
+def _filter_spec(mesh: Mesh, spec: P) -> P:
+    """Drop axis names the mesh doesn't have (a dp x sp x tp mesh simply
+    replicates the ep/pp dimensions), so one spec table serves any mesh."""
+    names = set(mesh.axis_names)
+    return P(*((a if a in names else None) for a in spec))
+
+
 def _shardings(mesh: Mesh, tree):
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
+        lambda spec: NamedSharding(mesh, _filter_spec(mesh, spec)),
         tree,
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
 def init_state(
-    rng: jax.Array, cfg: ModelConfig, mesh: Mesh, optimizer=None
+    rng: jax.Array, cfg: ModelConfig, mesh: Mesh, optimizer=None, pp: bool = False
 ) -> Tuple[TrainState, Any]:
     """Initialize params/opt state directly into their shardings (jit with
-    out_shardings: no host-side full copy, params materialize sharded)."""
+    out_shardings: no host-side full copy, params materialize sharded).
+    ``pp=True`` additionally shards the stacked layer axis over the pp mesh
+    axis (the pipeline path)."""
     optimizer = optimizer or make_optimizer()
-    p_shardings = _shardings(mesh, param_specs(cfg))
+    p_shardings = _shardings(mesh, param_specs(cfg, pp=pp))
 
     @partial(jax.jit, out_shardings=p_shardings)
     def _init(rng):
@@ -104,7 +130,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None, use_ring: bool
     def loss_fn(params, tokens, targets):
         return model_lib.next_token_loss(params, tokens, targets, cfg, attn_fn)
 
-    bspec = NamedSharding(mesh, batch_spec())
+    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
 
     def train_step(state: TrainState, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets)
@@ -121,7 +147,7 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None, use_ring: bool
 
 def make_eval_step(cfg: ModelConfig, mesh: Mesh, use_ring: bool = True):
     attn_fn = make_ring_attention(mesh) if use_ring else None
-    bspec = NamedSharding(mesh, batch_spec())
+    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
 
     def eval_step(params, tokens, targets):
         return model_lib.next_token_loss(params, tokens, targets, cfg, attn_fn)
