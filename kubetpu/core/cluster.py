@@ -304,6 +304,37 @@ class Cluster:
             s: [n for _, n in sorted(members)] for s, members in sorted(slices.items())
         }
 
+    # -- failure handling / elastic recovery ---------------------------------
+
+    def fail_node(self, name: str) -> List[PodInfo]:
+        """Handle a node failure: deregister the node and return the pods it
+        was running, reset to schedulable form (placement artifacts
+        stripped), for rescheduling elsewhere.
+
+        The reference's failure story stops at graceful degradation inside
+        one node (probe failure -> zero devices, nvidia_gpu_manager.go:
+        191-197); cross-node recovery was the external core's job, so
+        kubetpu implements it: callers re-submit the returned pods via
+        ``schedule``/``schedule_gang`` (all state is reconstructable, there
+        is nothing else to clean up — SURVEY.md §5.3-5.4).
+        """
+        node = self.nodes.get(name)
+        if node is None:
+            return []
+        evicted: List[PodInfo] = []
+        for pod in node.pods.values():
+            fresh = pod.copy()
+            fresh.node_name = ""
+            for cont in list(fresh.init_containers.values()) + list(
+                fresh.running_containers.values()
+            ):
+                cont.allocate_from.clear()
+                cont.dev_requests.clear()
+            evicted.append(fresh)
+        self.remove_node(name)
+        utils.logf(0, "node %s failed; %d pods evicted for rescheduling", name, len(evicted))
+        return evicted
+
     # -- introspection ------------------------------------------------------
 
     def gang_contiguity(self, pods: Sequence[PodInfo]) -> float:

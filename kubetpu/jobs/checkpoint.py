@@ -1,0 +1,80 @@
+"""Checkpoint / resume for training jobs (orbax-backed, sharding-aware).
+
+The *scheduler* side of kubetpu is deliberately stateless and rebuilds from
+probes (the reference's contract, SURVEY.md §5.4); the *job* side is where
+durable state lives. Checkpoints restore directly into the target mesh's
+shardings — each host writes/reads only its shards (OCDBT), which is what
+makes resume-on-a-new-slice (after the gang scheduler re-places a job)
+practical.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from kubetpu.jobs.train import TrainState
+
+
+def save_checkpoint(path: str, state: TrainState) -> None:
+    """Write a TrainState to *path* (created if needed)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state)
+        ckptr.wait_until_finished()
+
+
+def restore_checkpoint(path: str, target: TrainState) -> TrainState:
+    """Restore into the structure/shardings of *target* (a freshly-built
+    state on the destination mesh — possibly a different slice than the one
+    that saved)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if hasattr(x, "sharding")
+            else x,
+            target,
+        )
+        restored = ckptr.restore(path, abstract)
+    # Pin every leaf to a committed mesh sharding. Freshly-initialized
+    # scalars (optimizer counts, step) are uncommitted single-device arrays
+    # that jit may re-place freely, but restored arrays come back committed —
+    # a committed single-device scalar then clashes with mesh-sharded params
+    # inside one jitted step. Replicate such leaves over the target's mesh.
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    meshes = [
+        leaf.sharding.mesh
+        for leaf in jax.tree.leaves(target)
+        if hasattr(leaf, "sharding") and isinstance(leaf.sharding, NamedSharding)
+    ]
+    mesh = meshes[0] if meshes else None
+
+    def pin(restored_leaf, target_leaf):
+        sharding = getattr(target_leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.device_put(restored_leaf, sharding)
+        if mesh is not None:
+            return jax.device_put(restored_leaf, NamedSharding(mesh, PartitionSpec()))
+        return restored_leaf
+
+    return jax.tree.map(pin, restored, target)
+
+
+def latest_step_dir(root: str) -> Optional[str]:
+    """Resume helper: the highest-numbered step directory under *root*
+    (layout: root/<step>/...)."""
+    if not os.path.isdir(root):
+        return None
+    steps = [d for d in os.listdir(root) if d.isdigit()]
+    if not steps:
+        return None
+    return os.path.join(root, max(steps, key=int))

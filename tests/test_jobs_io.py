@@ -1,0 +1,90 @@
+"""Checkpoint/restore and data-pipeline tests (virtual CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubetpu.jobs import ModelConfig, init_state, make_mesh, make_train_step
+from kubetpu.jobs.checkpoint import latest_step_dir, restore_checkpoint, save_checkpoint
+from kubetpu.jobs.data import SyntheticCorpus, prefetch_to_mesh
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+
+
+def test_checkpoint_roundtrip_preserves_state_and_shardings(tmp_path):
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    state, opt = init_state(jax.random.PRNGKey(0), CFG, mesh)
+    step = make_train_step(CFG, mesh, optimizer=opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, CFG.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    state, _ = step(state, tokens, targets)
+
+    ckpt = tmp_path / "ckpt" / "1"
+    save_checkpoint(str(ckpt), state)
+
+    # restore into a FRESH state on the mesh (resume-after-reschedule shape)
+    fresh, _ = init_state(jax.random.PRNGKey(42), CFG, mesh)
+    restored = restore_checkpoint(str(ckpt), fresh)
+    assert int(restored.step) == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["head"]), np.asarray(state.params["head"])
+    )
+    assert restored.params["blocks"]["wq"].sharding.spec[2] == "tp"
+    # training continues from the restored state
+    cont, loss = step(restored, tokens, targets)
+    assert jnp.isfinite(loss)
+    assert int(cont.step) == 2
+
+
+def test_latest_step_dir(tmp_path):
+    root = tmp_path / "ckpts"
+    assert latest_step_dir(str(root)) is None
+    for s in (1, 10, 2):
+        (root / str(s)).mkdir(parents=True)
+    assert latest_step_dir(str(root)).endswith("/10")
+
+
+def test_synthetic_corpus_deterministic_and_learnable():
+    c1 = SyntheticCorpus(vocab=64, seed=3)
+    c2 = SyntheticCorpus(vocab=64, seed=3)
+    b1 = next(c1.batches(2, 16, seed=7))
+    b2 = next(c2.batches(2, 16, seed=7))
+    np.testing.assert_array_equal(b1[0], b2[0])
+    np.testing.assert_array_equal(b1[1], b2[1])
+    # targets are the shifted tokens
+    tokens, targets = b1
+    np.testing.assert_array_equal(tokens[:, 1:], targets[:, :-1])
+
+
+def test_prefetch_shards_batches():
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    corpus = SyntheticCorpus(vocab=64)
+    it = prefetch_to_mesh(
+        iter([b for _, b in zip(range(4), corpus.batches(4, 32))]), mesh
+    )
+    out = list(it)
+    assert len(out) == 4
+    tokens, targets = out[0]
+    assert tokens.sharding.spec == ("dp", "sp")
+    assert tokens.shape == (4, 32)
+
+
+def test_end_to_end_training_on_corpus():
+    """Model learns the synthetic corpus' transition structure: loss drops
+    well below uniform (ln 64 ~ 4.16)."""
+    from kubetpu.jobs.train import make_optimizer
+
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    opt = make_optimizer(lr=5e-3)
+    state, opt = init_state(jax.random.PRNGKey(0), CFG, mesh, optimizer=opt)
+    step = make_train_step(CFG, mesh, optimizer=opt)
+    corpus = SyntheticCorpus(vocab=64)
+    losses = []
+    for tokens, targets in prefetch_to_mesh(
+        (b for _, b in zip(range(60), corpus.batches(8, 32))), mesh
+    ):
+        state, loss = step(state, tokens, targets)
+        losses.append(float(loss))
+    # uniform over 64 tokens is ln 64 ~ 4.16; the corpus' true entropy is
+    # ln 4 ~ 1.39 — learning the transition structure must beat 2.8
+    assert losses[-1] < 2.8 < losses[0]
