@@ -16,7 +16,7 @@ Axes:
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -114,18 +114,40 @@ def init_state(
     return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)), optimizer
 
 
-def make_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None, use_ring: bool = True):
+def _resolve_attention(mesh: Mesh, attention: str):
+    """Pick the attention core: 'ring' (sequence-parallel over sp), 'flash'
+    (the Pallas kernel — single-sequence-shard paths), or 'dense'."""
+    if attention == "ring":
+        return make_ring_attention(mesh)
+    if attention == "flash":
+        from kubetpu.ops import flash_attention
+
+        return partial(flash_attention, block_q=128, block_k=128)
+    if attention == "dense":
+        return None
+    raise ValueError(f"unknown attention {attention!r}")
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer=None,
+    use_ring: bool = True,
+    attention: Optional[str] = None,
+):
     """Build the jitted full training step: loss -> grads -> adamw update.
 
     Pass the optimizer returned by ``init_state`` — the opt_state was built
     by it, and a mismatched default here would silently apply the wrong
     hyperparameters. Donates the state buffers (in-place update on device).
-    The attention core is ring attention over ``sp`` unless
-    ``use_ring=False`` (then dense attention, with the sequence gathered by
-    XLA as needed).
+    ``attention``: 'ring' (default; sequence-parallel over sp), 'flash'
+    (Pallas kernel, for sp=1 meshes), or 'dense'; ``use_ring=False`` is the
+    legacy spelling of 'dense'.
     """
     optimizer = optimizer or make_optimizer()
-    attn_fn = make_ring_attention(mesh) if use_ring else None
+    if attention is None:
+        attention = "ring" if use_ring else "dense"
+    attn_fn = _resolve_attention(mesh, attention)
 
     def loss_fn(params, tokens, targets):
         return model_lib.next_token_loss(params, tokens, targets, cfg, attn_fn)

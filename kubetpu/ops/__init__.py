@@ -1,0 +1,5 @@
+"""TPU kernels (Pallas) for the hot ops."""
+
+from kubetpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,89 @@
+"""Pallas kernel tests (interpret mode on CPU; compiled path runs on real
+TPU via scripts/tpu_smoke.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubetpu.jobs.model import dense_causal_attention
+from kubetpu.ops import flash_attention
+
+
+def _qkv(b=2, s=128, h=4, d=32, dtype=jnp.float32, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in keys)
+
+
+def test_flash_matches_dense():
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, 64, 64, True)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_uneven_block_ratio():
+    # block_q != block_k exercises the diagonal arithmetic
+    q, k, v = _qkv(s=128)
+    out = flash_attention(q, k, v, 32, 64, True)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+    out = flash_attention(q, k, v, 64, 32, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_single_block():
+    q, k, v = _qkv(s=32)
+    out = flash_attention(q, k, v, 128, 128, True)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_gradients_match_dense():
+    q, k, v = _qkv(s=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, 32, 32, True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_causal_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4)
+
+
+def test_flash_in_model_forward():
+    import functools
+
+    from kubetpu.jobs import ModelConfig, forward, init_params
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab)
+    attn = functools.partial(flash_attention, block_q=32, block_k=32, interpret=True)
+    got = forward(params, tokens, cfg, attn_fn=attn)
+    want = forward(params, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_in_train_step():
+    """'flash' as the train-step attention on an sp=1 mesh (interpret mode
+    can't run under jit, so this exercises the compiled-path wiring only at
+    trace level via dense fallback on CPU is not possible — instead run the
+    uncompiled loss)."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from kubetpu.jobs import ModelConfig, init_params, next_token_loss
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    attn = functools.partial(flash_attention, block_q=32, block_k=32, interpret=True)
+    loss_flash = next_token_loss(params, tokens, targets, cfg, attn)
+    loss_dense = next_token_loss(params, tokens, targets, cfg)
+    np.testing.assert_allclose(float(loss_flash), float(loss_dense), rtol=1e-4)
