@@ -178,6 +178,13 @@ class Cluster:
         t0 = time.perf_counter()
         try:
             slices = self._tpu_slices()
+            tpu_gang = all(
+                any(
+                    cont.requests.get("kubedevice/tpu", 0) > 0
+                    for cont in pod.running_containers.values()
+                )
+                for pod in pods
+            ) and bool(pods)
             for slice_nodes in slices.values():
                 # Best case: assign pods to a *geometrically contiguous set of
                 # host blocks* (a 2-host gang on a v5e-64 should get two
@@ -194,7 +201,15 @@ class Cluster:
                     return self._try_gang(pods, lambda n: n in members)
                 except SchedulingError:
                     continue
-            # fall back: anywhere
+            if tpu_gang and slices:
+                # A TPU gang must live inside ONE physical slice: chips in
+                # different slices are connected over DCN, not ICI, and a
+                # silent straddle would wreck the job's collectives.
+                raise SchedulingError(
+                    f"gang of {len(pods)} pods does not fit within any single "
+                    f"TPU slice ({', '.join(slices)})"
+                )
+            # non-TPU gangs (or clusters without slice geometry): anywhere
             return self._try_gang(pods, None)
         finally:
             self.metrics.record("schedule_gang", time.perf_counter() - t0)

@@ -66,6 +66,7 @@ class TpuDevManager(Device):
         self.topology: Optional[TpuTopology] = None
         self.host_index = 0
         self.topology_name = ""
+        self.slice_uid = "slice0"
         self._info: Optional[tputypes.TpusInfo] = None
         self._last_probe_time = 0.0
 
@@ -106,6 +107,7 @@ class TpuDevManager(Device):
             self.topology = TOPOLOGIES.get(info.topology.type)
             self.topology_name = info.topology.type
             self.host_index = info.topology.host_index
+            self.slice_uid = info.topology.slice_id
 
             # mark-and-sweep: if num_tpus != len(tpus) afterwards, some chips
             # have gone missing (reference comment at :152-154).
@@ -162,7 +164,9 @@ class TpuDevManager(Device):
                 add_group_resource(reslist, chip.name + "/memory", chip.memory.global_bytes)
         if self.topology is not None:
             for reslist in (node_info.capacity, node_info.allocatable):
-                reslist[slice_resource_key(self.topology_name, self.host_index)] = 1
+                reslist[
+                    slice_resource_key(self.topology_name, self.host_index, self.slice_uid)
+                ] = 1
 
     # -- allocation ---------------------------------------------------------
 

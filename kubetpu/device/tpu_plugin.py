@@ -37,6 +37,7 @@ def make_fake_tpus_info(
     topology_name: str = "v5e-8",
     host_index: int = 0,
     missing_chips: tuple = (),
+    slice_uid: str = "slice0",
 ) -> tputypes.TpusInfo:
     """Build a realistic canned host: one chip per local index of the host's
     block, /dev/accel<i> paths, per-generation HBM — the TPU analog of the
@@ -62,7 +63,8 @@ def make_fake_tpus_info(
     return tputypes.TpusInfo(
         version=tputypes.VersionInfo(runtime="fake", libtpu="0.0.0-fake"),
         topology=tputypes.TopologyInfo(
-            type=topology_name, host_index=host_index, num_hosts=topo.num_hosts
+            type=topology_name, host_index=host_index, num_hosts=topo.num_hosts,
+            slice_id=slice_uid,
         ),
         tpus=chips,
     )

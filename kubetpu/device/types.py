@@ -70,6 +70,7 @@ class TopologyInfo:
     type: str = ""       # slice topology name, e.g. "v5e-8"
     host_index: int = 0  # this host's index within the slice
     num_hosts: int = 1
+    slice_id: str = "slice0"  # identity of the physical slice (DCN boundary)
 
 
 @dataclass
@@ -99,6 +100,7 @@ def parse_tpus_info(data: bytes | str) -> TpusInfo:
         type=topo.get("Type", ""),
         host_index=int(topo.get("HostIndex", 0)),
         num_hosts=int(topo.get("NumHosts", 1)),
+        slice_id=topo.get("SliceId", "slice0") or "slice0",
     )
     chips: List[TpuChipInfo] = []
     for dev in obj.get("Devices", []) or []:
@@ -124,6 +126,7 @@ def dump_tpus_info(info: TpusInfo) -> str:
                 "Type": info.topology.type,
                 "HostIndex": info.topology.host_index,
                 "NumHosts": info.topology.num_hosts,
+                "SliceId": info.topology.slice_id,
             },
             "Devices": [
                 {

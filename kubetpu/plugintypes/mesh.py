@@ -105,23 +105,29 @@ class TpuTopology:
         ranges = [range(o, o + h) for o, h in zip(origin, self.host_shape)]
         return [c for c in itertools.product(*ranges)]
 
-    def neighbors(self, coord: Coord) -> List[Coord]:
+    def neighbors(self, coord: Coord) -> Tuple[Coord, ...]:
         """ICI neighbors of a chip (±1 per dimension, wrapping where the
-        torus wraps)."""
-        out: List[Coord] = []
-        for dim, (c, d, w) in enumerate(zip(coord, self.mesh_shape, self.wrap)):
-            for delta in (-1, 1):
-                nc = c + delta
-                if w:
-                    nc %= d
-                elif nc < 0 or nc >= d:
-                    continue
-                if d == 1:
-                    continue
-                n = list(coord)
-                n[dim] = nc
-                out.append(tuple(n))
-        return out
+        torus wraps). Cached — pure in (topology, coord) and called per
+        chip inside the scheduling hot path's contiguity scoring."""
+        return _neighbors_cached(self, coord)
+
+
+@functools.lru_cache(maxsize=65536)
+def _neighbors_cached(topo: "TpuTopology", coord: Coord) -> Tuple[Coord, ...]:
+    out: List[Coord] = []
+    for dim, (c, d, w) in enumerate(zip(coord, topo.mesh_shape, topo.wrap)):
+        for delta in (-1, 1):
+            nc = c + delta
+            if w:
+                nc %= d
+            elif nc < 0 or nc >= d:
+                continue
+            if d == 1:
+                continue
+            n = list(coord)
+            n[dim] = nc
+            out.append(tuple(n))
+    return tuple(out)
 
 
 def _mk(name: str, gen: str, shape: Tuple[int, ...], host: Tuple[int, ...],

@@ -22,17 +22,34 @@ from typing import Dict, Optional, Set
 from kubetpu.api.types import DeviceGroupPrefix, ResourceList
 from kubetpu.plugintypes.mesh import TOPOLOGIES, Coord, TpuTopology
 
-# resource/group/tpu-slice/<topology-name>/<host-index>
+# resource/group/tpu-slice/<topology-name>/<slice-uid>/<host-index>
+# (legacy 3-segment form without the slice uid is accepted: a cluster with a
+# single anonymous slice per topology)
 SLICE_KEY_RE = re.compile(
-    re.escape(DeviceGroupPrefix) + r"/tpu-slice/([^/]+)/(\d+)$"
+    re.escape(DeviceGroupPrefix) + r"/tpu-slice/([^/]+)(?:/([^/]+))?/(\d+)$"
 )
 # any grouped per-chip cards key: .../tpu/<localid>/cards
 CHIP_CARDS_RE = re.compile(r".*/tpu/(\d+)/cards$")
 
+DEFAULT_SLICE_UID = "slice0"
 
-def slice_resource_key(topology_name: str, host_index: int) -> str:
-    """The geometry advertisement key for a host of a slice."""
-    return DeviceGroupPrefix + "/tpu-slice/" + topology_name + "/" + str(host_index)
+
+def slice_resource_key(
+    topology_name: str, host_index: int, slice_uid: str = DEFAULT_SLICE_UID
+) -> str:
+    """The geometry advertisement key for a host of a slice. The slice uid
+    distinguishes physically distinct slices of the same topology type —
+    chips in different slices are connected over DCN, not ICI, and must
+    never be treated as torus-adjacent."""
+    return (
+        DeviceGroupPrefix
+        + "/tpu-slice/"
+        + topology_name
+        + "/"
+        + slice_uid
+        + "/"
+        + str(host_index)
+    )
 
 
 @dataclass
@@ -45,10 +62,13 @@ class NodeMeshState:
     coord_chip: Dict[Coord, int]   # inverse
     chip_key: Dict[int, str]       # local chip id -> advertised cards key
     free: Set[Coord]               # coords whose cards key is allocatable
+    slice_uid: str = DEFAULT_SLICE_UID
 
     @property
     def slice_name(self) -> str:
-        return self.topo.name
+        """Identity of the physical slice this host belongs to: hosts share
+        a torus frame iff both topology type and slice uid match."""
+        return self.topo.name + "/" + self.slice_uid
 
 
 def parse_mesh_state(node_resources: ResourceList) -> Optional[NodeMeshState]:
@@ -56,11 +76,14 @@ def parse_mesh_state(node_resources: ResourceList) -> Optional[NodeMeshState]:
     ResourceList; None if the node advertises no TPU slice."""
     topo: Optional[TpuTopology] = None
     host_index = 0
+    slice_uid = DEFAULT_SLICE_UID
     for key in node_resources:
         m = SLICE_KEY_RE.match(key)
         if m:
             topo = TOPOLOGIES.get(m.group(1))
-            host_index = int(m.group(2))
+            if m.group(2) is not None:
+                slice_uid = m.group(2)
+            host_index = int(m.group(3))
             break
     if topo is None:
         return None
@@ -86,4 +109,5 @@ def parse_mesh_state(node_resources: ResourceList) -> Optional[NodeMeshState]:
         coord_chip=coord_chip,
         chip_key=chip_key,
         free=free,
+        slice_uid=slice_uid,
     )

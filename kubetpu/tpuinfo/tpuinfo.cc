@@ -121,6 +121,7 @@ struct Chip {
 struct ProbeResult {
   const Topology* topo = nullptr;
   int host_index = 0;
+  std::string slice_id = "slice0";  // physical slice identity (DCN boundary)
   std::string runtime;
   std::string libtpu;
   std::vector<Chip> chips;
@@ -152,6 +153,7 @@ ProbeResult ProbeHardware() {
   std::string accel_type = EnvOr("TPU_ACCELERATOR_TYPE", "");
   r.topo = FindTopology(accel_type);
   r.host_index = atoi(EnvOr("TPU_HOST_INDEX", EnvOr("TPU_WORKER_ID", "0").c_str()).c_str());
+  r.slice_id = EnvOr("TPU_SLICE_ID", "slice0");
   r.runtime = EnvOr("TPU_RUNTIME_VERSION", "");
   r.libtpu = EnvOr("TPU_LIBRARY_VERSION", "");
 
@@ -184,7 +186,7 @@ ProbeResult ProbeHardware() {
 }
 
 ProbeResult FakeProbe(const std::string& topo_name, int host_index,
-                      const std::vector<int>& missing) {
+                      const std::string& slice_id, const std::vector<int>& missing) {
   ProbeResult r;
   r.topo = FindTopology(topo_name);
   if (!r.topo) {
@@ -192,6 +194,7 @@ ProbeResult FakeProbe(const std::string& topo_name, int host_index,
     exit(2);
   }
   r.host_index = host_index;
+  r.slice_id = slice_id;
   r.runtime = "fake";
   r.libtpu = "0.0.0-fake";
   for (int i = 0; i < ChipsPerHost(*r.topo); i++) {
@@ -216,8 +219,9 @@ ProbeResult FakeProbe(const std::string& topo_name, int host_index,
 void PrintJson(const ProbeResult& r) {
   printf("{\"Version\":{\"Runtime\":\"%s\",\"Libtpu\":\"%s\"},", r.runtime.c_str(),
          r.libtpu.c_str());
-  printf("\"Topology\":{\"Type\":\"%s\",\"HostIndex\":%d,\"NumHosts\":%d},",
-         r.topo ? r.topo->name : "", r.host_index, r.topo ? NumHosts(*r.topo) : 1);
+  printf("\"Topology\":{\"Type\":\"%s\",\"HostIndex\":%d,\"NumHosts\":%d,\"SliceId\":\"%s\"},",
+         r.topo ? r.topo->name : "", r.host_index, r.topo ? NumHosts(*r.topo) : 1,
+         r.slice_id.c_str());
   printf("\"Devices\":[");
   for (size_t i = 0; i < r.chips.size(); i++) {
     const Chip& c = r.chips[i];
@@ -264,6 +268,7 @@ int main(int argc, char** argv) {
   bool json = false;
   bool human = false;
   std::string fake_topo;
+  std::string slice_id = "slice0";
   int host_index = 0;
   std::vector<int> missing;
   for (int i = 1; i < argc; i++) {
@@ -275,19 +280,23 @@ int main(int argc, char** argv) {
       json = true;
     } else if (arg == "--host" && i + 1 < argc) {
       host_index = atoi(argv[++i]);
+    } else if (arg == "--slice" && i + 1 < argc) {
+      slice_id = argv[++i];
     } else if (arg == "--missing" && i + 1 < argc) {
       missing = ParseIntList(argv[++i]);
     } else if (arg == "--human") {
       human = true;
     } else {
       fprintf(stderr,
-              "usage: tpuinfo [json] [--fake TOPO [--host N] [--missing A,B]] [--human]\n");
+              "usage: tpuinfo [json] [--fake TOPO [--host N] [--slice ID] [--missing A,B]] "
+              "[--human]\n");
       return 2;
     }
   }
 
-  ProbeResult r =
-      fake_topo.empty() ? ProbeHardware() : FakeProbe(fake_topo, host_index, missing);
+  ProbeResult r = fake_topo.empty()
+                      ? ProbeHardware()
+                      : FakeProbe(fake_topo, host_index, slice_id, missing);
   if (json && !human)
     PrintJson(r);
   else

@@ -201,3 +201,34 @@ def test_init_containers_reuse_pool():
     assert len(main_chips) == 4
     assert init_chips <= main_chips  # init reuses the pod's pool
     assert cluster.nodes["v5e8-n0"].info.allocatable[ResourceTPU] == 4
+
+
+def test_two_physical_slices_not_conflated():
+    """Two distinct v5e-64 slices (different slice uids): a gang must land
+    entirely within ONE physical slice — chips across slices are DCN, not
+    ICI, and must never count as adjacent."""
+    cluster = Cluster()
+    for h in range(4):
+        cluster.register_node(
+            f"a{h}",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-64", host_index=h, slice_uid="podA")
+            ),
+        )
+        cluster.register_node(
+            f"b{h}",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-64", host_index=h, slice_uid="podB")
+            ),
+        )
+    placed = cluster.schedule_gang([tpu_pod(f"w{i}", 8) for i in range(4)])
+    slices = {p.node_name[0] for p in placed}
+    assert len(slices) == 1  # all four workers in one physical slice
+    assert cluster.gang_contiguity(placed) == 1.0
+
+    # a 5-host gang cannot fit either 4-host slice: all-or-nothing fails
+    # rather than silently straddling DCN
+    for p in placed:
+        cluster.release(p.name)
+    with pytest.raises(SchedulingError):
+        cluster.schedule_gang([tpu_pod(f"x{i}", 8) for i in range(5)])

@@ -24,7 +24,7 @@ def test_update_node_info_advertises_v5e8():
     mgr.update_node_info(node)
 
     hbm = TOPOLOGIES["v5e-8"].hbm_bytes_per_chip
-    expected = {ResourceTPU: 8, "resource/group/tpu-slice/v5e-8/0": 1}
+    expected = {ResourceTPU: 8, "resource/group/tpu-slice/v5e-8/slice0/0": 1}
     for i in range(8):
         expected[_expected_chip_prefix(i) + "/cards"] = 1
         expected[_expected_chip_prefix(i) + "/memory"] = hbm
@@ -88,7 +88,7 @@ def test_multi_host_slice_host_index():
     mgr = new_fake_tpu_dev_manager(info)
     node = NodeInfo(name="host3")
     mgr.update_node_info(node)
-    assert node.capacity["resource/group/tpu-slice/v5e-64/3"] == 1
+    assert node.capacity["resource/group/tpu-slice/v5e-64/slice0/3"] == 1
     assert node.capacity[ResourceTPU] == 8
     assert any(k.startswith("resource/group/tpugrp1/3/") for k in node.capacity)
     _, _, env = _alloc_all(mgr)

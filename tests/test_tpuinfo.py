@@ -83,7 +83,7 @@ def test_manager_over_native_probe(tpuinfo_binary, monkeypatch, tmp_path):
     node = NodeInfo(name="n")
     mgr.update_node_info(node)
     assert node.capacity[ResourceTPU] == 8
-    assert node.capacity["resource/group/tpu-slice/v5e-8/0"] == 1
+    assert node.capacity["resource/group/tpu-slice/v5e-8/slice0/0"] == 1
 
 
 def test_human_mode_runs(tpuinfo_binary):
