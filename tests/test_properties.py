@@ -1,0 +1,78 @@
+"""Property-based tests (hypothesis) for the load-bearing invariants:
+the translation grammar (any advertised node shape must accept any
+satisfiable request) and the mesh contiguity score bounds."""
+
+from hypothesis import given, settings, strategies as st
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.core import Cluster, SchedulingError
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceTPU
+from kubetpu.plugintypes.mesh import TOPOLOGIES, contiguity_score, find_contiguous_block
+
+TOPO_NAMES = ["v5e-4", "v5e-8", "v5e-16", "v4-8"]
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    topo_name=st.sampled_from(TOPO_NAMES),
+    taken=st.sets(st.integers(min_value=0, max_value=7), max_size=8),
+    n=st.integers(min_value=0, max_value=16),
+)
+def test_find_block_respects_free_set_and_score_bounds(topo_name, taken, n):
+    topo = TOPOLOGIES[topo_name]
+    all_coords = set(topo.coords())
+    taken_coords = {topo.index_coord(i % topo.num_chips) for i in taken}
+    free = all_coords - taken_coords
+    got = find_contiguous_block(set(free), n, topo)
+    if n > len(free):
+        assert got is None
+        return
+    assert got is not None
+    coords, score = got
+    assert len(coords) == n
+    assert len(set(coords)) == n          # no duplicates
+    assert set(coords) <= free            # never places on taken chips
+    assert 0.0 <= score <= 1.0
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    sizes=st.lists(st.integers(min_value=1, max_value=8), min_size=1, max_size=6),
+)
+def test_scheduler_accepts_any_satisfiable_sequence(sizes):
+    """Any sequence of pod sizes whose running total fits the host must all
+    schedule; the first overflowing pod must raise — the grammar/fill path
+    can never wedge in between."""
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    free = 8
+    for i, n in enumerate(sizes):
+        pod = PodInfo(
+            name=f"p{i}",
+            running_containers={"m": ContainerInfo(requests={ResourceTPU: n})},
+        )
+        if n <= free:
+            placed = cluster.schedule(pod)
+            assert len(placed.running_containers["m"].allocate_from) == n
+            free -= n
+        else:
+            try:
+                cluster.schedule(pod)
+                assert False, f"pod of {n} chips fit with only {free} free"
+            except SchedulingError:
+                pass
+    assert cluster.nodes["n0"].info.allocatable[ResourceTPU] == free
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    chips=st.sets(st.integers(min_value=0, max_value=15), min_size=1, max_size=16),
+)
+def test_contiguity_score_bounds_any_subset(chips):
+    topo = TOPOLOGIES["v5e-16"]
+    coords = {topo.index_coord(i) for i in chips}
+    s = contiguity_score(coords, topo)
+    assert 0.0 <= s <= 1.0
