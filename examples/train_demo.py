@@ -29,7 +29,6 @@ from kubetpu.api.types import ContainerInfo, PodInfo  # noqa: E402
 from kubetpu.core import Cluster  # noqa: E402
 from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager  # noqa: E402
 from kubetpu.plugintypes import ResourceTPU  # noqa: E402
-from kubetpu.scheduler import meshstate  # noqa: E402
 
 
 def pod(name, chips):
@@ -41,14 +40,8 @@ def pod(name, chips):
 
 def allocation_coords(cluster, placed):
     """The torus coordinates a placed pod's chips landed on."""
-    node = cluster.nodes[placed.node_name]
-    state = meshstate.parse_mesh_state(node.info.capacity)
-    coords = []
-    for to_key in placed.running_containers["main"].allocate_from.values():
-        m = meshstate.CHIP_CARDS_RE.match(to_key)
-        if m:
-            coords.append(state.chip_coord[int(m.group(1))])
-    return sorted(coords)
+    _topo, coords = cluster.pod_chip_coords(placed)
+    return coords
 
 
 def main():

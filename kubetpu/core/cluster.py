@@ -352,24 +352,67 @@ class Cluster:
 
     # -- introspection ------------------------------------------------------
 
+    def status(self) -> Dict[str, object]:
+        """Operator-facing snapshot: per-node free/total devices and pods,
+        per-slice free chips, and scheduling latency percentiles."""
+        nodes = {}
+        for name in utils.sorted_string_keys(self.nodes):
+            node = self.nodes[name]
+            state = meshstate.parse_mesh_state(node.info.allocatable)
+            entry: Dict[str, object] = {
+                "pods": sorted(node.pods),
+            }
+            from kubetpu.plugintypes import ResourceGPU, ResourceTPU
+
+            for scalar in (ResourceTPU, ResourceGPU):
+                if scalar in node.info.capacity:
+                    entry[scalar] = {
+                        "free": node.info.allocatable.get(scalar, 0),
+                        "total": node.info.capacity.get(scalar, 0),
+                    }
+            if state is not None:
+                entry["slice"] = state.slice_name
+                entry["host_index"] = state.host_index
+                entry["free_chips"] = len(state.free)
+            nodes[name] = entry
+        slices: Dict[str, int] = {}
+        for entry in nodes.values():
+            if "slice" in entry:
+                slices[entry["slice"]] = slices.get(entry["slice"], 0) + entry["free_chips"]
+        return {
+            "nodes": nodes,
+            "slices_free_chips": slices,
+            "latency": self.metrics.summary(),
+        }
+
+    def pod_chip_coords(self, pod: PodInfo):
+        """The global torus coordinates of a placed pod's chips (and the
+        slice topology) — the bridge input for ``jobs.mesh_from_allocation``."""
+        node = self.nodes[pod.node_name]
+        state = meshstate.parse_mesh_state(node.info.capacity)
+        if state is None:
+            return None, []
+        coords = []
+        for cont in pod.running_containers.values():
+            for to_key in cont.allocate_from.values():
+                m = meshstate.CHIP_CARDS_RE.match(to_key)
+                if m:
+                    local = int(m.group(1))
+                    if local in state.chip_coord:
+                        coords.append(state.chip_coord[local])
+        return state.topo, sorted(coords)
+
     def gang_contiguity(self, pods: Sequence[PodInfo]) -> float:
         """ICI-contiguity of the union of a placed gang's chips in the global
         slice frame — the BASELINE 'ICI-contiguity score' metric."""
         coords = []
         topo = None
         for pod in pods:
-            node = self.nodes[pod.node_name]
-            state = meshstate.parse_mesh_state(node.info.capacity)
-            if state is None:
+            pod_topo, pod_coords = self.pod_chip_coords(pod)
+            if pod_topo is None:
                 continue
-            topo = state.topo
-            for cont in pod.running_containers.values():
-                for to_key in cont.allocate_from.values():
-                    m = meshstate.CHIP_CARDS_RE.match(to_key)
-                    if m:
-                        local = int(m.group(1))
-                        if local in state.chip_coord:
-                            coords.append(state.chip_coord[local])
+            topo = pod_topo
+            coords.extend(pod_coords)
         if topo is None or not coords:
             return 0.0
         from kubetpu.plugintypes.mesh import contiguity_score

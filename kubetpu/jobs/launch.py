@@ -1,0 +1,104 @@
+"""Multi-host job launch wiring: from the scheduler's allocation to a
+``jax.distributed`` process group.
+
+The device manager injects the libtpu env contract per container
+(``TPU_VISIBLE_DEVICES``, ``TPU_WORKER_ID``, bounds — SURVEY.md §5.8); this
+module is the *inside-the-container* counterpart that turns a gang's
+allocations into the JAX runtime configuration for a multi-host slice:
+process index = worker id = host index, process count = gang size, chips
+per process from the bounds, coordinator = gang rank 0. Collectives between
+these processes ride ICI because the gang scheduler placed the hosts on a
+contiguous host-block of one slice.
+
+On single-host (or in tests) ``launch_config`` still produces a coherent
+config; ``initialize_distributed`` is a no-op when the gang is one process.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class LaunchConfig:
+    """Everything jax.distributed.initialize needs for one gang worker."""
+
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    local_device_ids: List[int]
+
+    def initialize_kwargs(self) -> Dict[str, object]:
+        return {
+            "coordinator_address": self.coordinator_address,
+            "num_processes": self.num_processes,
+            "process_id": self.process_id,
+            "local_device_ids": self.local_device_ids,
+        }
+
+
+def launch_config(
+    env: Mapping[str, str],
+    gang_hosts: Sequence[str],
+    rank: Optional[int] = None,
+    coordinator_port: int = 8476,
+) -> LaunchConfig:
+    """Build a worker's LaunchConfig from its injected container env and the
+    gang's host list (ordered by gang rank — the order schedule_gang placed
+    them).
+
+    ``rank`` is the worker's position within the gang and is what
+    jax.distributed requires (process_id must lie in [0, num_processes)).
+    It defaults to the env's TPU_WORKER_ID, which equals the gang rank only
+    when the gang spans a full slice in host order — a partial-slice gang
+    (e.g. hosts {0, 2}) MUST pass the explicit rank.
+    """
+    if not gang_hosts:
+        raise ValueError("gang_hosts must name at least the coordinator host")
+    process_id = int(env.get("TPU_WORKER_ID", "0")) if rank is None else rank
+    if not 0 <= process_id < len(gang_hosts):
+        raise ValueError(
+            f"process_id {process_id} outside [0, {len(gang_hosts)}); pass the "
+            "gang rank explicitly for partial-slice gangs"
+        )
+    visible = env.get("TPU_VISIBLE_DEVICES", "")
+    local_device_ids = [int(x) for x in visible.split(",") if x != ""]
+    return LaunchConfig(
+        coordinator_address=f"{gang_hosts[0]}:{coordinator_port}",
+        num_processes=len(gang_hosts),
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def gang_launch_configs(
+    cluster, placed_pods, coordinator_port: int = 8476
+) -> List[LaunchConfig]:
+    """One LaunchConfig per gang worker, from a ``schedule_gang`` result:
+    runs each pod's container allocation and assembles the process group.
+    Gang rank = position in the placed list (NOT the host's worker id —
+    a partial-slice gang's host indices are not contiguous)."""
+    hosts = [p.node_name for p in placed_pods]
+    configs: List[LaunchConfig] = []
+    for rank, pod in enumerate(placed_pods):
+        results = cluster.allocate(pod.name)
+        # the TPU-bearing container's env carries the device visibility; a
+        # pod may also have init/sidecar containers with empty allocations
+        env: Mapping[str, str] = {}
+        for _, _, cand in results.values():
+            if cand.get("TPU_VISIBLE_DEVICES"):
+                env = cand
+                break
+        configs.append(launch_config(env, hosts, rank=rank, coordinator_port=coordinator_port))
+    return configs
+
+
+def initialize_distributed(config: Optional[LaunchConfig]) -> None:
+    """Call jax.distributed.initialize for a multi-process gang; no-op for
+    single-process jobs (the local backend already owns all chips)."""
+    if config is None or config.num_processes <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(**config.initialize_kwargs())

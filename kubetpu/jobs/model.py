@@ -41,6 +41,11 @@ class ModelConfig:
     dtype: Any = jnp.float32  # bfloat16 on TPU
     remat: bool = False      # jax.checkpoint the scanned block
     n_experts: int = 0       # 0 = dense SwiGLU; >0 = top-1 MoE in every block
+    # 0.0 = dense one-hot dispatch (demo path: E-times activations, zero
+    # collectives); > 0 = capacity-based dispatch (production path: each
+    # expert processes at most capacity_factor*N/E tokens, XLA inserts the
+    # all_to_all over ep; overflowing tokens fall through on the residual)
+    moe_capacity_factor: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -142,13 +147,59 @@ def _block(
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
 
     h = rms_norm(x, layer["ln2"])
-    if cfg.n_experts > 0:
+    if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
+        x = x + _moe_mlp_capacity(h, layer, cfg.moe_capacity_factor)
+    elif cfg.n_experts > 0:
         x = x + _moe_mlp(h, layer)
     else:
         gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
         up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
         x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
     return x
+
+
+def _moe_mlp_capacity(h: jnp.ndarray, layer: Params, capacity_factor: float) -> jnp.ndarray:
+    """Top-1 mixture-of-experts with capacity-based dispatch — the
+    production path.
+
+    Tokens are assigned a slot inside their chosen expert's capacity buffer
+    (position = running count of earlier tokens routed to that expert); the
+    dispatch einsum gathers at most ``C = capacity_factor * N / E`` tokens
+    per expert into an (E, C, D) buffer, experts run on their buffers only
+    (total expert FLOPs ~ N*D*F instead of the dense path's E*N*D*F), and
+    the combine einsum scatters results back. With experts sharded over
+    ``ep`` XLA turns dispatch/combine into the all_to_all pair. Tokens past
+    capacity are dropped — they ride the residual connection (standard
+    switch-transformer semantics).
+    """
+    b, s, d = h.shape
+    n = b * s
+    e = layer["moe_router"].shape[-1]
+    tokens = h.reshape(n, d)
+
+    router = (tokens @ layer["moe_router"]).astype(jnp.float32)   # (N, E)
+    probs = jax.nn.softmax(router, axis=-1)
+    top1 = jnp.argmax(probs, axis=-1)                             # (N,)
+    gate_w = jnp.max(probs, axis=-1).astype(h.dtype)              # (N,)
+    onehot = jax.nn.one_hot(top1, e, dtype=jnp.float32)           # (N, E)
+
+    capacity = max(1, int(capacity_factor * n / e))
+    # slot of each token within its expert (0-based); tokens beyond the
+    # expert's capacity are masked out of the dispatch entirely
+    position = jnp.cumsum(onehot, axis=0) * onehot                # 1-based
+    keep = (position <= capacity).astype(jnp.float32) * onehot
+    slot_onehot = jax.nn.one_hot(
+        (position - 1.0).astype(jnp.int32), capacity, dtype=jnp.float32
+    )                                                             # (N, E, C)
+    dispatch = (keep[..., None] * slot_onehot).astype(h.dtype)    # (N, E, C)
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)       # (E, C, D)
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"])
+    out = jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"])  # (E, C, D)
+
+    combined = jnp.einsum("nec,ecd->nd", dispatch, out) * gate_w[:, None]
+    return combined.reshape(b, s, d)
 
 
 def _moe_mlp(h: jnp.ndarray, layer: Params) -> jnp.ndarray:

@@ -1,0 +1,99 @@
+"""CLI smoke tests (tpudevs, schedsim) + multi-host launch wiring +
+cluster status observability."""
+
+import json
+import subprocess
+import sys
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.core import Cluster
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceTPU
+
+
+def _run(args):
+    return subprocess.run(
+        [sys.executable, "-m"] + args, capture_output=True, text=True, timeout=120
+    )
+
+
+def test_tpudevs_plugin_fake():
+    proc = _run(["kubetpu.cli.tpudevs", "--plugin", "--fake", "v5e-8"])
+    assert proc.returncode == 0
+    assert "Using plugin" in proc.stdout
+    body = proc.stdout[proc.stdout.index("{"):]
+    node = json.loads(body)
+    assert node["capacity"]["kubedevice/tpu"] == 8
+    assert "resource/group/tpu-slice/v5e-8/slice0/0" in node["capacity"]
+
+
+def test_tpudevs_direct_fake():
+    proc = _run(["kubetpu.cli.tpudevs", "--fake", "v5e-4"])
+    assert proc.returncode == 0
+    info = json.loads(proc.stdout[proc.stdout.index("{"):])
+    assert len(info["Devices"]) == 4
+
+
+def test_schedsim_all_configs():
+    proc = _run(["kubetpu.cli.schedsim", "--rounds", "2"])
+    assert proc.returncode == 0
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    assert [l["config"] for l in lines] == [1, 2, 3, 4, 5]
+    by_cfg = {l["config"]: l for l in lines}
+    assert by_cfg[2]["contiguity"] == 1.0
+    assert by_cfg[3]["packed"] is True
+    assert by_cfg[4]["all_or_nothing"] is True
+    assert by_cfg[5]["co_scheduled"] is True
+
+
+def _gang_cluster():
+    cluster = Cluster()
+    for h in range(4):
+        cluster.register_node(
+            f"host{h}",
+            device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=h)),
+        )
+    return cluster
+
+
+def test_gang_launch_configs():
+    from kubetpu.jobs.launch import gang_launch_configs
+
+    cluster = _gang_cluster()
+    pods = [
+        PodInfo(name=f"w{i}", running_containers={"m": ContainerInfo(requests={ResourceTPU: 8})})
+        for i in range(2)
+    ]
+    placed = cluster.schedule_gang(pods)
+    configs = gang_launch_configs(cluster, placed)
+    assert len(configs) == 2
+    assert configs[0].num_processes == 2
+    # coordinator = rank-0 worker's host; every config agrees
+    assert {c.coordinator_address for c in configs} == {placed[0].node_name + ":8476"}
+    # process ids are gang ranks in [0, n) — NOT host indices (a 2-host gang
+    # may land on hosts {0, 2} for a square chip region)
+    assert [c.process_id for c in configs] == [0, 1]
+    assert all(c.local_device_ids == list(range(8)) for c in configs)
+
+
+def test_initialize_distributed_noop_single():
+    from kubetpu.jobs.launch import LaunchConfig, initialize_distributed
+
+    initialize_distributed(None)
+    initialize_distributed(
+        LaunchConfig("x:1", num_processes=1, process_id=0, local_device_ids=[0])
+    )  # must not try to contact a coordinator
+
+
+def test_cluster_status_snapshot():
+    cluster = _gang_cluster()
+    cluster.schedule(
+        PodInfo(name="p", running_containers={"m": ContainerInfo(requests={ResourceTPU: 4})})
+    )
+    status = cluster.status()
+    assert set(status["nodes"]) == {f"host{h}" for h in range(4)}
+    n0 = status["nodes"]["host0"]
+    assert n0["kubedevice/tpu"] == {"free": 4, "total": 8}
+    assert n0["pods"] == ["p"]
+    assert status["slices_free_chips"]["v5e-64/slice0"] == 28
+    assert status["latency"]["schedule_pod"]["count"] == 1

@@ -174,3 +174,53 @@ def test_pipeline_train_step_five_axes():
     # layer stack pp-sharded, experts ep-sharded
     assert state.params["blocks"]["wq"].sharding.spec[0] == "pp"
     assert state.params["blocks"]["w_gate"].sharding.spec[1] == "ep"
+
+
+def test_moe_capacity_matches_dense_dispatch_when_roomy():
+    """With capacity >= all tokens, the capacity path must equal the dense
+    one-hot dispatch exactly (same experts, same gate weighting)."""
+    from kubetpu.jobs.model import _moe_mlp, _moe_mlp_capacity, init_params
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4, d_ff=64, n_experts=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    layer = jax.tree.map(lambda x: x[0], params["blocks"])  # unstack layer 0
+    h = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 32))
+    dense = _moe_mlp(h, layer)
+    roomy = _moe_mlp_capacity(h, layer, capacity_factor=8.0)  # C >= N
+    np.testing.assert_allclose(np.asarray(roomy), np.asarray(dense), rtol=1e-5, atol=1e-6)
+
+
+def test_moe_capacity_drops_overflow():
+    from kubetpu.jobs.model import _moe_mlp_capacity, init_params
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4, d_ff=64, n_experts=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    layer = jax.tree.map(lambda x: x[0], params["blocks"])
+    h = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 32))
+    tight = _moe_mlp_capacity(h, layer, capacity_factor=0.25)  # forces drops
+    roomy = _moe_mlp_capacity(h, layer, capacity_factor=8.0)
+    assert np.isfinite(np.asarray(tight)).all()
+    # capacity masking must actually drop: outputs differ from the roomy
+    # path, and some token rows are exactly zero (dropped -> residual only)
+    assert not np.allclose(np.asarray(tight), np.asarray(roomy))
+    tight_rows = np.abs(np.asarray(tight)).sum(axis=-1).ravel()
+    assert (tight_rows == 0.0).any()
+
+
+def test_moe_capacity_trains_on_ep_mesh():
+    cfg = ModelConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        n_experts=4, moe_capacity_factor=1.5,
+    )
+    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 1, "ep": 4})
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt, attention="dense")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = []
+    for _ in range(8):
+        state, loss = step(state, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert state.params["blocks"]["w_gate"].sharding.spec[1] == "ep"
