@@ -74,6 +74,19 @@ def main():
     print(f"max |diff| = {diff:.4g}")
     tol = 3e-2 if dtype == jnp.bfloat16 else 3e-4
     assert diff < tol, f"numerics mismatch: {diff} >= {tol}"
+
+    # fused backward: grad through the kernel at a size the dense path can
+    # still check (small slice), then a full-size fwd+bwd smoke
+    small = slice(0, min(args.seq, 512))
+    qs, ks, vs = (x[:, small].astype(jnp.float32) for x in (q, k, v))
+    gf = jax.jit(jax.grad(lambda a, b, c: jnp.sum(flash_attention(a, b, c, 128, 128, False) ** 2)))(qs, ks, vs)
+    gd = jax.jit(jax.grad(lambda a, b, c: jnp.sum(dense_causal_attention(a, b, c) ** 2)))(qs, ks, vs)
+    rel = float(jnp.max(jnp.abs(gf - gd)) / (jnp.max(jnp.abs(gd)) + 1e-9))
+    print(f"fused bwd dq rel diff (S=512) = {rel:.3e}")
+    assert rel < 2e-2
+    g = jax.jit(jax.grad(lambda a, b, c: jnp.sum(flash_attention(a, b, c, 128, 128, False).astype(jnp.float32) ** 2)))(q, k, v)
+    jax.block_until_ready(g)
+    print(f"fused fwd+bwd at S={args.seq}: OK")
     print("OK")
 
 
