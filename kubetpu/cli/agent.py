@@ -1,0 +1,72 @@
+"""``kubetpu-agent`` — the long-running node agent.
+
+The process-topology counterpart of the reference's CRI-shim side (process
+A in SURVEY.md §3): loads the device plugin, probes on a cadence (the
+manager's 5-minute probe cache bounds actual hardware queries), and emits
+the node's advertisement as a JSON line whenever it changes — the stream a
+control plane (or an operator's pipe) consumes.
+
+    python -m kubetpu.cli.agent [--fake TOPO] [--host N] [--interval S]
+                                [--iterations N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from kubetpu.api.types import new_node_info
+
+
+def _advertisement(dev) -> dict:
+    info = new_node_info("local")
+    dev.update_node_info(info)
+    return {
+        "capacity": info.capacity,
+        "allocatable": info.allocatable,
+        "kube_cap": info.kube_cap,
+        "kube_alloc": info.kube_alloc,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubetpu-agent", description=__doc__)
+    ap.add_argument("--fake", metavar="TOPO", default=None,
+                    help="fake backend topology (e.g. v5e-8); default: native probe")
+    ap.add_argument("--host", type=int, default=0)
+    ap.add_argument("--interval", type=float, default=60.0,
+                    help="seconds between advertisement refreshes")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="stop after N refreshes (0 = run forever)")
+    args = ap.parse_args(argv)
+
+    if args.fake:
+        from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+
+        dev = new_fake_tpu_dev_manager(make_fake_tpus_info(args.fake, args.host))
+    else:
+        from kubetpu.device import new_tpu_dev_manager
+
+        dev = new_tpu_dev_manager()
+    dev.start()
+
+    last = None
+    iteration = 0
+    while True:
+        iteration += 1
+        try:
+            adv = _advertisement(dev)
+        except Exception as e:  # noqa: BLE001 — degrade, keep running
+            adv = {"error": str(e)}
+        if adv != last:
+            print(json.dumps({"ts": time.time(), **adv}), flush=True)
+            last = adv
+        if args.iterations and iteration >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

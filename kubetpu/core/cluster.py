@@ -78,6 +78,30 @@ class Cluster:
             s.remove_node(name)
         self.nodes.pop(name, None)
 
+    def refresh_node(self, name: str) -> NodeInfo:
+        """Re-probe a node's device manager and re-advertise, preserving the
+        resources held by its placed pods — the periodic refresh the
+        reference's CRI shim performs (UpdateNodeInfo on the 5-minute probe
+        cadence, nvidia_gpu_manager.go:110-121). A chip that disappeared
+        from the probe stops being advertised; chips held by pods are
+        re-subtracted from the fresh allocatable."""
+        node = self.nodes.get(name)
+        if node is None:
+            raise KeyError(name)
+        if node.device is None:
+            return node.info
+        fresh = new_node_info(name)
+        node.device.update_node_info(fresh)
+        for pod in node.pods.values():
+            group_scheduler.take_pod_resources(fresh, pod)
+        node.info.capacity = fresh.capacity
+        node.info.allocatable = fresh.allocatable
+        node.info.kube_cap = fresh.kube_cap
+        node.info.kube_alloc = fresh.kube_alloc
+        for s in self.schedulers:
+            s.add_node(name, node.info)
+        return node.info
+
     # -- per-pod scheduling (the hot path) ----------------------------------
 
     def schedule(

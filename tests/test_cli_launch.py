@@ -97,3 +97,49 @@ def test_cluster_status_snapshot():
     assert n0["pods"] == ["p"]
     assert status["slices_free_chips"]["v5e-64/slice0"] == 28
     assert status["latency"]["schedule_pod"]["count"] == 1
+
+
+def test_agent_emits_advertisement():
+    proc = _run(["kubetpu.cli.agent", "--fake", "v5e-8", "--interval", "0.1",
+                 "--iterations", "2"])
+    assert proc.returncode == 0
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    # advertisement unchanged -> emitted once despite 2 iterations
+    assert len(lines) == 1
+    assert lines[0]["capacity"]["kubedevice/tpu"] == 8
+
+
+def test_refresh_node_preserves_allocations():
+    from kubetpu.device.tpu_plugin import FakeTpuPlugin
+
+    cluster = _gang_cluster()
+    placed = cluster.schedule(
+        PodInfo(name="p", running_containers={"m": ContainerInfo(requests={ResourceTPU: 4})})
+    )
+    name = placed.node_name
+    assert cluster.nodes[name].info.allocatable[ResourceTPU] == 4
+
+    # plain refresh: held chips stay subtracted
+    cluster.refresh_node(name)
+    assert cluster.nodes[name].info.allocatable[ResourceTPU] == 4
+    held = set(placed.running_containers["m"].allocate_from.values())
+    for key in held:
+        assert cluster.nodes[name].info.allocatable[key] == 0
+
+    # a chip the pod does NOT hold disappears from the probe
+    from kubetpu.device import make_fake_tpus_info
+
+    mgr = cluster.nodes[name].device
+    free_locals = [
+        i for i in range(8)
+        if not any(f"/tpu/{i}/cards" in k for k in held)
+    ]
+    mgr._plugin = FakeTpuPlugin(
+        make_fake_tpus_info("v5e-64", host_index=int(name.removeprefix("host")),
+                            missing_chips=(free_locals[0],))
+    )
+    cluster.refresh_node(name)
+    info = cluster.nodes[name].info
+    assert info.capacity[ResourceTPU] == 7
+    assert info.allocatable[ResourceTPU] == 3  # 7 found - 4 held
+    assert not any(f"/tpu/{free_locals[0]}/cards" in k for k in info.capacity)
