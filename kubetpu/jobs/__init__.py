@@ -21,3 +21,8 @@ __all__ = [
     "make_eval_step",
     "make_train_step",
 ]
+
+# Submodules with heavier deps are imported lazily by users:
+#   kubetpu.jobs.pipeline   (pp training), kubetpu.jobs.decode (KV-cache
+#   generation), kubetpu.jobs.checkpoint (orbax), kubetpu.jobs.data,
+#   kubetpu.jobs.launch (jax.distributed wiring)

@@ -232,3 +232,20 @@ def test_two_physical_slices_not_conflated():
         cluster.release(p.name)
     with pytest.raises(SchedulingError):
         cluster.schedule_gang([tpu_pod(f"x{i}", 8) for i in range(5)])
+
+
+def test_gpu_pool_spills_across_groups():
+    """A 6-GPU pod on an 8-GPU two-socket box: the structural fill must
+    spill across NVLink groups (no single group holds 6) without failing."""
+    from tests.test_device_nvidia import titan_box
+    from kubetpu.device.nvidia import new_fake_nvidia_gpu_manager
+
+    cluster = Cluster()
+    cluster.register_node(
+        "gpu-node", device=new_fake_nvidia_gpu_manager(titan_box(), "v", "d")
+    )
+    placed = cluster.schedule(gpu_pod("big", 6))
+    af = placed.running_containers["main"].allocate_from
+    assert len(af) == 6
+    assert len(set(af.values())) == 6
+    assert cluster.nodes["gpu-node"].info.allocatable[ResourceGPU] == 2

@@ -224,3 +224,20 @@ def test_moe_capacity_trains_on_ep_mesh():
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
     assert state.params["blocks"]["w_gate"].sharding.spec[1] == "ep"
+
+
+def test_bfloat16_model_config():
+    import jax.numpy as jnp
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                      dtype=jnp.bfloat16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert params["embed"].dtype == jnp.bfloat16
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab)
+    logits = forward(params, tokens, cfg)
+    assert logits.dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(logits.astype(jnp.float32)).all())
+    targets = jnp.roll(tokens, -1, axis=1)
+    loss = next_token_loss(params, tokens, targets, cfg)
+    assert loss.dtype == jnp.float32  # CE tail always accumulates in f32
+    assert bool(jnp.isfinite(loss))
