@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from kubetpu.api import utils
 from kubetpu.api.device import AllocateResult, Device
@@ -35,6 +35,15 @@ from kubetpu.scheduler.tpu_scheduler import TpuScheduler
 
 class SchedulingError(Exception):
     """Pod (or gang) cannot be placed."""
+
+
+# Pod priority pseudo-resource (rides Requests untouched, like the
+# topology-generation knob); higher preempts lower via schedule_preempting.
+PriorityKey = "kubetpu/priority"
+
+
+def pod_priority(pod: PodInfo) -> int:
+    return int(pod.requests.get(PriorityKey, 0))
 
 
 @dataclass
@@ -342,6 +351,81 @@ class Cluster:
         return {
             s: [n for _, n in sorted(members)] for s, members in sorted(slices.items())
         }
+
+    # -- priorities & preemption ---------------------------------------------
+
+    def schedule_preempting(
+        self, pod: PodInfo
+    ) -> Tuple[PodInfo, List[PodInfo]]:
+        """Place a pod, evicting strictly-lower-priority pods if (and only
+        if) that makes it fit. Returns (placed pod, evicted pods — reset to
+        schedulable form for the caller to requeue).
+
+        Priority rides the pod's Requests as the pseudo-resource
+        ``kubetpu/priority`` (default 0) — the same resource-list-as-config
+        channel as the reference's topology knob (SURVEY.md §5.6).
+        Feasibility is checked geometrically BEFORE any eviction: victims
+        are only killed when the freed chips provably yield a contiguous
+        block, cheapest (lowest-priority) victims first.
+        """
+        try:
+            return self.schedule(pod), []
+        except SchedulingError:
+            pass
+
+        from kubetpu.plugintypes.mesh import find_contiguous_block
+        from kubetpu.scheduler.deviceclass import TPU
+        from kubetpu.scheduler.translate import pod_device_count
+
+        prio = pod_priority(pod)
+        probe = pod.copy()
+        for cont in probe.running_containers.values():
+            cont.requests.setdefault(TPU.resource_name, cont.kube_requests.get(TPU.resource_name, 0))
+        n = pod_device_count(TPU, probe)
+        if n == 0:
+            raise SchedulingError(f"pod {pod.name!r}: no node fits (nothing to preempt for)")
+
+        for name in utils.sorted_string_keys(self.nodes):
+            node = self.nodes[name]
+            state = meshstate.parse_mesh_state(node.info.allocatable)
+            if state is None:
+                continue
+            victims = sorted(
+                (p for p in node.pods.values() if pod_priority(p) < prio),
+                key=pod_priority,
+            )
+            avail = set(state.free)
+            chosen: List[PodInfo] = []
+            fits = find_contiguous_block(avail, n, state.topo) is not None
+            for victim in victims:
+                if fits:
+                    break
+                _topo, vcoords = self.pod_chip_coords(victim)
+                avail |= set(vcoords)
+                chosen.append(victim)
+                fits = find_contiguous_block(avail, n, state.topo) is not None
+            if not fits:
+                continue
+            evicted: List[PodInfo] = []
+            for victim in chosen:
+                self.release(victim.name)
+                fresh = victim.copy()
+                fresh.node_name = ""
+                for cont in list(fresh.init_containers.values()) + list(
+                    fresh.running_containers.values()
+                ):
+                    cont.allocate_from.clear()
+                    cont.dev_requests.clear()
+                evicted.append(fresh)
+            placed = self.schedule(pod, lambda c, node_name=name: c == node_name)
+            utils.logf(
+                0, "pod %s (priority %d) preempted %s on %s",
+                pod.name, prio, [v.name for v in evicted], name,
+            )
+            return placed, evicted
+        raise SchedulingError(
+            f"pod {pod.name!r}: no node fits even with preemption at priority {prio}"
+        )
 
     # -- failure handling / elastic recovery ---------------------------------
 

@@ -140,3 +140,83 @@ def test_cluster_concurrent_schedule_release():
     for node in cluster.nodes.values():
         assert node.info.allocatable[ResourceTPU] == 8
         assert not node.pods
+
+
+def test_preemption_evicts_lower_priority():
+    from kubetpu.core.cluster import PriorityKey
+
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    # fill with two low-priority pods
+    low1 = tpu_pod("low1", 4)
+    low2 = tpu_pod("low2", 4)
+    cluster.schedule(low1)
+    cluster.schedule(low2)
+
+    # high-priority 4-chip pod: evicts exactly one victim
+    high = tpu_pod("high", 4)
+    high.requests[PriorityKey] = 10
+    placed, evicted = cluster.schedule_preempting(high)
+    assert placed.node_name == "n0"
+    assert len(evicted) == 1 and evicted[0].name in ("low1", "low2")
+    assert "high" in cluster.nodes["n0"].pods
+    # evicted pod is schedulable form (no stale placement)
+    assert not any(
+        c.allocate_from for c in evicted[0].running_containers.values()
+    )
+
+
+def test_preemption_refuses_equal_priority():
+    from kubetpu.core.cluster import PriorityKey
+
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    a = tpu_pod("a", 8)
+    a.requests[PriorityKey] = 5
+    cluster.schedule(a)
+    b = tpu_pod("b", 4)
+    b.requests[PriorityKey] = 5  # equal, not higher
+    try:
+        cluster.schedule_preempting(b)
+        assert False, "equal priority must not preempt"
+    except SchedulingError:
+        pass
+    assert "a" in cluster.nodes["n0"].pods  # victim untouched
+
+
+def test_preemption_no_eviction_when_fits():
+    from kubetpu.core.cluster import PriorityKey
+
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    cluster.schedule(tpu_pod("low", 4))
+    high = tpu_pod("high", 2)
+    high.requests[PriorityKey] = 10
+    placed, evicted = cluster.schedule_preempting(high)
+    assert evicted == []  # fits without touching anyone
+    assert "low" in cluster.nodes["n0"].pods
+
+
+def test_preemption_evicts_minimum_set():
+    from kubetpu.core.cluster import PriorityKey
+
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    for i in range(4):
+        p = tpu_pod(f"low{i}", 2)
+        p.requests[PriorityKey] = i  # priorities 0..3
+    
+        cluster.schedule(p)
+    high = tpu_pod("high", 2)
+    high.requests[PriorityKey] = 10
+    placed, evicted = cluster.schedule_preempting(high)
+    assert len(evicted) == 1
+    assert evicted[0].name == "low0"  # cheapest victim first
