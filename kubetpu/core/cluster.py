@@ -53,6 +53,15 @@ class ClusterNode:
     pods: Dict[str, PodInfo] = field(default_factory=dict)
 
 
+@dataclass(frozen=True)
+class Migration:
+    """One step of a defragmentation plan."""
+
+    pod_name: str
+    from_node: str
+    to_node: str
+
+
 class Cluster:
     """Node registry + scheduling loop over the device-scheduler plugins."""
 
@@ -426,6 +435,143 @@ class Cluster:
         raise SchedulingError(
             f"pod {pod.name!r}: no node fits even with preemption at priority {prio}"
         )
+
+    # -- defragmentation ------------------------------------------------------
+
+    def defrag_plan(
+        self, chips: int, max_migrations: int = 3
+    ) -> Optional[List["Migration"]]:
+        """When *fragmentation* (not capacity) blocks a perfect
+        (contiguity-1.0) rectangular ``chips``-block, propose the smallest
+        pod-migration set that opens one: vacating those pods must provably
+        yield an exact rectangle on the source node AND each vacated pod
+        must provably re-place — on another node or back onto the source
+        node outside the opened block. Returns [] if a perfect block already
+        fits somewhere, None if no plan within ``max_migrations`` moves
+        exists (raise the cap for deeper searches; the search is
+        combinatorial in it). Proposals only — ``execute_defrag`` applies.
+
+        Planning considers TPU geometry only; pods with non-TPU requests are
+        not picked as victims, and ``execute_defrag`` re-places each victim
+        through the full scheduler (with rollback), so a plan invalidated by
+        concurrent scheduling fails safely rather than dropping pods.
+        """
+        import itertools as it
+
+        from kubetpu.plugintypes.mesh import find_contiguous_block, find_perfect_block
+        from kubetpu.plugintypes import ResourceGPU
+
+        states = {}
+        for name in utils.sorted_string_keys(self.nodes):
+            st = meshstate.parse_mesh_state(self.nodes[name].info.allocatable)
+            if st is not None:
+                states[name] = st
+        for name, st in states.items():
+            if find_perfect_block(set(st.free), chips, st.topo) is not None:
+                return []  # no defrag needed
+
+        for name, st in states.items():
+            if len(st.free) < chips:
+                continue  # capacity problem, not fragmentation
+            node = self.nodes[name]
+            # hoist: victim -> its chip coords, once per node
+            victim_coords = {}
+            for p in sorted(node.pods.values(), key=lambda p: p.name):
+                # plan only TPU-geometry pods (placed pods carry zero-valued
+                # scalar keys from every scheduler's max-merge — only a real
+                # GPU request disqualifies)
+                if any(
+                    c.requests.get(ResourceGPU, 0) > 0
+                    for c in p.running_containers.values()
+                ):
+                    continue
+                _t, vcoords = self.pod_chip_coords(p)
+                if vcoords:
+                    victim_coords[p.name] = (p, vcoords)
+            resident = list(victim_coords.values())
+            for r in range(1, min(max_migrations, len(resident)) + 1):
+                for combo in it.combinations(resident, r):
+                    avail = set(st.free)
+                    for _victim, vcoords in combo:
+                        avail |= set(vcoords)
+                    block = find_perfect_block(avail, chips, st.topo)
+                    if block is None:
+                        continue
+                    # can every vacated pod land contiguously elsewhere —
+                    # or back on this node outside the opened block?
+                    dest_free = {
+                        o: set(s2.free) for o, s2 in states.items() if o != name
+                    }
+                    dest_free[name] = avail - set(block)
+                    plan: List[Migration] = []
+                    feasible = True
+                    for victim, vcoords in combo:
+                        need = len(vcoords)
+                        placed = False
+                        for o in utils.sorted_string_keys(dest_free):
+                            got = find_contiguous_block(
+                                dest_free[o], need, states[o].topo
+                            )
+                            if got is not None:
+                                dest_free[o] -= set(got[0])
+                                plan.append(Migration(victim.name, name, o))
+                                placed = True
+                                break
+                        if not placed:
+                            feasible = False
+                            break
+                    if feasible:
+                        return plan
+        return None
+
+    def execute_defrag(
+        self, plan: List["Migration"], pending: Optional[PodInfo] = None
+    ) -> Tuple[List[PodInfo], Optional[PodInfo]]:
+        """Apply a defrag plan: release every migrating pod, place the
+        *pending* pod the plan was computed for (it takes the opened perfect
+        block — placing it first is what stops re-placed victims from
+        re-fragmenting the region), then re-place the victims (planned
+        destination first, anywhere as fallback). Returns
+        (moved victims, placed pending pod or None).
+
+        Rollback: if anything fails mid-way, every released pod is restored
+        and any partial placements are released before the error propagates
+        — no pod is ever dropped."""
+        originals: List[Tuple[Migration, PodInfo]] = []
+        for mig in plan:
+            pod = self.nodes[mig.from_node].pods[mig.pod_name]
+            fresh = pod.copy()
+            fresh.node_name = ""
+            for cont in list(fresh.init_containers.values()) + list(
+                fresh.running_containers.values()
+            ):
+                cont.allocate_from.clear()
+                cont.dev_requests.clear()
+            originals.append((mig, fresh))
+            self.release(mig.pod_name)
+
+        placed_pending: Optional[PodInfo] = None
+        moved: List[PodInfo] = []
+        try:
+            if pending is not None:
+                placed_pending = self.schedule(pending)
+            for mig, fresh in originals:
+                try:
+                    moved.append(
+                        self.schedule(fresh, lambda n, dest=mig.to_node: n == dest)
+                    )
+                except SchedulingError:
+                    moved.append(self.schedule(fresh))  # anywhere fallback
+            return moved, placed_pending
+        except SchedulingError:
+            for p in moved:
+                self.release(p.name)
+            if placed_pending is not None:
+                self.release(placed_pending.name)
+            for mig, fresh in originals:
+                self.schedule(fresh.copy(), lambda n, src=mig.from_node: n == src)
+            utils.errorf("defrag execution failed; all pods restored")
+            raise
 
     # -- failure handling / elastic recovery ---------------------------------
 

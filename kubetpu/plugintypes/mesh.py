@@ -312,6 +312,24 @@ def _place_rect(
     return None
 
 
+def find_perfect_block(
+    free: Set[Coord], n: int, topo: TpuTopology
+) -> Optional[List[Coord]]:
+    """An exact rectangular n-chip block within *free*, or None — unlike
+    ``find_contiguous_block`` this never falls back to a fragmented set, so
+    it answers "is a contiguity-1.0 placement possible?" (the
+    defragmentation criterion)."""
+    if n <= 0:
+        return []
+    if len(free) < n:
+        return None
+    for shape in factorizations(n, len(topo.mesh_shape)):
+        block = _place_rect(free, shape, topo)
+        if block is not None:
+            return sorted(block)
+    return None
+
+
 def find_contiguous_block(
     free: Set[Coord], n: int, topo: TpuTopology
 ) -> Optional[Tuple[List[Coord], float]]:
@@ -323,10 +341,9 @@ def find_contiguous_block(
         return [], 1.0
     if len(free) < n:
         return None
-    for shape in factorizations(n, len(topo.mesh_shape)):
-        block = _place_rect(free, shape, topo)
-        if block is not None:
-            return sorted(block), contiguity_score(block, topo)
+    block = find_perfect_block(free, n, topo)
+    if block is not None:
+        return block, contiguity_score(block, topo)
     # No exact rectangle free: greedy frontier growth from each free chip,
     # preferring candidates with the most already-chosen neighbors.
     best: Optional[List[Coord]] = None

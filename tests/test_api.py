@@ -125,3 +125,26 @@ def test_plugin_loading_roundtrip(tmp_path):
 
     dev = create_device_from_plugin(str(plug))
     assert dev.get_name() == "fakedev"
+
+
+def test_scheduler_plugin_loading_roundtrip():
+    # component #7 end-to-end: the core loads the scheduler by its factory
+    # contract (analog of plugin.Open on gpuschedulerplugin.so) and
+    # schedules through it.
+    from kubetpu.api.devicescheduler import create_device_scheduler_from_plugin
+    from kubetpu.api.types import ContainerInfo, PodInfo
+    from kubetpu.core import Cluster
+    from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+
+    tpu_sched = create_device_scheduler_from_plugin("kubetpu.scheduler.plugin")
+    assert tpu_sched.get_name() == "tpu"
+    assert tpu_sched.using_group_scheduler()
+
+    cluster = Cluster(schedulers=[tpu_sched])
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    placed = cluster.schedule(
+        PodInfo(name="p", running_containers={"m": ContainerInfo(requests={"kubedevice/tpu": 2})})
+    )
+    assert len(placed.running_containers["m"].allocate_from) == 2

@@ -220,3 +220,69 @@ def test_preemption_evicts_minimum_set():
     placed, evicted = cluster.schedule_preempting(high)
     assert len(evicted) == 1
     assert evicted[0].name == "low0"  # cheapest victim first
+
+
+def _fragment_node(cluster, node_name, keep_coords):
+    """Schedule 8 single-chip pods on a v5e-8 node, then release those whose
+    chip landed outside keep_coords — leaving exactly keep_coords occupied."""
+    placed = {}
+    for i in range(8):
+        p = cluster.schedule(tpu_pod(f"frag{i}", 1), lambda n: n == node_name)
+        _t, coords = cluster.pod_chip_coords(p)
+        placed[coords[0]] = p.name
+    for coord, pname in placed.items():
+        if coord not in keep_coords:
+            cluster.release(pname)
+    return placed
+
+
+def test_defrag_plan_and_execute():
+    cluster = Cluster()
+    for i in range(2):
+        cluster.register_node(
+            f"n{i}", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+        )
+    # fragment n0: occupied at (0,1) and (1,2) -> free 6 chips but no 2x3/3x2
+    # ... and specifically no contiguous 6-block
+    occupied = {(0, 1), (1, 2)}
+    _fragment_node(cluster, "n0", occupied)
+    # fill n1 partially so re-placement is non-trivial but possible
+    cluster.schedule(tpu_pod("n1pod", 4), lambda n: n == "n1")
+
+    from kubetpu.plugintypes.mesh import TOPOLOGIES, find_perfect_block
+
+    st_free = {c for c in TOPOLOGIES["v5e-8"].coords() if c not in occupied}
+    # 6 free chips but no 2x3/3x2/1x6 rectangle: fragmented
+    assert find_perfect_block(st_free, 6, TOPOLOGIES["v5e-8"]) is None
+
+    plan = cluster.defrag_plan(6)
+    assert plan, plan  # non-empty migration list
+    assert all(m.from_node == "n0" for m in plan)
+    assert all(m.to_node in ("n0", "n1") for m in plan)  # intra-node moves allowed
+
+    moved, placed = cluster.execute_defrag(plan, pending=tpu_pod("big6", 6))
+    assert all(p.node_name in ("n0", "n1") for p in moved)
+    # the pending pod got the opened perfect block
+    assert placed.node_name == "n0"
+    assert cluster.gang_contiguity([placed]) == 1.0
+    # nobody was dropped: both fragments and the n1 pod still exist
+    all_pods = {p for n in cluster.nodes.values() for p in n.pods}
+    assert {"big6", "n1pod"} <= all_pods
+    assert len(all_pods) == 2 + len(moved)
+
+
+def test_defrag_plan_empty_when_fits():
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    assert cluster.defrag_plan(4) == []
+
+
+def test_defrag_plan_none_when_capacity_short():
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    cluster.schedule(tpu_pod("a", 6))
+    assert cluster.defrag_plan(4) is None  # only 2 free anywhere, no 2nd node
