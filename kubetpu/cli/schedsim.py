@@ -133,7 +133,56 @@ def config5():
     }
 
 
-CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+def config6():
+    """extension: priority preemption (high evicts low, feasibility-checked)"""
+    from kubetpu.core.cluster import PriorityKey
+
+    c = _v5e8_cluster()
+    c.schedule(_tpu_pod("low-a", 4))
+    c.schedule(_tpu_pod("low-b", 4))
+    high = _tpu_pod("high", 4)
+    high.requests[PriorityKey] = 10
+    placed, evicted = c.schedule_preempting(high)
+    return {
+        "placed": placed.node_name,
+        "evicted": [p.name for p in evicted],
+        "preempted": len(evicted) == 1,
+    }
+
+
+def config7():
+    """extension: defragmentation (migrations open a perfect block)"""
+    c = Cluster()
+    for i in range(2):
+        c.register_node(
+            f"n{i}", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+        )
+    # fragment n0: 8 singles, release all but two awkward chips
+    placed = {}
+    for i in range(8):
+        p = c.schedule(_tpu_pod(f"s{i}", 1), lambda n: n == "n0")
+        _t, coords = c.pod_chip_coords(p)
+        placed[coords[0]] = p.name
+    for coord, pname in placed.items():
+        if coord not in {(0, 1), (1, 2)}:
+            c.release(pname)
+    # partially fill n1 so no perfect 6-block exists anywhere without moving
+    c.schedule(_tpu_pod("n1pod", 4), lambda n: n == "n1")
+    plan = c.defrag_plan(6)
+    if plan is None:
+        return {"plan": None, "defragged": False}
+    if plan == []:
+        return {"plan": [], "defragged": True, "note": "already fits"}
+    moved, pending = c.execute_defrag(plan, pending=_tpu_pod("big6", 6))
+    return {
+        "plan": [f"{m.pod_name}:{m.from_node}->{m.to_node}" for m in plan],
+        "pending_contiguity": c.gang_contiguity([pending]),
+        "defragged": c.gang_contiguity([pending]) == 1.0,
+    }
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+           6: config6, 7: config7}
 
 
 def main(argv=None) -> int:

@@ -38,7 +38,7 @@ def test_schedsim_all_configs():
     proc = _run(["kubetpu.cli.schedsim", "--rounds", "2"])
     assert proc.returncode == 0
     lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
-    assert [l["config"] for l in lines] == [1, 2, 3, 4, 5]
+    assert [l["config"] for l in lines] == [1, 2, 3, 4, 5, 6, 7]
     by_cfg = {l["config"]: l for l in lines}
     assert by_cfg[2]["contiguity"] == 1.0
     assert by_cfg[3]["packed"] is True
