@@ -94,25 +94,47 @@ def _forward_one(cfg: ModelConfig, params: Params, token, k_cache, v_cache, pos)
     return logits, k_cache, v_cache
 
 
+def _forward_collect_kv(cfg: ModelConfig, params: Params, tokens):
+    """Full batched forward over the prompt that also returns each layer's
+    rotary-embedded K/V: (logits_last (B, V), k (L, B, S, H, D), v (...))."""
+    positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+    x = params["embed"][tokens]  # (B, S, D)
+
+    def scan_body(carry, layer):
+        x = carry
+        h = model_lib.rms_norm(x, layer["ln1"])
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+        q = model_lib.rope(q, positions, cfg.rope_theta)
+        k = model_lib.rope(k, positions, cfg.rope_theta)
+        attn = model_lib.dense_causal_attention(q, k, v)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
+        h = model_lib.rms_norm(x, layer["ln2"])
+        if cfg.n_experts > 0:
+            x = x + model_lib._moe_mlp(h, layer)
+        else:
+            gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
+            up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+            x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, params["blocks"])
+    x = model_lib.rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])[:, -1]
+    return logits.astype(jnp.float32), ks, vs
+
+
 def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache):
-    """Run the prompt through the full batched forward while filling the
-    cache, returning last-position logits. tokens: (B, S_prompt)."""
+    """Fill the cache from one batched forward over the whole prompt (a
+    single MXU-friendly pass, not a per-token loop), returning last-position
+    logits. tokens: (B, S_prompt)."""
     b, s = tokens.shape
-
-    # fill the cache by replaying per-position decode (correct and simple);
-    # the batched-prefill optimization (single forward + cache scatter) is
-    # a follow-up — decode dominates generation time.
-    def pos_body(carry, t):
-        k_cache, v_cache, _ = carry
-        logits, k_cache, v_cache = _forward_one(
-            cfg, params, tokens[:, t], k_cache, v_cache, t
-        )
-        return (k_cache, v_cache, logits), None
-
-    (k_cache, v_cache, logits), _ = jax.lax.scan(
-        pos_body, (k_cache, v_cache, jnp.zeros((b, cfg.vocab), jnp.float32)),
-        jnp.arange(s),
-    )
+    logits, ks, vs = _forward_collect_kv(cfg, params, tokens)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, ks.astype(k_cache.dtype),
+                                           (0, 0, 0, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, vs.astype(v_cache.dtype),
+                                           (0, 0, 0, 0, 0))
     return logits, k_cache, v_cache
 
 

@@ -46,6 +46,10 @@ class ModelConfig:
     # expert processes at most capacity_factor*N/E tokens, XLA inserts the
     # all_to_all over ep; overflowing tokens fall through on the residual)
     moe_capacity_factor: float = 0.0
+    # switch-transformer load-balance auxiliary loss coefficient: adds
+    # coeff * E * sum_e(frac_tokens_e * mean_prob_e) to next_token_loss,
+    # keeping the router from collapsing onto few experts (0 = off)
+    moe_aux_coeff: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -129,6 +133,20 @@ def dense_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jn
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _moe_aux_loss(h: jnp.ndarray, layer: Params) -> jnp.ndarray:
+    """Switch-transformer load-balance term: E * sum_e(f_e * P_e), minimized
+    (= 1) when routing is uniform. f_e = fraction of tokens routed to e
+    (non-differentiable), P_e = mean router probability (carries the
+    gradient)."""
+    router = jnp.einsum("bsd,de->bse", h, layer["moe_router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(router, axis=-1)
+    e = probs.shape[-1]
+    top1 = jnp.argmax(probs, axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=(0, 1))
+    mean_prob = jnp.mean(probs, axis=(0, 1))
+    return e * jnp.sum(jax.lax.stop_gradient(frac) * mean_prob)
+
+
 def _block(
     cfg: ModelConfig,
     attn_fn: AttnFn,
@@ -137,6 +155,19 @@ def _block(
     layer: Params,
 ) -> jnp.ndarray:
     """One transformer block (the lax.scan body)."""
+    x, _aux = _block_with_aux(cfg, attn_fn, positions, x, layer)
+    return x
+
+
+def _block_with_aux(
+    cfg: ModelConfig,
+    attn_fn: AttnFn,
+    positions: jnp.ndarray,
+    x: jnp.ndarray,
+    layer: Params,
+):
+    """One transformer block; also returns the layer's MoE aux-loss term
+    (0.0 for dense blocks)."""
     h = rms_norm(x, layer["ln1"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
@@ -147,6 +178,7 @@ def _block(
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
 
     h = rms_norm(x, layer["ln2"])
+    aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
         x = x + _moe_mlp_capacity(h, layer, cfg.moe_capacity_factor)
     elif cfg.n_experts > 0:
@@ -155,7 +187,9 @@ def _block(
         gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
         up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
         x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
-    return x
+    if cfg.n_experts > 0 and cfg.moe_aux_coeff > 0:
+        aux = _moe_aux_loss(h, layer)
+    return x, aux
 
 
 def _moe_mlp_capacity(h: jnp.ndarray, layer: Params, capacity_factor: float) -> jnp.ndarray:
@@ -231,8 +265,10 @@ def forward(
     cfg: ModelConfig,
     attn_fn: Optional[AttnFn] = None,
     positions: Optional[jnp.ndarray] = None,
+    return_aux: bool = False,
 ) -> jnp.ndarray:
-    """Logits for next-token prediction. tokens: (B, S) int32 -> (B, S, V).
+    """Logits for next-token prediction. tokens: (B, S) int32 -> (B, S, V);
+    with ``return_aux`` also the summed MoE load-balance term.
 
     ``positions`` defaults to 0..S-1; sequence-parallel callers pass global
     positions for their shard.
@@ -243,17 +279,21 @@ def forward(
         positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
 
     x = params["embed"][tokens]  # (B, S, D) gather rides the MXU-free path
-    body = partial(_block, cfg, attn_fn, positions)
+    body = partial(_block_with_aux, cfg, attn_fn, positions)
 
     def scan_body(carry, layer):
-        return body(carry, layer), None
+        x, aux = body(carry, layer)
+        return x, aux
 
     if cfg.remat:
         scan_body = jax.checkpoint(scan_body)
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
 
     x = rms_norm(x, params["ln_f"])
-    return jnp.einsum("bsd,dv->bsv", x, params["head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])
+    if return_aux:
+        return logits, jnp.sum(auxes)
+    return logits
 
 
 def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
@@ -278,5 +318,8 @@ def next_token_loss(
     the sequence axis sharded for sequence parallelism, an in-model
     ``[:, 1:]`` shift would need a cross-shard halo exchange for nothing.
     """
+    if cfg.n_experts > 0 and cfg.moe_aux_coeff > 0:
+        logits, aux = forward(params, tokens, cfg, attn_fn, positions, return_aux=True)
+        return token_cross_entropy(logits, targets) + cfg.moe_aux_coeff * aux
     logits = forward(params, tokens, cfg, attn_fn, positions)
     return token_cross_entropy(logits, targets)

@@ -241,3 +241,36 @@ def test_bfloat16_model_config():
     loss = next_token_loss(params, tokens, targets, cfg)
     assert loss.dtype == jnp.float32  # CE tail always accumulates in f32
     assert bool(jnp.isfinite(loss))
+
+
+def test_moe_aux_loss_balances_router():
+    """With the aux coefficient on, the loss gains a positive term that is
+    1.0*coeff*L for a perfectly uniform router and larger when collapsed."""
+    from kubetpu.jobs.model import forward as fwd
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                      n_experts=4, moe_aux_coeff=0.01)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    logits, aux = fwd(params, tokens, cfg, return_aux=True)
+    # aux per MoE layer >= 1 (uniform lower bound), summed over layers
+    assert float(aux) >= cfg.n_layers * 0.99
+
+    loss_with = next_token_loss(params, tokens, targets, cfg)
+    cfg_off = ModelConfig(**{**cfg.__dict__, "moe_aux_coeff": 0.0})
+    loss_without = next_token_loss(params, tokens, targets, cfg_off)
+    np.testing.assert_allclose(
+        float(loss_with), float(loss_without) + 0.01 * float(aux), rtol=1e-5
+    )
+
+    # trains on an ep mesh with the aux term active
+    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 1, "ep": 4})
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt, attention="dense")
+    losses = []
+    for _ in range(6):
+        state, loss = step(state, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
