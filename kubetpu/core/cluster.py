@@ -65,12 +65,21 @@ class Migration:
 class Cluster:
     """Node registry + scheduling loop over the device-scheduler plugins."""
 
+    # ring buffer size of the event log (observability; SURVEY.md §5.1/5.5)
+    MAX_EVENTS = 1000
+
     def __init__(self, schedulers: Optional[Sequence[DeviceScheduler]] = None):
         self.schedulers: List[DeviceScheduler] = (
             list(schedulers) if schedulers is not None else [TpuScheduler(), GpuScheduler()]
         )
         self.nodes: Dict[str, ClusterNode] = {}
         self.metrics = LatencyRecorder()
+        self.events: List[Dict[str, object]] = []
+
+    def _event(self, kind: str, **detail: object) -> None:
+        self.events.append({"ts": time.time(), "kind": kind, **detail})
+        if len(self.events) > self.MAX_EVENTS:
+            del self.events[: len(self.events) - self.MAX_EVENTS]
 
     # -- node lifecycle -----------------------------------------------------
 
@@ -171,6 +180,7 @@ class Cluster:
             pod_copy.node_name = name
             node.pods[pod_copy.name] = pod_copy
             utils.logf(3, "scheduled pod %s on %s (score %.3f)", pod.name, name, -neg_score)
+            self._event("schedule", pod=pod_copy.name, node=name, score=-neg_score)
             return pod_copy
         raise SchedulingError(f"pod {pod.name!r}: fill failed on every fitting node")
 
@@ -182,6 +192,7 @@ class Cluster:
                 group_scheduler.return_pod_resources(node.info, placed)
                 for s in self.schedulers:
                     s.return_pod_resources(node.info, placed)
+                self._event("release", pod=pod_name, node=node.info.name)
                 return
         raise KeyError(pod_name)
 
@@ -431,6 +442,8 @@ class Cluster:
                 0, "pod %s (priority %d) preempted %s on %s",
                 pod.name, prio, [v.name for v in evicted], name,
             )
+            self._event("preempt", pod=pod.name, node=name,
+                        victims=[v.name for v in evicted])
             return placed, evicted
         raise SchedulingError(
             f"pod {pod.name!r}: no node fits even with preemption at priority {prio}"
@@ -602,6 +615,7 @@ class Cluster:
             evicted.append(fresh)
         self.remove_node(name)
         utils.logf(0, "node %s failed; %d pods evicted for rescheduling", name, len(evicted))
+        self._event("node_failed", node=name, evicted=[p.name for p in evicted])
         return evicted
 
     # -- introspection ------------------------------------------------------
@@ -637,6 +651,7 @@ class Cluster:
             "nodes": nodes,
             "slices_free_chips": slices,
             "latency": self.metrics.summary(),
+            "recent_events": self.events[-20:],
         }
 
     def pod_chip_coords(self, pod: PodInfo):

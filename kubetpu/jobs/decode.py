@@ -66,13 +66,8 @@ def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos):
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
 
     h = model_lib.rms_norm(x, layer["ln2"])
-    if cfg.n_experts > 0:
-        x = x + model_lib._moe_mlp(h, layer)
-    else:
-        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
-        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
-        x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
-    return x, k_cache_l, v_cache_l
+    delta, _aux = model_lib._mlp(cfg, h, layer)
+    return x + delta, k_cache_l, v_cache_l
 
 
 def _forward_one(cfg: ModelConfig, params: Params, token, k_cache, v_cache, pos):
@@ -90,47 +85,17 @@ def _forward_one(cfg: ModelConfig, params: Params, token, k_cache, v_cache, pos)
         layer_body, x, (params["blocks"], k_cache, v_cache)
     )
     x = model_lib.rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])[:, 0]
+    # float32 logits: matches prefill's and keeps the decode scan carry
+    # dtype-stable for bfloat16 model configs
+    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])[:, 0].astype(jnp.float32)
     return logits, k_cache, v_cache
-
-
-def _forward_collect_kv(cfg: ModelConfig, params: Params, tokens):
-    """Full batched forward over the prompt that also returns each layer's
-    rotary-embedded K/V: (logits_last (B, V), k (L, B, S, H, D), v (...))."""
-    positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
-    x = params["embed"][tokens]  # (B, S, D)
-
-    def scan_body(carry, layer):
-        x = carry
-        h = model_lib.rms_norm(x, layer["ln1"])
-        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
-        q = model_lib.rope(q, positions, cfg.rope_theta)
-        k = model_lib.rope(k, positions, cfg.rope_theta)
-        attn = model_lib.dense_causal_attention(q, k, v)
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
-        h = model_lib.rms_norm(x, layer["ln2"])
-        if cfg.n_experts > 0:
-            x = x + model_lib._moe_mlp(h, layer)
-        else:
-            gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
-            up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
-            x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
-        return x, (k, v)
-
-    x, (ks, vs) = jax.lax.scan(scan_body, x, params["blocks"])
-    x = model_lib.rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])[:, -1]
-    return logits.astype(jnp.float32), ks, vs
 
 
 def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache):
     """Fill the cache from one batched forward over the whole prompt (a
     single MXU-friendly pass, not a per-token loop), returning last-position
     logits. tokens: (B, S_prompt)."""
-    b, s = tokens.shape
-    logits, ks, vs = _forward_collect_kv(cfg, params, tokens)
+    logits, ks, vs = model_lib.forward_with_kv(params, tokens, cfg)
     k_cache = jax.lax.dynamic_update_slice(k_cache, ks.astype(k_cache.dtype),
                                            (0, 0, 0, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, vs.astype(v_cache.dtype),

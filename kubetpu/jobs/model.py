@@ -133,18 +133,36 @@ def dense_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jn
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _moe_aux_loss(h: jnp.ndarray, layer: Params) -> jnp.ndarray:
-    """Switch-transformer load-balance term: E * sum_e(f_e * P_e), minimized
-    (= 1) when routing is uniform. f_e = fraction of tokens routed to e
-    (non-differentiable), P_e = mean router probability (carries the
-    gradient)."""
-    router = jnp.einsum("bsd,de->bse", h, layer["moe_router"]).astype(jnp.float32)
-    probs = jax.nn.softmax(router, axis=-1)
+def _moe_aux_from_probs(probs: jnp.ndarray) -> jnp.ndarray:
+    """Switch-transformer load-balance term from router probs (B, S, E) or
+    (N, E): E * sum_e(f_e * P_e), minimized (= 1) when routing is uniform.
+    f_e = fraction of tokens routed to e (non-differentiable), P_e = mean
+    router probability (carries the gradient)."""
+    probs = probs.reshape(-1, probs.shape[-1])
     e = probs.shape[-1]
     top1 = jnp.argmax(probs, axis=-1)
-    frac = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=(0, 1))
-    mean_prob = jnp.mean(probs, axis=(0, 1))
+    frac = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
     return e * jnp.sum(jax.lax.stop_gradient(frac) * mean_prob)
+
+
+def _mlp(cfg: ModelConfig, h: jnp.ndarray, layer: Params):
+    """The block's MLP branch (dense SwiGLU / dense-dispatch MoE /
+    capacity-dispatch MoE) -> (residual delta, aux loss term). One
+    implementation shared by training forward, pipeline stages, and the
+    decode path so they can never diverge."""
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
+        out, probs = _moe_mlp_capacity(h, layer, cfg.moe_capacity_factor)
+    elif cfg.n_experts > 0:
+        out, probs = _moe_mlp(h, layer)
+    else:
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+        return jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"]), aux
+    if cfg.moe_aux_coeff > 0:
+        aux = _moe_aux_from_probs(probs)
+    return out, aux
 
 
 def _block(
@@ -155,7 +173,7 @@ def _block(
     layer: Params,
 ) -> jnp.ndarray:
     """One transformer block (the lax.scan body)."""
-    x, _aux = _block_with_aux(cfg, attn_fn, positions, x, layer)
+    x, _aux, _k, _v = _block_with_aux(cfg, attn_fn, positions, x, layer)
     return x
 
 
@@ -167,7 +185,8 @@ def _block_with_aux(
     layer: Params,
 ):
     """One transformer block; also returns the layer's MoE aux-loss term
-    (0.0 for dense blocks)."""
+    (0.0 for dense blocks) and the rotary-embedded (k, v) projections (for
+    prefill cache filling)."""
     h = rms_norm(x, layer["ln1"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
@@ -178,18 +197,8 @@ def _block_with_aux(
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
 
     h = rms_norm(x, layer["ln2"])
-    aux = jnp.zeros((), jnp.float32)
-    if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
-        x = x + _moe_mlp_capacity(h, layer, cfg.moe_capacity_factor)
-    elif cfg.n_experts > 0:
-        x = x + _moe_mlp(h, layer)
-    else:
-        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, layer["w_gate"]))
-        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
-        x = x + jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"])
-    if cfg.n_experts > 0 and cfg.moe_aux_coeff > 0:
-        aux = _moe_aux_loss(h, layer)
-    return x, aux
+    delta, aux = _mlp(cfg, h, layer)
+    return x + delta, aux, k, v
 
 
 def _moe_mlp_capacity(h: jnp.ndarray, layer: Params, capacity_factor: float) -> jnp.ndarray:
@@ -233,7 +242,7 @@ def _moe_mlp_capacity(h: jnp.ndarray, layer: Params, capacity_factor: float) -> 
     out = jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"])  # (E, C, D)
 
     combined = jnp.einsum("nec,ecd->nd", dispatch, out) * gate_w[:, None]
-    return combined.reshape(b, s, d)
+    return combined.reshape(b, s, d), probs
 
 
 def _moe_mlp(h: jnp.ndarray, layer: Params) -> jnp.ndarray:
@@ -256,7 +265,7 @@ def _moe_mlp(h: jnp.ndarray, layer: Params) -> jnp.ndarray:
     gate = jax.nn.silu(jnp.einsum("ebsd,edf->ebsf", expert_in, layer["w_gate"]))
     up = jnp.einsum("ebsd,edf->ebsf", expert_in, layer["w_up"])
     out = jnp.einsum("ebsf,efd->ebsd", gate * up, layer["w_down"])
-    return jnp.einsum("ebsd,bse->bsd", out, one_hot) * weight
+    return jnp.einsum("ebsd,bse->bsd", out, one_hot) * weight, probs
 
 
 def forward(
@@ -282,7 +291,7 @@ def forward(
     body = partial(_block_with_aux, cfg, attn_fn, positions)
 
     def scan_body(carry, layer):
-        x, aux = body(carry, layer)
+        x, aux, _k, _v = body(carry, layer)
         return x, aux
 
     if cfg.remat:
@@ -294,6 +303,29 @@ def forward(
     if return_aux:
         return logits, jnp.sum(auxes)
     return logits
+
+
+def forward_with_kv(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
+    """Batched forward that also returns every layer's rotary-embedded K/V
+    stacks — the prefill path of the decode cache. Uses the exact same
+    block implementation as training (including the MoE dispatch mode), so
+    prefill can never drift from the trained model.
+
+    Returns (last-position logits (B, V) float32, ks (L, B, S, H, D),
+    vs (L, B, S, H, D)).
+    """
+    positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+    x = params["embed"][tokens]
+    body = partial(_block_with_aux, cfg, dense_causal_attention, positions)
+
+    def scan_body(carry, layer):
+        x, _aux, k, v = body(carry, layer)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, params["blocks"])
+    x = rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])[:, -1]
+    return logits.astype(jnp.float32), ks, vs
 
 
 def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:

@@ -143,3 +143,15 @@ def test_refresh_node_preserves_allocations():
     assert info.capacity[ResourceTPU] == 7
     assert info.allocatable[ResourceTPU] == 3  # 7 found - 4 held
     assert not any(f"/tpu/{free_locals[0]}/cards" in k for k in info.capacity)
+
+
+def test_event_log_records_lifecycle():
+    cluster = _gang_cluster()
+    p = cluster.schedule(
+        PodInfo(name="e1", running_containers={"m": ContainerInfo(requests={ResourceTPU: 2})})
+    )
+    cluster.release("e1")
+    cluster.fail_node(p.node_name)
+    kinds = [e["kind"] for e in cluster.events]
+    assert kinds == ["schedule", "release", "node_failed"]
+    assert cluster.status()["recent_events"][-1]["kind"] == "node_failed"

@@ -54,3 +54,29 @@ def test_sampled_generate_runs():
     out = gen(params, prompt, jax.random.PRNGKey(2), 5)
     assert out.shape == (2, 9)
     assert (np.asarray(out) >= 0).all() and (np.asarray(out) < CFG.vocab).all()
+
+
+def test_bfloat16_generate():
+    # bf16 configs must generate: prefill and per-token logits both f32 so
+    # the decode scan carry is dtype-stable
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                      dtype=jnp.bfloat16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, cfg.vocab)
+    out = make_generate(cfg)(params, prompt, jax.random.PRNGKey(2), 4)
+    assert out.shape == (2, 8)
+
+
+def test_capacity_moe_prefill_matches_training_forward():
+    # prefill must use the SAME dispatch mode as training (capacity), not a
+    # divergent copy
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                      n_experts=4, moe_capacity_factor=1.5)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab)
+    k_cache, v_cache = init_kv_cache(cfg, 2, 12)
+    logits, _, _ = prefill(cfg, params, tokens, k_cache, v_cache)
+    full = forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, -1], np.float32), rtol=2e-4, atol=2e-5
+    )

@@ -185,8 +185,8 @@ def test_moe_capacity_matches_dense_dispatch_when_roomy():
     params = init_params(jax.random.PRNGKey(0), cfg)
     layer = jax.tree.map(lambda x: x[0], params["blocks"])  # unstack layer 0
     h = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 32))
-    dense = _moe_mlp(h, layer)
-    roomy = _moe_mlp_capacity(h, layer, capacity_factor=8.0)  # C >= N
+    dense, _ = _moe_mlp(h, layer)
+    roomy, _ = _moe_mlp_capacity(h, layer, capacity_factor=8.0)  # C >= N
     np.testing.assert_allclose(np.asarray(roomy), np.asarray(dense), rtol=1e-5, atol=1e-6)
 
 
@@ -197,8 +197,8 @@ def test_moe_capacity_drops_overflow():
     params = init_params(jax.random.PRNGKey(0), cfg)
     layer = jax.tree.map(lambda x: x[0], params["blocks"])
     h = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 32))
-    tight = _moe_mlp_capacity(h, layer, capacity_factor=0.25)  # forces drops
-    roomy = _moe_mlp_capacity(h, layer, capacity_factor=8.0)
+    tight, _ = _moe_mlp_capacity(h, layer, capacity_factor=0.25)  # forces drops
+    roomy, _ = _moe_mlp_capacity(h, layer, capacity_factor=8.0)
     assert np.isfinite(np.asarray(tight)).all()
     # capacity masking must actually drop: outputs differ from the roomy
     # path, and some token rows are exactly zero (dropped -> residual only)
