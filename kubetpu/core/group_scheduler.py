@@ -204,6 +204,9 @@ def _pod_held_keys(pod_info: PodInfo) -> Set[str]:
 
 
 def _account(node_info: NodeInfo, pod_info: PodInfo, sign: int) -> None:
+    # the one in-place mutator of advertised ResourceLists: drop any
+    # memoized mesh geometry for this dict (meshstate memo contract)
+    meshstate.invalidate_mesh_state(node_info.allocatable)
     for to_key in _pod_held_keys(pod_info):
         m = _CARDS_KEY_RE.match(to_key)
         if not m:

@@ -6,11 +6,14 @@ The TPU device manager advertises one geometry key per node,
 
 alongside the per-chip grouped card keys. Chip local id <-> torus coordinate
 is a fixed bijection (row-major within the host's block), so the scheduler
-can reconstruct full geometry from the ResourceList alone — state is always
-derivable from what the node advertises, never cached scheduler-side
-(mirrors the reference's stateless rebuild-from-probe contract, SURVEY.md
-§5.4). Multi-host slices share <topology-name>; each host advertises its own
-<host-index>, giving gang placement a global coordinate frame.
+can reconstruct full geometry from the ResourceList alone — the source of
+truth is always the advertised resources (the reference's stateless
+rebuild-from-probe contract, SURVEY.md §5.4). ``parse_mesh_state`` keeps a
+derived-data memo purely as a hot-path optimization; its invalidation
+contract is documented at the memo below, and the single in-place mutator of
+advertised lists (core accounting) invalidates explicitly. Multi-host slices
+share <topology-name>; each host advertises its own <host-index>, giving
+gang placement a global coordinate frame.
 """
 
 from __future__ import annotations
@@ -21,6 +24,7 @@ from typing import Dict, Optional, Set
 
 from kubetpu.api.types import DeviceGroupPrefix, ResourceList
 from kubetpu.plugintypes.mesh import TOPOLOGIES, Coord, TpuTopology
+from kubetpu.plugintypes.treetypes import ResourceTPU
 
 # resource/group/tpu-slice/<topology-name>/<slice-uid>/<host-index>
 # (legacy 3-segment form without the slice uid is accepted: a cluster with a
@@ -71,9 +75,46 @@ class NodeMeshState:
         return self.topo.name + "/" + self.slice_uid
 
 
+# Memo for parse_mesh_state — the scheduler hot path re-parses the same
+# ResourceList dict for fit, fill, slice grouping and status. The contract:
+# the ONE code path that mutates an advertised ResourceList in place
+# (core.group_scheduler._account) MUST call invalidate_mesh_state(); every
+# other change replaces the dict object (new id). The fingerprint below is
+# belt-and-braces only — (len, scalar) is NOT injective over free-chip sets
+# (a take+return netting zero chips restores it), hence the explicit
+# invalidation. Entries hold a STRONG reference to the dict so its id
+# cannot be recycled while cached; bounded.
+_PARSE_MEMO: "dict[int, tuple]" = {}
+_PARSE_MEMO_MAX = 4096
+
+
+def _fingerprint(node_resources: ResourceList):
+    return (len(node_resources), node_resources.get(ResourceTPU, -1))
+
+
+def invalidate_mesh_state(node_resources: ResourceList) -> None:
+    """Drop the memoized geometry for a ResourceList about to be (or just)
+    mutated in place. Required by the memo contract above."""
+    _PARSE_MEMO.pop(id(node_resources), None)
+
+
 def parse_mesh_state(node_resources: ResourceList) -> Optional[NodeMeshState]:
     """Reconstruct a node's mesh geometry from its (current) allocatable
-    ResourceList; None if the node advertises no TPU slice."""
+    ResourceList; None if the node advertises no TPU slice. Memoized on
+    (dict identity, free-chip fingerprint)."""
+    key = id(node_resources)
+    hit = _PARSE_MEMO.get(key)
+    fp = _fingerprint(node_resources)
+    if hit is not None and hit[0] is node_resources and hit[1] == fp:
+        return hit[2]
+    state = _parse_mesh_state_uncached(node_resources)
+    if len(_PARSE_MEMO) >= _PARSE_MEMO_MAX:
+        _PARSE_MEMO.clear()
+    _PARSE_MEMO[key] = (node_resources, fp, state)
+    return state
+
+
+def _parse_mesh_state_uncached(node_resources: ResourceList) -> Optional[NodeMeshState]:
     topo: Optional[TpuTopology] = None
     host_index = 0
     slice_uid = DEFAULT_SLICE_UID

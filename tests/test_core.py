@@ -249,3 +249,21 @@ def test_gpu_pool_spills_across_groups():
     assert len(af) == 6
     assert len(set(af.values())) == 6
     assert cluster.nodes["gpu-node"].info.allocatable[ResourceGPU] == 2
+
+
+def test_mesh_state_memo_survives_net_zero_churn():
+    """Regression: take+return netting zero chips must NOT serve a stale
+    memoized mesh state (the (len, scalar) fingerprint aliases; explicit
+    invalidation from the accounting path is load-bearing)."""
+    cluster = v5e8_cluster()
+    a = cluster.schedule(tpu_pod("a", 4))
+    a_chips = set(a.running_containers["main"].allocate_from.values())
+    b = cluster.schedule(tpu_pod("b", 4))  # parses at scalar 4
+    cluster.release("a")                   # scalar back to 4: aliases b's parse
+    c = cluster.schedule(tpu_pod("c", 4))  # must get a's freed chips, not b's
+    c_chips = set(c.running_containers["main"].allocate_from.values())
+    b_chips = set(b.running_containers["main"].allocate_from.values())
+    assert c_chips == a_chips
+    assert c_chips.isdisjoint(b_chips)
+    # no negative card values anywhere
+    assert all(v >= 0 for v in cluster.nodes["v5e8-n0"].info.allocatable.values())
