@@ -22,6 +22,14 @@ test: tpuinfo
 bench: tpuinfo
 	python bench.py
 
+.PHONY: schedsim
+schedsim:
+	python -m kubetpu.cli.schedsim
+
+.PHONY: demo
+demo:
+	python examples/train_demo.py
+
 .PHONY: clean
 clean:
 	rm -rf $(BUILD_DIR)/*
