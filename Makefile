@@ -30,6 +30,10 @@ schedsim:
 demo:
 	python examples/train_demo.py
 
+.PHONY: wire-demo
+wire-demo:
+	python examples/wire_demo.py
+
 .PHONY: clean
 clean:
 	rm -rf $(BUILD_DIR)/*

@@ -1,0 +1,104 @@
+"""End-to-end demo of the agent wire: REAL agent processes, one control
+plane, a gang scheduled over HTTP, a killed agent driving elastic recovery.
+
+What happens (each step printed):
+1. Spawn 4 ``kubetpu-agent --serve`` processes — one per v5e-64 host
+   (fake probe), each on an ephemeral port.
+2. The control plane registers them over the wire and gang-schedules a
+   2-host x 8-chip job; AllocateFrom is filled control-plane-side, the
+   container-start injection (``POST /allocate``) runs node-side where the
+   devices live.
+3. SIGKILL one gang member's agent. The next poll detects the dead node,
+   evicts its pod, and the worker reschedules onto a surviving host.
+
+This is the process topology the reference has (CRI shim / scheduler /
+nvmlinfo as separate processes, SURVEY.md §3) with the transport leg the
+reference left to the external KubeDevice core.
+
+    python examples/wire_demo.py
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubetpu.api.types import ContainerInfo, PodInfo  # noqa: E402
+from kubetpu.core import Cluster  # noqa: E402
+from kubetpu.plugintypes import ResourceTPU  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tpu_pod(name, chips):
+    return PodInfo(
+        name=name,
+        running_containers={"main": ContainerInfo(requests={ResourceTPU: chips})},
+    )
+
+
+def spawn_agent(host_index):
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "kubetpu.cli.agent", "--serve",
+            "--fake", "v5e-64", "--host", str(host_index), "--port", "0",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, cwd=REPO, text=True,
+    )
+    hello = json.loads(proc.stdout.readline())
+    return proc, hello["listening"], hello["node"]
+
+
+def main():
+    agents = [spawn_agent(h) for h in range(4)]
+    try:
+        for _p, url, name in agents:
+            print(f"agent up: {name} at {url}")
+
+        cluster = Cluster()
+        for _p, url, _n in agents:
+            info = cluster.register_remote_node(url)
+            print(f"registered {info.name}: {info.allocatable[ResourceTPU]} chips free")
+
+        gang = [tpu_pod("w0", 8), tpu_pod("w1", 8)]
+        placed = cluster.schedule_gang(gang)
+        print(f"gang placed on {[p.node_name for p in placed]}, "
+              f"contiguity={cluster.gang_contiguity(placed)}")
+        for p in placed:
+            _m, devices, env = cluster.allocate(p.name)["main"]
+            print(f"  {p.name} on {p.node_name}: {len(devices)} devices, "
+                  f"TPU_VISIBLE_DEVICES={env['TPU_VISIBLE_DEVICES']}")
+
+        victim_node = placed[0].node_name
+        victim = next(p for p, _u, n in agents if n == victim_node)
+        print(f"\nSIGKILL agent of {victim_node} (pid {victim.pid})")
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+
+        evicted = cluster.poll_remote_nodes()
+        for node, pods in evicted.items():
+            print(f"node {node} failed; evicted {[p.name for p in pods]}")
+            for pod in pods:
+                again = cluster.schedule(pod)
+                _m, devices, env = cluster.allocate(again.name)["main"]
+                print(f"  {again.name} rescheduled -> {again.node_name} "
+                      f"({len(devices)} devices)")
+
+        print("\nfinal status:")
+        status = cluster.status()
+        for name, entry in status["nodes"].items():
+            print(f"  {name}: free_chips={entry.get('free_chips')} pods={entry['pods']}")
+        print("wire demo OK")
+        return 0
+    finally:
+        for p, _u, _n in agents:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

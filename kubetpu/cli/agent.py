@@ -2,10 +2,21 @@
 
 The process-topology counterpart of the reference's CRI-shim side (process
 A in SURVEY.md §3): loads the device plugin, probes on a cadence (the
-manager's 5-minute probe cache bounds actual hardware queries), and emits
-the node's advertisement as a JSON line whenever it changes — the stream a
-control plane (or an operator's pipe) consumes.
+manager's 5-minute probe cache bounds actual hardware queries), and serves
+the node to the control plane.
 
+Two modes:
+
+- ``--serve`` (the real wire): an HTTP server exposing ``GET /nodeinfo`` +
+  ``POST /allocate`` (see ``kubetpu.wire.server``). On startup it prints ONE
+  JSON line ``{"listening": "http://...", "node": ...}`` so spawners can
+  discover the ephemeral port, then serves until killed. The control plane
+  registers it via ``Cluster.register_remote_node(url)``.
+- legacy stream mode (default): emit the advertisement as a JSON line
+  whenever it changes — for operator pipes and diagnostics.
+
+    python -m kubetpu.cli.agent --serve [--port P] [--name NODE]
+                                [--fake TOPO] [--host N] [--slice-uid UID]
     python -m kubetpu.cli.agent [--fake TOPO] [--host N] [--interval S]
                                 [--iterations N]
 """
@@ -31,26 +42,59 @@ def _advertisement(dev) -> dict:
     }
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(prog="kubetpu-agent", description=__doc__)
-    ap.add_argument("--fake", metavar="TOPO", default=None,
-                    help="fake backend topology (e.g. v5e-8); default: native probe")
-    ap.add_argument("--host", type=int, default=0)
-    ap.add_argument("--interval", type=float, default=60.0,
-                    help="seconds between advertisement refreshes")
-    ap.add_argument("--iterations", type=int, default=0,
-                    help="stop after N refreshes (0 = run forever)")
-    args = ap.parse_args(argv)
-
+def _make_device(args):
     if args.fake:
         from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
 
-        dev = new_fake_tpu_dev_manager(make_fake_tpus_info(args.fake, args.host))
+        missing = tuple(int(x) for x in args.missing.split(",") if x) if args.missing else ()
+        dev = new_fake_tpu_dev_manager(
+            make_fake_tpus_info(
+                args.fake, args.host, missing_chips=missing, slice_uid=args.slice_uid
+            )
+        )
     else:
         from kubetpu.device import new_tpu_dev_manager
 
         dev = new_tpu_dev_manager()
     dev.start()
+    return dev
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubetpu-agent", description=__doc__)
+    ap.add_argument("--fake", metavar="TOPO", default=None,
+                    help="fake backend topology (e.g. v5e-8); default: native probe")
+    ap.add_argument("--host", type=int, default=0)
+    ap.add_argument("--slice-uid", default="slice0",
+                    help="physical slice uid for the fake backend")
+    ap.add_argument("--missing", default="",
+                    help="comma-separated local chip ids to fault-inject as absent")
+    ap.add_argument("--serve", action="store_true",
+                    help="serve the agent HTTP wire instead of streaming JSON lines")
+    ap.add_argument("--bind", default="127.0.0.1", help="--serve bind address")
+    ap.add_argument("--port", type=int, default=0,
+                    help="--serve port (0 = ephemeral, printed on startup)")
+    ap.add_argument("--name", default=None,
+                    help="node name to advertise (default: <topo>-h<host> or 'local')")
+    ap.add_argument("--interval", type=float, default=60.0,
+                    help="stream mode: seconds between advertisement refreshes")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="stream mode: stop after N refreshes (0 = run forever)")
+    args = ap.parse_args(argv)
+
+    dev = _make_device(args)
+
+    if args.serve:
+        from kubetpu.wire import NodeAgentServer
+
+        name = args.name or (f"{args.fake}-h{args.host}" if args.fake else "local")
+        server = NodeAgentServer(dev, name, host=args.bind, port=args.port)
+        print(json.dumps({"listening": server.address, "node": name}), flush=True)
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        return 0
 
     last = None
     iteration = 0

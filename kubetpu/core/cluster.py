@@ -18,6 +18,7 @@ Flow per pod (mirrors the reference's documented call stack, SURVEY.md §3.3):
 
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -28,9 +29,21 @@ from kubetpu.api.devicescheduler import DeviceScheduler
 from kubetpu.api.types import NodeInfo, PodInfo, new_node_info
 from kubetpu.core import group_scheduler
 from kubetpu.core.metrics import LatencyRecorder
+from kubetpu.plugintypes import ResourceGPU, ResourceTPU
+from kubetpu.plugintypes.mesh import (
+    TpuTopology,
+    contiguity_score,
+    enumerate_blocks,
+    factorizations,
+    find_contiguous_block,
+    find_perfect_block,
+    internal_links,
+)
 from kubetpu.scheduler import meshstate
+from kubetpu.scheduler.deviceclass import TPU
 from kubetpu.scheduler.gpu_scheduler import GpuScheduler
 from kubetpu.scheduler.tpu_scheduler import TpuScheduler
+from kubetpu.scheduler.translate import pod_device_count
 
 
 class SchedulingError(Exception):
@@ -62,6 +75,19 @@ class Migration:
     to_node: str
 
 
+def _reset_for_reschedule(pod: PodInfo) -> PodInfo:
+    """A schedulable copy of a placed pod: placement artifacts stripped so
+    it can go back through the full schedule path."""
+    fresh = pod.copy()
+    fresh.node_name = ""
+    for cont in list(fresh.init_containers.values()) + list(
+        fresh.running_containers.values()
+    ):
+        cont.allocate_from.clear()
+        cont.dev_requests.clear()
+    return fresh
+
+
 class Cluster:
     """Node registry + scheduling loop over the device-scheduler plugins."""
 
@@ -88,12 +114,15 @@ class Cluster:
         name: str,
         device: Optional[Device] = None,
         node_info: Optional[NodeInfo] = None,
+        probe: bool = True,
     ) -> NodeInfo:
         """Register a node from its device manager's advertisement (or a
-        prebuilt NodeInfo), and AddNode it into every scheduler plugin."""
+        prebuilt NodeInfo), and AddNode it into every scheduler plugin.
+        ``probe=False`` skips the device probe when *node_info* already holds
+        a fresh advertisement (avoids a duplicate wire round-trip)."""
         info = node_info if node_info is not None else new_node_info(name)
         info.name = name
-        if device is not None:
+        if device is not None and probe:
             device.update_node_info(info)
         for s in self.schedulers:
             s.add_node(name, info)
@@ -128,6 +157,59 @@ class Cluster:
         for s in self.schedulers:
             s.add_node(name, node.info)
         return node.info
+
+    # -- remote nodes (the agent wire) --------------------------------------
+
+    def register_remote_node(self, url: str, name: Optional[str] = None) -> NodeInfo:
+        """Register a node served by a live agent process (``kubetpu-agent
+        --serve``): probe it over the wire and enter it into the scheduling
+        loop exactly like an in-process manager. The node's advertised name
+        is used unless *name* overrides it. Raises ``AgentUnreachable`` when
+        no agent answers at *url*."""
+        from kubetpu.wire import RemoteDevice
+
+        dev = RemoteDevice(url)
+        dev.start()  # health check — fail fast on a dead address
+        info = new_node_info(name or "")
+        dev.update_node_info(info)
+        if not info.name:
+            raise ValueError(f"agent at {url} advertises no node name; pass name=")
+        if info.name in self.nodes:
+            # Silently replacing would drop the existing node's placed pods
+            # from control-plane state; the caller must fail_node/remove_node
+            # first (or name the agents distinctly).
+            raise ValueError(
+                f"node {info.name!r} is already registered; fail_node/remove_node "
+                f"it first, or start the agent with a distinct --name"
+            )
+        self._event("register_remote", node=info.name, url=url)
+        # probe=False: the advertisement above is fresh — don't re-GET.
+        return self.register_node(info.name, device=dev, node_info=info, probe=False)
+
+    def poll_remote_nodes(self) -> Dict[str, List[PodInfo]]:
+        """Refresh every remote (agent-backed) node; a node whose agent has
+        died is failed (``fail_node``) and its evicted pods returned, keyed
+        by node name, for the caller to requeue — the cross-process leg of
+        elastic recovery (SURVEY.md §5.3). Healthy nodes re-advertise, so
+        chips that disappeared from a live agent's probe also stop being
+        scheduled onto."""
+        from kubetpu.wire import AgentUnreachable, RemoteDevice
+
+        evicted: Dict[str, List[PodInfo]] = {}
+        for name in utils.sorted_string_keys(self.nodes):
+            node = self.nodes.get(name)
+            if node is None or not isinstance(node.device, RemoteDevice):
+                continue
+            try:
+                self.refresh_node(name)
+            except AgentUnreachable:
+                evicted[name] = self.fail_node(name)
+            except RuntimeError as e:
+                # The agent answered but its probe failed (HTTP 500): the
+                # node is degraded, not dead — keep its last advertisement,
+                # keep polling the rest of the fleet.
+                utils.errorf("refresh of %s failed (degraded agent): %s", name, e)
+        return evicted
 
     # -- per-pod scheduling (the hot path) ----------------------------------
 
@@ -231,10 +313,21 @@ class Cluster:
         t0 = time.perf_counter()
         try:
             slices = self._tpu_slices()
+            # A pod may carry the chip count in device-native requests OR
+            # kube-native requests (set_device_reqs max-merges them later, on
+            # per-node copies) — consider both so a kube-only gang is still
+            # pinned to a single slice below.
             tpu_gang = all(
                 any(
-                    cont.requests.get("kubedevice/tpu", 0) > 0
-                    for cont in pod.running_containers.values()
+                    max(
+                        cont.requests.get(ResourceTPU, 0),
+                        cont.kube_requests.get(ResourceTPU, 0),
+                    )
+                    > 0
+                    for cont in itertools.chain(
+                        pod.running_containers.values(),
+                        pod.init_containers.values(),
+                    )
                 )
                 for pod in pods
             ) and bool(pods)
@@ -272,14 +365,6 @@ class Cluster:
         region of the torus, via rectangle search on the *host grid*."""
         if k > len(slice_nodes):
             return None
-        from kubetpu.plugintypes.mesh import (
-            TpuTopology,
-            enumerate_blocks,
-            factorizations,
-            find_contiguous_block,
-            internal_links,
-        )
-
         states = {}
         for name in slice_nodes:
             st = meshstate.parse_mesh_state(self.nodes[name].info.allocatable)
@@ -306,11 +391,9 @@ class Cluster:
         # anisotropic (2x4), so 2 hosts stacked along x give a 4x4 chip
         # square while 2 along y give a 2x8 strip.
         def chip_links(shape):
-            import itertools as _it
-
             region = [
                 tuple(c for c in coord)
-                for coord in _it.product(
+                for coord in itertools.product(
                     *(range(s * h) for s, h in zip(shape, topo.host_shape))
                 )
             ]
@@ -347,6 +430,24 @@ class Cluster:
                 self.release(p.name)
             raise
         return placed
+
+    def _restore_pods(self, pods: Sequence[PodInfo], node_name: str) -> List[PodInfo]:
+        """Best-effort re-placement of released pods (rollback paths):
+        pinned to *node_name* first (their resources are typically still
+        free there), anywhere as fallback. Returns the pods that could not
+        be restored — callers must surface those, never drop them."""
+        lost: List[PodInfo] = []
+        for p in pods:
+            try:
+                self.schedule(p.copy(), lambda n, h=node_name: n == h)
+                continue
+            except SchedulingError:
+                pass
+            try:
+                self.schedule(p.copy())
+            except SchedulingError:
+                lost.append(p)
+        return lost
 
     def _try_gang(
         self, pods: Sequence[PodInfo], node_filter: Optional[Callable[[str], bool]]
@@ -393,14 +494,19 @@ class Cluster:
         except SchedulingError:
             pass
 
-        from kubetpu.plugintypes.mesh import find_contiguous_block
-        from kubetpu.scheduler.deviceclass import TPU
-        from kubetpu.scheduler.translate import pod_device_count
-
         prio = pod_priority(pod)
         probe = pod.copy()
-        for cont in probe.running_containers.values():
-            cont.requests.setdefault(TPU.resource_name, cont.kube_requests.get(TPU.resource_name, 0))
+        # Same kube/device max-merge as set_device_reqs, over BOTH container
+        # kinds — a pod carrying its chip count only in an init container's
+        # kube_requests is still preemption-eligible (mirrors the
+        # schedule_gang TPU-gang detection above).
+        for cont in itertools.chain(
+            probe.running_containers.values(), probe.init_containers.values()
+        ):
+            cont.requests[TPU.resource_name] = max(
+                cont.requests.get(TPU.resource_name, 0),
+                cont.kube_requests.get(TPU.resource_name, 0),
+            )
         n = pod_device_count(TPU, probe)
         if n == 0:
             raise SchedulingError(f"pod {pod.name!r}: no node fits (nothing to preempt for)")
@@ -429,15 +535,23 @@ class Cluster:
             evicted: List[PodInfo] = []
             for victim in chosen:
                 self.release(victim.name)
-                fresh = victim.copy()
-                fresh.node_name = ""
-                for cont in list(fresh.init_containers.values()) + list(
-                    fresh.running_containers.values()
-                ):
-                    cont.allocate_from.clear()
-                    cont.dev_requests.clear()
-                evicted.append(fresh)
-            placed = self.schedule(pod, lambda c, node_name=name: c == node_name)
+                evicted.append(_reset_for_reschedule(victim))
+            # The geometric pre-check is TPU-only: the pinned schedule can
+            # still fail on another dimension (e.g. the pod also wants GPUs
+            # this node lacks). Never drop the already-evicted victims —
+            # restore them (their resources are still free) and move on to
+            # the next candidate node.
+            try:
+                placed = self.schedule(pod, lambda c, node_name=name: c == node_name)
+            except SchedulingError:
+                lost = self._restore_pods(evicted, name)
+                if lost:  # cannot happen while resources are untouched, but
+                    # never swallow a pod silently
+                    raise SchedulingError(
+                        f"pod {pod.name!r}: preemption rollback failed to "
+                        f"restore {[p.name for p in lost]} on {name}"
+                    )
+                continue
             utils.logf(
                 0, "pod %s (priority %d) preempted %s on %s",
                 pod.name, prio, [v.name for v in evicted], name,
@@ -469,11 +583,6 @@ class Cluster:
         through the full scheduler (with rollback), so a plan invalidated by
         concurrent scheduling fails safely rather than dropping pods.
         """
-        import itertools as it
-
-        from kubetpu.plugintypes.mesh import find_contiguous_block, find_perfect_block
-        from kubetpu.plugintypes import ResourceGPU
-
         states = {}
         for name in utils.sorted_string_keys(self.nodes):
             st = meshstate.parse_mesh_state(self.nodes[name].info.allocatable)
@@ -503,7 +612,7 @@ class Cluster:
                     victim_coords[p.name] = (p, vcoords)
             resident = list(victim_coords.values())
             for r in range(1, min(max_migrations, len(resident)) + 1):
-                for combo in it.combinations(resident, r):
+                for combo in itertools.combinations(resident, r):
                     avail = set(st.free)
                     for _victim, vcoords in combo:
                         avail |= set(vcoords)
@@ -553,14 +662,7 @@ class Cluster:
         originals: List[Tuple[Migration, PodInfo]] = []
         for mig in plan:
             pod = self.nodes[mig.from_node].pods[mig.pod_name]
-            fresh = pod.copy()
-            fresh.node_name = ""
-            for cont in list(fresh.init_containers.values()) + list(
-                fresh.running_containers.values()
-            ):
-                cont.allocate_from.clear()
-                cont.dev_requests.clear()
-            originals.append((mig, fresh))
+            originals.append((mig, _reset_for_reschedule(pod)))
             self.release(mig.pod_name)
 
         placed_pending: Optional[PodInfo] = None
@@ -576,13 +678,27 @@ class Cluster:
                 except SchedulingError:
                     moved.append(self.schedule(fresh))  # anywhere fallback
             return moved, placed_pending
-        except SchedulingError:
+        except SchedulingError as exc:
             for p in moved:
                 self.release(p.name)
             if placed_pending is not None:
                 self.release(placed_pending.name)
+            # Restore each original to its source node, falling back to an
+            # unpinned placement if cluster state changed concurrently; an
+            # irrecoverable pod is surfaced in the raised error, never
+            # silently dropped.
+            lost: List[PodInfo] = []
             for mig, fresh in originals:
-                self.schedule(fresh.copy(), lambda n, src=mig.from_node: n == src)
+                lost.extend(self._restore_pods([fresh], mig.from_node))
+            if lost:
+                utils.errorf(
+                    "defrag execution failed; pods %s could not be restored",
+                    [p.name for p in lost],
+                )
+                raise SchedulingError(
+                    f"defrag rollback could not restore pods "
+                    f"{[p.name for p in lost]} (cause: {exc})"
+                ) from exc
             utils.errorf("defrag execution failed; all pods restored")
             raise
 
@@ -603,16 +719,7 @@ class Cluster:
         node = self.nodes.get(name)
         if node is None:
             return []
-        evicted: List[PodInfo] = []
-        for pod in node.pods.values():
-            fresh = pod.copy()
-            fresh.node_name = ""
-            for cont in list(fresh.init_containers.values()) + list(
-                fresh.running_containers.values()
-            ):
-                cont.allocate_from.clear()
-                cont.dev_requests.clear()
-            evicted.append(fresh)
+        evicted = [_reset_for_reschedule(pod) for pod in node.pods.values()]
         self.remove_node(name)
         utils.logf(0, "node %s failed; %d pods evicted for rescheduling", name, len(evicted))
         self._event("node_failed", node=name, evicted=[p.name for p in evicted])
@@ -630,8 +737,6 @@ class Cluster:
             entry: Dict[str, object] = {
                 "pods": sorted(node.pods),
             }
-            from kubetpu.plugintypes import ResourceGPU, ResourceTPU
-
             for scalar in (ResourceTPU, ResourceGPU):
                 if scalar in node.info.capacity:
                     entry[scalar] = {
@@ -684,6 +789,4 @@ class Cluster:
             coords.extend(pod_coords)
         if topo is None or not coords:
             return 0.0
-        from kubetpu.plugintypes.mesh import contiguity_score
-
         return contiguity_score(coords, topo)

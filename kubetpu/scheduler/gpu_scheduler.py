@@ -13,6 +13,7 @@ from __future__ import annotations
 from kubetpu.api import utils
 from kubetpu.api.devicescheduler import DeviceScheduler, FitResult, PredicateFailureReason
 from kubetpu.api.types import DeviceGroupPrefix, NodeInfo, PodInfo
+from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import GPU
 from kubetpu.scheduler.translate import (
     pod_device_count,
@@ -35,6 +36,9 @@ class GpuScheduler(DeviceScheduler):
         synthetic = {
             DeviceGroupPrefix + "/gpugrp1/A/gpugrp0/B/gpu/GPU0/cards": 1,
         }
+        # In-place mutation of allocatable follows — invalidate the mesh
+        # memo keyed on this dict (same contract as TpuScheduler.add_node).
+        meshstate.invalidate_mesh_state(node_info.allocatable)
         node_info.allocatable = translate_device_resources(
             GPU,
             node_info.kube_alloc.get(GPU.resource_name, 0),

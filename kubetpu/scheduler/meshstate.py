@@ -77,9 +77,11 @@ class NodeMeshState:
 
 # Memo for parse_mesh_state — the scheduler hot path re-parses the same
 # ResourceList dict for fit, fill, slice grouping and status. The contract:
-# the ONE code path that mutates an advertised ResourceList in place
-# (core.group_scheduler._account) MUST call invalidate_mesh_state(); every
-# other change replaces the dict object (new id). The fingerprint below is
+# every code path that mutates an advertised ResourceList in place MUST call
+# invalidate_mesh_state() — today that is core.group_scheduler._account and
+# the schedulers' add_node stage-1 translation (add_group_resource mutates
+# allocatable before re-assignment); every other change replaces the dict
+# object (new id). The fingerprint below is
 # belt-and-braces only — (len, scalar) is NOT injective over free-chip sets
 # (a take+return netting zero chips restores it), hence the explicit
 # invalidation. Entries hold a STRONG reference to the dict so its id

@@ -47,6 +47,10 @@ class TpuScheduler(DeviceScheduler):
         synthetic = {
             DeviceGroupPrefix + "/tpugrp1/A/tpugrp0/B/tpu/TPU0/cards": 1,
         }
+        # The translation below mutates node_info.allocatable in place
+        # (add_group_resource) before re-assigning it — drop any memoized
+        # geometry keyed on the old dict identity (meshstate memo contract).
+        meshstate.invalidate_mesh_state(node_info.allocatable)
         node_info.allocatable = translate_device_resources(
             TPU,
             node_info.kube_alloc.get(TPU.resource_name, 0),

@@ -1,0 +1,41 @@
+"""The agent <-> control-plane wire boundary.
+
+The reference's architecture is process boundaries: the CRI shim dlopens the
+device plugin on every node (``nvidiagpuplugin/cmd/main.go:23``), the
+scheduler runs as a separate control-plane process, and hardware probes cross
+an exec/HTTP wire (``nvidiagpuplugin/gpu/nvgputypes/types.go:45-58``,
+``nvidia_docker_plugin.go:21-27``). The reference itself ships only the
+node-local legs and leaves agent<->scheduler transport to the external
+KubeDevice core; kubetpu owns the core, so it owns this boundary too:
+
+- ``codec``  — JSON encodings of the KubeDevice-API types (the wire format).
+- ``server`` — ``NodeAgentServer``: the node agent's HTTP surface
+  (``GET /healthz``, ``GET /nodeinfo``, ``POST /allocate``) over a local
+  device manager.
+- ``client`` — ``RemoteDevice``: a ``device.Device`` whose probe and
+  allocate legs cross the wire, so a ``Cluster`` schedules across live agent
+  processes with zero changes to the scheduling path.
+"""
+
+from kubetpu.wire.client import AgentUnreachable, RemoteDevice
+from kubetpu.wire.codec import (
+    allocate_result_from_json,
+    allocate_result_to_json,
+    node_info_from_json,
+    node_info_to_json,
+    pod_info_from_json,
+    pod_info_to_json,
+)
+from kubetpu.wire.server import NodeAgentServer
+
+__all__ = [
+    "AgentUnreachable",
+    "NodeAgentServer",
+    "RemoteDevice",
+    "allocate_result_from_json",
+    "allocate_result_to_json",
+    "node_info_from_json",
+    "node_info_to_json",
+    "pod_info_from_json",
+    "pod_info_to_json",
+]

@@ -1,0 +1,104 @@
+"""``RemoteDevice`` — a ``device.Device`` whose node lives in another
+process, reached over the agent HTTP wire.
+
+The control-plane counterpart of ``NodeAgentServer``: ``update_node_info``
+becomes ``GET /nodeinfo`` and ``allocate`` becomes ``POST /allocate``, so a
+``Cluster`` registers a live agent exactly like an in-process manager —
+``refresh_node`` polls the wire, ``Cluster.allocate`` calls through it. A
+dead agent raises ``AgentUnreachable``; ``Cluster.poll_remote_nodes`` turns
+that into the ``fail_node`` -> reschedule path (SURVEY.md §5.3).
+
+Follows the reference's HTTP-backend pattern (``NvidiaDockerPlugin``'s REST
+client against localhost:3476, ``nvidia_docker_plugin.go:21-27``) with
+stdlib urllib — no third-party HTTP dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from kubetpu.api.device import AllocateResult, Device
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo
+from kubetpu.wire.codec import (
+    allocate_result_from_json,
+    node_info_from_json,
+    pod_info_to_json,
+)
+
+
+class AgentUnreachable(ConnectionError):
+    """The node agent did not answer — treat the node as failed."""
+
+
+class RemoteDevice(Device):
+    """Device manager proxy over a node agent's HTTP surface."""
+
+    def __init__(self, url: str, timeout: float = 5.0) -> None:
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self._plugin_name: Optional[str] = None
+
+    # -- transport ----------------------------------------------------------
+
+    def _request(self, path: str, payload: Optional[dict] = None) -> dict:
+        req = urllib.request.Request(
+            self.url + path,
+            data=None if payload is None else json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="GET" if payload is None else "POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # The agent answered with an application error — surface it as a
+            # normal failure, NOT as node death.
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                detail = str(e)
+            raise RuntimeError(f"agent {self.url}{path}: {detail}") from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+            raise AgentUnreachable(f"agent {self.url} unreachable: {e}") from e
+
+    # -- Device surface ------------------------------------------------------
+
+    def new(self) -> None:
+        """Nothing to initialize locally; state lives in the agent."""
+
+    def start(self) -> None:
+        """Health-check the agent (raises AgentUnreachable if down)."""
+        health = self._request("/healthz")
+        self._plugin_name = health.get("plugin")
+
+    def update_node_info(self, node_info: NodeInfo) -> None:
+        remote = node_info_from_json(self._request("/nodeinfo"))
+        node_info.capacity = remote.capacity
+        node_info.allocatable = remote.allocatable
+        node_info.kube_cap = remote.kube_cap
+        node_info.kube_alloc = remote.kube_alloc
+        if not node_info.name:
+            node_info.name = remote.name
+
+    def allocate(self, pod: PodInfo, container: ContainerInfo) -> AllocateResult:
+        cname = next(
+            (
+                n
+                for n, c in list(pod.running_containers.items())
+                + list(pod.init_containers.items())
+                if c is container
+            ),
+            None,
+        )
+        if cname is None:
+            raise ValueError("container is not part of pod")
+        result = self._request(
+            "/allocate", {"pod": pod_info_to_json(pod), "container": cname}
+        )
+        return allocate_result_from_json(result)
+
+    def get_name(self) -> str:
+        return self._plugin_name or "remote"

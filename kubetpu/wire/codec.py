@@ -1,0 +1,116 @@
+"""JSON codecs for the KubeDevice-API types — the agent wire format.
+
+The reference's wire formats are JSON throughout (``nvmlinfo json`` exec
+boundary, ``nvgputypes/types.go:45-58``; nvidia-docker REST,
+``nvidia_docker_plugin.go:21-27``); these codecs extend the same convention
+to the NodeInfo/PodInfo/AllocateResult shapes that cross the agent <->
+control-plane boundary. Resource quantities stay integers; resource keys are
+the grouped-key grammar strings and round-trip untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from kubetpu.api.device import AllocateResult, Mount
+from kubetpu.api.types import ContainerInfo, NodeInfo, PodInfo
+
+
+def node_info_to_json(info: NodeInfo) -> dict:
+    return {
+        "name": info.name,
+        "capacity": dict(info.capacity),
+        "allocatable": dict(info.allocatable),
+        "kube_cap": dict(info.kube_cap),
+        "kube_alloc": dict(info.kube_alloc),
+    }
+
+
+def node_info_from_json(obj: dict) -> NodeInfo:
+    return NodeInfo(
+        name=obj.get("name", ""),
+        capacity=dict(obj.get("capacity", {})),
+        allocatable=dict(obj.get("allocatable", {})),
+        kube_cap=dict(obj.get("kube_cap", {})),
+        kube_alloc=dict(obj.get("kube_alloc", {})),
+    )
+
+
+def _container_to_json(cont: ContainerInfo) -> dict:
+    return {
+        "requests": dict(cont.requests),
+        "kube_requests": dict(cont.kube_requests),
+        "dev_requests": dict(cont.dev_requests),
+        "allocate_from": dict(cont.allocate_from),
+    }
+
+
+def _container_from_json(obj: dict) -> ContainerInfo:
+    return ContainerInfo(
+        requests=dict(obj.get("requests", {})),
+        kube_requests=dict(obj.get("kube_requests", {})),
+        dev_requests=dict(obj.get("dev_requests", {})),
+        allocate_from=dict(obj.get("allocate_from", {})),
+    )
+
+
+def pod_info_to_json(pod: PodInfo) -> dict:
+    return {
+        "name": pod.name,
+        "node_name": pod.node_name,
+        "requests": dict(pod.requests),
+        "init_containers": {
+            k: _container_to_json(v) for k, v in pod.init_containers.items()
+        },
+        "running_containers": {
+            k: _container_to_json(v) for k, v in pod.running_containers.items()
+        },
+    }
+
+
+def pod_info_from_json(obj: dict) -> PodInfo:
+    return PodInfo(
+        name=obj.get("name", ""),
+        node_name=obj.get("node_name", ""),
+        requests=dict(obj.get("requests", {})),
+        init_containers={
+            k: _container_from_json(v)
+            for k, v in obj.get("init_containers", {}).items()
+        },
+        running_containers={
+            k: _container_from_json(v)
+            for k, v in obj.get("running_containers", {}).items()
+        },
+    )
+
+
+def allocate_result_to_json(result: AllocateResult) -> dict:
+    mounts, devices, env = result
+    return {
+        "mounts": [
+            {
+                "name": m.name,
+                "host_path": m.host_path,
+                "container_path": m.container_path,
+                "read_only": m.read_only,
+            }
+            for m in mounts
+        ],
+        "devices": list(devices),
+        "env": dict(env),
+    }
+
+
+def allocate_result_from_json(obj: dict) -> AllocateResult:
+    mounts: List[Mount] = [
+        Mount(
+            name=m.get("name", ""),
+            host_path=m.get("host_path", ""),
+            container_path=m.get("container_path", ""),
+            read_only=m.get("read_only", True),
+        )
+        for m in obj.get("mounts", [])
+    ]
+    devices: List[str] = list(obj.get("devices", []))
+    env: Dict[str, str] = dict(obj.get("env", {}))
+    return mounts, devices, env

@@ -267,3 +267,30 @@ def test_mesh_state_memo_survives_net_zero_churn():
     assert c_chips.isdisjoint(b_chips)
     # no negative card values anywhere
     assert all(v >= 0 for v in cluster.nodes["v5e8-n0"].info.allocatable.values())
+
+
+def test_gang_kube_only_requests_single_slice_guard():
+    """A gang whose chip counts ride ONLY kube-native requests is still a
+    TPU gang: when no single slice can host it, schedule_gang must raise
+    rather than silently straddle slices over DCN (ADVICE r1 medium)."""
+    cluster = Cluster()
+    for uid in ("podA", "podB"):
+        cluster.register_node(
+            f"{uid}-h0",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-8", slice_uid=uid)
+            ),
+        )
+
+    def kube_pod(name):
+        return PodInfo(
+            name=name,
+            running_containers={
+                "main": ContainerInfo(kube_requests={ResourceTPU: 8})
+            },
+        )
+
+    with pytest.raises(SchedulingError):
+        cluster.schedule_gang([kube_pod("w0"), kube_pod("w1")])
+    for node in cluster.nodes.values():  # all-or-nothing left no residue
+        assert not node.pods

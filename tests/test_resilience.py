@@ -286,3 +286,33 @@ def test_defrag_plan_none_when_capacity_short():
     )
     cluster.schedule(tpu_pod("a", 6))
     assert cluster.defrag_plan(4) is None  # only 2 free anywhere, no 2nd node
+
+
+def test_preemption_rollback_when_other_dimension_rejects():
+    """The geometric feasibility pre-check is TPU-only: when the pinned
+    schedule after eviction is rejected on another dimension (the pod also
+    wants GPUs the node lacks), the already-evicted victims must be restored
+    with their chips, never dropped (ADVICE r1 medium)."""
+    from kubetpu.core.cluster import PriorityKey
+    from kubetpu.plugintypes import ResourceGPU
+
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    cluster.schedule(tpu_pod("low", 8))
+
+    greedy = PodInfo(
+        name="greedy",
+        running_containers={
+            "main": ContainerInfo(requests={ResourceTPU: 8, ResourceGPU: 1})
+        },
+    )
+    greedy.requests[PriorityKey] = 10
+    try:
+        cluster.schedule_preempting(greedy)
+        assert False, "must not place a pod whose GPU leg can never fit"
+    except SchedulingError:
+        pass
+    assert "low" in cluster.nodes["n0"].pods  # victim restored
+    assert cluster.nodes["n0"].info.allocatable[ResourceTPU] == 0  # chips held
