@@ -26,6 +26,10 @@ bench: tpuinfo
 schedsim:
 	python -m kubetpu.cli.schedsim
 
+.PHONY: bench-adversarial
+bench-adversarial:
+	python -m kubetpu.cli.schedsim --config 8 9 10
+
 .PHONY: demo
 demo:
 	python examples/train_demo.py

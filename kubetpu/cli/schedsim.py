@@ -62,8 +62,9 @@ def config3():
     return {"pods": len(sizes), "free_after": free, "packed": free == 0}
 
 
-def config4(rounds=5):
+def config4(rounds=None):
     """gang-scheduled multi-host job (v5e-64, 8 hosts, all-or-nothing)"""
+    rounds = rounds or 5
     c = Cluster()
     for h in range(8):
         c.register_node(
@@ -181,21 +182,153 @@ def config7():
     }
 
 
+# -- adversarial configs (VERDICT r1 #4): p50 AND p99 under fragmentation, --
+# -- churn, and multi-slice scale — the happy-path bench.py number alone   --
+# -- says nothing about where the cache design breaks.                     --
+
+
+def _percentiles(lat_ms):
+    lat = sorted(lat_ms)
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(round(p / 100 * (len(lat) - 1))))], 3)
+
+    return {"n": len(lat), "p50_ms": pct(50), "p99_ms": pct(99)}
+
+
+def _v5e256_cluster(slice_uid="slice0", prefix="h"):
+    c = Cluster()
+    for h in range(32):
+        c.register_node(
+            f"{prefix}{h:02d}",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-256", host_index=h, slice_uid=slice_uid)
+            ),
+        )
+    return c
+
+
+def config8(rounds=None):
+    """adversarial: fragmented v5e-256 (~30% of chips held at random); p50/p99 of mixed placements"""
+    import random
+
+    rounds = rounds or 80
+    rng = random.Random(42)
+    c = _v5e256_cluster()
+    # hold a random ~30% of all 256 chips as 1-chip pods: schedule all 256
+    # singles, then release a random 70%
+    singles = []
+    for h in range(32):
+        for i in range(8):
+            p = c.schedule(_tpu_pod(f"hold-{h}-{i}", 1), lambda n, hh=f"h{h:02d}": n == hh)
+            singles.append(p.name)
+    rng.shuffle(singles)
+    held = singles[: int(len(singles) * 0.30)]
+    for name in singles[len(held):]:
+        c.release(name)
+
+    lat, failures, window = [], 0, []
+    sizes = [1, 2, 4, 8]
+    for r in range(rounds):
+        size = sizes[r % len(sizes)]
+        t0 = time.perf_counter()
+        try:
+            p = c.schedule(_tpu_pod(f"q{r}", size))
+            window.append(p.name)
+        except SchedulingError:
+            failures += 1
+        lat.append((time.perf_counter() - t0) * 1e3)
+        if len(window) > 6:  # sliding window keeps pressure without filling up
+            c.release(window.pop(0))
+    return {**_percentiles(lat), "held_chips": len(held), "failures": failures}
+
+
+def config9(rounds=None):
+    """adversarial: mixed 1/2/4/8-chip pod churn with releases on v5e-256 at ~70% utilization"""
+    import random
+
+    rounds = rounds or 300
+    rng = random.Random(7)
+    c = _v5e256_cluster()
+    live = {}  # pod name -> chips
+    held = 0
+    lat, failures = [], 0
+    for i in range(rounds):
+        size = rng.choice([1, 1, 2, 2, 4, 8])
+        t0 = time.perf_counter()
+        try:
+            c.schedule(_tpu_pod(f"c{i}", size))
+            live[f"c{i}"] = size
+            held += size
+        except SchedulingError:
+            failures += 1
+        lat.append((time.perf_counter() - t0) * 1e3)
+        while held > 0.75 * 256:  # drain to ~60% so churn continues
+            victim = rng.choice(sorted(live))
+            held -= live.pop(victim)
+            c.release(victim)
+    return {**_percentiles(lat), "failures": failures, "final_util": round(held / 256, 2)}
+
+
+def config10(rounds=None):
+    """adversarial: 512-node cluster (16 distinct v5e-256 slices); p50/p99 single-pod + 32-host gang"""
+    rounds = rounds or 30
+    t0 = time.perf_counter()
+    c = Cluster()
+    for s in range(16):
+        for h in range(32):
+            c.register_node(
+                f"s{s:02d}h{h:02d}",
+                device=new_fake_tpu_dev_manager(
+                    make_fake_tpus_info("v5e-256", host_index=h, slice_uid=f"slice{s}")
+                ),
+            )
+    setup_s = time.perf_counter() - t0
+
+    pod_lat = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        p = c.schedule(_tpu_pod(f"p{r}", 8))
+        pod_lat.append((time.perf_counter() - t0) * 1e3)
+        c.release(p.name)
+    gang_lat = []
+    for r in range(max(3, rounds // 10)):
+        pods = [_tpu_pod(f"g{r}w{i}", 8) for i in range(32)]
+        t0 = time.perf_counter()
+        placed = c.schedule_gang(pods)
+        gang_lat.append((time.perf_counter() - t0) * 1e3)
+        contig = c.gang_contiguity(placed)
+        for p in placed:
+            c.release(p.name)
+    return {
+        "nodes": 512,
+        "setup_s": round(setup_s, 2),
+        "pod": _percentiles(pod_lat),
+        "gang_256chip": _percentiles(gang_lat),
+        "gang_contiguity": contig,
+    }
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
+TAKES_ROUNDS = {4, 8, 9, 10}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="schedsim", description=__doc__)
-    ap.add_argument("--config", type=int, choices=sorted(CONFIGS), default=None)
-    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--config", type=int, nargs="*", choices=sorted(CONFIGS),
+                    default=None,
+                    help="configs to run (default: 1-7; the adversarial "
+                    "configs 8-10 run only when named — see make bench-adversarial)")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="override per-config default round counts")
     args = ap.parse_args(argv)
-    selected = [args.config] if args.config else sorted(CONFIGS)
+    selected = args.config if args.config else [n for n in sorted(CONFIGS) if n <= 7]
     ok = True
     for n in selected:
         fn = CONFIGS[n]
         try:
-            result = fn(args.rounds) if n == 4 else fn()
+            result = fn(args.rounds) if n in TAKES_ROUNDS else fn()
             print(json.dumps({"config": n, "desc": fn.__doc__, **result}))
         except Exception as e:  # noqa: BLE001
             ok = False

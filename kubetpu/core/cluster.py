@@ -227,30 +227,36 @@ class Cluster:
     def _schedule_inner(
         self, pod: PodInfo, node_filter: Optional[Callable[[str], bool]]
     ) -> PodInfo:
-        candidates: List[tuple] = []  # (-score, name, pod_copy)
+        # One scratch copy serves the whole predicate sweep: fit/score never
+        # read the translation artifacts a previous node left in it (the fit
+        # decision is scalar pre-filter + shape cache + mesh geometry), and
+        # the winner is re-translated from a FRESH copy below — so per-node
+        # copies would only feed the garbage collector (512-node p50).
+        scratch = pod.copy()
+        candidates: List[tuple] = []  # (-score, name)
         for name in utils.sorted_string_keys(self.nodes):
             if node_filter is not None and not node_filter(name):
                 continue
             node = self.nodes[name]
-            pod_copy = pod.copy()
             fits = True
             score = 0.0
             for s in self.schedulers:
-                ok, _reasons, sc = s.pod_fits_device(node.info, pod_copy, False)
+                ok, _reasons, sc = s.pod_fits_device(node.info, scratch, False)
                 if not ok:
                     fits = False
                     break
                 score += sc
             if fits:
-                candidates.append((-score, name, pod_copy))
+                candidates.append((-score, name))
         if not candidates:
             raise SchedulingError(f"pod {pod.name!r}: no node fits")
 
         # Best score first; if the group-scheduler fill disagrees with the
         # fit (e.g. stale scalar vs. actual free cards), demote the node and
         # try the next candidate instead of rejecting the pod.
-        for neg_score, name, pod_copy in sorted(candidates, key=lambda c: (c[0], c[1])):
+        for neg_score, name in sorted(candidates):
             node = self.nodes[name]
+            pod_copy = pod.copy()
             for s in self.schedulers:
                 s.pod_allocate(node.info, pod_copy)
             if not group_scheduler.fill_allocate_from(node.info, pod_copy):

@@ -289,16 +289,21 @@ def _place_rect(
     """First free placement of a rectangular block (origins slide with
     wraparound only on wrapping dimensions). Early-aborts per candidate on
     the first non-free cell — this is the schedule-latency hot path."""
-    origins_per_dim: List[range] = []
-    for d, m, w in zip(shape, topo.mesh_shape, topo.wrap):
-        if d > m:
-            return None
-        origins_per_dim.append(range(m) if (w and d < m) else range(m - d + 1))
+    if any(d > m for d, m in zip(shape, topo.mesh_shape)):
+        return None
     offsets = _rect_offsets(tuple(shape))
     mesh = topo.mesh_shape
-    for origin in itertools.product(*origins_per_dim):
-        if origin not in free:  # the all-zero offset cell
-            continue
+    wrap = topo.wrap
+    # Every placement's anchor (the all-zero-offset cell) is itself free, so
+    # candidate origins are the free cells — |free| candidates instead of a
+    # full torus sweep (free is per-host-sized in the predicate loop; the
+    # sweep dominated 500-node p50 before this).
+    for origin in sorted(free):
+        if any(
+            not w and o + d > m
+            for o, d, m, w in zip(origin, shape, mesh, wrap)
+        ):
+            continue  # would fall off a non-wrapping edge
         block: List[Coord] = []
         ok = True
         for off in offsets:

@@ -17,6 +17,7 @@ from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import GPU
 from kubetpu.scheduler.translate import (
     pod_device_count,
+    set_device_reqs,
     translate_device_resources,
     translate_pod_device_resources,
 )
@@ -54,24 +55,40 @@ class GpuScheduler(DeviceScheduler):
     def pod_fits_device(
         self, node_info: NodeInfo, pod_info: PodInfo, fill_allocate_from: bool
     ) -> FitResult:
+        # Scalar pre-filter before translation (same rationale as
+        # TpuScheduler.pod_fits_device: don't synthesize topology keys for a
+        # node that can't fit the count anyway).
+        for cont in list(pod_info.init_containers.values()) + list(
+            pod_info.running_containers.values()
+        ):
+            set_device_reqs(GPU, cont)
+        want = pod_device_count(GPU, pod_info)
+        if want == 0 and not any(
+            GPU.any_base_re.match(k)
+            for cont in list(pod_info.running_containers.values())
+            + list(pod_info.init_containers.values())
+            for k in cont.dev_requests
+        ):
+            # TPU-only pod: GPU translation would be a no-op — skip the
+            # per-node key scan entirely (see TpuScheduler.pod_fits_device).
+            return True, [], 0.0
+        if want > 0 and node_info.allocatable.get(GPU.resource_name, 0) < want:
+            reason = PredicateFailureReason(
+                resource_name=GPU.resource_name,
+                requested=int(want),
+                capacity=int(node_info.allocatable.get(GPU.resource_name, 0)),
+                message="insufficient free GPUs",
+            )
+            return False, [reason], 0.0
         err, found = translate_pod_device_resources(GPU, self._cache, node_info, pod_info)
         if err is not None or not found:
             return False, [], 0.0
-        n = pod_device_count(GPU, pod_info)
-        if n == 0:
+        if want == 0:
             # No GPUs requested: fit trivially, contribute nothing to the
             # cross-scheduler score sum (a TPU pod's ranking must not be
             # steered by NVLink tree density).
             return True, [], 0.0
-        free = node_info.allocatable.get(GPU.resource_name, 0)
-        if free < n:
-            reason = PredicateFailureReason(
-                resource_name=GPU.resource_name,
-                requested=int(n),
-                capacity=int(free),
-                message="insufficient free GPUs",
-            )
-            return False, [reason], 0.0
+        # (scalar sufficiency was already established by the pre-filter)
         # Rank by this node's tree score so denser NVLink grouping wins ties
         # (the reference returns 0.0 and lets the core's group scheduler
         # decide, gpu_scheduler.go:34-44; kubetpu surfaces the score).

@@ -67,6 +67,15 @@ class NodeMeshState:
     chip_key: Dict[int, str]       # local chip id -> advertised cards key
     free: Set[Coord]               # coords whose cards key is allocatable
     slice_uid: str = DEFAULT_SLICE_UID
+    # n -> find_contiguous_block(free, n, topo) result. Valid for this
+    # state object's lifetime: the parse memo rebuilds the whole state
+    # whenever the advertised resources change, so the cache dies with it.
+    # NOTE: cache users must not mutate ``free`` in place.
+    fit_cache: Dict[int, object] = None  # type: ignore[assignment]
+
+    def __post_init__(self) -> None:
+        if self.fit_cache is None:
+            self.fit_cache = {}
 
     @property
     def slice_name(self) -> str:

@@ -54,16 +54,24 @@ def assign_devices(
     return res_list
 
 
-def translate_to_tree(dc: DeviceClass, node: SortedTreeNode, cont: ContainerInfo) -> None:
-    """Strip the container's existing per-device topology requests and
-    append ones synthesized against *node* (reference translateToTree,
-    gpu.go:273-291)."""
-    cont.dev_requests = {
-        k: v for k, v in cont.dev_requests.items() if not dc.any_base_re.match(k)
-    }
-    num_left = [int(cont.requests.get(dc.resource_name, 0))]
+# assign_devices output depends ONLY on (tree shape, device class, count) —
+# memoize it so the per-(pod x node) predicate loop doesn't re-synthesize
+# identical key sets for every node sharing a shape (the reference's shape
+# dedup cache exists for exactly this reason, gpu.go:163-245; at 500+ nodes
+# the re-synthesis dominates the <100 ms p50 budget). Entries hold a strong
+# reference to the tree so its id cannot be recycled while cached; bounded.
+_ASSIGN_MEMO: dict = {}
+_ASSIGN_MEMO_MAX = 4096
+
+
+def _assigned_for(dc: DeviceClass, tree: SortedTreeNode, count: int) -> ResourceList:
+    key = (id(tree), dc.grp_prefix, count)
+    hit = _ASSIGN_MEMO.get(key)
+    if hit is not None and hit[0] is tree:
+        return hit[1]
+    num_left = [count]
     res_list = assign_devices(
-        node,
+        tree,
         DeviceGroupPrefix + "/" + dc.grp_prefix,
         dc.grp_prefix,
         dc.base,
@@ -71,7 +79,21 @@ def translate_to_tree(dc: DeviceClass, node: SortedTreeNode, cont: ContainerInfo
         2,
         num_left,
     )
-    cont.dev_requests.update(res_list)
+    if len(_ASSIGN_MEMO) >= _ASSIGN_MEMO_MAX:
+        _ASSIGN_MEMO.clear()
+    _ASSIGN_MEMO[key] = (tree, res_list)
+    return res_list
+
+
+def translate_to_tree(dc: DeviceClass, node: SortedTreeNode, cont: ContainerInfo) -> None:
+    """Strip the container's existing per-device topology requests and
+    append ones synthesized against *node* (reference translateToTree,
+    gpu.go:273-291)."""
+    cont.dev_requests = {
+        k: v for k, v in cont.dev_requests.items() if not dc.any_base_re.match(k)
+    }
+    count = int(cont.requests.get(dc.resource_name, 0))
+    cont.dev_requests.update(_assigned_for(dc, node, count))
 
 
 def convert_to_best_requests(
