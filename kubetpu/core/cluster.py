@@ -40,7 +40,7 @@ from kubetpu.plugintypes.mesh import (
     internal_links,
 )
 from kubetpu.scheduler import meshstate
-from kubetpu.scheduler.deviceclass import TPU
+from kubetpu.scheduler.deviceclass import GPU, TPU
 from kubetpu.scheduler.gpu_scheduler import GpuScheduler
 from kubetpu.scheduler.tpu_scheduler import TpuScheduler
 from kubetpu.scheduler.translate import pod_device_count
@@ -503,39 +503,67 @@ class Cluster:
         prio = pod_priority(pod)
         probe = pod.copy()
         # Same kube/device max-merge as set_device_reqs, over BOTH container
-        # kinds — a pod carrying its chip count only in an init container's
-        # kube_requests is still preemption-eligible (mirrors the
-        # schedule_gang TPU-gang detection above).
+        # kinds and BOTH device classes — a pod carrying its count only in
+        # an init container's kube_requests is still preemption-eligible
+        # (mirrors the schedule_gang TPU-gang detection above).
         for cont in itertools.chain(
             probe.running_containers.values(), probe.init_containers.values()
         ):
-            cont.requests[TPU.resource_name] = max(
-                cont.requests.get(TPU.resource_name, 0),
-                cont.kube_requests.get(TPU.resource_name, 0),
-            )
-        n = pod_device_count(TPU, probe)
-        if n == 0:
+            for dc in (TPU, GPU):
+                cont.requests[dc.resource_name] = max(
+                    cont.requests.get(dc.resource_name, 0),
+                    cont.kube_requests.get(dc.resource_name, 0),
+                )
+        n_tpu = pod_device_count(TPU, probe)
+        n_gpu = pod_device_count(GPU, probe)
+        if n_tpu == 0 and n_gpu == 0:
             raise SchedulingError(f"pod {pod.name!r}: no node fits (nothing to preempt for)")
 
         for name in utils.sorted_string_keys(self.nodes):
             node = self.nodes[name]
             state = meshstate.parse_mesh_state(node.info.allocatable)
-            if state is None:
-                continue
+            if n_tpu > 0 and state is None:
+                continue  # the TPU leg needs mesh geometry on this node
             victims = sorted(
                 (p for p in node.pods.values() if pod_priority(p) < prio),
                 key=pod_priority,
             )
-            avail = set(state.free)
+            # Feasibility per device class: TPU is geometric (evictions must
+            # provably open a contiguous block); GPU (tree) is scalar — the
+            # structural fill spills across NVLink groups, so free count is
+            # exact (group_scheduler._pick_pool_tree fails only on count).
+            avail = set(state.free) if state is not None else set()
+            free_gpu = node.info.allocatable.get(GPU.resource_name, 0)
             chosen: List[PodInfo] = []
-            fits = find_contiguous_block(avail, n, state.topo) is not None
+
+            def _fits() -> bool:
+                if n_tpu > 0 and find_contiguous_block(avail, n_tpu, state.topo) is None:
+                    return False
+                return not (n_gpu > 0 and free_gpu < n_gpu)
+
+            fits = _fits()
             for victim in victims:
                 if fits:
                     break
-                _topo, vcoords = self.pod_chip_coords(victim)
-                avail |= set(vcoords)
+                # Evict only victims that actually free the needed device
+                # class — a CPU-only (or wrong-class) neighbor must not be
+                # killed for nothing.
+                contributes = False
+                if n_tpu > 0:
+                    _topo, vcoords = self.pod_chip_coords(victim)
+                    fresh_coords = set(vcoords) - avail
+                    if fresh_coords:
+                        avail |= fresh_coords
+                        contributes = True
+                if n_gpu > 0:
+                    cards = group_scheduler.held_cards(victim, GPU.base)
+                    if cards:
+                        free_gpu += len(cards)
+                        contributes = True
+                if not contributes:
+                    continue
                 chosen.append(victim)
-                fits = find_contiguous_block(avail, n, state.topo) is not None
+                fits = _fits()
             if not fits:
                 continue
             evicted: List[PodInfo] = []
@@ -572,7 +600,7 @@ class Cluster:
     # -- defragmentation ------------------------------------------------------
 
     def defrag_plan(
-        self, chips: int, max_migrations: int = 3
+        self, chips: int, max_migrations: int = 3, device: str = "tpu"
     ) -> Optional[List["Migration"]]:
         """When *fragmentation* (not capacity) blocks a perfect
         (contiguity-1.0) rectangular ``chips``-block, propose the smallest
@@ -584,11 +612,18 @@ class Cluster:
         exists (raise the cap for deeper searches; the search is
         combinatorial in it). Proposals only — ``execute_defrag`` applies.
 
-        Planning considers TPU geometry only; pods with non-TPU requests are
-        not picked as victims, and ``execute_defrag`` re-places each victim
-        through the full scheduler (with rollback), so a plan invalidated by
-        concurrent scheduling fails safely rather than dropping pods.
+        ``device="gpu"`` plans for tree nodes instead: "perfect" there means
+        *chips* free cards within ONE level-1 (socket) group — the NVLink
+        locality the structural fill silently gives up when it spills
+        (reference grouping semantics, nvidia_gpu_manager.go:74-88).
+
+        Victims are single-class pods only, and ``execute_defrag`` re-places
+        each victim through the full scheduler (with rollback), so a plan
+        invalidated by concurrent scheduling fails safely rather than
+        dropping pods.
         """
+        if device == GPU.base:
+            return self._defrag_plan_tree(chips, max_migrations)
         states = {}
         for name in utils.sorted_string_keys(self.nodes):
             st = meshstate.parse_mesh_state(self.nodes[name].info.allocatable)
@@ -650,6 +685,95 @@ class Cluster:
                             break
                     if feasible:
                         return plan
+        return None
+
+    def _defrag_plan_tree(
+        self, cards: int, max_migrations: int
+    ) -> Optional[List["Migration"]]:
+        """Tree-node (GPU) defrag: open *cards* free cards within one
+        level-1 group by migrating the fewest GPU-only pods out of it; every
+        migrated pod must provably re-place on another node's scalar free
+        count (exact for tree fill — it spills structurally, never fails on
+        shape). Returns []/plan/None with ``defrag_plan`` semantics."""
+        free_by = {
+            name: group_scheduler.free_cards_by_group(self.nodes[name].info, GPU.base)
+            for name in utils.sorted_string_keys(self.nodes)
+        }
+        for name, groups in free_by.items():
+            if any(len(keys) >= cards for keys in groups.values()):
+                return []  # some group already holds a full block
+
+        for name in utils.sorted_string_keys(self.nodes):
+            node = self.nodes[name]
+            # victims by group: GPU-only pods holding cards in that group,
+            # largest in-group holdings first (fewest migrations)
+            holders_by_group: Dict[str, List[tuple]] = {}
+            group_capacity: Dict[str, int] = {
+                g: len(keys) for g, keys in free_by[name].items()
+            }
+            for p in sorted(node.pods.values(), key=lambda p: p.name):
+                if group_scheduler.held_cards(p, TPU.base):
+                    continue  # mixed/TPU pod: not a tree-defrag victim
+                by_g: Dict[str, int] = {}
+                for key in group_scheduler.held_cards(p, GPU.base):
+                    g = group_scheduler.cards_group(key)
+                    if g is not None:
+                        by_g[g] = by_g.get(g, 0) + 1
+                for g, cnt in by_g.items():
+                    holders_by_group.setdefault(g, []).append((p, cnt))
+                    group_capacity[g] = group_capacity.get(g, 0) + cnt
+            for g in utils.sorted_string_keys(group_capacity):
+                if group_capacity[g] < cards:
+                    continue  # group too small even fully vacated
+                free_g = len(free_by[name].get(g, []))
+                holders = sorted(
+                    holders_by_group.get(g, []), key=lambda t: (-t[1], t[0].name)
+                )
+                chosen: List[PodInfo] = []
+                got = free_g
+                for p, cnt in holders:
+                    if got >= cards or len(chosen) >= max_migrations:
+                        break
+                    chosen.append(p)
+                    got += cnt
+                if got < cards or not chosen:
+                    continue
+                # Re-placement feasibility on scalar free counts. The source
+                # node itself is a valid destination (mirroring the TPU
+                # plan's "back onto the source node outside the opened
+                # block"): after vacating the chosen pods and giving *cards*
+                # to the block, it has free = current + freed - cards
+                # (execute_defrag places the pending pod first, so re-placed
+                # victims cannot re-take the opened group).
+                freed = sum(
+                    len(group_scheduler.held_cards(p, GPU.base)) for p in chosen
+                )
+                dest_free = {
+                    o: self.nodes[o].info.allocatable.get(GPU.resource_name, 0)
+                    for o in utils.sorted_string_keys(self.nodes)
+                    if o != name
+                }
+                dest_free[name] = (
+                    self.nodes[name].info.allocatable.get(GPU.resource_name, 0)
+                    + freed
+                    - cards
+                )
+                plan: List[Migration] = []
+                feasible = True
+                for p in chosen:
+                    need = len(group_scheduler.held_cards(p, GPU.base))
+                    placed = False
+                    for o in utils.sorted_string_keys(dest_free):
+                        if dest_free[o] >= need:
+                            dest_free[o] -= need
+                            plan.append(Migration(p.name, name, o))
+                            placed = True
+                            break
+                    if not placed:
+                        feasible = False
+                        break
+                if feasible:
+                    return plan
         return None
 
     def execute_defrag(

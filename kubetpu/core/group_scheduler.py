@@ -203,6 +203,36 @@ def _pod_held_keys(pod_info: PodInfo) -> Set[str]:
     return held
 
 
+def held_cards(pod_info: PodInfo, base: str) -> Set[str]:
+    """The node cards keys of *base* a placed pod holds (its device pool) —
+    input to cross-class preemption/defrag victim selection."""
+    out: Set[str] = set()
+    for key in _pod_held_keys(pod_info):
+        m = _CARDS_KEY_RE.match(key)
+        if m and m.group(5) == base:
+            out.add(key)
+    return out
+
+
+def free_cards_by_group(node_info: NodeInfo, base: str) -> Dict[str, List[str]]:
+    """Free cards keys of *base* grouped by their level-1 group id — the
+    structural-fill view of a tree node's fragmentation (NVLink locality:
+    the reference's gpugrp1 is the socket level, nvidia_gpu_manager.go
+    :74-88)."""
+    groups: Dict[str, List[str]] = {}
+    for key, val in node_info.allocatable.items():
+        m = _CARDS_KEY_RE.match(key)
+        if m and m.group(5) == base and val >= 1:
+            groups.setdefault(m.group(2), []).append(key)
+    return {g: sorted(keys) for g, keys in groups.items()}
+
+
+def cards_group(key: str) -> Optional[str]:
+    """Level-1 group id of a cards key, or None if it isn't one."""
+    m = _CARDS_KEY_RE.match(key)
+    return m.group(2) if m else None
+
+
 def _account(node_info: NodeInfo, pod_info: PodInfo, sign: int) -> None:
     # the one in-place mutator of advertised ResourceLists: drop any
     # memoized mesh geometry for this dict (meshstate memo contract)

@@ -187,7 +187,9 @@ class TpuDevManager(Device):
                 indices.append(idx)
                 chip_id = self.index_to_id.get(idx)
                 if chip_id is not None and self.tpus[chip_id].found:
-                    devices.append(self.tpus[chip_id].path)
+                    path = self.tpus[chip_id].path
+                    if path:  # sysfs-only chips (masked /dev) have no node
+                        devices.append(path)
             indices.sort()
             devices.sort()
             env = {

@@ -127,14 +127,12 @@ class TpuScheduler(DeviceScheduler):
         err, found = translate_pod_device_resources(TPU, self._cache, node_info, pod_info)
         if err is not None or not found:
             return False, [], 0.0
-        n = pod_device_count(TPU, pod_info)
-        if n == 0:
-            return True, [], 0.0
-        fits, score = self._mesh_fit(node_info, n)
+        # (translation never changes the scalar count: want still holds)
+        fits, score = self._mesh_fit(node_info, want)
         if not fits:
             reason = PredicateFailureReason(
                 resource_name=TPU.resource_name,
-                requested=n,
+                requested=want,
                 capacity=node_info.allocatable.get(TPU.resource_name, 0),
                 message="insufficient free ICI-contiguous TPU chips",
             )

@@ -12,7 +12,12 @@
 //   2. environment    — TPU_ACCELERATOR_TYPE (e.g. "v5litepod-8"),
 //                       TPU_WORKER_ID / TPU_HOST_INDEX (host index within a
 //                       multi-host slice); the libtpu runtime env contract
-//   3. /sys/class/accel*/... model names where present
+//   3. sysfs          — <root>/class/accel/accel<N>/ entries (a second
+//                       device-discovery source, e.g. when /dev is masked),
+//                       plus per-device enrichment from device/vendor,
+//                       device/device and device/model where present. The
+//                       root defaults to /sys and is overridable via
+//                       TPUINFO_SYSFS_ROOT so tests can fixture it.
 //
 // Chip torus coordinates are the fixed row-major bijection from (topology,
 // host index, local chip index) — the same model kubetpu's Python mesh layer
@@ -113,6 +118,9 @@ void ChipCoords(const Topology& t, int host_index, int idx, int out[3]) {
 struct Chip {
   std::string id;
   std::string path;
+  std::string model;   // per-chip model (sysfs may override the table's)
+  std::string vendor;  // PCI vendor id string from sysfs, e.g. "0x1ae0"
+  std::string device;  // PCI device id string from sysfs
   int index;
   int coords[3];
   int ndims;
@@ -132,10 +140,10 @@ std::string EnvOr(const char* key, const char* fallback) {
   return v ? std::string(v) : std::string(fallback);
 }
 
-// Enumerate /dev/accel<N> device nodes.
-std::vector<int> ScanAccelDevices() {
+// Collect accel<N> indices from one directory of accel-named entries.
+std::vector<int> ScanAccelNames(const std::string& dir_path) {
   std::vector<int> found;
-  DIR* dir = opendir("/dev");
+  DIR* dir = opendir(dir_path.c_str());
   if (!dir) return found;
   while (dirent* ent = readdir(dir)) {
     if (strncmp(ent->d_name, "accel", 5) == 0) {
@@ -145,6 +153,47 @@ std::vector<int> ScanAccelDevices() {
     }
   }
   closedir(dir);
+  return found;
+}
+
+std::string SysfsRoot() { return EnvOr("TPUINFO_SYSFS_ROOT", "/sys"); }
+
+// First line of a sysfs attribute file, trimmed; "" when absent.
+std::string ReadSysfsAttr(int idx, const char* attr) {
+  char path[256];
+  snprintf(path, sizeof(path), "%s/class/accel/accel%d/device/%s",
+           SysfsRoot().c_str(), idx, attr);
+  FILE* f = fopen(path, "r");
+  if (!f) return "";
+  char buf[128] = {0};
+  if (!fgets(buf, sizeof(buf), f)) buf[0] = '\0';
+  fclose(f);
+  size_t len = strlen(buf);
+  while (len > 0 && (buf[len - 1] == '\n' || buf[len - 1] == '\r' ||
+                     buf[len - 1] == ' '))
+    buf[--len] = '\0';
+  return buf;
+}
+
+// Union of /dev/accel<N> nodes and <sysfs>/class/accel/accel<N> entries,
+// sorted ascending (sysfs covers environments where /dev is masked, e.g.
+// non-privileged containers; the reference's NVML probe likewise reports
+// devices the runtime may not yet expose as nodes).
+std::vector<int> ScanAccelDevices() {
+  std::vector<int> found = ScanAccelNames("/dev");
+  for (int idx : ScanAccelNames(SysfsRoot() + "/class/accel")) {
+    bool seen = false;
+    for (int f : found)
+      if (f == idx) seen = true;
+    if (!seen) found.push_back(idx);
+  }
+  for (size_t i = 0; i < found.size(); i++)  // insertion sort (tiny n)
+    for (size_t j = i + 1; j < found.size(); j++)
+      if (found[j] < found[i]) {
+        int t = found[i];
+        found[i] = found[j];
+        found[j] = t;
+      }
   return found;
 }
 
@@ -164,12 +213,34 @@ ProbeResult ProbeHardware() {
     snprintf(guess, sizeof(guess), "v5e-%zu", devs.size());
     r.topo = FindTopology(guess);
   }
+  std::vector<int> dev_nodes = ScanAccelNames("/dev");
   for (int idx : devs) {
     Chip c;
     char buf[64];
-    snprintf(buf, sizeof(buf), "/dev/accel%d", idx);
-    c.path = buf;
+    bool has_node = false;
+    for (int d : dev_nodes)
+      if (d == idx) has_node = true;
+    if (has_node) {
+      snprintf(buf, sizeof(buf), "/dev/accel%d", idx);
+      c.path = buf;
+    }  // sysfs-only discovery (masked /dev): no device node to inject —
+       // Path stays empty and the manager skips it at allocate time
     c.index = idx;
+    // sysfs enrichment (probe source 3): PCI ids always recorded when
+    // present; an explicit model attribute (driver-provided) wins over the
+    // topology table; the Google PCI vendor id at least brands an
+    // otherwise-unidentified chip.
+    c.vendor = ReadSysfsAttr(idx, "vendor");
+    c.device = ReadSysfsAttr(idx, "device");
+    std::string sys_model = ReadSysfsAttr(idx, "model");
+    if (!sys_model.empty())
+      c.model = sys_model;
+    else if (r.topo)
+      c.model = r.topo->model;
+    else if (c.vendor == "0x1ae0")
+      c.model = "Google TPU";
+    else
+      c.model = "TPU";
     if (r.topo) {
       snprintf(buf, sizeof(buf), "TPU-%s-h%d-c%d", r.topo->name, r.host_index, idx);
       c.id = buf;
@@ -208,6 +279,7 @@ ProbeResult FakeProbe(const std::string& topo_name, int host_index,
     c.id = buf;
     snprintf(buf, sizeof(buf), "/dev/accel%d", i);
     c.path = buf;
+    c.model = r.topo->model;
     c.index = i;
     c.ndims = Dims(*r.topo);
     ChipCoords(*r.topo, host_index, i, c.coords);
@@ -227,7 +299,10 @@ void PrintJson(const ProbeResult& r) {
     const Chip& c = r.chips[i];
     if (i) printf(",");
     printf("{\"ID\":\"%s\",\"Model\":\"%s\",\"Path\":\"%s\",\"Index\":%d,", c.id.c_str(),
-           r.topo ? r.topo->model : "TPU", c.path.c_str(), c.index);
+           c.model.empty() ? "TPU" : c.model.c_str(), c.path.c_str(), c.index);
+    if (!c.vendor.empty() || !c.device.empty())
+      printf("\"Pci\":{\"Vendor\":\"%s\",\"Device\":\"%s\"},", c.vendor.c_str(),
+             c.device.c_str());
     printf("\"Memory\":{\"Global\":%lld},", r.topo ? r.topo->hbm_bytes : 0LL);
     printf("\"Coords\":[");
     for (int d = 0; d < c.ndims; d++) {

@@ -1,0 +1,181 @@
+"""Preemption + defragmentation for tree (GPU) nodes — the capabilities
+VERDICT r1 flagged as TPU-only. Victim selection is by structural fill
+(scalar count is exact for tree fill, which spills across NVLink groups);
+defrag's "perfect" target is a whole level-1 (socket) group.
+"""
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.core import Cluster, SchedulingError
+from kubetpu.core import group_scheduler
+from kubetpu.core.cluster import PriorityKey
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.device.nvidia import new_fake_nvidia_gpu_manager
+from kubetpu.device.nvidia.types import (
+    GpuInfo, GpusInfo, MemoryInfo, PciInfo, TopologyInfo, VersionInfo,
+)
+from kubetpu.plugintypes import ResourceGPU, ResourceTPU
+
+
+def gpu_mgr():
+    """8-GPU two-socket box: pairs NVLinked (link 5) within a socket ->
+    gpugrp0 pairs, one gpugrp1 group per socket of 4 (the TITAN X fixture
+    shape, nvidia_gpu_manager_test.go:16)."""
+    bus = [f"0000:{i:02X}:00.0" for i in range(8)]
+    gpus = []
+    for i in range(8):
+        socket = i // 4
+        topo = [
+            TopologyInfo(bus_id=bus[j], link=5 if j // 2 == i // 2 else 3)
+            for j in range(socket * 4, socket * 4 + 4)
+            if j != i
+        ]
+        gpus.append(GpuInfo(id=f"GPU{i:02d}", model="Fake", path=f"/dev/nvidia{i}",
+                            memory=MemoryInfo(global_mib=12238),
+                            pci=PciInfo(bus_id=bus[i], bandwidth=15760),
+                            topology=topo))
+    info = GpusInfo(version=VersionInfo(driver="fake", cuda=""), gpus=gpus)
+    return new_fake_nvidia_gpu_manager(info, "v", "d")
+
+
+def gpu_pod(name, n, prio=None):
+    p = PodInfo(
+        name=name,
+        running_containers={"main": ContainerInfo(requests={ResourceGPU: n})},
+    )
+    if prio is not None:
+        p.requests[PriorityKey] = prio
+    return p
+
+
+def test_gpu_preemption_evicts_lower_priority():
+    cluster = Cluster()
+    cluster.register_node("g0", device=gpu_mgr())
+    cluster.schedule(gpu_pod("low1", 4))
+    cluster.schedule(gpu_pod("low2", 4))
+
+    placed, evicted = cluster.schedule_preempting(gpu_pod("high", 4, prio=10))
+    assert placed.node_name == "g0"
+    assert len(evicted) == 1 and evicted[0].name in ("low1", "low2")
+    assert "high" in cluster.nodes["g0"].pods
+    assert not any(c.allocate_from for c in evicted[0].running_containers.values())
+
+
+def test_gpu_preemption_refuses_equal_priority():
+    cluster = Cluster()
+    cluster.register_node("g0", device=gpu_mgr())
+    cluster.schedule(gpu_pod("a", 8, prio=5))
+    try:
+        cluster.schedule_preempting(gpu_pod("b", 4, prio=5))
+        assert False, "equal priority must not preempt"
+    except SchedulingError:
+        pass
+    assert "a" in cluster.nodes["g0"].pods
+
+
+def test_gpu_preemption_evicts_minimum_set():
+    cluster = Cluster()
+    cluster.register_node("g0", device=gpu_mgr())
+    for i in range(4):
+        cluster.schedule(gpu_pod(f"low{i}", 2, prio=i))
+    placed, evicted = cluster.schedule_preempting(gpu_pod("high", 2, prio=10))
+    assert [p.name for p in evicted] == ["low0"]  # cheapest victim first
+
+
+def test_gpu_defrag_plan_and_execute():
+    cluster = Cluster()
+    cluster.register_node("n0", device=gpu_mgr())
+    cluster.register_node("n1", device=gpu_mgr())
+    # n0: four 2-GPU pods fill both sockets (a,b -> socket 0; c,d -> 1);
+    # release one pod per socket -> each socket has 2 free, no socket has 4.
+    for nm in ("a", "b", "c", "d"):
+        cluster.schedule(gpu_pod(nm, 2), lambda n: n == "n0")
+    cluster.release("b")
+    cluster.release("d")
+    # n1: a 6-GPU pod leaves 2 free (no socket with 4 free there either)
+    cluster.schedule(gpu_pod("big6", 6), lambda n: n == "n1")
+
+    plan = cluster.defrag_plan(4, device="gpu")
+    assert plan is not None and len(plan) == 1
+    assert plan[0].from_node == "n0"  # destination may be n1 OR back on n0
+    # (the source node is a valid destination outside the opened group)
+
+    moved, pending = cluster.execute_defrag(plan, pending=gpu_pod("quad", 4))
+    assert pending is not None and pending.node_name == "n0"
+    # the pending pod's 4 GPUs all landed within ONE socket group
+    held = group_scheduler.held_cards(pending, "gpu")
+    assert len(held) == 4
+    assert len({group_scheduler.cards_group(k) for k in held}) == 1
+    # the migrated pod is placed somewhere and did not re-take that group
+    assert moved[0].node_name in ("n0", "n1")
+    if moved[0].node_name == "n0":
+        moved_groups = {
+            group_scheduler.cards_group(k)
+            for k in group_scheduler.held_cards(moved[0], "gpu")
+        }
+        assert moved_groups.isdisjoint(
+            {group_scheduler.cards_group(k) for k in held}
+        )
+
+
+def test_gpu_defrag_intra_node():
+    """Single-node cross-socket defrag: the source node itself is a valid
+    re-placement destination (no second node exists)."""
+    cluster = Cluster()
+    cluster.register_node("n0", device=gpu_mgr())
+    for nm in ("a", "b", "c", "d"):
+        cluster.schedule(gpu_pod(nm, 2))
+    cluster.release("b")
+    cluster.release("d")  # each socket: 2 held, 2 free
+    plan = cluster.defrag_plan(4, device="gpu")
+    assert plan is not None and len(plan) == 1 and plan[0].to_node == "n0"
+    moved, pending = cluster.execute_defrag(plan, pending=gpu_pod("quad", 4))
+    held = group_scheduler.held_cards(pending, "gpu")
+    assert len({group_scheduler.cards_group(k) for k in held}) == 1
+
+
+def test_gpu_defrag_noop_and_infeasible():
+    cluster = Cluster()
+    cluster.register_node("n0", device=gpu_mgr())
+    assert cluster.defrag_plan(4, device="gpu") == []  # already fits
+    # fill the node completely: no migrations can open a group, and no
+    # destination has room
+    cluster.schedule(gpu_pod("all", 8))
+    assert cluster.defrag_plan(4, device="gpu") is None
+
+
+def test_mixed_cluster_preemption_ignores_wrong_class_nodes():
+    """A GPU preemptor must not evict TPU pods (and vice versa): the only
+    eligible node is the one whose class can satisfy the request."""
+    cluster = Cluster()
+    cluster.register_node("g0", device=gpu_mgr())
+    cluster.register_node(
+        "t0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    tpu_low = PodInfo(
+        name="tpu-low",
+        running_containers={"main": ContainerInfo(requests={ResourceTPU: 8})},
+    )
+    cluster.schedule(tpu_low)
+    cluster.schedule(gpu_pod("gpu-low", 8))
+
+    placed, evicted = cluster.schedule_preempting(gpu_pod("gpu-high", 4, prio=10))
+    assert placed.node_name == "g0"
+    assert [p.name for p in evicted] == ["gpu-low"]
+    assert "tpu-low" in cluster.nodes["t0"].pods  # untouched
+
+
+def test_preemption_skips_noncontributing_victims():
+    """A victim that frees none of the needed device class (e.g. a CPU-only
+    pod) must not be evicted, whatever its priority."""
+    cluster = Cluster()
+    cluster.register_node("g0", device=gpu_mgr())
+    cpu_only = PodInfo(
+        name="cpu-only",
+        running_containers={"main": ContainerInfo(requests={})},
+    )
+    cluster.schedule(cpu_only)  # prio 0, holds no devices
+    cluster.schedule(gpu_pod("gpu-low", 8, prio=1))
+
+    placed, evicted = cluster.schedule_preempting(gpu_pod("high", 4, prio=10))
+    assert [p.name for p in evicted] == ["gpu-low"]
+    assert "cpu-only" in cluster.nodes["g0"].pods  # innocent bystander kept

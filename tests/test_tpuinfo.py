@@ -2,6 +2,7 @@
 run it through the exec-JSON boundary (kubetpu.device.types.get_devices),
 and check it agrees with the in-process fake fixtures."""
 
+import json
 import os
 import shutil
 import subprocess
@@ -99,3 +100,49 @@ def test_bad_topology_errors(tpuinfo_binary):
     )
     assert proc.returncode == 2
     assert b"unknown topology" in proc.stderr
+
+
+def test_sysfs_probe_with_fixture_root(tpuinfo_binary, tmp_path):
+    """Probe source 3: a fixtured TPUINFO_SYSFS_ROOT provides both device
+    discovery (class/accel entries, /dev masked) and per-device model/vendor
+    enrichment (the analog of NVML's model/memory detail,
+    nvml.go:57-80)."""
+    for i in range(4):
+        d = tmp_path / "class" / "accel" / f"accel{i}" / "device"
+        d.mkdir(parents=True)
+        (d / "vendor").write_text("0x1ae0\n")
+        (d / "device").write_text("0x0063\n")
+        if i == 0:
+            (d / "model").write_text("TPU v5e (sysfs)\n")
+    env = dict(os.environ)
+    env["TPUINFO_SYSFS_ROOT"] = str(tmp_path)
+    env["TPU_ACCELERATOR_TYPE"] = "v5e-4"
+    out = subprocess.run(
+        [tpuinfo_binary, "json"], capture_output=True, check=True, env=env
+    )
+    info = json.loads(out.stdout)
+    assert info["Topology"]["Type"] == "v5e-4"
+    devs = info["Devices"]
+    assert [d["Index"] for d in devs] == [0, 1, 2, 3]
+    # driver-provided model wins; table model otherwise
+    assert devs[0]["Model"] == "TPU v5e (sysfs)"
+    assert devs[1]["Model"] == "TPU v5e"
+    assert all(d["Pci"] == {"Vendor": "0x1ae0", "Device": "0x0063"} for d in devs)
+    # coords still come from the fixed bijection
+    assert devs[0]["Coords"] == [0, 0] and devs[3]["Coords"] == [1, 1]
+
+
+def test_sysfs_vendor_brands_unknown_topology(tpuinfo_binary, tmp_path):
+    d = tmp_path / "class" / "accel" / "accel0" / "device"
+    d.mkdir(parents=True)
+    (d / "vendor").write_text("0x1ae0\n")
+    env = dict(os.environ)
+    env["TPUINFO_SYSFS_ROOT"] = str(tmp_path)
+    env.pop("TPU_ACCELERATOR_TYPE", None)
+    out = subprocess.run(
+        [tpuinfo_binary, "json"], capture_output=True, check=True, env=env
+    )
+    info = json.loads(out.stdout)
+    # one sysfs-discovered chip; count-inferred topology (v5e-1) or vendor brand
+    assert len(info["Devices"]) == 1
+    assert info["Devices"][0]["Model"] in ("Google TPU", "TPU v5e")
