@@ -5,7 +5,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -Wall -Wextra -std=c++17
 
 .PHONY: all
-all: tpuinfo
+all: tpuinfo gpuinfo
 
 .PHONY: tpuinfo
 tpuinfo: $(BUILD_DIR)/tpuinfo
@@ -14,8 +14,15 @@ $(BUILD_DIR)/tpuinfo: kubetpu/tpuinfo/tpuinfo.cc
 	@mkdir -p $(BUILD_DIR)
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
+.PHONY: gpuinfo
+gpuinfo: $(BUILD_DIR)/gpuinfo
+
+$(BUILD_DIR)/gpuinfo: kubetpu/gpuinfo/gpuinfo.cc
+	@mkdir -p $(BUILD_DIR)
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
 .PHONY: test
-test: tpuinfo
+test: tpuinfo gpuinfo
 	python -m pytest tests/ -x -q
 
 .PHONY: bench

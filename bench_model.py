@@ -1,0 +1,212 @@
+#!/usr/bin/env python3
+"""Model-performance benchmark: the framework's OWN workload numbers on the
+local accelerator (VERDICT r1 #2 — "fast" must be measured, not asserted).
+
+Measures, on whatever chip JAX sees (designed for one TPU v5e):
+
+1. training throughput — full train step (fwd+bwd+adamw) of the flagship
+   decoder transformer, bf16 + flash attention + remat, seq >= 2k:
+   tokens/sec, step time, and achieved MFU vs the chip's bf16 peak;
+2. flash-vs-dense attention speedup — Pallas flash attention core vs the
+   XLA dense softmax core at growing sequence lengths;
+3. decode throughput — KV-cached autoregressive generation tokens/sec,
+   MHA vs grouped-query (n_kv_heads=4) at the same model size.
+
+Prints one JSON line per measurement; --out FILE also writes them to a
+checked-in artifact (BENCH_MODEL.json). --smoke runs a tiny config (CI /
+CPU-mesh sanity; numbers are meaningless there, structure is identical).
+
+    python bench_model.py [--smoke] [--steps N] [--out BENCH_MODEL.json]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+
+# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets)
+PEAK_BF16 = {"v5e": 197e12, "v5litepod": 197e12, "v4": 275e12, "v5p": 459e12}
+
+
+def flagship_cfg(smoke: bool):
+    from kubetpu.jobs import ModelConfig
+
+    if smoke:
+        return ModelConfig(vocab=256, d_model=128, n_layers=2, n_heads=4,
+                           d_ff=256, max_seq=512, dtype=jnp.bfloat16, remat=True)
+    # ~0.75B params: fits one v5e (16 GiB) with adamw + remat at seq 2048
+    return ModelConfig(vocab=32000, d_model=2048, n_layers=12, n_heads=16,
+                       d_ff=5632, max_seq=4096, dtype=jnp.bfloat16, remat=True)
+
+
+def param_count(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def chip_peak_flops():
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    for key, peak in PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def train_throughput(cfg, batch, seq, steps, attention):
+    from kubetpu.jobs import init_state, make_mesh, make_train_step
+
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    n_params = param_count(state.params)
+    step = make_train_step(cfg, mesh, optimizer=opt, attention=attention)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab,
+                                jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    state, loss = step(state, tokens, targets)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, tokens, targets)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_s = batch * seq / dt
+    # FLOPs/token for fwd+bwd: 6*P (matmul params) + 12*L*D*S (causal
+    # attention scores+values, fwd 4*L*D*S and bwd 2x) — the PaLM appendix
+    # accounting. Remat re-computes the fwd once more: +50% of the fwd
+    # third, i.e. x(8/6) on the model term when counting HARDWARE flops;
+    # MFU convention counts MODEL flops, so remat overhead shows up as
+    # lower MFU, which is what we want to observe.
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    peak = chip_peak_flops()
+    mfu = tokens_per_s * flops_per_token / peak if peak else None
+    del state
+    return {
+        "metric": "train_tokens_per_s",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "step_ms": round(dt * 1e3, 2),
+        "batch": batch,
+        "seq": seq,
+        "params": n_params,
+        "attention": attention,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "device": getattr(jax.devices()[0], "device_kind", str(jax.devices()[0])),
+    }
+
+
+def flash_vs_dense(cfg, seqs, smoke):
+    from kubetpu.jobs.model import dense_causal_attention
+
+    if jax.default_backend() == "cpu":
+        return []  # Pallas TPU kernels don't run on the CPU backend
+    from kubetpu.ops import flash_attention
+
+    out = []
+    b, h, d = (2, cfg.n_heads, cfg.head_dim)
+    for seq in seqs:
+        q, k, v = (
+            jax.random.normal(jax.random.PRNGKey(i), (b, seq, h, d), jnp.bfloat16)
+            for i in range(3)
+        )
+        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+        dense = jax.jit(dense_causal_attention)
+
+        def timeit(fn):
+            r = fn(q, k, v)
+            jax.block_until_ready(r)
+            t0 = time.perf_counter()
+            for _ in range(10):
+                r = fn(q, k, v)
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / 10 * 1e3
+
+        fms = timeit(flash)
+        try:
+            dms = timeit(dense)
+        except Exception:  # noqa: BLE001 — dense OOMs first at long seq
+            dms = None
+        out.append({
+            "metric": "flash_vs_dense_speedup",
+            "seq": seq,
+            "flash_ms": round(fms, 3),
+            "dense_ms": round(dms, 3) if dms else None,
+            "value": round(dms / fms, 2) if dms else None,
+            "unit": "x",
+        })
+    return out
+
+
+def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads):
+    import dataclasses
+
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.decode import make_generate
+
+    dcfg = dataclasses.replace(cfg, n_kv_heads=n_kv_heads, remat=False)
+    params = init_params(jax.random.PRNGKey(0), dcfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len), 0,
+                                dcfg.vocab, jnp.int32)
+    gen = make_generate(dcfg)
+    out = gen(params, prompt, jax.random.PRNGKey(2), gen_steps)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = gen(params, prompt, jax.random.PRNGKey(3), gen_steps)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    del params
+    return {
+        "metric": "decode_tokens_per_s",
+        "value": round(batch * gen_steps / dt, 1),
+        "unit": "tokens/s",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "gen_steps": gen_steps,
+        "n_kv_heads": n_kv_heads or cfg.n_heads,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny config (structure check; numbers meaningless)")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--out", default=None, help="also write JSON lines to FILE")
+    args = ap.parse_args()
+
+    cfg = flagship_cfg(args.smoke)
+    results = []
+
+    if args.smoke:
+        batch, seq = 2, 256
+        seqs = [256]
+        dec = (2, 16, 8)
+    else:
+        batch, seq = 4, 2048
+        seqs = [2048, 4096, 8192]
+        dec = (8, 128, 128)
+
+    results.append(train_throughput(cfg, batch, seq, args.steps, "flash"
+                                    if jax.default_backend() != "cpu" else "dense"))
+    results.extend(flash_vs_dense(cfg, seqs, args.smoke))
+    results.append(decode_throughput(cfg, *dec, n_kv_heads=0))
+    results.append(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2))
+
+    for r in results:
+        print(json.dumps(r), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -799,7 +799,22 @@ class Cluster:
         moved: List[PodInfo] = []
         try:
             if pending is not None:
-                placed_pending = self.schedule(pending)
+                if plan:
+                    # Pin the pending pod to the node the plan opened the
+                    # block on: the TPU score (placement contiguity) makes
+                    # that node win naturally, but the tree (GPU) score is
+                    # free-locality-blind — unpinned, the pod could land
+                    # split across sockets on another node and the victim's
+                    # fallback could re-take the opened group.
+                    src = plan[0].from_node
+                    try:
+                        placed_pending = self.schedule(
+                            pending, lambda n, s=src: n == s
+                        )
+                    except SchedulingError:
+                        placed_pending = self.schedule(pending)
+                else:
+                    placed_pending = self.schedule(pending)
             for mig, fresh in originals:
                 try:
                     moved.append(

@@ -4,6 +4,7 @@ config 5). Functional mirror of the reference's ``nvidiagpuplugin``."""
 from kubetpu.device.nvidia.manager import (
     NvidiaGPUManager,
     new_fake_nvidia_gpu_manager,
+    new_native_nvidia_gpu_manager,
     new_nvidia_gpu_manager,
 )
 from kubetpu.device.nvidia.types import GpuInfo, GpusInfo, parse_gpus_info
@@ -11,6 +12,7 @@ from kubetpu.device.nvidia.types import GpuInfo, GpusInfo, parse_gpus_info
 __all__ = [
     "NvidiaGPUManager",
     "new_fake_nvidia_gpu_manager",
+    "new_native_nvidia_gpu_manager",
     "new_nvidia_gpu_manager",
     "GpuInfo",
     "GpusInfo",

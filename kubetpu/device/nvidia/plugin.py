@@ -63,3 +63,43 @@ class NvidiaFakePlugin(NvidiaPlugin):
         for idx in device_indices:
             cli += " --device=" + self._info.gpus[idx].path
         return cli.encode()
+
+
+class NvidiaNativePlugin(NvidiaPlugin):
+    """Exec the native ``gpuinfo`` enumerator — the reference's nvmlinfo
+    exec-JSON process boundary (``nvgputypes/types.go:45-58``), NVML-free:
+    gpuinfo reads sysfs PCI state (see ``kubetpu/gpuinfo/gpuinfo.cc``).
+    Binary path from ``KUBETPU_GPUINFO_PATH``, default ``_output/gpuinfo``.
+    ``extra_args`` lets callers pin a fake box (e.g. ``["--fake",
+    "titan8"]``) while still crossing the real exec boundary."""
+
+    def __init__(self, binary: str | None = None, extra_args: List[str] | None = None,
+                 timeout: float = 30.0):
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        self.binary = binary or os.environ.get(
+            "KUBETPU_GPUINFO_PATH", os.path.join(repo, "_output", "gpuinfo")
+        )
+        self.extra_args = list(extra_args or [])
+        self.timeout = timeout
+        self._last_info: bytes | None = None
+
+    def get_gpu_info(self) -> bytes:
+        import subprocess
+
+        out = subprocess.run(
+            [self.binary, "json", *self.extra_args],
+            capture_output=True, timeout=self.timeout, check=True,
+        )
+        self._last_info = out.stdout
+        return out.stdout
+
+    def get_gpu_command_line(self, device_indices: List[int]) -> bytes:
+        # No nvidia-docker daemon behind the native probe: synthesize the
+        # legacy CLI fragment from the last probe (static hardware — don't
+        # fork a fresh sysfs walk per container allocation).
+        info = nvtypes.parse_gpus_info(self._last_info or self.get_gpu_info())
+        cli = "--device=/dev/nvidiactl --device=/dev/nvidia-uvm --device=/dev/nvidia-uvm-tools"
+        for idx in device_indices:
+            cli += " --device=" + info.gpus[idx].path
+        return cli.encode()

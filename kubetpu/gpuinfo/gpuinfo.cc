@@ -1,0 +1,275 @@
+// gpuinfo — native GPU hardware enumerator (NVML wire schema).
+//
+// The GPU-side analog of tpuinfo and of the reference's nvmlinfo binary
+// (nvidiagpuplugin/nvmlinfo/main.go): a short-lived native process that
+// emits one JSON object in the NVML wire format
+// (nvgputypes/types.go:8-43) on stdout, behind the same exec-JSON process
+// boundary. No NVML linkage exists in this environment, so the probe reads
+// sysfs PCI state; the P2P link levels NVML would report (1..6,
+// nvidia_gpu_manager.go:158-176) are approximated from PCI topology:
+//
+//   same PCI parent bridge             -> link 4 (single switch)
+//   same NUMA node (when known)        -> link 3 (hostbridge / same CPU)
+//   different NUMA nodes               -> link 1 (cross CPU)
+//   NUMA unknown, same PCI domain      -> link 3
+//
+// (links 6/5 — same board / NVLink — are NVML-only knowledge and never
+// emitted by the sysfs probe; fixtures can exercise them via --fake.)
+//
+// Probe root defaults to /sys and is overridable via GPUINFO_SYSFS_ROOT so
+// tests can fixture it. Fixture device dirs may carry two extra files the
+// kernel doesn't provide: `parent` (opaque bridge token, stands in for the
+// resolved parent path) and `vram_mib` (memory size).
+//
+// Modes:
+//   gpuinfo json            probe sysfs, print JSON
+//   gpuinfo --fake titan8   canned 8-GPU two-socket box (the TITAN X test
+//                           fixture shape, nvidia_gpu_manager_test.go:16)
+//   gpuinfo --fake k80x4    canned 4-GPU box with no topology (the K80
+//                           cloud-box fixture, nvidia_gpu_manager_test.go:17)
+//   gpuinfo                 human-readable dump
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <limits.h>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Gpu {
+  std::string uuid;
+  std::string model;
+  std::string path;
+  std::string bus_id;
+  std::string parent;   // bridge token for link inference
+  long long mem_mib = 0;
+  int numa = -1;
+  int bandwidth = 0;
+  std::vector<std::pair<std::string, int>> topology;  // (BusID, Link)
+};
+
+std::string EnvOr(const char* key, const char* fallback) {
+  const char* v = getenv(key);
+  return v ? std::string(v) : std::string(fallback);
+}
+
+std::string SysfsRoot() { return EnvOr("GPUINFO_SYSFS_ROOT", "/sys"); }
+
+std::string ReadFileTrim(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return "";
+  char buf[256] = {0};
+  if (!fgets(buf, sizeof(buf), f)) buf[0] = '\0';
+  fclose(f);
+  size_t len = strlen(buf);
+  while (len > 0 && (buf[len - 1] == '\n' || buf[len - 1] == '\r' || buf[len - 1] == ' '))
+    buf[--len] = '\0';
+  return buf;
+}
+
+// Known NVIDIA device ids -> marketing names; anything else gets the hex id.
+const struct { const char* dev; const char* name; } kModels[] = {
+    {"0x17c2", "GeForce GTX TITAN X"},
+    {"0x102d", "Tesla K80"},
+    {"0x1db4", "Tesla V100-PCIE-16GB"},
+    {"0x20b0", "A100-SXM4-40GB"},
+    {"0x2330", "H100 SXM5"},
+};
+
+std::string ModelFor(const std::string& device_id) {
+  for (const auto& m : kModels)
+    if (device_id == m.dev) return m.name;
+  return device_id.empty() ? "NVIDIA GPU" : "NVIDIA GPU (" + device_id + ")";
+}
+
+// Bridge token of a PCI function: the fixture's `parent` file when present,
+// else the parent directory of the resolved sysfs device path.
+std::string ParentToken(const std::string& dev_dir) {
+  std::string fixture = ReadFileTrim(dev_dir + "/parent");
+  if (!fixture.empty()) return fixture;
+  char resolved[PATH_MAX];
+  if (realpath(dev_dir.c_str(), resolved) == nullptr) return "";
+  std::string p(resolved);
+  size_t slash = p.rfind('/');
+  return slash == std::string::npos ? "" : p.substr(0, slash);
+}
+
+std::string RootComplex(const std::string& bus_id) {
+  // "0000:05:00.0" -> domain+bus nibble "0000:05" is too fine; the root
+  // complex is the PCI domain ("0000") — segment before the first ':'.
+  size_t colon = bus_id.find(':');
+  return colon == std::string::npos ? bus_id : bus_id.substr(0, colon);
+}
+
+std::vector<Gpu> ProbeSysfs() {
+  std::vector<Gpu> gpus;
+  std::string dev_root = SysfsRoot() + "/bus/pci/devices";
+  DIR* dir = opendir(dev_root.c_str());
+  if (!dir) return gpus;
+  std::vector<std::string> entries;
+  while (dirent* ent = readdir(dir)) {
+    if (ent->d_name[0] == '.') continue;
+    entries.push_back(ent->d_name);
+  }
+  closedir(dir);
+  // sort bus ids so indices are stable
+  for (size_t i = 0; i < entries.size(); i++)
+    for (size_t j = i + 1; j < entries.size(); j++)
+      if (entries[j] < entries[i]) std::swap(entries[i], entries[j]);
+
+  int index = 0;
+  for (const std::string& name : entries) {
+    std::string d = dev_root + "/" + name;
+    std::string vendor = ReadFileTrim(d + "/vendor");
+    std::string cls = ReadFileTrim(d + "/class");
+    // NVIDIA display (0x0300xx) / 3D (0x0302xx) controllers only
+    if (vendor != "0x10de") continue;
+    if (cls.rfind("0x0300", 0) != 0 && cls.rfind("0x0302", 0) != 0) continue;
+    Gpu g;
+    g.bus_id = name;
+    g.uuid = "GPU-" + name;  // sysfs has no NVML UUID; bus id is unique
+    g.model = ModelFor(ReadFileTrim(d + "/device"));
+    char path[64];
+    snprintf(path, sizeof(path), "/dev/nvidia%d", index);
+    g.path = path;
+    g.parent = ParentToken(d);
+    std::string numa = ReadFileTrim(d + "/numa_node");
+    g.numa = numa.empty() ? -1 : atoi(numa.c_str());
+    std::string vram = ReadFileTrim(d + "/vram_mib");
+    g.mem_mib = vram.empty() ? 0 : atoll(vram.c_str());
+    index++;
+    gpus.push_back(g);
+  }
+  // pairwise link levels from PCI topology (see header comment)
+  for (size_t i = 0; i < gpus.size(); i++) {
+    for (size_t j = 0; j < gpus.size(); j++) {
+      if (i == j) continue;
+      int link;
+      if (!gpus[i].parent.empty() && gpus[i].parent == gpus[j].parent)
+        link = 4;
+      else if (gpus[i].numa >= 0 && gpus[j].numa >= 0)
+        link = (gpus[i].numa == gpus[j].numa) ? 3 : 1;
+      else
+        link = RootComplex(gpus[i].bus_id) == RootComplex(gpus[j].bus_id) ? 3 : 1;
+      gpus[i].topology.push_back({gpus[j].bus_id, link});
+    }
+  }
+  return gpus;
+}
+
+std::vector<Gpu> FakeBox(const std::string& kind) {
+  std::vector<Gpu> gpus;
+  if (kind == "titan8") {
+    // 8x TITAN X, two sockets; NVLink-ish pairs (link 5) within a socket,
+    // hostbridge (3) across pairs on the same socket, and — like the
+    // reference's TITAN fixture — NO cross-socket entries, so grouping
+    // yields gpugrp0 pairs / one gpugrp1 quad per socket.
+    for (int i = 0; i < 8; i++) {
+      Gpu g;
+      char buf[64];
+      snprintf(buf, sizeof(buf), "0000:%02X:00.0", i + 4);
+      g.bus_id = buf;
+      snprintf(buf, sizeof(buf), "GPU-titan8-%d", i);
+      g.uuid = buf;
+      g.model = "GeForce GTX TITAN X";
+      snprintf(buf, sizeof(buf), "/dev/nvidia%d", i);
+      g.path = buf;
+      g.mem_mib = 12238;
+      g.bandwidth = 15760;
+      gpus.push_back(g);
+    }
+    for (int i = 0; i < 8; i++)
+      for (int j = 0; j < 8; j++) {
+        if (i == j || i / 4 != j / 4) continue;  // same socket only
+        gpus[i].topology.push_back({gpus[j].bus_id, (i / 2 == j / 2) ? 5 : 3});
+      }
+  } else if (kind == "k80x4") {
+    for (int i = 0; i < 4; i++) {
+      Gpu g;
+      char buf[64];
+      snprintf(buf, sizeof(buf), "0000:%02X:00.0", i + 4);
+      g.bus_id = buf;
+      snprintf(buf, sizeof(buf), "GPU-k80x4-%d", i);
+      g.uuid = buf;
+      g.model = "Tesla K80";
+      snprintf(buf, sizeof(buf), "/dev/nvidia%d", i);
+      g.path = buf;
+      g.mem_mib = 11441;
+      g.bandwidth = 11832;
+      gpus.push_back(g);  // Topology deliberately empty (cloud box)
+    }
+  } else {
+    fprintf(stderr, "gpuinfo: unknown fake box %s (titan8|k80x4)\n", kind.c_str());
+    exit(2);
+  }
+  return gpus;
+}
+
+void PrintJson(const std::vector<Gpu>& gpus) {
+  printf("{\"Version\":{\"Driver\":\"%s\",\"CUDA\":\"%s\"},",
+         EnvOr("GPUINFO_DRIVER_VERSION", "sysfs").c_str(),
+         EnvOr("GPUINFO_CUDA_VERSION", "").c_str());
+  printf("\"Devices\":[");
+  for (size_t i = 0; i < gpus.size(); i++) {
+    const Gpu& g = gpus[i];
+    if (i) printf(",");
+    printf("{\"UUID\":\"%s\",\"Model\":\"%s\",\"Path\":\"%s\",", g.uuid.c_str(),
+           g.model.c_str(), g.path.c_str());
+    printf("\"Memory\":{\"Global\":%lld},", g.mem_mib);
+    printf("\"PCI\":{\"BusID\":\"%s\",\"Bandwidth\":%d},", g.bus_id.c_str(),
+           g.bandwidth);
+    if (g.topology.empty()) {
+      printf("\"Topology\":null}");
+    } else {
+      printf("\"Topology\":[");
+      for (size_t t = 0; t < g.topology.size(); t++) {
+        if (t) printf(",");
+        printf("{\"BusID\":\"%s\",\"Link\":%d}", g.topology[t].first.c_str(),
+               g.topology[t].second);
+      }
+      printf("]}");
+    }
+  }
+  printf("]}\n");
+}
+
+void PrintHuman(const std::vector<Gpu>& gpus) {
+  printf("GPUs: %zu\n", gpus.size());
+  for (const Gpu& g : gpus) {
+    printf("  %s %s %s (%lld MiB) bus=%s\n", g.uuid.c_str(), g.model.c_str(),
+           g.path.c_str(), g.mem_mib, g.bus_id.c_str());
+    for (const auto& t : g.topology)
+      printf("    -> %s link %d\n", t.first.c_str(), t.second);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  bool json = false;
+  bool human = false;
+  std::string fake;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    if (arg == "json") {
+      json = true;
+    } else if (arg == "--fake" && i + 1 < argc) {
+      fake = argv[++i];
+      json = true;
+    } else if (arg == "--human") {
+      human = true;
+    } else {
+      fprintf(stderr, "usage: gpuinfo [json] [--fake titan8|k80x4] [--human]\n");
+      return 2;
+    }
+  }
+  std::vector<Gpu> gpus = fake.empty() ? ProbeSysfs() : FakeBox(fake);
+  if (json && !human)
+    PrintJson(gpus);
+  else
+    PrintHuman(gpus);
+  return 0;
+}

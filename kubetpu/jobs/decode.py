@@ -25,8 +25,10 @@ from kubetpu.jobs.model import ModelConfig, Params
 
 
 def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(k_cache, v_cache), each (L, B, S_max, H, D)."""
-    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.head_dim)
+    """(k_cache, v_cache), each (L, B, S_max, H_kv, D) — with grouped-query
+    attention the cache holds only the kv heads, an n_heads/n_kv_heads HBM
+    saving (the reason GQA exists)."""
+    shape = (cfg.n_layers, batch, max_seq, cfg.kv_heads, cfg.head_dim)
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
@@ -36,17 +38,24 @@ def kv_cache_specs() -> P:
 
 
 def _attend_cached(q, k_cache, v_cache, length):
-    """One-query-position attention over the first *length* cache entries.
-    q: (B, 1, H, D); caches: (B, S_max, H, D)."""
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+    """One-query-position attention over the first *length* cache entries,
+    grouped-query aware: the query's H heads attend against H_kv cached
+    heads in groups of G = H/H_kv WITHOUT expanding the cache (expansion
+    would materialize the full-head cache per step and erase GQA's memory
+    win). q: (B, 1, H, D); caches: (B, S_max, H_kv, D)."""
+    b, one, h, d = q.shape
+    h_kv = k_cache.shape[2]
+    g = h // h_kv
+    scale = d ** -0.5
+    qg = q.reshape(b, one, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg,
                         k_cache.astype(jnp.float32)) * scale
     positions = jnp.arange(k_cache.shape[1])
-    mask = positions[None, None, None, :] < length  # (1,1,1,S_max)
+    mask = positions[None, None, None, None, :] < length  # (...,S_max)
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(b, one, h, d).astype(q.dtype)
 
 
 def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos):

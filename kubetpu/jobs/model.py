@@ -50,10 +50,27 @@ class ModelConfig:
     # coeff * E * sum_e(frac_tokens_e * mean_prob_e) to next_token_loss,
     # keeping the router from collapsing onto few experts (0 = off)
     moe_aux_coeff: float = 0.0
+    # grouped-query attention: number of K/V heads (0 = n_heads, plain MHA;
+    # 1 = MQA). Must divide n_heads; the decode KV cache stores only these,
+    # cutting its HBM footprint by n_heads/n_kv_heads. With tensor
+    # parallelism, tp must divide n_kv_heads (the kv-head axis is the one
+    # sharded over tp).
+    n_kv_heads: int = 0
+
+    def __post_init__(self):
+        if self.n_kv_heads and self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_kv_heads ({self.n_kv_heads}) must divide "
+                f"n_heads ({self.n_heads})"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
@@ -66,12 +83,13 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
 
     ks = jax.random.split(k_layers, 7)
     scale = d ** -0.5
+    kv = cfg.kv_heads  # == h for MHA: init stays bit-identical per seed
     blocks: Params = {
         "ln1": jnp.ones((L, d), cfg.dtype),
         "ln2": jnp.ones((L, d), cfg.dtype),
         "wq": norm(ks[0], L, d, h, hd) * scale,
-        "wk": norm(ks[1], L, d, h, hd) * scale,
-        "wv": norm(ks[2], L, d, h, hd) * scale,
+        "wk": norm(ks[1], L, d, kv, hd) * scale,
+        "wv": norm(ks[2], L, d, kv, hd) * scale,
         "wo": norm(ks[3], L, h, hd, d) * (h * hd) ** -0.5,
     }
     if cfg.n_experts > 0:
@@ -120,6 +138,14 @@ def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
     sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :d_half], x[..., d_half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand grouped K/V heads to the full query-head count
+    (..., H_kv, D) -> (..., H_kv * n_rep, D). Identity for MHA."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=2)
 
 
 def dense_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
@@ -193,7 +219,11 @@ def _block_with_aux(
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    attn = attn_fn(q, k, v)
+    # GQA: expand grouped K/V to full heads ONLY for the attention core, so
+    # every core (dense, flash, ring) sees equal head counts; the returned
+    # k/v stay at kv_heads width — that is what the decode cache stores.
+    n_rep = cfg.n_heads // cfg.kv_heads
+    attn = attn_fn(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep))
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
 
     h = rms_norm(x, layer["ln2"])

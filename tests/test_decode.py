@@ -80,3 +80,55 @@ def test_capacity_moe_prefill_matches_training_forward():
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(full[:, -1], np.float32), rtol=2e-4, atol=2e-5
     )
+
+
+GQA_CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                      n_kv_heads=2)
+
+
+def test_gqa_equals_mha_when_groups_is_heads():
+    """n_kv_heads == n_heads must be bit-identical to the MHA default: same
+    init (same RNG consumption), same forward."""
+    cfg_mha = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    cfg_kv4 = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                          n_kv_heads=4)
+    p1 = init_params(jax.random.PRNGKey(0), cfg_mha)
+    p2 = init_params(jax.random.PRNGKey(0), cfg_kv4)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+    np.testing.assert_array_equal(
+        np.asarray(forward(p1, tokens, cfg_mha)),
+        np.asarray(forward(p2, tokens, cfg_kv4)),
+    )
+
+
+def test_gqa_cache_is_kv_heads_sized():
+    k_cache, v_cache = init_kv_cache(GQA_CFG, 2, 16)
+    assert k_cache.shape == (2, 2, 16, 2, 8)  # H_kv == 2, not H == 4
+
+
+def test_gqa_prefill_matches_forward():
+    params = init_params(jax.random.PRNGKey(0), GQA_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, GQA_CFG.vocab)
+    k_cache, v_cache = init_kv_cache(GQA_CFG, 2, 12)
+    logits, _, _ = prefill(GQA_CFG, params, tokens, k_cache, v_cache)
+    full = forward(params, tokens, GQA_CFG)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, -1]), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_gqa_greedy_generate_matches_rescoring():
+    """The grouped cached-attention decode path must agree with the full
+    forward — for GQA (2 groups) and MQA (n_kv_heads=1)."""
+    for n_kv in (2, 1):
+        cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                          n_kv_heads=n_kv)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab)
+        out = make_generate(cfg)(params, prompt, jax.random.PRNGKey(2), 6)
+        seq = np.asarray(out)
+        for t in range(5, 11):
+            logits = forward(params, jnp.asarray(seq[:, :t]), cfg)
+            np.testing.assert_array_equal(
+                seq[:, t], np.argmax(np.asarray(logits[:, -1]), axis=-1)
+            )

@@ -1,0 +1,119 @@
+"""The native GPU enumerator (gpuinfo) — the GPU analog of tpuinfo behind
+the reference's nvmlinfo exec-JSON boundary (nvgputypes/types.go:45-58),
+NVML-free: sysfs probe with PCI-topology-derived link levels, plus canned
+fake boxes mirroring the reference's test fixtures."""
+
+import os
+import subprocess
+
+import pytest
+
+from kubetpu.api.types import new_node_info
+from kubetpu.device.nvidia import new_native_nvidia_gpu_manager, parse_gpus_info
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BINARY = os.path.join(REPO, "_output", "gpuinfo")
+
+
+@pytest.fixture(scope="module")
+def gpuinfo_binary():
+    if not os.path.exists(BINARY):
+        subprocess.run(["make", "-C", REPO, "gpuinfo"], check=True, capture_output=True)
+    return BINARY
+
+
+def test_fake_titan8_matches_reference_fixture_shape(gpuinfo_binary):
+    out = subprocess.run([gpuinfo_binary, "--fake", "titan8"],
+                         capture_output=True, check=True)
+    info = parse_gpus_info(out.stdout)
+    assert len(info.gpus) == 8
+    assert info.gpus[0].model == "GeForce GTX TITAN X"
+    assert info.gpus[0].memory.global_mib == 12238
+    # NVLink pairs within a socket, hostbridge across pairs, no cross-socket
+    links = {t.bus_id: t.link for t in info.gpus[0].topology}
+    assert links[info.gpus[1].pci.bus_id] == 5
+    assert links[info.gpus[2].pci.bus_id] == 3
+    assert info.gpus[4].pci.bus_id not in links  # other socket: absent
+
+
+def test_fake_k80x4_has_no_topology(gpuinfo_binary):
+    out = subprocess.run([gpuinfo_binary, "--fake", "k80x4"],
+                         capture_output=True, check=True)
+    info = parse_gpus_info(out.stdout)
+    assert len(info.gpus) == 4
+    assert all(not g.topology for g in info.gpus)
+
+
+def test_manager_over_native_probe_advertises_groups(gpuinfo_binary):
+    """Full manager lifecycle over the REAL exec boundary: the titan8 box
+    must group into gpugrp0 pairs and per-socket gpugrp1 quads — the same
+    expectations as the reference's TITAN fixture
+    (nvidia_gpu_manager_test.go:118-145)."""
+    mgr = new_native_nvidia_gpu_manager(binary=gpuinfo_binary,
+                                        extra_args=["--fake", "titan8"])
+    mgr.start()
+    info = new_node_info("g0")
+    mgr.update_node_info(info)
+    assert info.kube_alloc.get("nvidia.com/gpu") == 8
+    grp_keys = [k for k in info.allocatable if "/gpugrp1/" in k and k.endswith("/cards")]
+    assert len(grp_keys) == 8
+    # pairs: GPUs 0,1 share a gpugrp0 id; quads: 0..3 share a gpugrp1 id
+    def seg(key, name):
+        parts = key.split("/")
+        return parts[parts.index(name) + 1]
+    by_uuid = {k.split("/gpu/")[1].split("/")[0]: k for k in grp_keys}
+    k0, k1, k2, k4 = (by_uuid[f"GPU-titan8-{i}"] for i in (0, 1, 2, 4))
+    assert seg(k0, "gpugrp0") == seg(k1, "gpugrp0")
+    assert seg(k0, "gpugrp0") != seg(k2, "gpugrp0")
+    assert seg(k0, "gpugrp1") == seg(k2, "gpugrp1")
+    assert seg(k0, "gpugrp1") != seg(k4, "gpugrp1")
+
+
+def test_sysfs_probe_with_fixture_root(gpuinfo_binary, tmp_path):
+    """Fixtured GPUINFO_SYSFS_ROOT: two GPUs behind one bridge (link 4), a
+    third on another NUMA node (link 1); model from the PCI device id,
+    memory from the fixture's vram_mib."""
+    def dev(bus, parent, numa, devid="0x17c2"):
+        d = tmp_path / "bus" / "pci" / "devices" / bus
+        d.mkdir(parents=True)
+        (d / "vendor").write_text("0x10de\n")
+        (d / "device").write_text(devid + "\n")
+        (d / "class").write_text("0x030000\n")
+        (d / "numa_node").write_text(f"{numa}\n")
+        (d / "parent").write_text(parent + "\n")
+        (d / "vram_mib").write_text("12238\n")
+
+    dev("0000:05:00.0", "bridgeA", 0)
+    dev("0000:06:00.0", "bridgeA", 0)
+    dev("0000:85:00.0", "bridgeB", 1, devid="0x102d")
+    # a non-GPU PCI function must be ignored
+    d = tmp_path / "bus" / "pci" / "devices" / "0000:00:1f.0"
+    d.mkdir(parents=True)
+    (d / "vendor").write_text("0x8086\n")
+    (d / "class").write_text("0x060100\n")
+
+    env = dict(os.environ)
+    env["GPUINFO_SYSFS_ROOT"] = str(tmp_path)
+    out = subprocess.run([gpuinfo_binary, "json"], capture_output=True,
+                         check=True, env=env)
+    info = parse_gpus_info(out.stdout)
+    assert [g.pci.bus_id for g in info.gpus] == [
+        "0000:05:00.0", "0000:06:00.0", "0000:85:00.0"
+    ]
+    assert info.gpus[0].model == "GeForce GTX TITAN X"
+    assert info.gpus[2].model == "Tesla K80"
+    assert info.gpus[0].memory.global_mib == 12238
+    links0 = {t.bus_id: t.link for t in info.gpus[0].topology}
+    assert links0["0000:06:00.0"] == 4  # same bridge
+    assert links0["0000:85:00.0"] == 1  # cross NUMA
+
+
+def test_human_mode_runs(gpuinfo_binary):
+    out = subprocess.run([gpuinfo_binary, "--fake", "titan8", "--human"],
+                         capture_output=True, check=True)
+    assert b"TITAN X" in out.stdout
+
+
+def test_unknown_fake_errors(gpuinfo_binary):
+    r = subprocess.run([gpuinfo_binary, "--fake", "nope"], capture_output=True)
+    assert r.returncode == 2
