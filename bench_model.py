@@ -30,8 +30,13 @@ import jax
 import jax.numpy as jnp
 
 
-# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets)
-PEAK_BF16 = {"v5e": 197e12, "v5litepod": 197e12, "v4": 275e12, "v5p": 459e12}
+# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets).
+# Ordered: device_kind strings are e.g. "TPU v5 lite" (v5e), "TPU v5p",
+# "TPU v4" — match the most specific marker first.
+PEAK_BF16 = [
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v5p", 459e12), ("v4", 275e12),
+]
 
 
 def flagship_cfg(smoke: bool):
@@ -52,7 +57,7 @@ def param_count(params) -> int:
 def chip_peak_flops():
     d = jax.devices()[0]
     kind = getattr(d, "device_kind", "").lower()
-    for key, peak in PEAK_BF16.items():
+    for key, peak in PEAK_BF16:
         if key in kind:
             return peak
     return None
@@ -102,7 +107,7 @@ def train_throughput(cfg, batch, seq, steps, attention):
     }
 
 
-def flash_vs_dense(cfg, seqs, smoke):
+def flash_vs_dense(cfg, seqs):
     from kubetpu.jobs.model import dense_causal_attention
 
     if jax.default_backend() == "cpu":
@@ -181,6 +186,14 @@ def main() -> int:
     ap.add_argument("--out", default=None, help="also write JSON lines to FILE")
     args = ap.parse_args()
 
+    if args.smoke:
+        # Smoke must run where a sitecustomize pins JAX to a hardware
+        # platform (tests/conftest.py documents the same workaround).
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — backend already initialized
+            pass
+
     cfg = flagship_cfg(args.smoke)
     results = []
 
@@ -195,7 +208,7 @@ def main() -> int:
 
     results.append(train_throughput(cfg, batch, seq, args.steps, "flash"
                                     if jax.default_backend() != "cpu" else "dense"))
-    results.extend(flash_vs_dense(cfg, seqs, args.smoke))
+    results.extend(flash_vs_dense(cfg, seqs))
     results.append(decode_throughput(cfg, *dec, n_kv_heads=0))
     results.append(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2))
 

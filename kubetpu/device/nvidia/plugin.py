@@ -13,6 +13,19 @@ from typing import List
 from kubetpu.device.nvidia import types as nvtypes
 
 
+def _docker_cli_fragment(paths: List[str], volume: str = "",
+                         volume_driver: str = "") -> bytes:
+    """The legacy nvidia-docker CLI fragment: control devices + per-GPU
+    --device flags (one synthesis shared by every daemon-less backend)."""
+    cli = ""
+    if volume or volume_driver:
+        cli = f"--volume-driver={volume_driver} --volume={volume} "
+    cli += "--device=/dev/nvidiactl --device=/dev/nvidia-uvm --device=/dev/nvidia-uvm-tools"
+    for path in paths:
+        cli += " --device=" + path
+    return cli.encode()
+
+
 class NvidiaPlugin(ABC):
     @abstractmethod
     def get_gpu_info(self) -> bytes: ...
@@ -58,11 +71,10 @@ class NvidiaFakePlugin(NvidiaPlugin):
         return nvtypes.dump_gpus_info(self._info).encode()
 
     def get_gpu_command_line(self, device_indices: List[int]) -> bytes:
-        cli = f"--volume-driver={self._volume_driver} --volume={self._volume}"
-        cli += " --device=/dev/nvidiactl --device=/dev/nvidia-uvm --device=/dev/nvidia-uvm-tools"
-        for idx in device_indices:
-            cli += " --device=" + self._info.gpus[idx].path
-        return cli.encode()
+        return _docker_cli_fragment(
+            [self._info.gpus[idx].path for idx in device_indices],
+            self._volume, self._volume_driver,
+        )
 
 
 class NvidiaNativePlugin(NvidiaPlugin):
@@ -99,7 +111,6 @@ class NvidiaNativePlugin(NvidiaPlugin):
         # legacy CLI fragment from the last probe (static hardware — don't
         # fork a fresh sysfs walk per container allocation).
         info = nvtypes.parse_gpus_info(self._last_info or self.get_gpu_info())
-        cli = "--device=/dev/nvidiactl --device=/dev/nvidia-uvm --device=/dev/nvidia-uvm-tools"
-        for idx in device_indices:
-            cli += " --device=" + info.gpus[idx].path
-        return cli.encode()
+        return _docker_cli_fragment(
+            [info.gpus[idx].path for idx in device_indices]
+        )

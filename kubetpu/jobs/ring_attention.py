@@ -85,7 +85,130 @@ def _ring_attention_local(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, S_local, H, D)
 
 
-def make_ring_attention(mesh: "Mesh | None", axis_name: str = "sp"):
+# ---------------------------------------------------------------------------
+# Flash-in-ring: each ring step runs the Pallas flash kernels on the visiting
+# K/V block instead of a dense S_local x S_local softmax — per-step score
+# materialization drops from O(S_local^2) HBM to VMEM tiles, which is what
+# lets local blocks grow to 8k+ under sequence parallelism. Block results
+# merge through their log-sum-exp (exact, no approximation); the backward is
+# its own ring: dK/dV accumulators travel WITH the rotating K/V block and
+# arrive home fully summed, dQ accumulates locally — all through the fused
+# FlashAttention-2 kernels with the GLOBAL lse (their P-recompute formulas
+# are exact under a global lse, see ops.flash_attention._flash_backward).
+# ---------------------------------------------------------------------------
+
+
+def _merge_weights(w, b, h, s_local):
+    """(B*H, S, 1) lse-space weight -> (B, S, H, 1) activation layout."""
+    return w.reshape(b, h, s_local, 1).transpose(0, 2, 1, 3)
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
+    from kubetpu.ops.flash_attention import _flash_forward
+
+    sp_size = jax.lax.psum(1, axis_name)  # static under shard_map
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+
+    # step 0 is ALWAYS the diagonal block: causal kernel, always visible
+    o0, lse0 = _flash_forward(q, k, v, block_q, block_k, interpret, causal=True)
+
+    def rotate(x):
+        return jax.lax.ppermute(
+            x, axis_name, [(i, (i + 1) % sp_size) for i in range(sp_size)]
+        )
+
+    def step(t, carry):
+        o_acc, lse, k_blk, v_blk = carry
+        k_blk = rotate(k_blk)
+        v_blk = rotate(v_blk)
+        # after t rotations we hold block (my_idx - t); visible iff j < i,
+        # i.e. t <= my_idx (wrapped blocks are future positions)
+        visible = (t <= my_idx)
+        o_t, lse_t = _flash_forward(
+            q, k_blk, v_blk, block_q, block_k, interpret, causal=False
+        )
+        lse_t = jnp.where(visible, lse_t, NEG_INF)
+        lse_new = jnp.logaddexp(lse, lse_t)
+        w_old = _merge_weights(jnp.exp(lse - lse_new), b, h, s_local)
+        w_new = _merge_weights(jnp.exp(lse_t - lse_new), b, h, s_local)
+        o_acc = o_acc * w_old + o_t.astype(jnp.float32) * w_new
+        return o_acc, lse_new, k_blk, v_blk
+
+    o_acc, lse, _, _ = jax.lax.fori_loop(
+        1, sp_size, step, (o0.astype(jnp.float32), lse0, k, v)
+    )
+    return o_acc.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, block_q, block_k, interpret):
+    out, _lse = _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, block_q, block_k, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, block_q, block_k, interpret, res, g):
+    from kubetpu.ops.flash_attention import _flash_backward
+
+    q, k, v, out, lse = res
+    my_idx = jax.lax.axis_index(axis_name)
+    sp_size = jax.lax.psum(1, axis_name)
+
+    def rotate(x):
+        return jax.lax.ppermute(
+            x, axis_name, [(i, (i + 1) % sp_size) for i in range(sp_size)]
+        )
+
+    # diagonal step: causal kernels, contributions to MY home block
+    dq0, dk0, dv0 = _flash_backward(
+        q, k, v, out, lse, g, block_q, block_k, interpret, causal=True
+    )
+
+    def step(t, carry):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        # the (k, v, dk, dv) quad travels together around the ring
+        k_blk = rotate(k_blk)
+        v_blk = rotate(v_blk)
+        dk_blk = rotate(dk_blk)
+        dv_blk = rotate(dv_blk)
+        visible = (t <= my_idx).astype(jnp.float32)
+        dq_t, dk_t, dv_t = _flash_backward(
+            q, k_blk, v_blk, out, lse, g, block_q, block_k, interpret,
+            causal=False,
+        )
+        dq = dq + dq_t.astype(jnp.float32) * visible
+        dk_blk = dk_blk + dk_t.astype(jnp.float32) * visible
+        dv_blk = dv_blk + dv_t.astype(jnp.float32) * visible
+        return dq, k_blk, v_blk, dk_blk, dv_blk
+
+    dq, _k_home, _v_home, dk, dv = jax.lax.fori_loop(
+        1, sp_size, step,
+        (dq0.astype(jnp.float32), k, v,
+         dk0.astype(jnp.float32), dv0.astype(jnp.float32)),
+    )
+    # after sp_size - 1 in-loop rotations the quad is ONE hop short of home:
+    # complete the cycle so each device's dk/dv correspond to its own block
+    dk = rotate(dk)
+    dv = rotate(dv)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def make_ring_attention(
+    mesh: "Mesh | None",
+    axis_name: str = "sp",
+    impl: str = "dense",
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
     """An attention core (q, k, v) -> out with the sequence axis sharded over
     *axis_name*, drop-in for ``model.forward``'s ``attn_fn``.
 
@@ -94,11 +217,23 @@ def make_ring_attention(mesh: "Mesh | None", axis_name: str = "sp"):
     so the same core composes under the plain GSPMD train step *and* inside
     the pipeline's pp-manual region — pass ``mesh=None`` when nesting inside
     another shard_map so the context (abstract) mesh is used.
+
+    ``impl="flash"`` runs the Pallas flash kernels inside every ring step
+    (VMEM-tiled scores instead of a dense per-step softmax; fused ring
+    backward). ``interpret=True`` for CPU tests of the flash impl.
     """
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"unknown ring impl {impl!r} (expected 'dense' or 'flash')")
     specs = P(None, axis_name, None, None)
-    local = partial(_ring_attention_local, axis_name=axis_name)
+    if impl == "flash":
+        fn = lambda q, k, v: _ring_flash(  # noqa: E731
+            q, k, v, axis_name, block_q, block_k, interpret
+        )
+    else:
+        local = partial(_ring_attention_local, axis_name=axis_name)
+        fn = lambda q, k, v: local(q, k, v)  # noqa: E731
     return jax.shard_map(
-        lambda q, k, v: local(q, k, v),
+        fn,
         mesh=mesh,
         in_specs=(specs, specs, specs),
         out_specs=specs,

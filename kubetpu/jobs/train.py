@@ -115,10 +115,17 @@ def init_state(
 
 
 def _resolve_attention(mesh: Mesh, attention: str):
-    """Pick the attention core: 'ring' (sequence-parallel over sp), 'flash'
-    (the Pallas kernel — single-sequence-shard paths), or 'dense'."""
+    """Pick the attention core: 'ring' (sequence-parallel over sp),
+    'ring_flash' (ring with the Pallas flash kernels inside every step —
+    VMEM-tiled scores, fused ring backward; append '_interpret' for the CPU
+    Pallas interpreter in tests), 'flash' (the Pallas kernel —
+    single-sequence-shard paths), or 'dense'."""
     if attention == "ring":
         return make_ring_attention(mesh)
+    if attention in ("ring_flash", "ring_flash_interpret"):
+        return make_ring_attention(
+            mesh, impl="flash", interpret=attention.endswith("_interpret")
+        )
     if attention == "flash":
         from kubetpu.ops import flash_attention
 

@@ -39,7 +39,7 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
-                  block_k: int, scale: float, seq_len: int):
+                  block_k: int, scale: float, seq_len: int, causal: bool):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
     d = q.shape[-1]
@@ -53,14 +53,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )                                              # (block_q, block_k)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        mask = q_pos >= k_pos
-        s = jnp.where(mask, s, NEG_INF)
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
         correction = jnp.exp(m - m_new)
         l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * correction + jax.lax.dot_general(
@@ -71,8 +74,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    # causal: only blocks up to (and including) the diagonal
-    num_k_blocks = (qi * block_q) // block_k + (block_q + block_k - 1) // block_k
+    if causal:  # only blocks up to (and including) the diagonal
+        num_k_blocks = (qi * block_q) // block_k + (block_q + block_k - 1) // block_k
+    else:       # full visibility (ring attention's sub-diagonal blocks)
+        num_k_blocks = seq_len // block_k
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # log-sum-exp per row (the softmax residual the backward kernels need);
@@ -85,8 +90,10 @@ def _heads_layout(x):
     return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
-def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool):
-    """Returns (out (B,S,H,D), lse (B*H, S)) — lse is the backward residual."""
+def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool,
+                   causal: bool = True):
+    """Returns (out (B,S,H,D), lse (B*H, S, 1)) — lse is the backward
+    residual and the merge weight for ring-attention block combination."""
     b, s, h, d = q.shape
     scale = d ** -0.5
     # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head)
@@ -98,7 +105,8 @@ def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool):
 
     out, lse = pl.pallas_call(
         functools.partial(
-            _flash_kernel, block_q=bq, block_k=bk, scale=scale, seq_len=s
+            _flash_kernel, block_q=bq, block_k=bk, scale=scale, seq_len=s,
+            causal=causal,
         ),
         grid=(b * h, s // bq),
         in_specs=[
@@ -120,9 +128,11 @@ def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool):
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_q: int, block_k: int, scale: float):
-    """dQ for one query block: stream the causal k/v blocks, recompute P
-    from the saved log-sum-exp (FlashAttention-2 backward, dQ pass)."""
+                         dq_ref, *, block_q: int, block_k: int, scale: float,
+                         seq_len: int, causal: bool):
+    """dQ for one query block: stream the (causal or all) k/v blocks,
+    recompute P from the saved log-sum-exp (FlashAttention-2 backward, dQ
+    pass)."""
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)                   # (bq, D)
     do = do_ref[0].astype(jnp.float32)                 # (bq, D)
@@ -137,9 +147,16 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        mask = q_pos >= k_pos
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)     # exact probs via lse
+        # exact probs via lse. The clamp is a no-op for every score the lse
+        # covers (s <= lse row-wise by construction) and bounds the ring's
+        # INVISIBLE-step calls, whose scores the global lse does not cover —
+        # without it exp() overflows to inf there and inf * 0-gate = NaN.
+        p = jnp.exp(jnp.minimum(s - lse, 0.0))
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -148,16 +165,20 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
 
-    num_k_blocks = (qi * block_q) // block_k + (block_q + block_k - 1) // block_k
+    if causal:
+        num_k_blocks = (qi * block_q) // block_k + (block_q + block_k - 1) // block_k
+    else:
+        num_k_blocks = seq_len // block_k
     dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, block_k: int,
-                          scale: float, num_q_blocks: int):
+                          scale: float, num_q_blocks: int, causal: bool):
     """dK/dV for one key block: stream the query blocks at or below the
-    diagonal (FlashAttention-2 backward, dK/dV pass)."""
+    diagonal — or all of them when non-causal (FlashAttention-2 backward,
+    dK/dV pass)."""
     kj = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)               # (bk, D)
     v_blk = v_ref[0].astype(jnp.float32)               # (bk, D)
@@ -173,9 +194,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        mask = q_pos >= k_pos
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)     # (bq, bk)
+        # clamped for the same reason as the dQ kernel (ring invisible steps)
+        p = jnp.exp(jnp.minimum(s - lse, 0.0))         # (bq, bk)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
         dv = dv + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -189,7 +214,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dk, dv
 
     # first query block whose rows can see this key block
-    first_qi = (kj * block_k) // block_q
+    first_qi = (kj * block_k) // block_q if causal else 0
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(first_qi, num_q_blocks, body, (dk0, dv0))
@@ -197,7 +222,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret):
+def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret,
+                    causal: bool = True):
+    """Fused backward. With ``causal=False`` this also serves the ring
+    attention's off-diagonal steps: *out*/*lse*/*g* are then the GLOBAL
+    (merged) output, log-sum-exp and cotangent — the FlashAttention-2
+    formulas are exact under a global lse, so the per-block contributions
+    returned here sum to the full gradient across ring steps."""
     b, s, h, d = q.shape
     scale = d ** -0.5
     qh, kh, vh = _heads_layout(q), _heads_layout(k), _heads_layout(v)
@@ -210,7 +241,8 @@ def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret):
     bk = min(block_k, s)
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk, scale=scale),
+        functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
+                          scale=scale, seq_len=s, causal=causal),
         grid=(b * h, s // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),   # q
@@ -228,7 +260,7 @@ def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret):
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, block_q=bq, block_k=bk, scale=scale,
-            num_q_blocks=s // bq,
+            num_q_blocks=s // bq, causal=causal,
         ),
         grid=(b * h, s // bk),
         in_specs=[
