@@ -178,6 +178,44 @@ def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads):
     }
 
 
+def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma):
+    import dataclasses
+
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.speculative import make_speculative_generate
+
+    tcfg = dataclasses.replace(cfg, remat=False)
+    # draft: a quarter-depth, quarter-width shrink of the target
+    dcfg = dataclasses.replace(
+        tcfg,
+        d_model=max(64, cfg.d_model // 4),
+        n_layers=max(1, cfg.n_layers // 4),
+        n_heads=max(1, cfg.n_heads // 4),
+        d_ff=max(128, cfg.d_ff // 4),
+    )
+    t_params = init_params(jax.random.PRNGKey(0), tcfg)
+    d_params = init_params(jax.random.PRNGKey(7), dcfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len), 0,
+                                tcfg.vocab, jnp.int32)
+    gen = make_speculative_generate(tcfg, dcfg, gamma)
+    out, accept = gen(t_params, d_params, prompt, gen_steps)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out, accept = gen(t_params, d_params, prompt, gen_steps)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    del t_params, d_params
+    return {
+        "metric": "speculative_decode_tokens_per_s",
+        "value": round(batch * gen_steps / dt, 1),
+        "unit": "tokens/s",
+        "batch": batch,
+        "gen_steps": gen_steps,
+        "gamma": gamma,
+        "mean_tokens_per_round": round(float(accept), 2),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -211,6 +249,7 @@ def main() -> int:
     results.extend(flash_vs_dense(cfg, seqs))
     results.append(decode_throughput(cfg, *dec, n_kv_heads=0))
     results.append(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2))
+    results.append(speculative_throughput(cfg, *dec, gamma=4))
 
     for r in results:
         print(json.dumps(r), flush=True)

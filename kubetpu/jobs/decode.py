@@ -37,41 +37,46 @@ def kv_cache_specs() -> P:
     return P(None, "dp", None, "tp", None)
 
 
-def _attend_cached(q, k_cache, v_cache, length):
-    """One-query-position attention over the first *length* cache entries,
-    grouped-query aware: the query's H heads attend against H_kv cached
-    heads in groups of G = H/H_kv WITHOUT expanding the cache (expansion
-    would materialize the full-head cache per step and erase GQA's memory
-    win). q: (B, 1, H, D); caches: (B, S_max, H_kv, D)."""
-    b, one, h, d = q.shape
+def _attend_cached(q, k_cache, v_cache, pos):
+    """Chunk attention through the cache: query t (of T new positions
+    starting at *pos*) sees cache entries 0..pos+t. Grouped-query aware:
+    the query's H heads attend against H_kv cached heads in groups of
+    G = H/H_kv WITHOUT expanding the cache (expansion would materialize the
+    full-head cache per step and erase GQA's memory win).
+    q: (B, T, H, D); caches: (B, S_max, H_kv, D)."""
+    b, t, h, d = q.shape
     h_kv = k_cache.shape[2]
     g = h // h_kv
     scale = d ** -0.5
-    qg = q.reshape(b, one, h_kv, g, d).astype(jnp.float32)
+    qg = q.reshape(b, t, h_kv, g, d).astype(jnp.float32)
     scores = jnp.einsum("bqkgd,bskd->bkgqs", qg,
                         k_cache.astype(jnp.float32)) * scale
-    positions = jnp.arange(k_cache.shape[1])
-    mask = positions[None, None, None, None, :] < length  # (...,S_max)
-    scores = jnp.where(mask, scores, -1e30)
+    k_pos = jnp.arange(k_cache.shape[1])
+    q_pos = pos + jnp.arange(t)
+    mask = k_pos[None, :] <= q_pos[:, None]            # (T, S_max)
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache.astype(jnp.float32))
-    return out.reshape(b, one, h, d).astype(q.dtype)
+    return out.reshape(b, t, h, d).astype(q.dtype)
 
 
 def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos):
-    """One transformer block for one new token position, reading/updating
-    this layer's cache. x: (B, 1, D); caches: (B, S_max, H, D)."""
+    """One transformer block over a T-token chunk at positions
+    pos..pos+T-1, writing the chunk's K/V into this layer's cache.
+    x: (B, T, D); caches: (B, S_max, H_kv, D). T == 1 is plain
+    token-at-a-time decoding; T > 1 is speculative verification."""
     h = model_lib.rms_norm(x, layer["ln1"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
-    positions = jnp.full((x.shape[0], 1), pos, jnp.int32)
+    positions = pos + jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+    positions = jnp.broadcast_to(positions, (x.shape[0], x.shape[1]))
     q = model_lib.rope(q, positions, cfg.rope_theta)
     k = model_lib.rope(k, positions, cfg.rope_theta)
 
-    k_cache_l = jax.lax.dynamic_update_index_in_dim(k_cache_l, k[:, 0], pos, 1)
-    v_cache_l = jax.lax.dynamic_update_index_in_dim(v_cache_l, v[:, 0], pos, 1)
-    attn = _attend_cached(q, k_cache_l, v_cache_l, pos + 1)
+    k_cache_l = jax.lax.dynamic_update_slice(k_cache_l, k, (0, pos, 0, 0))
+    v_cache_l = jax.lax.dynamic_update_slice(v_cache_l, v, (0, pos, 0, 0))
+    attn = _attend_cached(q, k_cache_l, v_cache_l, pos)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
 
     h = model_lib.rms_norm(x, layer["ln2"])
@@ -79,10 +84,12 @@ def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos):
     return x + delta, k_cache_l, v_cache_l
 
 
-def _forward_one(cfg: ModelConfig, params: Params, token, k_cache, v_cache, pos):
-    """Logits for one new token at *pos*, updating the cache.
-    token: (B,) int32 -> logits (B, V)."""
-    x = params["embed"][token][:, None, :]  # (B, 1, D)
+def forward_chunk(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache, pos):
+    """Logits for a T-token chunk fed at positions pos..pos+T-1 through the
+    KV cache (T == 1: one decode step; T > 1: speculative verification in a
+    single MXU-friendly pass). tokens: (B, T) -> logits (B, T, V) float32;
+    caches are updated with the chunk's K/V."""
+    x = params["embed"][tokens]                        # (B, T, D)
 
     def layer_body(carry, inputs):
         x = carry
@@ -96,8 +103,18 @@ def _forward_one(cfg: ModelConfig, params: Params, token, k_cache, v_cache, pos)
     x = model_lib.rms_norm(x, params["ln_f"])
     # float32 logits: matches prefill's and keeps the decode scan carry
     # dtype-stable for bfloat16 model configs
-    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])[:, 0].astype(jnp.float32)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["head"]).astype(jnp.float32)
     return logits, k_cache, v_cache
+
+
+def _forward_one(cfg: ModelConfig, params: Params, token, k_cache, v_cache, pos):
+    """Logits for one new token at *pos*, updating the cache.
+    token: (B,) int32 -> logits (B, V). (A T=1 chunk — one shared block
+    implementation for decode and speculative verification.)"""
+    logits, k_cache, v_cache = forward_chunk(
+        cfg, params, token[:, None], k_cache, v_cache, pos
+    )
+    return logits[:, 0], k_cache, v_cache
 
 
 def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache):

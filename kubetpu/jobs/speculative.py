@@ -1,0 +1,160 @@
+"""Speculative decoding: a small draft model proposes ``gamma`` tokens, the
+target model verifies them in ONE batched cached forward, and the longest
+agreeing prefix is accepted plus one correction/bonus token.
+
+TPU-shaped throughout: generation is a single jitted ``lax.while_loop`` of
+fixed-shape rounds (static shapes, no host round-trips) that exits as soon
+as every sequence has its tokens — rounds with high acceptance finish the
+job in ~num_steps/(gamma+1) iterations, which is the entire speedup (decode
+is memory-bound: the target's weights stream once per ROUND instead of once
+per token). The verification pass is a (gamma+1)-token CHUNK forward
+through the target's KV cache (``decode.forward_chunk`` — the same block
+implementation as plain decoding, so the two can never diverge).
+
+Greedy acceptance makes the output EXACTLY equal to target-only greedy
+decoding — token j is accepted iff the draft's choice equals the target's
+argmax given the same prefix, and the first disagreement is replaced by the
+target's own choice (when all gamma agree, the target's next argmax is the
+bonus token). Rejected cache slots need no rollback: positions rewind and
+later rounds overwrite them, and every attention mask is position-bounded
+so stale entries are never read.
+
+Reference: the reference framework has no inference stack at all
+(SURVEY.md §2 "parallelism" note) — this is a TPU-first extension, like the
+rest of kubetpu's jobs layer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubetpu.jobs.decode import forward_chunk, init_kv_cache, prefill
+from kubetpu.jobs.model import ModelConfig
+
+
+def make_speculative_generate(
+    target_cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    gamma: int = 4,
+):
+    """Jitted ``generate(target_params, draft_params, prompt, num_steps)``
+    -> ((B, S_p + num_steps) tokens, mean accepted-per-live-round) — greedy
+    speculative decoding, output identical to target-only greedy decode.
+
+    Both models must share the vocab; the draft is typically a few-layer
+    shrink of the target. ``gamma`` drafts per round; each round emits
+    between 1 and gamma+1 tokens per sequence.
+    """
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError("target and draft must share a vocabulary")
+
+    def generate(target_params, draft_params, prompt, num_steps: int):
+        b, s_prompt = prompt.shape
+        max_seq = s_prompt + num_steps + gamma + 1
+        tk, tv = init_kv_cache(target_cfg, b, max_seq)
+        dk, dv = init_kv_cache(draft_cfg, b, max_seq)
+
+        t_logits, tk, tv = prefill(target_cfg, target_params, prompt, tk, tv)
+        _d_logits, dk, dv = prefill(draft_cfg, draft_params, prompt, dk, dv)
+
+        # first emitted token: the target's own choice after the prompt
+        last = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)       # (B,)
+        out = jnp.zeros((b, num_steps + gamma + 2), jnp.int32)
+        out = out.at[:, 0].set(last)
+
+        pos0 = jnp.full((b,), s_prompt, jnp.int32)  # index of `last` in seq
+        count0 = jnp.ones((b,), jnp.int32)          # emitted so far
+        stats0 = jnp.zeros((2,), jnp.float32)       # (live tokens, live rounds)
+
+        def round_step(carry):
+            tk, tv, dk, dv, last, out, pos, count, stats = carry
+            live = count < num_steps                            # (B,)
+
+            # -- draft gamma tokens sequentially through the draft cache --
+            def draft_step(c, _):
+                dk, dv, tok, p = c
+                logits, dk, dv = _forward_chunk_at(
+                    draft_cfg, draft_params, tok[:, None], dk, dv, p
+                )
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                return (dk, dv, nxt, p + 1), nxt
+
+            (dk, dv, _tok, _), drafts = jax.lax.scan(
+                draft_step, (dk, dv, last, pos), None, length=gamma
+            )
+            drafts = drafts.transpose(1, 0)                     # (B, gamma)
+
+            # -- verify: ONE (gamma+1)-chunk forward [last, d_0..d_{g-1}] --
+            chunk = jnp.concatenate([last[:, None], drafts], axis=1)
+            t_logits, tk, tv = _forward_chunk_at(
+                target_cfg, target_params, chunk, tk, tv, pos
+            )                                               # (B, gamma+1, V)
+            target_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+            # longest agreeing prefix, then one correction/bonus token
+            agree = (drafts == target_tok[:, :gamma]).astype(jnp.int32)
+            accepted = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)  # (B,)
+            n_emit = accepted + 1                           # 1..gamma+1
+
+            # emit target_tok[:, :n_emit] at out[count:count+n_emit]; writes
+            # past num_steps (and whole post-completion rounds) route to a
+            # sacrificial last column
+            idx = jnp.arange(gamma + 1)[None, :]
+            write_pos = count[:, None] + idx                # (B, gamma+1)
+            valid = (idx < n_emit[:, None]) & (write_pos < num_steps)
+            write_pos = jnp.where(valid, write_pos, out.shape[1] - 1)
+            out = _scatter_rows(out, write_pos, target_tok, valid)
+
+            new_last = jnp.take_along_axis(
+                target_tok, (n_emit - 1)[:, None], axis=1
+            )[:, 0]
+            new_pos = jnp.minimum(pos + n_emit, s_prompt + num_steps)
+            new_count = jnp.minimum(count + n_emit, num_steps)
+            stats = stats + jnp.array(
+                [jnp.sum(jnp.where(live, n_emit, 0)).astype(jnp.float32),
+                 jnp.sum(live.astype(jnp.float32))]
+            )
+            return (tk, tv, dk, dv, new_last, out, new_pos, new_count, stats)
+
+        def not_done(carry):
+            count = carry[7]
+            return jnp.any(count < num_steps)
+
+        (tk, tv, dk, dv, last, out, pos, count, stats) = jax.lax.while_loop(
+            not_done, round_step,
+            (tk, tv, dk, dv, last, out, pos0, count0, stats0),
+        )
+        tokens = jnp.concatenate([prompt, out[:, :num_steps]], axis=1)
+        mean_accept = stats[0] / jnp.maximum(stats[1], 1.0)
+        return tokens, mean_accept
+
+    return jax.jit(generate, static_argnums=(3,))
+
+
+def _forward_chunk_at(cfg, params, chunk, k_cache, v_cache, pos):
+    """``decode.forward_chunk`` with PER-BATCH positions (vmapped over the
+    batch: speculative rounds advance each sequence unevenly, so the cache
+    write offset differs per example)."""
+    def one(params, chunk, k_c, v_c, p):
+        logits, k_c, v_c = forward_chunk(
+            cfg, params, chunk[None], k_c[:, None], v_c[:, None], p
+        )
+        return logits[0], k_c[:, 0], v_c[:, 0]
+
+    return jax.vmap(
+        one, in_axes=(None, 0, 1, 1, 0), out_axes=(0, 1, 1)
+    )(params, chunk, k_cache, v_cache, pos)
+
+
+def _scatter_rows(out, write_pos, values, valid):
+    """out[b, write_pos[b, j]] = values[b, j] where valid[b, j] (invalid
+    writes are routed by the caller to a sacrificial last column)."""
+    rows = jnp.arange(out.shape[0])[:, None] * out.shape[1]
+    flat_idx = (rows + write_pos).reshape(-1)
+    flat_val = values.reshape(-1)
+    keep = valid.reshape(-1)
+    base = out.reshape(-1)
+    cur = base[flat_idx]
+    upd = jnp.where(keep, flat_val, cur)
+    return base.at[flat_idx].set(upd).reshape(out.shape)
