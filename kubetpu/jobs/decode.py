@@ -1,14 +1,19 @@
 """Autoregressive decoding with a KV cache — the inference path.
 
 TPU-shaped decoding: the whole generation loop is ONE ``lax.scan`` inside a
-single jit (no per-token dispatch), the KV cache is a preallocated static
-(L, B, S_max, H, D) buffer updated with ``dynamic_update_index_in_dim``
-(static shapes — XLA requirement), and the cache shards over the mesh like
-activations (batch on dp, heads on tp; the sequence axis of the *cache*
-stays unsharded — decode is token-at-a-time, sp is a training-time axis).
+single jit (no per-token dispatch); the KV cache is a preallocated static
+(L, B, S_max, H_kv, D) buffer (kv heads only under grouped-query
+attention) updated with ``dynamic_update_slice`` (static shapes — XLA
+requirement), and the cache shards over the mesh like activations (batch
+on dp, heads on tp; the sequence axis of the *cache* stays unsharded — sp
+is a training-time axis).
 
-Prefill processes the prompt in one batched forward (MXU-friendly), then
-the decode scan consumes/extends the cache one token per step.
+The core is the T-token CHUNK forward through the cache
+(``forward_chunk``): plain decoding is its T == 1 case, speculative
+verification (``kubetpu.jobs.speculative``) its T == gamma+1 case — one
+block implementation for both, so they cannot diverge. Prefill processes
+the prompt in one batched forward (MXU-friendly), then the decode scan
+consumes/extends the cache one token per step.
 """
 
 from __future__ import annotations

@@ -34,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubetpu.jobs import model as model_lib
 from kubetpu.jobs.model import ModelConfig, Params
-from kubetpu.jobs.ring_attention import _ring_attention_local
+from kubetpu.jobs.ring_attention import _ring_attention_local, _ring_flash
 from kubetpu.jobs.train import (
     TrainState,
     _filter_spec,
@@ -64,12 +64,23 @@ def make_pipeline_forward(
     mesh: Mesh,
     n_microbatches: int,
     use_ring: bool = True,
+    ring_impl: str = "dense",
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
 ):
     """(params, tokens (M*B, S)) -> logits (M*B, S, V) through the pipeline.
 
     Embedding and head are replicated (cheap) and run outside the manual
-    region; only the block stack is staged.
+    region; only the block stack is staged. ``ring_impl="flash"`` runs the
+    Pallas flash kernels inside every ring step (the {pp, sp} region is
+    already manual, so the flash-ring LOCAL body drops in directly —
+    no nested shard_map); ``interpret=True`` for CPU tests of it.
     """
+    if ring_impl not in ("dense", "flash"):
+        raise ValueError(
+            f"unknown ring impl {ring_impl!r} (expected 'dense' or 'flash')"
+        )
     axis_name, sp_axis = "pp", "sp"
     manual_axes = {axis_name} | ({sp_axis} if use_ring else set())
     seq_spec = sp_axis if use_ring else None
@@ -80,11 +91,14 @@ def make_pipeline_forward(
         last = pp_size - 1
         m, b, s, d = h_stack.shape  # s is the sp-local length under use_ring
         ticks = n_microbatches + pp_size - 1
-        attn = (
-            partial(_ring_attention_local, axis_name=sp_axis)
-            if use_ring
-            else model_lib.dense_causal_attention
-        )
+        if use_ring and ring_impl == "flash":
+            attn = lambda q, k, v: _ring_flash(  # noqa: E731
+                q, k, v, sp_axis, block_q, block_k, interpret
+            )
+        elif use_ring:
+            attn = partial(_ring_attention_local, axis_name=sp_axis)
+        else:
+            attn = model_lib.dense_causal_attention
         stage = partial(_stage_forward, cfg, attn, positions, blocks)
 
         def tick(t, carry):
@@ -160,10 +174,17 @@ def make_pipeline_train_step(
     n_microbatches: int,
     optimizer=None,
     use_ring: bool = True,
+    ring_impl: str = "dense",
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
 ):
-    """Full pipelined training step: GPipe forward/backward + adamw."""
+    """Full pipelined training step: GPipe forward/backward + adamw.
+    ``ring_impl="flash"`` puts the Pallas flash kernels inside the ring."""
     optimizer = optimizer or make_optimizer()
-    fwd = make_pipeline_forward(cfg, mesh, n_microbatches, use_ring=use_ring)
+    fwd = make_pipeline_forward(cfg, mesh, n_microbatches, use_ring=use_ring,
+                                ring_impl=ring_impl, block_q=block_q,
+                                block_k=block_k, interpret=interpret)
 
     def loss_fn(params, tokens, targets):
         return model_lib.token_cross_entropy(fwd(params, tokens), targets)

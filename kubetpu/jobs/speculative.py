@@ -111,8 +111,11 @@ def make_speculative_generate(
             )[:, 0]
             new_pos = jnp.minimum(pos + n_emit, s_prompt + num_steps)
             new_count = jnp.minimum(count + n_emit, num_steps)
+            # stats count only tokens actually WRITTEN (valid), so a final
+            # round clipped at num_steps doesn't inflate tokens-per-round
+            n_written = jnp.sum(valid.astype(jnp.int32), axis=1)
             stats = stats + jnp.array(
-                [jnp.sum(jnp.where(live, n_emit, 0)).astype(jnp.float32),
+                [jnp.sum(jnp.where(live, n_written, 0)).astype(jnp.float32),
                  jnp.sum(live.astype(jnp.float32))]
             )
             return (tk, tv, dk, dv, new_last, out, new_pos, new_count, stats)

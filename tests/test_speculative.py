@@ -80,9 +80,10 @@ def test_speculative_equals_greedy_perfect_draft():
     # High acceptance — not exactly gamma+1: the draft decodes in T=1 steps
     # while verification is one chunk, so reduction order differs and a
     # random-init model's near-uniform logits flip argmax on near-ties.
-    # Real (trained) models have separated logits; here > 2.5 tokens/round
+    # Real (trained) models have separated logits; here > 1.8 WRITTEN
+    # tokens/round (the stat excludes clipped final-round tokens)
     # demonstrates multi-token acceptance.
-    assert float(mean_accept) > 2.5
+    assert float(mean_accept) > 1.8
 
 
 def test_speculative_with_gqa_target():
