@@ -38,7 +38,8 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_seq: int) -> Tuple[jnp.ndarr
 
 
 def kv_cache_specs() -> P:
-    """Cache sharding: batch on dp, heads on tp."""
+    """Cache sharding: batch on dp, (kv) heads on tp — under grouped-query
+    attention tp must divide n_kv_heads (ModelConfig docs)."""
     return P(None, "dp", None, "tp", None)
 
 
@@ -142,6 +143,15 @@ def make_generate(cfg: ModelConfig, mesh: Optional[Mesh] = None, temperature: fl
         b, s_prompt = prompt.shape
         max_seq = s_prompt + num_steps
         k_cache, v_cache = init_kv_cache(cfg, b, max_seq)
+        if mesh is not None:
+            # pin the cache layout (batch on dp, kv heads on tp) so the
+            # decode scan's cache updates stay local instead of whatever
+            # layout GSPMD happens to infer from the prompt
+            from kubetpu.jobs.train import _filter_spec
+
+            cspec = NamedSharding(mesh, _filter_spec(mesh, kv_cache_specs()))
+            k_cache = jax.lax.with_sharding_constraint(k_cache, cspec)
+            v_cache = jax.lax.with_sharding_constraint(v_cache, cspec)
         logits, k_cache, v_cache = prefill(cfg, params, prompt, k_cache, v_cache)
 
         def sample(logits, rng):

@@ -34,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubetpu.jobs import model as model_lib
 from kubetpu.jobs.model import ModelConfig, Params
-from kubetpu.jobs.ring_attention import _ring_attention_local, _ring_flash
+from kubetpu.jobs.ring_attention import make_ring_local
 from kubetpu.jobs.train import (
     TrainState,
     _filter_spec,
@@ -77,13 +77,15 @@ def make_pipeline_forward(
     already manual, so the flash-ring LOCAL body drops in directly —
     no nested shard_map); ``interpret=True`` for CPU tests of it.
     """
-    if ring_impl not in ("dense", "flash"):
-        raise ValueError(
-            f"unknown ring impl {ring_impl!r} (expected 'dense' or 'flash')"
-        )
     axis_name, sp_axis = "pp", "sp"
     manual_axes = {axis_name} | ({sp_axis} if use_ring else set())
     seq_spec = sp_axis if use_ring else None
+    # built (and impl-validated) eagerly; binds the sp axis only when traced
+    attn = (
+        make_ring_local(ring_impl, sp_axis, block_q, block_k, interpret)
+        if use_ring
+        else model_lib.dense_causal_attention
+    )
 
     def region(blocks, h_stack, positions):
         pp_size = jax.lax.psum(1, axis_name)
@@ -91,14 +93,6 @@ def make_pipeline_forward(
         last = pp_size - 1
         m, b, s, d = h_stack.shape  # s is the sp-local length under use_ring
         ticks = n_microbatches + pp_size - 1
-        if use_ring and ring_impl == "flash":
-            attn = lambda q, k, v: _ring_flash(  # noqa: E731
-                q, k, v, sp_axis, block_q, block_k, interpret
-            )
-        elif use_ring:
-            attn = partial(_ring_attention_local, axis_name=sp_axis)
-        else:
-            attn = model_lib.dense_causal_attention
         stage = partial(_stage_forward, cfg, attn, positions, blocks)
 
         def tick(t, carry):

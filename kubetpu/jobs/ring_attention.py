@@ -201,6 +201,25 @@ def _ring_flash_vjp_bwd(axis_name, block_q, block_k, interpret, res, g):
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
+def make_ring_local(
+    impl: str,
+    axis_name: str = "sp",
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """The per-device ring body (q, k, v) -> out, for callers that are
+    ALREADY inside a manual region over *axis_name* (e.g. the pipeline's
+    {pp, sp} region) — the single place the impl dispatch lives."""
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"unknown ring impl {impl!r} (expected 'dense' or 'flash')")
+    if impl == "flash":
+        return lambda q, k, v: _ring_flash(
+            q, k, v, axis_name, block_q, block_k, interpret
+        )
+    return partial(_ring_attention_local, axis_name=axis_name)
+
+
 def make_ring_attention(
     mesh: "Mesh | None",
     axis_name: str = "sp",
@@ -222,18 +241,10 @@ def make_ring_attention(
     (VMEM-tiled scores instead of a dense per-step softmax; fused ring
     backward). ``interpret=True`` for CPU tests of the flash impl.
     """
-    if impl not in ("dense", "flash"):
-        raise ValueError(f"unknown ring impl {impl!r} (expected 'dense' or 'flash')")
     specs = P(None, axis_name, None, None)
-    if impl == "flash":
-        fn = lambda q, k, v: _ring_flash(  # noqa: E731
-            q, k, v, axis_name, block_q, block_k, interpret
-        )
-    else:
-        local = partial(_ring_attention_local, axis_name=axis_name)
-        fn = lambda q, k, v: local(q, k, v)  # noqa: E731
+    local = make_ring_local(impl, axis_name, block_q, block_k, interpret)
     return jax.shard_map(
-        fn,
+        lambda q, k, v: local(q, k, v),
         mesh=mesh,
         in_specs=(specs, specs, specs),
         out_specs=specs,

@@ -8,6 +8,9 @@ small threaded HTTP server wrapping a ``device.Device``:
     GET  /healthz   -> {"ok": true, "node": <name>, "plugin": <device name>}
     GET  /nodeinfo  -> NodeInfo JSON (fresh advertisement; the manager's
                        probe cache bounds actual hardware queries)
+    GET  /metrics   -> Prometheus-style text: request/error counters,
+                       advertised device count, uptime (the metrics
+                       endpoint the reference never had, SURVEY.md §5.5)
     POST /allocate  -> {"pod": PodInfo, "container": <name>} ->
                        AllocateResult JSON (the container-start injection
                        step, run node-local where the devices live)
@@ -21,6 +24,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -46,7 +50,24 @@ class NodeAgentServer:
     ) -> None:
         self.device = device
         self.node_name = node_name
+        self.started_at = time.time()
+        # counters are written under the per-request threads; int += is a
+        # single bytecode read-modify-write, so guard with a lock
+        self._counter_lock = threading.Lock()
+        self.counters = {
+            "nodeinfo_requests": 0,
+            "allocate_requests": 0,
+            "errors": 0,
+        }
+        # last advertised kube capacity — /metrics serves this snapshot
+        # instead of re-probing hardware per scrape (a 15s Prometheus
+        # interval must not defeat the manager's probe-cache bound)
+        self.last_capacity: dict = {}
         agent = self
+
+        def bump(key: str) -> None:
+            with agent._counter_lock:
+                agent.counters[key] += 1
 
         class Handler(BaseHTTPRequestHandler):
             # quiet the default per-request stderr lines; route to leveled log
@@ -57,6 +78,14 @@ class NodeAgentServer:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_text(self, code: int, text: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -72,12 +101,42 @@ class NodeAgentServer:
                         },
                     )
                 elif self.path == "/nodeinfo":
+                    bump("nodeinfo_requests")
                     try:
                         info = new_node_info(agent.node_name)
                         agent.device.update_node_info(info)
+                        agent.last_capacity = dict(info.kube_cap)
                         self._reply(200, node_info_to_json(info))
                     except Exception as e:  # noqa: BLE001 — degrade, stay up
+                        bump("errors")
                         self._reply(500, {"error": str(e)})
+                elif self.path == "/metrics":
+                    if agent.last_capacity:
+                        scalars = dict(sorted(agent.last_capacity.items()))
+                    else:  # never probed yet: one probe to seed the snapshot
+                        try:
+                            info = new_node_info(agent.node_name)
+                            agent.device.update_node_info(info)
+                            agent.last_capacity = dict(info.kube_cap)
+                            scalars = dict(sorted(info.kube_cap.items()))
+                        except Exception:  # noqa: BLE001 — metrics never 500
+                            bump("errors")
+                            scalars = {}
+                    with agent._counter_lock:
+                        counters = dict(agent.counters)
+                    lines = [
+                        "# TYPE kubetpu_agent_uptime_seconds gauge",
+                        f"kubetpu_agent_uptime_seconds {time.time() - agent.started_at:.1f}",
+                    ]
+                    for key, val in sorted(counters.items()):
+                        lines.append(f"# TYPE kubetpu_agent_{key}_total counter")
+                        lines.append(f"kubetpu_agent_{key}_total {val}")
+                    for res, val in scalars.items():
+                        lines.append(
+                            'kubetpu_agent_capacity{resource="%s",node="%s"} %d'
+                            % (res, agent.node_name, val)
+                        )
+                    self._reply_text(200, "\n".join(lines) + "\n")
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -85,6 +144,7 @@ class NodeAgentServer:
                 if self.path != "/allocate":
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
+                bump("allocate_requests")
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
@@ -101,6 +161,7 @@ class NodeAgentServer:
                     result = agent.device.allocate(pod, cont)
                     self._reply(200, allocate_result_to_json(result))
                 except Exception as e:  # noqa: BLE001 — report, stay up
+                    bump("errors")
                     self._reply(500, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)

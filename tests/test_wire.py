@@ -221,3 +221,22 @@ def test_killed_agent_process_drives_failover(three_agents):
     assert again.node_name not in (victim_node, placed[1].node_name)
     _mounts, devices, _env = cluster.allocate(again.name)["main"]
     assert len(devices) == 8
+
+
+def test_agent_metrics_endpoint(agent_server):
+    """GET /metrics: Prometheus-style counters + capacity gauges (the
+    metrics endpoint the reference never had, SURVEY.md §5.5)."""
+    import urllib.request
+
+    cluster = Cluster()
+    cluster.register_remote_node(agent_server.address)
+    cluster.schedule(tpu_pod("job", 2))
+    cluster.allocate("job")
+
+    with urllib.request.urlopen(agent_server.address + "/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert "kubetpu_agent_uptime_seconds" in text
+    assert "kubetpu_agent_allocate_requests_total 1" in text
+    # register (1x nodeinfo) only — register_remote_node probes once
+    assert "kubetpu_agent_nodeinfo_requests_total 1" in text
+    assert 'kubetpu_agent_capacity{resource="kubedevice/tpu",node="wire-n0"} 8' in text
