@@ -67,6 +67,17 @@ class DeviceScheduler(ABC):
         scheduler (reference gpu_scheduler.go:69-71; kubetpu implements that
         group scheduler in ``kubetpu.core``)."""
 
+    def perfect_score(self, pod_info: PodInfo) -> "float | None":
+        """The provably-best fit score this scheduler can award *pod_info*
+        on ANY node, or None when no tight bound exists. The core's
+        predicate sweep stops early once a node reaches the sum of all
+        schedulers' bounds — at cluster scale (hundreds of nodes) that
+        turns the common 'a perfectly-contiguous node exists' case from
+        O(nodes) into O(nodes scanned until the first perfect one).
+        Default None: never stop early (kubetpu extension; the reference's
+        external core has no ranking at all, gpu_scheduler.go:34-44)."""
+        return None
+
 
 def create_device_scheduler_from_plugin(path: str) -> DeviceScheduler:
     """Load a scheduler plugin module and call its

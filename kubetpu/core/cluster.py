@@ -43,7 +43,7 @@ from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import GPU, TPU
 from kubetpu.scheduler.gpu_scheduler import GpuScheduler
 from kubetpu.scheduler.tpu_scheduler import TpuScheduler
-from kubetpu.scheduler.translate import pod_device_count
+from kubetpu.scheduler.translate import pod_device_count, pod_wants_device
 
 
 class SchedulingError(Exception):
@@ -233,44 +233,75 @@ class Cluster:
         # the winner is re-translated from a FRESH copy below — so per-node
         # copies would only feed the garbage collector (512-node p50).
         scratch = pod.copy()
+        # Provably-best achievable score across schedulers (None = unknown):
+        # the sweep visits nodes in sorted-name order and the final pick is
+        # (-score, name)-sorted, so the FIRST node reaching the bound IS the
+        # winner — stop scanning there (O(first perfect node), not O(nodes)).
+        bound: Optional[float] = 0.0
+        for s in self.schedulers:
+            b = s.perfect_score(scratch)
+            if b is None:
+                bound = None
+                break
+            bound += b
+        names = [
+            n
+            for n in utils.sorted_string_keys(self.nodes)
+            if node_filter is None or node_filter(n)
+        ]
         candidates: List[tuple] = []  # (-score, name)
-        for name in utils.sorted_string_keys(self.nodes):
-            if node_filter is not None and not node_filter(name):
-                continue
-            node = self.nodes[name]
-            fits = True
-            score = 0.0
-            for s in self.schedulers:
-                ok, _reasons, sc = s.pod_fits_device(node.info, scratch, False)
-                if not ok:
-                    fits = False
-                    break
-                score += sc
-            if fits:
-                candidates.append((-score, name))
-        if not candidates:
-            raise SchedulingError(f"pod {pod.name!r}: no node fits")
+        tried: set = set()
+        idx = 0
+        while True:
+            # sweep (resumable): collect fitting nodes; stop early at a
+            # bound-reaching node — it IS the sorted winner
+            while idx < len(names):
+                name = names[idx]
+                idx += 1
+                node = self.nodes[name]
+                fits = True
+                score = 0.0
+                for s in self.schedulers:
+                    ok, _reasons, sc = s.pod_fits_device(node.info, scratch, False)
+                    if not ok:
+                        fits = False
+                        break
+                    score += sc
+                if fits:
+                    candidates.append((-score, name))
+                    if bound is not None and score >= bound - 1e-9:
+                        break
 
-        # Best score first; if the group-scheduler fill disagrees with the
-        # fit (e.g. stale scalar vs. actual free cards), demote the node and
-        # try the next candidate instead of rejecting the pod.
-        for neg_score, name in sorted(candidates):
-            node = self.nodes[name]
-            pod_copy = pod.copy()
-            for s in self.schedulers:
-                s.pod_allocate(node.info, pod_copy)
-            if not group_scheduler.fill_allocate_from(node.info, pod_copy):
-                utils.logf(3, "pod %s: fill failed on %s, trying next node", pod.name, name)
-                continue
-            group_scheduler.take_pod_resources(node.info, pod_copy)
-            for s in self.schedulers:
-                s.take_pod_resources(node.info, pod_copy)
-            pod_copy.node_name = name
-            node.pods[pod_copy.name] = pod_copy
-            utils.logf(3, "scheduled pod %s on %s (score %.3f)", pod.name, name, -neg_score)
-            self._event("schedule", pod=pod_copy.name, node=name, score=-neg_score)
-            return pod_copy
-        raise SchedulingError(f"pod {pod.name!r}: fill failed on every fitting node")
+            # Best score first; if the group-scheduler fill disagrees with
+            # the fit (e.g. stale scalar vs. actual free cards), demote the
+            # node and try the next candidate — and when the early exit
+            # truncated the sweep, RESUME it rather than giving up (a later
+            # unscanned node may still fill).
+            for neg_score, name in sorted(candidates):
+                if name in tried:
+                    continue
+                tried.add(name)
+                node = self.nodes[name]
+                pod_copy = pod.copy()
+                for s in self.schedulers:
+                    s.pod_allocate(node.info, pod_copy)
+                if not group_scheduler.fill_allocate_from(node.info, pod_copy):
+                    utils.logf(3, "pod %s: fill failed on %s, trying next node", pod.name, name)
+                    continue
+                group_scheduler.take_pod_resources(node.info, pod_copy)
+                for s in self.schedulers:
+                    s.take_pod_resources(node.info, pod_copy)
+                pod_copy.node_name = name
+                node.pods[pod_copy.name] = pod_copy
+                utils.logf(3, "scheduled pod %s on %s (score %.3f)", pod.name, name, -neg_score)
+                self._event("schedule", pod=pod_copy.name, node=name, score=-neg_score)
+                return pod_copy
+            if idx >= len(names):
+                if not candidates:
+                    raise SchedulingError(f"pod {pod.name!r}: no node fits")
+                raise SchedulingError(
+                    f"pod {pod.name!r}: fill failed on every fitting node"
+                )
 
     def release(self, pod_name: str) -> None:
         """Return a pod's resources (pod deletion)."""
@@ -319,24 +350,12 @@ class Cluster:
         t0 = time.perf_counter()
         try:
             slices = self._tpu_slices()
-            # A pod may carry the chip count in device-native requests OR
-            # kube-native requests (set_device_reqs max-merges them later, on
-            # per-node copies) — consider both so a kube-only gang is still
-            # pinned to a single slice below.
-            tpu_gang = all(
-                any(
-                    max(
-                        cont.requests.get(ResourceTPU, 0),
-                        cont.kube_requests.get(ResourceTPU, 0),
-                    )
-                    > 0
-                    for cont in itertools.chain(
-                        pod.running_containers.values(),
-                        pod.init_containers.values(),
-                    )
-                )
-                for pod in pods
-            ) and bool(pods)
+            # pod_wants_device covers device-native AND kube-native requests
+            # over both container kinds, so a kube-only gang is still pinned
+            # to a single slice below.
+            tpu_gang = bool(pods) and all(
+                pod_wants_device(TPU, pod) for pod in pods
+            )
             for slice_nodes in slices.values():
                 # Best case: assign pods to a *geometrically contiguous set of
                 # host blocks* (a 2-host gang on a v5e-64 should get two

@@ -17,6 +17,7 @@ from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import GPU
 from kubetpu.scheduler.translate import (
     pod_device_count,
+    pod_wants_device,
     set_device_reqs,
     translate_device_resources,
     translate_pod_device_resources,
@@ -108,6 +109,12 @@ class GpuScheduler(DeviceScheduler):
 
     def return_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
         """No-op (reference gpu_scheduler.go:61-63)."""
+
+    def perfect_score(self, pod_info: PodInfo):
+        """Tree scores (NVLink density) have no universal maximum, so GPU
+        pods get no early-exit bound; pods requesting no GPUs always score
+        0.0 here (see pod_fits_device)."""
+        return None if pod_wants_device(GPU, pod_info) else 0.0
 
     def get_name(self) -> str:
         return "nvidiagpu"

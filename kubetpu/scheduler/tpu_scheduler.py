@@ -21,6 +21,7 @@ from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import TPU
 from kubetpu.scheduler.translate import (
     pod_device_count,
+    pod_wants_device,
     set_device_reqs,
     translate_device_resources,
     translate_pod_device_resources,
@@ -76,7 +77,9 @@ class TpuScheduler(DeviceScheduler):
             free = node_info.allocatable.get(TPU.resource_name, 0)
             return free >= n, 0.0
         if n == 0:
-            return True, 1.0
+            # A pod wanting no TPUs must not be steered TOWARD mesh nodes
+            # (and 0.0 keeps perfect_score's bound provably-best).
+            return True, 0.0
         # Placement depends only on (free set, n, topo) — all captured by
         # the state object, which is rebuilt whenever the advertised
         # resources change, so caching per-n on it is sound and saves the
@@ -152,6 +155,11 @@ class TpuScheduler(DeviceScheduler):
 
     def return_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
         """No-op (reference gpu_scheduler.go:61-63)."""
+
+    def perfect_score(self, pod_info: PodInfo):
+        """ICI contiguity is capped at 1.0 (a perfect rectangular block);
+        pods requesting no TPUs always score 0.0 here (see _mesh_fit)."""
+        return 1.0 if pod_wants_device(TPU, pod_info) else 0.0
 
     def get_name(self) -> str:
         return "tpu"

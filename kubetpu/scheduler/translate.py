@@ -34,6 +34,23 @@ def pod_device_count(dc: DeviceClass, pod_info: PodInfo) -> int:
     return int(num)
 
 
+def pod_wants_device(dc: DeviceClass, pod_info: PodInfo) -> bool:
+    """Does the pod request any devices of this class, counting BOTH
+    device-native and kube-native requests over BOTH container kinds (the
+    same max-merge semantics ``set_device_reqs`` applies later) — the one
+    place this question is answered (gang detection, preemption
+    eligibility, perfect-score bounds)."""
+    return any(
+        max(
+            cont.requests.get(dc.resource_name, 0),
+            cont.kube_requests.get(dc.resource_name, 0),
+        )
+        > 0
+        for cont in list(pod_info.running_containers.values())
+        + list(pod_info.init_containers.values())
+    )
+
+
 def translate_device_resources(
     dc: DeviceClass,
     needed: int,

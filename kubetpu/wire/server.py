@@ -61,8 +61,9 @@ class NodeAgentServer:
         }
         # last advertised kube capacity — /metrics serves this snapshot
         # instead of re-probing hardware per scrape (a 15s Prometheus
-        # interval must not defeat the manager's probe-cache bound)
-        self.last_capacity: dict = {}
+        # interval must not defeat the manager's probe-cache bound). None =
+        # never probed (an EMPTY capacity is a valid snapshot).
+        self.last_capacity: Optional[dict] = None
         agent = self
 
         def bump(key: str) -> None:
@@ -111,7 +112,7 @@ class NodeAgentServer:
                         bump("errors")
                         self._reply(500, {"error": str(e)})
                 elif self.path == "/metrics":
-                    if agent.last_capacity:
+                    if agent.last_capacity is not None:
                         scalars = dict(sorted(agent.last_capacity.items()))
                     else:  # never probed yet: one probe to seed the snapshot
                         try:
