@@ -275,11 +275,18 @@ class Cluster:
             # Best score first; if the group-scheduler fill disagrees with
             # the fit (e.g. stale scalar vs. actual free cards), demote the
             # node and try the next candidate — and when the early exit
-            # truncated the sweep, RESUME it rather than giving up (a later
-            # unscanned node may still fill).
+            # truncated the sweep, RESUME it rather than settling: an
+            # unscanned node may still reach the bound, and a bound-score
+            # placement must never silently degrade to a sub-bound one.
             for neg_score, name in sorted(candidates):
                 if name in tried:
                     continue
+                if (
+                    bound is not None
+                    and idx < len(names)
+                    and -neg_score < bound - 1e-9
+                ):
+                    break  # resume the sweep before trying sub-bound nodes
                 tried.add(name)
                 node = self.nodes[name]
                 pod_copy = pod.copy()

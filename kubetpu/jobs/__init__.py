@@ -25,5 +25,6 @@ __all__ = [
 # Submodules with heavier deps are imported lazily by users:
 #   kubetpu.jobs.pipeline   (pp training), kubetpu.jobs.decode (KV-cache
 #   generation), kubetpu.jobs.speculative (draft+verify decoding),
+#   kubetpu.jobs.serving (continuous batching),
 #   kubetpu.jobs.checkpoint (orbax), kubetpu.jobs.data,
 #   kubetpu.jobs.launch (jax.distributed wiring)

@@ -1,0 +1,196 @@
+"""Continuous batching for KV-cached decoding: a fixed batch of SLOTS that
+independent requests enter and leave without ever stopping the batch — the
+serving pattern behind modern LLM inference engines, TPU-shaped:
+
+- static shapes everywhere: the slot batch, per-slot caches
+  (L, n_slots, S_max, H_kv, D) and positions are allocated once; a request
+  entering/leaving never recompiles the step;
+- one jitted decode step advances ALL active slots (per-slot positions via
+  the same vmapped chunk forward speculative decoding uses); inactive
+  slots compute a masked no-op — uniform work beats dynamic batch shapes
+  on TPU;
+- prefill writes a new request's prompt into its slot with one chunk
+  forward (compiled once per prompt length — pad prompts into a few
+  buckets in production to bound compilations);
+- the host-side loop only routes tokens and frees slots (EOS / length);
+  no tensor work happens outside jit.
+
+A drained slot is immediately reusable: its cache region is overwritten by
+the next occupant's prefill, and every attention mask is position-bounded,
+so stale entries are never read (same invariant as speculative decoding).
+
+Reference: no inference stack exists in the reference (SURVEY.md §2) —
+TPU-first extension.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubetpu.jobs.decode import forward_chunk, init_kv_cache
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.speculative import _forward_chunk_at
+
+
+class DecodeServer:
+    """Slot-based continuous batching over one model replica.
+
+    ``submit(prompt)`` -> request id (or None when all slots are busy);
+    ``step()`` advances every active request by one token and returns
+    ``{request_id: token}``; ``finished(rid)``/``result(rid)`` collect
+    completed sequences. ``max_new_tokens`` and optional ``eos_id`` bound
+    each request.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Params,
+        n_slots: int = 8,
+        max_seq: int = 512,
+        max_new_tokens: int = 64,
+        eos_id: Optional[int] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+
+        self.k_cache, self.v_cache = init_kv_cache(cfg, n_slots, max_seq)
+        self.pos = jnp.zeros((n_slots,), jnp.int32)    # index of `last` token
+        self.last = jnp.zeros((n_slots,), jnp.int32)   # last emitted token
+        self.active = np.zeros((n_slots,), bool)       # host-side occupancy
+
+        self._next_rid = 0
+        self._slot_rid: List[Optional[int]] = [None] * n_slots
+        self._prompts: Dict[int, List[int]] = {}
+        self._emitted: Dict[int, List[int]] = {}
+        self._done: Dict[int, bool] = {}
+
+        cfg_ = cfg
+
+        # donate_argnums=(1, 2): the caller overwrites self.k_cache/v_cache
+        # with the results, so XLA updates the (large) cache buffers in
+        # place instead of holding input+output copies live per step
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill_slot(params, k_cache, v_cache, prompt, slot):
+            # single-sequence chunk forward at pos 0, written into `slot`
+            k_s = jnp.take(k_cache, slot[None], axis=1)      # (L,1,S,Hkv,D)
+            v_s = jnp.take(v_cache, slot[None], axis=1)
+            logits, k_s, v_s = forward_chunk(
+                cfg_, params, prompt[None], k_s, v_s, 0
+            )
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k_s, (0, slot, 0, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v_s, (0, slot, 0, 0, 0)
+            )
+            first = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+            return k_cache, v_cache, first
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def step_all(params, k_cache, v_cache, last, pos, active):
+            logits, k_cache, v_cache = _forward_chunk_at(
+                cfg_, params, last[:, None], k_cache, v_cache, pos
+            )
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, last)     # inactive slots hold
+            pos = pos + active.astype(jnp.int32)
+            return k_cache, v_cache, nxt, pos
+
+        self._prefill_slot = prefill_slot
+        self._step_all = step_all
+
+    # -- request lifecycle ---------------------------------------------------
+
+    def submit(self, prompt: List[int]) -> Optional[int]:
+        """Admit a request into a free slot (None if the batch is full)."""
+        if len(prompt) + self.max_new_tokens + 1 > self.max_seq:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq")
+        free = [i for i in range(self.n_slots) if not self.active[i]]
+        if not free:
+            return None
+        slot = free[0]
+        rid = self._next_rid
+        self._next_rid += 1
+
+        self.k_cache, self.v_cache, first = self._prefill_slot(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(prompt, jnp.int32), jnp.int32(slot),
+        )
+        self.pos = self.pos.at[slot].set(len(prompt))
+        self.last = self.last.at[slot].set(first)
+        self.active[slot] = True
+        self._slot_rid[slot] = rid
+        self._prompts[rid] = list(prompt)
+        self._emitted[rid] = [int(first)]
+        self._done[rid] = False
+        self._retire_if_done(slot)
+        return rid
+
+    def step(self) -> Dict[int, int]:
+        """One decode step for every active slot -> {request_id: new token}."""
+        if not self.active.any():
+            return {}
+        self.k_cache, self.v_cache, nxt, self.pos = self._step_all(
+            self.params, self.k_cache, self.v_cache, self.last, self.pos,
+            jnp.asarray(self.active),
+        )
+        self.last = nxt
+        tokens = np.asarray(nxt)
+        out: Dict[int, int] = {}
+        for slot in range(self.n_slots):
+            if not self.active[slot]:
+                continue
+            rid = self._slot_rid[slot]
+            tok = int(tokens[slot])
+            self._emitted[rid].append(tok)
+            out[rid] = tok
+            self._retire_if_done(slot)
+        return out
+
+    def _retire_if_done(self, slot: int) -> None:
+        rid = self._slot_rid[slot]
+        emitted = self._emitted[rid]
+        if len(emitted) >= self.max_new_tokens or (
+            self.eos_id is not None and emitted[-1] == self.eos_id
+        ):
+            self._done[rid] = True
+            self.active[slot] = False       # slot immediately reusable
+            self._slot_rid[slot] = None
+
+    # -- results -------------------------------------------------------------
+
+    def finished(self, rid: int) -> bool:
+        return self._done.get(rid, False)
+
+    def result(self, rid: int) -> List[int]:
+        """prompt + emitted tokens for a request (final once finished);
+        retained until ``pop_result`` — a long-running server must pop."""
+        return self._prompts[rid] + self._emitted[rid]
+
+    def pop_result(self, rid: int) -> List[int]:
+        """Collect AND evict a finished request's tokens — the bookkeeping
+        for a request is dropped so an indefinitely-running server doesn't
+        grow memory with every request ever served."""
+        if not self._done.get(rid, False):
+            raise KeyError(f"request {rid} is not finished")
+        out = self._prompts.pop(rid) + self._emitted.pop(rid)
+        del self._done[rid]
+        return out
+
+    def drain(self, max_steps: int = 10_000) -> None:
+        """Run until every admitted request finishes."""
+        for _ in range(max_steps):
+            if not self.active.any():
+                return
+            self.step()
+        raise RuntimeError("drain did not converge")

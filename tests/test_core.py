@@ -294,3 +294,33 @@ def test_gang_kube_only_requests_single_slice_guard():
         cluster.schedule_gang([kube_pod("w0"), kube_pod("w1")])
     for node in cluster.nodes.values():  # all-or-nothing left no residue
         assert not node.pods
+
+
+def test_early_exit_resumes_when_fill_disagrees(monkeypatch):
+    """The predicate sweep stops at the first bound-reaching node; if the
+    group-scheduler fill rejects it (stale scalar vs real free cards), the
+    sweep must RESUME and land on the NEXT bound-reaching node — never fail
+    the pod, and never settle for a sub-bound candidate."""
+    from kubetpu.core import group_scheduler
+
+    cluster = Cluster()
+    for i in range(3):
+        cluster.register_node(
+            f"n{i}", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+        )
+    real_fill = group_scheduler.fill_allocate_from
+    attempts = []
+
+    def flaky_fill(node_info, pod_info):
+        attempts.append(node_info.name)
+        if node_info.name == "n0":
+            return False  # the disagreement the fallback path exists for
+        return real_fill(node_info, pod_info)
+
+    monkeypatch.setattr(group_scheduler, "fill_allocate_from", flaky_fill)
+    placed = cluster.schedule(tpu_pod("p", 4))
+    # sweep broke at n0 (perfect score), fill failed there, sweep resumed
+    # and the next perfect node n1 won — n2 was never needed
+    assert placed.node_name == "n1"
+    assert attempts == ["n0", "n1"]
+    assert not cluster.nodes["n0"].pods and "p" in cluster.nodes["n1"].pods
