@@ -180,3 +180,17 @@ def make_generate(cfg: ModelConfig, mesh: Optional[Mesh] = None, temperature: fl
     bspec = NamedSharding(mesh, P("dp", None) if "dp" in mesh.axis_names else P())
     return jax.jit(generate, static_argnums=(3,), in_shardings=(None, bspec, None))
 
+
+def forward_chunk_at(cfg, params, chunk, k_cache, v_cache, pos):
+    """``forward_chunk`` with PER-BATCH positions (vmapped over the
+    batch: speculative rounds advance each sequence unevenly, so the cache
+    write offset differs per example)."""
+    def one(params, chunk, k_c, v_c, p):
+        logits, k_c, v_c = forward_chunk(
+            cfg, params, chunk[None], k_c[:, None], v_c[:, None], p
+        )
+        return logits[0], k_c[:, 0], v_c[:, 0]
+
+    return jax.vmap(
+        one, in_axes=(None, 0, 1, 1, 0), out_axes=(0, 1, 1)
+    )(params, chunk, k_cache, v_cache, pos)

@@ -1,0 +1,71 @@
+"""Tracing / profiling for the jobs layer (SURVEY.md §5.1: the reference
+has no tracing at all; kubetpu's scheduler side has latency histograms —
+this is the compute side).
+
+- ``trace(log_dir)``: context manager around the JAX profiler — captures a
+  TensorBoard/XProf-loadable device trace of whatever runs inside (train
+  steps, decode rounds), the tool for finding HBM-bound ops and collective
+  stalls on real TPU.
+- ``StepTimer``: wall-clock step statistics (p50/p99 + tokens/sec) over the
+  same ``LatencyRecorder`` the scheduler uses, for quick in-loop numbers
+  without a trace viewer.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+import jax
+
+from kubetpu.core.metrics import LatencyRecorder
+
+
+@contextmanager
+def trace(log_dir: str):
+    """Capture a JAX profiler trace into *log_dir* (view with TensorBoard's
+    profile plugin / xprof). Wrap a handful of already-compiled steps —
+    tracing compilations swamps the timeline."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Time training/decode steps and report tokens/sec.
+
+    >>> timer = StepTimer(tokens_per_step=batch * seq)
+    >>> for ... :
+    ...     with timer.step():
+    ...         state, loss = train_step(state, tokens, targets)
+    >>> timer.summary()   # {"p50_ms": ..., "p99_ms": ..., "tokens_per_s": ...}
+
+    The timed block must block on the result (jit is async — call
+    ``jax.block_until_ready`` or read the loss) or the numbers are
+    dispatch times, not step times.
+    """
+
+    def __init__(self, tokens_per_step: int = 0):
+        self.tokens_per_step = tokens_per_step
+        self._rec = LatencyRecorder()
+
+    @contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._rec.record("step", time.perf_counter() - t0)
+
+    def summary(self) -> dict:
+        stats = self._rec.summary().get("step")
+        if not stats:
+            return {}
+        out = dict(stats)
+        if self.tokens_per_step and stats.get("p50_ms"):
+            out["tokens_per_s"] = round(
+                self.tokens_per_step / (stats["p50_ms"] / 1e3), 1
+            )
+        return out

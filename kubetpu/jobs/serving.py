@@ -10,8 +10,12 @@ serving pattern behind modern LLM inference engines, TPU-shaped:
   slots compute a masked no-op — uniform work beats dynamic batch shapes
   on TPU;
 - prefill writes a new request's prompt into its slot with one chunk
-  forward (compiled once per prompt length — pad prompts into a few
-  buckets in production to bound compilations);
+  forward, padded to the next power-of-two bucket so ONE compilation
+  serves every prompt length in the bucket. Pad K/V entries are written
+  past the true prompt length, but decode overwrites position p exactly
+  when it first feeds the token at p — a real query at position p only
+  ever attends positions <= p, all of which real tokens have re-written
+  by then, so the pads are never read;
 - the host-side loop only routes tokens and frees slots (EOS / length);
   no tensor work happens outside jit.
 
@@ -32,9 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubetpu.jobs.decode import forward_chunk, init_kv_cache
+from kubetpu.jobs.decode import forward_chunk, forward_chunk_at, init_kv_cache
 from kubetpu.jobs.model import ModelConfig, Params
-from kubetpu.jobs.speculative import _forward_chunk_at
 
 
 class DecodeServer:
@@ -80,8 +83,11 @@ class DecodeServer:
         # with the results, so XLA updates the (large) cache buffers in
         # place instead of holding input+output copies live per step
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_slot(params, k_cache, v_cache, prompt, slot):
-            # single-sequence chunk forward at pos 0, written into `slot`
+        def prefill_slot(params, k_cache, v_cache, prompt, slot, prompt_len):
+            # single-sequence chunk forward at pos 0, written into `slot`;
+            # `prompt` is bucket-padded (see module docstring) — only
+            # prompt_len is real, and the last REAL position's logits pick
+            # the first token
             k_s = jnp.take(k_cache, slot[None], axis=1)      # (L,1,S,Hkv,D)
             v_s = jnp.take(v_cache, slot[None], axis=1)
             logits, k_s, v_s = forward_chunk(
@@ -93,12 +99,14 @@ class DecodeServer:
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v_s, (0, slot, 0, 0, 0)
             )
-            first = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+            first = jnp.argmax(
+                jnp.take(logits[0], prompt_len - 1, axis=0)
+            ).astype(jnp.int32)
             return k_cache, v_cache, first
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def step_all(params, k_cache, v_cache, last, pos, active):
-            logits, k_cache, v_cache = _forward_chunk_at(
+            logits, k_cache, v_cache = forward_chunk_at(
                 cfg_, params, last[:, None], k_cache, v_cache, pos
             )
             nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
@@ -113,6 +121,8 @@ class DecodeServer:
 
     def submit(self, prompt: List[int]) -> Optional[int]:
         """Admit a request into a free slot (None if the batch is full)."""
+        if not prompt:
+            raise ValueError("empty prompt")
         if len(prompt) + self.max_new_tokens + 1 > self.max_seq:
             raise ValueError("prompt + max_new_tokens exceeds max_seq")
         free = [i for i in range(self.n_slots) if not self.active[i]]
@@ -122,9 +132,17 @@ class DecodeServer:
         rid = self._next_rid
         self._next_rid += 1
 
+        # pad to the next power-of-two bucket (capped at max_seq) so one
+        # compilation serves the whole bucket
+        bucket = 1
+        while bucket < len(prompt):
+            bucket *= 2
+        bucket = min(bucket, self.max_seq)
+        padded = prompt + [0] * (bucket - len(prompt))
         self.k_cache, self.v_cache, first = self._prefill_slot(
             self.params, self.k_cache, self.v_cache,
-            jnp.asarray(prompt, jnp.int32), jnp.int32(slot),
+            jnp.asarray(padded, jnp.int32), jnp.int32(slot),
+            jnp.int32(len(prompt)),
         )
         self.pos = self.pos.at[slot].set(len(prompt))
         self.last = self.last.at[slot].set(first)

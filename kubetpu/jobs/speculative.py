@@ -29,7 +29,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from kubetpu.jobs.decode import forward_chunk, init_kv_cache, prefill
+from kubetpu.jobs.decode import forward_chunk_at as _forward_chunk_at
+from kubetpu.jobs.decode import init_kv_cache, prefill
 from kubetpu.jobs.model import ModelConfig
 
 
@@ -133,21 +134,6 @@ def make_speculative_generate(
         return tokens, mean_accept
 
     return jax.jit(generate, static_argnums=(3,))
-
-
-def _forward_chunk_at(cfg, params, chunk, k_cache, v_cache, pos):
-    """``decode.forward_chunk`` with PER-BATCH positions (vmapped over the
-    batch: speculative rounds advance each sequence unevenly, so the cache
-    write offset differs per example)."""
-    def one(params, chunk, k_c, v_c, p):
-        logits, k_c, v_c = forward_chunk(
-            cfg, params, chunk[None], k_c[:, None], v_c[:, None], p
-        )
-        return logits[0], k_c[:, 0], v_c[:, 0]
-
-    return jax.vmap(
-        one, in_axes=(None, 0, 1, 1, 0), out_axes=(0, 1, 1)
-    )(params, chunk, k_cache, v_cache, pos)
 
 
 def _scatter_rows(out, write_pos, values, valid):

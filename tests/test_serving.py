@@ -100,3 +100,16 @@ def test_pop_result_evicts_bookkeeping():
     assert tokens == plain_greedy(params, [7, 8], 4)
     with pytest.raises(KeyError):
         server.pop_result(rid)      # evicted
+
+
+def test_bucketed_prefill_exact_for_same_bucket_lengths():
+    """Prompt lengths 5, 6, 7 all pad to the 8-bucket; each must still
+    match its dedicated greedy decode exactly (pads never influence real
+    positions: causal masks forward, overwrite-before-read in decode)."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    server = DecodeServer(CFG, params, n_slots=3, max_seq=64, max_new_tokens=4)
+    prompts = [[11, 3, 5, 60, 2], [1, 2, 3, 4, 5, 6], [9, 8, 7, 6, 5, 4, 3]]
+    rids = [server.submit(p) for p in prompts]
+    server.drain()
+    for rid, p in zip(rids, prompts):
+        assert server.result(rid) == plain_greedy(params, p, 4)
