@@ -45,6 +45,10 @@ demo:
 wire-demo:
 	python examples/wire_demo.py
 
+.PHONY: serve-demo
+serve-demo:
+	python examples/serve_demo.py
+
 .PHONY: clean
 clean:
 	rm -rf $(BUILD_DIR)/*

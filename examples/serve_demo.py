@@ -1,0 +1,48 @@
+"""Continuous-batching inference demo: requests stream through a fixed
+slot batch, entering and leaving without stopping it.
+
+    python examples/serve_demo.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.serving import DecodeServer  # noqa: E402
+
+
+def main():
+    cfg = ModelConfig(vocab=256, d_model=64, n_layers=2, n_heads=4, d_ff=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = DecodeServer(cfg, params, n_slots=2, max_seq=128, max_new_tokens=8)
+
+    print("submit r0 (4-token prompt), r1 (2-token prompt)")
+    r0 = server.submit([3, 14, 15, 9])
+    r1 = server.submit([26, 5])
+    rejected = server.submit([1, 2, 3])
+    print(f"third request while full -> {rejected} (queued by the caller)")
+
+    step = 0
+    pending = [1, 2, 3]
+    r2 = None
+    while server.active.any() or r2 is None:
+        toks = server.step()
+        step += 1
+        print(f"step {step}: {toks}")
+        if r2 is None:
+            r2 = server.submit(pending)  # admitted the moment a slot frees
+            if r2 is not None:
+                print(f"slot freed -> r2 admitted as request {r2}")
+    server.drain()
+
+    for rid in (r0, r1, r2):
+        print(f"request {rid}: {server.pop_result(rid)}")
+    print("serve demo OK")
+
+
+if __name__ == "__main__":
+    main()

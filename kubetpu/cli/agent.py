@@ -85,10 +85,15 @@ def main(argv=None) -> int:
     dev = _make_device(args)
 
     if args.serve:
+        import os
+
         from kubetpu.wire import NodeAgentServer
 
         name = args.name or (f"{args.fake}-h{args.host}" if args.fake else "local")
-        server = NodeAgentServer(dev, name, host=args.bind, port=args.port)
+        server = NodeAgentServer(
+            dev, name, host=args.bind, port=args.port,
+            token=os.environ.get("KUBETPU_WIRE_TOKEN"),
+        )
         print(json.dumps({"listening": server.address, "node": name}), flush=True)
         try:
             server.serve_forever()

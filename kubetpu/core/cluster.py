@@ -160,15 +160,19 @@ class Cluster:
 
     # -- remote nodes (the agent wire) --------------------------------------
 
-    def register_remote_node(self, url: str, name: Optional[str] = None) -> NodeInfo:
+    def register_remote_node(
+        self, url: str, name: Optional[str] = None, token: Optional[str] = None
+    ) -> NodeInfo:
         """Register a node served by a live agent process (``kubetpu-agent
         --serve``): probe it over the wire and enter it into the scheduling
         loop exactly like an in-process manager. The node's advertised name
         is used unless *name* overrides it. Raises ``AgentUnreachable`` when
-        no agent answers at *url*."""
+        no agent answers at *url*. Token-protected agents: pass *token*
+        per agent (secrets may differ per node) or set ``KUBETPU_WIRE_TOKEN``
+        for a fleet-wide default."""
         from kubetpu.wire import RemoteDevice
 
-        dev = RemoteDevice(url)
+        dev = RemoteDevice(url, token=token)
         dev.start()  # health check — fail fast on a dead address
         info = new_node_info(name or "")
         dev.update_node_info(info)

@@ -36,18 +36,31 @@ class AgentUnreachable(ConnectionError):
 class RemoteDevice(Device):
     """Device manager proxy over a node agent's HTTP surface."""
 
-    def __init__(self, url: str, timeout: float = 5.0) -> None:
+    def __init__(
+        self, url: str, timeout: float = 5.0, token: Optional[str] = None
+    ) -> None:
+        """*token*: shared-secret bearer token matching the agent's
+        (``NodeAgentServer(token=)`` / agent ``KUBETPU_WIRE_TOKEN``);
+        defaults to the client-side ``KUBETPU_WIRE_TOKEN`` env."""
+        import os
+
         self.url = url.rstrip("/")
         self.timeout = timeout
+        if token is None:
+            token = os.environ.get("KUBETPU_WIRE_TOKEN")
+        self.token = token or None  # "" (blank env var) = no auth, both sides
         self._plugin_name: Optional[str] = None
 
     # -- transport ----------------------------------------------------------
 
     def _request(self, path: str, payload: Optional[dict] = None) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
             self.url + path,
             data=None if payload is None else json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="GET" if payload is None else "POST",
         )
         try:

@@ -22,6 +22,7 @@ bound address is printed/returned so spawners can discover it.
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 import time
@@ -47,9 +48,15 @@ class NodeAgentServer:
         node_name: str,
         host: str = "127.0.0.1",
         port: int = 0,
+        token: "str | None" = None,
     ) -> None:
+        """*token*: shared-secret auth — when set, every request must carry
+        ``Authorization: Bearer <token>`` or is rejected 401 (``/healthz``
+        stays open for liveness probes). Matches ``RemoteDevice(token=)``;
+        the agent CLI reads it from ``KUBETPU_WIRE_TOKEN``."""
         self.device = device
         self.node_name = node_name
+        self.token = token or None  # "" (e.g. a blank env var) = no auth
         self.started_at = time.time()
         # counters are written under the per-request threads; int += is a
         # single bytecode read-modify-write, so guard with a lock
@@ -91,6 +98,17 @@ class NodeAgentServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _authorized(self) -> bool:
+                if agent.token is None:
+                    return True
+                got = self.headers.get("Authorization", "")
+                # constant-time compare: plain == short-circuits at the
+                # first differing byte, leaking the secret through timing
+                if hmac.compare_digest(got, f"Bearer {agent.token}"):
+                    return True
+                self._reply(401, {"error": "missing or invalid bearer token"})
+                return False
+
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
                     self._reply(
@@ -101,6 +119,8 @@ class NodeAgentServer:
                             "plugin": agent.device.get_name(),
                         },
                     )
+                elif not self._authorized():
+                    pass  # 401 already sent
                 elif self.path == "/nodeinfo":
                     bump("nodeinfo_requests")
                     try:
@@ -142,6 +162,8 @@ class NodeAgentServer:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):  # noqa: N802
+                if not self._authorized():  # auth before routing, like GET
+                    return
                 if self.path != "/allocate":
                     self._reply(404, {"error": f"no route {self.path}"})
                     return

@@ -240,3 +240,51 @@ def test_agent_metrics_endpoint(agent_server):
     # register (1x nodeinfo) only — register_remote_node probes once
     assert "kubetpu_agent_nodeinfo_requests_total 1" in text
     assert 'kubetpu_agent_capacity{resource="kubedevice/tpu",node="wire-n0"} 8' in text
+
+
+def test_wire_auth_token():
+    """With a shared secret set, unauthenticated requests are rejected 401
+    (healthz stays open for liveness); matching tokens work end to end."""
+    import urllib.error
+    import urllib.request
+
+    dev = new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    server = NodeAgentServer(dev, "auth-n0", token="s3cret")
+    server.start()
+    try:
+        # healthz open
+        with urllib.request.urlopen(server.address + "/healthz", timeout=5) as r:
+            assert json.loads(r.read())["ok"]
+        # nodeinfo: no token -> 401
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(server.address + "/nodeinfo", timeout=5)
+        assert e.value.code == 401
+        # wrong token -> 401, surfaced as RuntimeError (not node death)
+        from kubetpu.api.types import new_node_info
+
+        bad = RemoteDevice(server.address, token="wrong")
+        with pytest.raises(RuntimeError):
+            bad.update_node_info(new_node_info("x"))
+        # right token: full register/schedule/allocate flow over the wire
+        cluster = Cluster()
+        cluster.register_remote_node(server.address, token="s3cret")
+        placed = cluster.schedule(tpu_pod("job", 2))
+        assert placed.node_name == "auth-n0"
+        _m, devices, _e = cluster.allocate("job")["main"]
+        assert len(devices) == 2
+    finally:
+        server.shutdown()
+
+
+def test_wire_empty_token_means_no_auth():
+    """A blank token (templated env file with an empty value) must mean
+    no-auth on BOTH sides, not a bricked wire."""
+    dev = new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    server = NodeAgentServer(dev, "blank-n0", token="")
+    server.start()
+    try:
+        cluster = Cluster()
+        cluster.register_remote_node(server.address, token="")
+        assert "blank-n0" in cluster.nodes
+    finally:
+        server.shutdown()
