@@ -103,8 +103,14 @@ class NodeAgentServer:
                     return True
                 got = self.headers.get("Authorization", "")
                 # constant-time compare: plain == short-circuits at the
-                # first differing byte, leaking the secret through timing
-                if hmac.compare_digest(got, f"Bearer {agent.token}"):
+                # first differing byte, leaking the secret through timing.
+                # Compare BYTES — compare_digest raises TypeError on
+                # non-ASCII str (http.server hands headers latin-1-decoded),
+                # which would drop the connection instead of replying 401.
+                if hmac.compare_digest(
+                    got.encode("latin-1", "replace"),
+                    f"Bearer {agent.token}".encode("latin-1", "replace"),
+                ):
                     return True
                 self._reply(401, {"error": "missing or invalid bearer token"})
                 return False

@@ -288,3 +288,71 @@ def test_wire_empty_token_means_no_auth():
         assert "blank-n0" in cluster.nodes
     finally:
         server.shutdown()
+
+
+def test_wire_concurrent_requests_stress(agent_server):
+    """ThreadingHTTPServer + counter lock under parallel load: concurrent
+    nodeinfo probes and allocates must all succeed and the counters must
+    add up exactly (no lost increments)."""
+    import threading
+    import urllib.request
+
+    cluster = Cluster()
+    cluster.register_remote_node(agent_server.address)
+    placed = [cluster.schedule(tpu_pod(f"job{i}", 1)) for i in range(4)]
+
+    errors = []
+
+    def probe(n):
+        try:
+            for _ in range(n):
+                with urllib.request.urlopen(
+                    agent_server.address + "/nodeinfo", timeout=10
+                ) as r:
+                    json.loads(r.read())
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def allocate(pod_name, n):
+        try:
+            for _ in range(n):
+                out = cluster.allocate(pod_name)
+                assert len(out["main"][1]) == 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=probe, args=(5,)) for _ in range(4)] + [
+        threading.Thread(target=allocate, args=(p.name, 5)) for p in placed
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+
+    with urllib.request.urlopen(agent_server.address + "/metrics", timeout=5) as r:
+        text = r.read().decode()
+    # 1 register probe + 4*5 concurrent probes; 4 pods * 5 allocates
+    assert "kubetpu_agent_nodeinfo_requests_total 21" in text
+    assert "kubetpu_agent_allocate_requests_total 20" in text
+
+
+def test_wire_auth_non_ascii_is_401_not_node_death():
+    """A non-ASCII Authorization header must get a clean 401 (not a dropped
+    connection that poll_remote_nodes would misread as node death)."""
+    import urllib.error
+    import urllib.request
+
+    dev = new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    server = NodeAgentServer(dev, "na-n0", token="s3cret")
+    server.start()
+    try:
+        req = urllib.request.Request(
+            server.address + "/nodeinfo",
+            headers={"Authorization": "Bearer café"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 401
+    finally:
+        server.shutdown()
