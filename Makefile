@@ -41,6 +41,10 @@ bench-adversarial:
 demo:
 	python examples/train_demo.py
 
+.PHONY: train-demo-wire
+train-demo-wire:
+	python examples/train_demo.py --wire
+
 .PHONY: wire-demo
 wire-demo:
 	python examples/wire_demo.py

@@ -10,10 +10,16 @@ on the new allocation — the elastic loop the framework exists to serve.
 
 Runs anywhere (fake devices; JAX on an 8-device virtual CPU mesh):
 
-    python examples/train_demo.py
+    python examples/train_demo.py          # in-process cluster
+    python examples/train_demo.py --wire   # 8 REAL agent processes; the
+                                           # "node failure" is a SIGKILLed
+                                           # agent detected over the wire
 """
 
+import json
 import os
+import signal
+import subprocess
 import sys
 import tempfile
 
@@ -44,16 +50,60 @@ def allocation_coords(cluster, placed):
     return coords
 
 
-def main():
-    # --- 1. a v5e-64 slice: 8 host-nodes, fake probes --------------------
-    cluster = Cluster()
-    for h in range(8):
-        cluster.register_node(
-            f"host{h}",
-            device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=h)),
+def spawn_agents(n):
+    """Start n agent processes concurrently, then collect their hello
+    lines (startup overlaps; a dead agent's stderr is surfaced)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "kubetpu.cli.agent", "--serve",
+             "--fake", "v5e-64", "--host", str(h), "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=repo, text=True,
         )
-    print(f"cluster: {len(cluster.nodes)} hosts x 8 chips (v5e-64)")
+        for h in range(n)
+    ]
+    agents = []
+    for proc in procs:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"agent pid {proc.pid} died during startup:\n"
+                + (proc.stderr.read() or "(no stderr)")
+            )
+        hello = json.loads(line)
+        agents.append((proc, hello["listening"], hello["node"]))
+    return agents
 
+
+def main(wire: bool = False):
+    # --- 1. a v5e-64 slice: 8 host-nodes (in-process fakes, or REAL agent
+    # processes reached over the HTTP wire) ------------------------------
+    cluster = Cluster()
+    agents = []
+    if wire:
+        agents = spawn_agents(8)
+        for _proc, url, _name in agents:
+            cluster.register_remote_node(url)
+        print(f"cluster: {len(cluster.nodes)} hosts x 8 chips (v5e-64), "
+              f"served by {len(agents)} live agent processes")
+    else:
+        for h in range(8):
+            cluster.register_node(
+                f"host{h}",
+                device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=h)),
+            )
+        print(f"cluster: {len(cluster.nodes)} hosts x 8 chips (v5e-64)")
+
+    try:
+        _run_demo(cluster, agents, wire)
+    finally:
+        for proc, _u, _n in agents:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+
+
+def _run_demo(cluster, agents, wire):
     # --- 2. schedule one 8-chip worker, ICI-contiguous -------------------
     placed = cluster.schedule(pod("trainer", 8))
     _, devices, env = cluster.allocate("trainer")["main"]
@@ -88,7 +138,14 @@ def main():
     print(f"checkpointed step {int(state.step)} -> {ckpt_dir}")
 
     # --- 4. the host fails; reschedule and resume ------------------------
-    evicted = cluster.fail_node(placed.node_name)
+    if wire:
+        victim = next(p for p, _u, n in agents if n == placed.node_name)
+        print(f"SIGKILL agent of {placed.node_name} (pid {victim.pid})")
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+        evicted = cluster.poll_remote_nodes()[placed.node_name]
+    else:
+        evicted = cluster.fail_node(placed.node_name)
     replaced = cluster.schedule(evicted[0])
     new_coords = allocation_coords(cluster, replaced)
     print(f"host failed; rescheduled onto {replaced.node_name}, coords={new_coords}")
@@ -107,4 +164,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    main(wire="--wire" in sys.argv[1:])

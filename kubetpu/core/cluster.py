@@ -37,7 +37,7 @@ from kubetpu.plugintypes.mesh import (
     factorizations,
     find_contiguous_block,
     find_perfect_block,
-    internal_links,
+    host_block_links,
 )
 from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import GPU, TPU
@@ -425,22 +425,13 @@ class Cluster:
         # Rank host-grid rectangle shapes by the CHIP-level links of the
         # resulting region, not host-grid compactness: host blocks are
         # anisotropic (2x4), so 2 hosts stacked along x give a 4x4 chip
-        # square while 2 along y give a 2x8 strip.
-        def chip_links(shape):
-            region = [
-                tuple(c for c in coord)
-                for coord in itertools.product(
-                    *(range(s * h) for s, h in zip(shape, topo.host_shape))
-                )
-            ]
-            return internal_links(region, topo)
-
+        # square while 2 along y give a 2x8 strip. (memoized pure geometry)
         shapes = [
             s
             for s in factorizations(k, len(hosts_per_dim))
             if all(d <= m for d, m in zip(s, hosts_per_dim))
         ]
-        shapes.sort(key=lambda s: (-chip_links(s), s))
+        shapes.sort(key=lambda s: (-host_block_links(topo, s), s))
         free_set = set(free_host_coords)
         for shape in shapes:
             for block in enumerate_blocks(host_grid, shape):

@@ -283,6 +283,22 @@ def _rect_offsets(shape: Tuple[int, ...]) -> Tuple[Coord, ...]:
     return tuple(itertools.product(*(range(d) for d in shape)))
 
 
+@functools.lru_cache(maxsize=1024)
+def host_block_links(topo: "TpuTopology", host_grid_shape: Tuple[int, ...]) -> int:
+    """Internal chip-level ICI links of the rectangular chip region covered
+    by a host-grid block of *host_grid_shape*. Hosts own anisotropic chip
+    blocks (v5e: 2x4), so host-grid compactness != chip compactness — gang
+    host selection ranks candidate host rectangles by THIS. Pure geometry,
+    cached per (topology, shape): it sits on the gang-scheduling hot path."""
+    region = [
+        tuple(c)
+        for c in itertools.product(
+            *(range(s * h) for s, h in zip(host_grid_shape, topo.host_shape))
+        )
+    ]
+    return internal_links(region, topo)
+
+
 def _place_rect(
     free: Set[Coord], shape: Sequence[int], topo: TpuTopology
 ) -> Optional[List[Coord]]:
