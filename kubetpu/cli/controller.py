@@ -1,0 +1,50 @@
+"""``kubetpu-controller`` — the long-running control-plane daemon.
+
+Holds the Cluster, registers agents, reconciles on an interval
+(dead agent -> evict -> reschedule), and serves the operator HTTP API
+(see ``kubetpu.wire.controller``).
+
+    python -m kubetpu.cli.controller --agents URL [URL ...]
+                                     [--port P] [--poll-interval S]
+
+Auth: ``KUBETPU_WIRE_TOKEN`` protects the controller API and is also
+used toward the agents.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from kubetpu.wire.controller import ControllerServer
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubetpu-controller", description=__doc__)
+    ap.add_argument("--agents", nargs="*", default=[],
+                    help="agent URLs to register at startup")
+    ap.add_argument("--bind", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="API port (0 = ephemeral, printed on startup)")
+    ap.add_argument("--poll-interval", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    token = os.environ.get("KUBETPU_WIRE_TOKEN")
+    server = ControllerServer(
+        host=args.bind, port=args.port, poll_interval=args.poll_interval,
+        token=token,
+    )
+    registered = [server.register_agent(url, token=token) for url in args.agents]
+    addr = server.start()
+    print(json.dumps({"listening": addr, "nodes": registered}), flush=True)
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,20 +134,28 @@ class Cluster:
             s.remove_node(name)
         self.nodes.pop(name, None)
 
-    def refresh_node(self, name: str) -> NodeInfo:
+    def refresh_node(self, name: str, probed: Optional[NodeInfo] = None) -> NodeInfo:
         """Re-probe a node's device manager and re-advertise, preserving the
         resources held by its placed pods — the periodic refresh the
         reference's CRI shim performs (UpdateNodeInfo on the 5-minute probe
         cadence, nvidia_gpu_manager.go:110-121). A chip that disappeared
         from the probe stops being advertised; chips held by pods are
-        re-subtracted from the fresh allocatable."""
+        re-subtracted from the fresh allocatable.
+
+        *probed*: a pre-probed advertisement to apply instead of probing
+        here — lets callers that serialize cluster mutations under a lock
+        keep the (slow, possibly remote) probe OUTSIDE it."""
         node = self.nodes.get(name)
         if node is None:
             raise KeyError(name)
-        if node.device is None:
+        if node.device is None and probed is None:
             return node.info
-        fresh = new_node_info(name)
-        node.device.update_node_info(fresh)
+        if probed is not None:
+            fresh = probed
+            fresh.name = name
+        else:
+            fresh = new_node_info(name)
+            node.device.update_node_info(fresh)
         for pod in node.pods.values():
             group_scheduler.take_pod_resources(fresh, pod)
         node.info.capacity = fresh.capacity

@@ -1,0 +1,356 @@
+"""``ControllerServer`` — the control plane as a long-running process.
+
+The reference's control plane IS Kubernetes: operators talk to the
+kube-apiserver and the external KubeDevice core plugs into it. kubetpu owns
+the core, so it owns this surface too — a daemon that holds the
+``Cluster``, keeps agent-backed nodes fresh, auto-reschedules pods off dead
+agents, and serves a small operator HTTP API:
+
+    GET    /healthz          liveness
+    GET    /status           Cluster.status() snapshot (nodes, slices,
+                             latency percentiles, recent events)
+    POST   /nodes            {"url": ..., "token"?: ...} -> register agent
+    GET    /nodes            node name -> {url, free chips, pods}
+    POST   /pods             {"pod": PodInfo} or {"gang": [PodInfo, ...]}
+                             -> placements + per-container AllocateResult
+                             (the env/devices a launcher starts the job
+                             with); 409 when nothing fits
+    DELETE /pods/<name>      release a placed pod
+
+A background poll loop refreshes every remote node on an interval; pods
+evicted by a dead agent are automatically rescheduled onto surviving
+nodes (pods that fit nowhere stay in a pending queue, retried each poll —
+elastic recovery as a service, SURVEY.md §5.3). All Cluster mutations are
+serialized under one lock; the HTTP layer is threaded.
+
+Shared-secret auth: like the agent server, a ``token`` protects every
+route except ``/healthz`` (``KUBETPU_WIRE_TOKEN`` in the CLI).
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from kubetpu.api import utils
+from kubetpu.core import Cluster, SchedulingError
+from kubetpu.wire.codec import (
+    allocate_result_to_json,
+    pod_info_from_json,
+    pod_info_to_json,
+)
+
+
+class ControllerServer:
+    """Operator API + reconcile loop over one ``Cluster``."""
+
+    def __init__(
+        self,
+        cluster: Optional[Cluster] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        poll_interval: float = 5.0,
+        token: Optional[str] = None,
+    ) -> None:
+        self.cluster = cluster or Cluster()
+        self.poll_interval = poll_interval
+        self.token = token or None
+        self._lock = threading.Lock()
+        self._node_urls: Dict[str, str] = {}
+        self._pending: List = []  # evicted pods awaiting capacity
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        controller = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802
+                utils.logf(5, "controller: " + fmt, *args)
+
+            def _reply(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authorized(self) -> bool:
+                if controller.token is None:
+                    return True
+                got = self.headers.get("Authorization", "")
+                if hmac.compare_digest(
+                    got.encode("latin-1", "replace"),
+                    f"Bearer {controller.token}".encode("latin-1", "replace"),
+                ):
+                    return True
+                self._reply(401, {"error": "missing or invalid bearer token"})
+                return False
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_GET(self):  # noqa: N802
+                # NOTE: payloads are built under the lock but written to the
+                # socket OUTSIDE it — one stalled reader must never block
+                # scheduling or reconciliation.
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True})
+                    return
+                if not self._authorized():
+                    return
+                if self.path == "/status":
+                    with controller._lock:
+                        out = controller.cluster.status()
+                    self._reply(200, out)
+                elif self.path == "/nodes":
+                    with controller._lock:
+                        status = controller.cluster.status()["nodes"]
+                        out = {
+                            name: {**entry, "url": controller._node_urls.get(name)}
+                            for name, entry in status.items()
+                        }
+                    self._reply(200, out)
+                elif self.path.startswith("/pods/"):
+                    # launcher env for an already-placed pod (idempotent:
+                    # device allocate only derives env from AllocateFrom) —
+                    # how a launcher recovers env after a reconcile re-place
+                    name = self.path[len("/pods/"):]
+                    try:
+                        with controller._lock:
+                            alloc = controller.cluster.allocate(name)
+                            out = {
+                                c: allocate_result_to_json(r)
+                                for c, r in alloc.items()
+                            }
+                        self._reply(200, {"pod": name, "containers": out})
+                    except KeyError:
+                        self._reply(404, {"error": f"no pod {name!r}"})
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(500, {"error": str(e)})
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):  # noqa: N802
+                if not self._authorized():
+                    return
+                try:
+                    if self.path == "/nodes":
+                        req = self._body()
+                        name = controller.register_agent(
+                            req["url"], name=req.get("name"),
+                            token=req.get("token"),
+                        )
+                        self._reply(200, {"node": name})
+                    elif self.path == "/pods":
+                        req = self._body()
+                        with controller._lock:
+                            out = controller._submit(req)
+                        self._reply(200, out)
+                    else:
+                        self._reply(404, {"error": f"no route {self.path}"})
+                except SchedulingError as e:
+                    self._reply(409, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — report, stay up
+                    self._reply(500, {"error": str(e)})
+
+            def do_DELETE(self):  # noqa: N802
+                if not self._authorized():
+                    return
+                if not self.path.startswith("/pods/"):
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                name = self.path[len("/pods/"):]
+                try:
+                    with controller._lock:
+                        controller.cluster.release(name)
+                    self._reply(200, {"released": name})
+                except KeyError:
+                    self._reply(404, {"error": f"no pod {name!r}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def register_agent(
+        self, url: str, name: Optional[str] = None, token: Optional[str] = None
+    ) -> str:
+        """Register a live agent (the one registration path — the POST
+        /nodes handler and the CLI both call this)."""
+        with self._lock:
+            info = self.cluster.register_remote_node(url, name=name, token=token)
+            self._node_urls[info.name] = url
+            return info.name
+
+    def _pod_name_in_use(self, name: str) -> bool:
+        return any(name in node.pods for node in self.cluster.nodes.values())
+
+    def _submit(self, req: dict) -> dict:
+        """Place a pod or a gang and run container-start allocation — the
+        caller gets everything a launcher needs. Caller holds the lock.
+        All-or-nothing: an allocate failure (e.g. the agent died since
+        placement) releases everything placed here before re-raising."""
+        if "gang" in req:
+            pods = [pod_info_from_json(p) for p in req["gang"]]
+        else:
+            pods = [pod_info_from_json(req["pod"])]
+        names = [p.name for p in pods]
+        if len(set(names)) != len(names):
+            raise SchedulingError(f"duplicate pod names in request: {names}")
+        for n in names:
+            if self._pod_name_in_use(n) or any(
+                p.name == n for p in self._pending
+            ):
+                # a duplicate submit would silently overwrite the placed
+                # record and leak its resources (Cluster.schedule keys
+                # node.pods by name)
+                raise SchedulingError(f"pod name {n!r} is already in use")
+        if "gang" in req:
+            placed = self.cluster.schedule_gang(pods)
+            contiguity = self.cluster.gang_contiguity(placed)
+        else:
+            placed = [self.cluster.schedule(pods[0])]
+            contiguity = None
+        out = {"placements": []}
+        try:
+            for p in placed:
+                alloc = self.cluster.allocate(p.name)
+                out["placements"].append({
+                    "pod": p.name,
+                    "node": p.node_name,
+                    "containers": {
+                        c: allocate_result_to_json(r) for c, r in alloc.items()
+                    },
+                })
+        except Exception:
+            for p in placed:  # no half-allocated capacity left behind
+                try:
+                    self.cluster.release(p.name)
+                except KeyError:
+                    pass
+            raise
+        if contiguity is not None:
+            out["gang_contiguity"] = contiguity
+        return out
+
+    # -- reconcile loop ------------------------------------------------------
+
+    def poll_once(self) -> dict:
+        """One reconcile pass: probe remote agents (OUTSIDE the lock — a
+        partition must not stall the operator API for timeout x agents),
+        fail dead ones, apply fresh advertisements, and re-place evicted +
+        pending pods where capacity allows. Re-placed pods are allocated
+        too, so their launcher env is ready (also at GET /pods/<name>)."""
+        from kubetpu.api.types import new_node_info
+        from kubetpu.wire import AgentUnreachable, RemoteDevice
+
+        with self._lock:
+            remotes = [
+                (name, node.device)
+                for name, node in sorted(self.cluster.nodes.items())
+                if isinstance(node.device, RemoteDevice)
+            ]
+        probed: Dict[str, object] = {}
+        dead: List[str] = []
+        for name, dev in remotes:
+            fresh = new_node_info(name)
+            try:
+                dev.update_node_info(fresh)
+                probed[name] = fresh
+            except AgentUnreachable:
+                dead.append(name)
+            except RuntimeError as e:  # degraded (HTTP 500), not dead
+                utils.errorf("refresh of %s failed (degraded agent): %s", name, e)
+
+        with self._lock:
+            failed: List[str] = []
+            for name in dead:
+                if name in self.cluster.nodes:
+                    self._node_urls.pop(name, None)
+                    self._pending.extend(self.cluster.fail_node(name))
+                    failed.append(name)
+            for name, fresh in probed.items():
+                if name in self.cluster.nodes:
+                    self.cluster.refresh_node(name, probed=fresh)
+            rescheduled, still_pending = [], []
+            for pod in self._pending:
+                try:
+                    placed = self.cluster.schedule(pod)
+                    alloc = self.cluster.allocate(placed.name)
+                    rescheduled.append({
+                        "pod": placed.name,
+                        "node": placed.node_name,
+                        "containers": {
+                            c: allocate_result_to_json(r)
+                            for c, r in alloc.items()
+                        },
+                    })
+                except SchedulingError:
+                    still_pending.append(pod)
+                except Exception as e:  # noqa: BLE001 — allocate leg died
+                    utils.errorf("allocate after reschedule failed for %s: %s",
+                                 pod.name, e)
+                    try:
+                        self.cluster.release(pod.name)
+                    except KeyError:
+                        pass
+                    still_pending.append(pod)
+            self._pending = still_pending
+            return {
+                "failed_nodes": sorted(failed),
+                "rescheduled": rescheduled,
+                "pending": [p.name for p in self._pending],
+            }
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                result = self.poll_once()
+                if result["failed_nodes"] or result["rescheduled"]:
+                    utils.logf(0, "reconcile: %s", result)
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                utils.errorf("reconcile pass failed: %s", e)
+
+    @property
+    def pending_pods(self) -> List[str]:
+        with self._lock:
+            return [p.name for p in self._pending]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> str:
+        threading.Thread(
+            target=self._httpd.serve_forever, name="kubetpu-controller",
+            daemon=True,
+        ).start()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="kubetpu-reconcile", daemon=True
+        )
+        self._poll_thread.start()
+        return self.address
+
+    def wait(self) -> None:
+        """Block until shutdown (the CLI's serve-forever)."""
+        if self._poll_thread is not None:
+            self._poll_thread.join()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=self.poll_interval + 5)
+
+
+def pod_to_json(pod) -> dict:
+    """Convenience re-export for API clients building /pods bodies."""
+    return pod_info_to_json(pod)

@@ -1,0 +1,216 @@
+"""The control-plane daemon: operator HTTP API + reconcile loop over live
+agent servers — submit/status/release over the wire, dead agents drive
+automatic rescheduling, pods that fit nowhere wait in the pending queue."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceTPU
+from kubetpu.wire import NodeAgentServer
+from kubetpu.wire.controller import ControllerServer, pod_to_json
+
+
+def tpu_pod(name, chips):
+    return PodInfo(
+        name=name,
+        running_containers={"main": ContainerInfo(requests={ResourceTPU: chips})},
+    )
+
+
+def _post(url, obj, token=None):
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 headers=headers, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture
+def stack():
+    """Two agent servers + one controller, all live."""
+    # hosts 0 and 2 are vertically adjacent in the v5e-64 host grid (4x2),
+    # so a 2-host gang can tile a perfect 4x4 chip square
+    agents = [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-64", host_index=h)
+            ),
+            f"h{h}",
+        )
+        for h in (0, 2)
+    ]
+    for a in agents:
+        a.start()
+    # long poll interval: tests drive reconciliation via poll_once()
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    for a in agents:
+        _post(controller.address + "/nodes", {"url": a.address})
+    yield controller, agents
+    controller.shutdown()
+    for a in agents:
+        try:
+            a.shutdown()
+        except Exception:  # noqa: BLE001 — may already be down
+            pass
+
+
+def test_submit_status_release_over_api(stack):
+    controller, _agents = stack
+    out = _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("j", 4))})
+    assert out["placements"][0]["pod"] == "j"
+    node = out["placements"][0]["node"]
+    env = out["placements"][0]["containers"]["main"]["env"]
+    assert env["TPU_VISIBLE_DEVICES"].count(",") == 3
+
+    status = _get(controller.address + "/status")
+    assert "j" in status["nodes"][node]["pods"]
+    nodes = _get(controller.address + "/nodes")
+    assert nodes[node]["url"]
+
+    req = urllib.request.Request(
+        controller.address + "/pods/j", method="DELETE"
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert json.loads(r.read())["released"] == "j"
+    status = _get(controller.address + "/status")
+    assert status["nodes"][node]["pods"] == []
+
+
+def test_gang_submit_over_api(stack):
+    controller, _agents = stack
+    out = _post(
+        controller.address + "/pods",
+        {"gang": [pod_to_json(tpu_pod(f"w{i}", 8)) for i in range(2)]},
+    )
+    assert len(out["placements"]) == 2
+    assert out["gang_contiguity"] == 1.0
+
+
+def test_unschedulable_is_409(stack):
+    controller, _agents = stack
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("big", 64))})
+    assert e.value.code == 409
+
+
+def test_dead_agent_reconcile_reschedules(stack):
+    controller, agents = stack
+    out = _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("job", 4))})
+    node = out["placements"][0]["node"]
+    victim = next(a for a in agents if a.node_name == node)
+    victim.shutdown()
+
+    result = controller.poll_once()
+    assert result["failed_nodes"] == [node]
+    assert result["rescheduled"][0]["pod"] == "job"
+    assert result["rescheduled"][0]["node"] != node
+    assert result["pending"] == []
+
+
+def test_nowhere_to_go_stays_pending_then_recovers(stack):
+    controller, agents = stack
+    # fill BOTH nodes, then kill one: its pod cannot re-place until space
+    out0 = _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("a", 8))})
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("b", 8))})
+    victim_node = out0["placements"][0]["node"]
+    victim = next(a for a in agents if a.node_name == victim_node)
+    victim.shutdown()
+
+    result = controller.poll_once()
+    assert result["pending"] == ["a"]
+    # release "b": the next reconcile pass finds room
+    req = urllib.request.Request(controller.address + "/pods/b", method="DELETE")
+    urllib.request.urlopen(req, timeout=10).read()
+    result = controller.poll_once()
+    assert result["rescheduled"][0]["pod"] == "a"
+    assert controller.pending_pods == []
+
+
+def test_controller_auth():
+    controller = ControllerServer(poll_interval=3600, token="t0k3n")
+    controller.start()
+    try:
+        assert _get(controller.address + "/healthz")["ok"]  # liveness open
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(controller.address + "/status")
+        assert e.value.code == 401
+        req = urllib.request.Request(
+            controller.address + "/status",
+            headers={"Authorization": "Bearer t0k3n"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert "nodes" in json.loads(r.read())
+    finally:
+        controller.shutdown()
+
+
+def test_duplicate_pod_name_is_409(stack):
+    controller, _agents = stack
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("dup", 2))})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("dup", 2))})
+    assert e.value.code == 409
+    # original pod untouched, capacity not double-counted
+    status = _get(controller.address + "/status")
+    held = sum(
+        8 - entry["kubedevice/tpu"]["free"] for entry in status["nodes"].values()
+    )
+    assert held == 2
+
+
+def test_allocation_fetch_for_existing_pod(stack):
+    controller, _agents = stack
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("x", 2))})
+    out = _get(controller.address + "/pods/x")
+    assert out["containers"]["main"]["env"]["TPU_VISIBLE_DEVICES"].count(",") == 1
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(controller.address + "/pods/ghost")
+    assert e.value.code == 404
+
+
+def test_reconcile_rescheduled_pod_carries_launcher_env(stack):
+    controller, agents = stack
+    out = _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("job", 4))})
+    node = out["placements"][0]["node"]
+    next(a for a in agents if a.node_name == node).shutdown()
+    result = controller.poll_once()
+    entry = result["rescheduled"][0]
+    assert entry["pod"] == "job" and entry["node"] != node
+    assert entry["containers"]["main"]["env"]["TPU_VISIBLE_DEVICES"]
+    # and the env stays fetchable afterwards
+    again = _get(controller.address + "/pods/job")
+    assert again["containers"]["main"]["devices"]
+
+
+def test_submit_rolls_back_when_allocate_fails(stack, monkeypatch):
+    """If the agent dies between placement and allocation, the submission
+    must not leave capacity held by an unlaunchable pod."""
+    controller, agents = stack
+
+    real_allocate = controller.cluster.allocate
+
+    def dying_allocate(name):
+        raise ConnectionError("agent vanished mid-submit")
+
+    monkeypatch.setattr(controller.cluster, "allocate", dying_allocate)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("z", 4))})
+    assert e.value.code == 500
+    monkeypatch.setattr(controller.cluster, "allocate", real_allocate)
+    status = _get(controller.address + "/status")
+    for entry in status["nodes"].values():
+        assert entry["kubedevice/tpu"]["free"] == 8  # fully rolled back
+        assert entry["pods"] == []
