@@ -36,9 +36,19 @@ def main(argv=None) -> int:
         host=args.bind, port=args.port, poll_interval=args.poll_interval,
         token=token,
     )
-    registered = [server.register_agent(url, token=token) for url in args.agents]
+    registered, skipped = [], []
+    for url in args.agents:
+        try:
+            registered.append(server.register_agent(url, token=token))
+        except Exception as e:  # noqa: BLE001 — one dead agent must not
+            # crash-loop the whole control plane (the outage the reconcile
+            # loop exists to survive); re-register later via POST /nodes
+            print(f"warning: agent {url} not registered ({e}); "
+                  f"retry with POST /nodes", file=sys.stderr)
+            skipped.append(url)
     addr = server.start()
-    print(json.dumps({"listening": addr, "nodes": registered}), flush=True)
+    print(json.dumps({"listening": addr, "nodes": registered,
+                      "skipped": skipped}), flush=True)
     try:
         server.wait()
     except KeyboardInterrupt:

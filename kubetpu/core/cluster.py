@@ -54,6 +54,12 @@ class SchedulingError(Exception):
 # topology-generation knob); higher preempts lower via schedule_preempting.
 PriorityKey = "kubetpu/priority"
 
+# Gang identity pseudo-resource: schedule_gang stamps every member with one
+# id, so later RE-placements (reconcile after a node death) can honor the
+# single-slice invariant toward the gang's surviving members — an untagged
+# individual reschedule would silently straddle slices over DCN.
+GangKey = "kubetpu/gang"
+
 
 def pod_priority(pod: PodInfo) -> int:
     return int(pod.requests.get(PriorityKey, 0))
@@ -101,6 +107,7 @@ class Cluster:
         self.nodes: Dict[str, ClusterNode] = {}
         self.metrics = LatencyRecorder()
         self.events: List[Dict[str, object]] = []
+        self._gang_seq = 0  # gang-identity stamps (GangKey)
 
     def _event(self, kind: str, **detail: object) -> None:
         self.events.append({"ts": time.time(), "kind": kind, **detail})
@@ -178,14 +185,9 @@ class Cluster:
         no agent answers at *url*. Token-protected agents: pass *token*
         per agent (secrets may differ per node) or set ``KUBETPU_WIRE_TOKEN``
         for a fleet-wide default."""
-        from kubetpu.wire import RemoteDevice
+        from kubetpu.wire.client import probe_remote_agent
 
-        dev = RemoteDevice(url, token=token)
-        dev.start()  # health check — fail fast on a dead address
-        info = new_node_info(name or "")
-        dev.update_node_info(info)
-        if not info.name:
-            raise ValueError(f"agent at {url} advertises no node name; pass name=")
+        dev, info = probe_remote_agent(url, name=name, token=token)
         if info.name in self.nodes:
             # Silently replacing would drop the existing node's placed pods
             # from control-plane state; the caller must fail_node/remove_node
@@ -368,6 +370,13 @@ class Cluster:
         """
         t0 = time.perf_counter()
         try:
+            # Stamp gang identity on copies (inputs are templates): members
+            # carry it through placement, eviction, and reset, so a later
+            # individual re-place can find its surviving gang mates.
+            self._gang_seq += 1
+            pods = [p.copy() for p in pods]
+            for p in pods:
+                p.requests[GangKey] = self._gang_seq
             slices = self._tpu_slices()
             # pod_wants_device covers device-native AND kube-native requests
             # over both container kinds, so a kube-only gang is still pinned
@@ -496,6 +505,25 @@ class Cluster:
                 self.release(p.name)
             raise
         return placed
+
+    def gang_slice_filter(self, pod: PodInfo) -> Optional[Callable[[str], bool]]:
+        """Node filter honoring a re-placed pod's gang slice affinity: when
+        surviving members of its gang are placed on a TPU slice, only that
+        slice's nodes are eligible — the single-slice gang invariant
+        (schedule_gang's DCN guard) applies to RE-placements too. None when
+        the pod carries no gang id or has no placed gang mates."""
+        gid = pod.requests.get(GangKey)
+        if not gid:
+            return None
+        for node in self.nodes.values():
+            for placed in node.pods.values():
+                if placed.name != pod.name and placed.requests.get(GangKey) == gid:
+                    state = meshstate.parse_mesh_state(node.info.allocatable)
+                    if state is None:
+                        return None  # non-mesh gang: no slice constraint
+                    members = set(self._tpu_slices().get(state.slice_name, []))
+                    return lambda n, m=members: n in m
+        return None
 
     def _tpu_slices(self) -> Dict[str, List[str]]:
         """Slice name -> node names sorted by host index."""

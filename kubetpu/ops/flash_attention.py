@@ -288,27 +288,30 @@ def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret,
     return back(dq), back(dk), back(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False):
-    """Causal flash attention: (B, S, H, D) -> (B, S, H, D), drop-in for
+                    interpret: bool = False, causal: bool = True):
+    """Flash attention: (B, S, H, D) -> (B, S, H, D), drop-in for
     ``model.forward``'s ``attn_fn`` (wrap block sizes with functools.partial).
+    Causal by default; ``causal=False`` is full bidirectional visibility —
+    the encoder/ViT-style core (and the ring's off-diagonal steps).
     Training uses the fused FlashAttention-2-style backward kernels (dQ pass
     + dK/dV pass over the saved log-sum-exp) — no O(S^2) materialization in
     either direction.
     """
-    out, _lse = _flash_forward(q, k, v, block_q, block_k, interpret)
+    out, _lse = _flash_forward(q, k, v, block_q, block_k, interpret, causal)
     return out
 
 
-def _fwd(q, k, v, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, block_q, block_k, interpret)
+def _fwd(q, k, v, block_q, block_k, interpret, causal):
+    out, lse = _flash_forward(q, k, v, block_q, block_k, interpret, causal)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(block_q, block_k, interpret, res, g):
+def _bwd(block_q, block_k, interpret, causal, res, g):
     q, k, v, out, lse = res
-    return _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret)
+    return _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret,
+                           causal)
 
 
 flash_attention.defvjp(_fwd, _bwd)

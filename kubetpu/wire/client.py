@@ -33,6 +33,24 @@ class AgentUnreachable(ConnectionError):
     """The node agent did not answer — treat the node as failed."""
 
 
+def probe_remote_agent(
+    url: str, name: Optional[str] = None, token: Optional[str] = None
+):
+    """Health-check + probe an agent and return ``(RemoteDevice, NodeInfo)``
+    — the wire half of remote-node registration, factored out so callers
+    that serialize cluster mutations under a lock (the controller) can keep
+    this slow leg OUTSIDE it. Raises ``AgentUnreachable``/``ValueError``."""
+    from kubetpu.api.types import new_node_info
+
+    dev = RemoteDevice(url, token=token)
+    dev.start()  # fail fast on a dead address
+    info = new_node_info(name or "")
+    dev.update_node_info(info)
+    if not info.name:
+        raise ValueError(f"agent at {url} advertises no node name; pass name=")
+    return dev, info
+
+
 class RemoteDevice(Device):
     """Device manager proxy over a node agent's HTTP surface."""
 

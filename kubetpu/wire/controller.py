@@ -29,10 +29,9 @@ route except ``/healthz`` (``KUBETPU_WIRE_TOKEN`` in the CLI).
 
 from __future__ import annotations
 
-import hmac
 import json
 import threading
-import time
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -43,6 +42,7 @@ from kubetpu.wire.codec import (
     pod_info_from_json,
     pod_info_to_json,
 )
+from kubetpu.wire.httpcommon import check_bearer, write_json
 
 
 class ControllerServer:
@@ -71,21 +71,10 @@ class ControllerServer:
                 utils.logf(5, "controller: " + fmt, *args)
 
             def _reply(self, code: int, obj) -> None:
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                write_json(self, code, obj)
 
             def _authorized(self) -> bool:
-                if controller.token is None:
-                    return True
-                got = self.headers.get("Authorization", "")
-                if hmac.compare_digest(
-                    got.encode("latin-1", "replace"),
-                    f"Bearer {controller.token}".encode("latin-1", "replace"),
-                ):
+                if check_bearer(self.headers, controller.token):
                     return True
                 self._reply(401, {"error": "missing or invalid bearer token"})
                 return False
@@ -121,12 +110,7 @@ class ControllerServer:
                     # how a launcher recovers env after a reconcile re-place
                     name = self.path[len("/pods/"):]
                     try:
-                        with controller._lock:
-                            alloc = controller.cluster.allocate(name)
-                            out = {
-                                c: allocate_result_to_json(r)
-                                for c, r in alloc.items()
-                            }
+                        out = controller._allocate_existing(name)
                         self._reply(200, {"pod": name, "containers": out})
                     except KeyError:
                         self._reply(404, {"error": f"no pod {name!r}"})
@@ -180,11 +164,48 @@ class ControllerServer:
         self, url: str, name: Optional[str] = None, token: Optional[str] = None
     ) -> str:
         """Register a live agent (the one registration path — the POST
-        /nodes handler and the CLI both call this)."""
+        /nodes handler and the CLI both call this). The wire probe runs
+        OUTSIDE the cluster lock: a black-holed URL must cost the caller a
+        timeout, not stall the whole operator API."""
+        from kubetpu.wire.client import probe_remote_agent
+
+        dev, info = probe_remote_agent(url, name=name, token=token)
         with self._lock:
-            info = self.cluster.register_remote_node(url, name=name, token=token)
+            if info.name in self.cluster.nodes:
+                raise ValueError(
+                    f"node {info.name!r} is already registered; remove it "
+                    f"first, or start the agent with a distinct --name"
+                )
+            self.cluster._event("register_remote", node=info.name, url=url)
+            self.cluster.register_node(
+                info.name, device=dev, node_info=info, probe=False
+            )
             self._node_urls[info.name] = url
             return info.name
+
+    def _allocate_existing(self, name: str) -> dict:
+        """Launcher env for a placed pod. The snapshot (pod copy + device)
+        is taken under the lock; the per-container wire calls run outside
+        it, so a slow-but-alive agent cannot freeze the control plane."""
+        with self._lock:
+            for node in self.cluster.nodes.values():
+                placed = node.pods.get(name)
+                if placed is not None:
+                    device = node.device
+                    pod_copy = placed.copy()
+                    break
+            else:
+                raise KeyError(name)
+        out = {}
+        for cname in sorted(pod_copy.init_containers):
+            out[cname] = allocate_result_to_json(
+                device.allocate(pod_copy, pod_copy.init_containers[cname])
+            )
+        for cname in sorted(pod_copy.running_containers):
+            out[cname] = allocate_result_to_json(
+                device.allocate(pod_copy, pod_copy.running_containers[cname])
+            )
+        return out
 
     def _pod_name_in_use(self, name: str) -> bool:
         return any(name in node.pods for node in self.cluster.nodes.values())
@@ -256,15 +277,28 @@ class ControllerServer:
             ]
         probed: Dict[str, object] = {}
         dead: List[str] = []
-        for name, dev in remotes:
+
+        def probe(item):
+            name, dev = item
             fresh = new_node_info(name)
             try:
                 dev.update_node_info(fresh)
-                probed[name] = fresh
-            except AgentUnreachable:
-                dead.append(name)
+                return name, fresh, None
+            except AgentUnreachable as e:
+                return name, None, e
             except RuntimeError as e:  # degraded (HTTP 500), not dead
                 utils.errorf("refresh of %s failed (degraded agent): %s", name, e)
+                return name, None, None
+
+        if remotes:
+            # concurrent probes: a partition must cost one timeout per pass,
+            # not one per dead agent
+            with ThreadPoolExecutor(max_workers=min(16, len(remotes))) as pool:
+                for name, fresh, err in pool.map(probe, remotes):
+                    if fresh is not None:
+                        probed[name] = fresh
+                    elif err is not None:
+                        dead.append(name)
 
         with self._lock:
             failed: List[str] = []
@@ -279,7 +313,13 @@ class ControllerServer:
             rescheduled, still_pending = [], []
             for pod in self._pending:
                 try:
-                    placed = self.cluster.schedule(pod)
+                    # gang members re-place ONLY within their surviving
+                    # mates' slice — an unconstrained reschedule would
+                    # silently straddle the gang over DCN, the exact
+                    # failure schedule_gang refuses (core gang invariant)
+                    placed = self.cluster.schedule(
+                        pod, self.cluster.gang_slice_filter(pod)
+                    )
                     alloc = self.cluster.allocate(placed.name)
                     rescheduled.append({
                         "pod": placed.name,

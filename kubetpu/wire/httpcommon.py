@@ -1,0 +1,44 @@
+"""Shared HTTP plumbing for the wire servers (agent + controller): JSON /
+text replies and the bearer-token check. One implementation so security
+hardening (constant-time compare, latin-1 header handling) can never drift
+between the two surfaces."""
+
+from __future__ import annotations
+
+import hmac
+import json
+from typing import Optional
+
+
+def write_json(handler, code: int, obj) -> None:
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def write_text(handler, code: int, text: str,
+               content_type: str = "text/plain; version=0.0.4") -> None:
+    body = text.encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", content_type)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def check_bearer(headers, token: Optional[str]) -> bool:
+    """True when the request may proceed. Constant-time compare — plain ==
+    short-circuits at the first differing byte, leaking the secret through
+    timing. Compares BYTES: hmac.compare_digest raises TypeError on
+    non-ASCII str (http.server hands headers latin-1-decoded), which would
+    drop the connection instead of letting the caller reply 401."""
+    if token is None:
+        return True
+    got = headers.get("Authorization", "")
+    return hmac.compare_digest(
+        got.encode("latin-1", "replace"),
+        f"Bearer {token}".encode("latin-1", "replace"),
+    )

@@ -22,7 +22,6 @@ bound address is printed/returned so spawners can discover it.
 
 from __future__ import annotations
 
-import hmac
 import json
 import threading
 import time
@@ -37,6 +36,7 @@ from kubetpu.wire.codec import (
     node_info_to_json,
     pod_info_from_json,
 )
+from kubetpu.wire.httpcommon import check_bearer, write_json, write_text
 
 
 class NodeAgentServer:
@@ -83,34 +83,13 @@ class NodeAgentServer:
                 utils.logf(5, "agent %s: " + fmt, agent.node_name, *args)
 
             def _reply(self, code: int, obj: dict) -> None:
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                write_json(self, code, obj)
 
             def _reply_text(self, code: int, text: str) -> None:
-                body = text.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                write_text(self, code, text)
 
             def _authorized(self) -> bool:
-                if agent.token is None:
-                    return True
-                got = self.headers.get("Authorization", "")
-                # constant-time compare: plain == short-circuits at the
-                # first differing byte, leaking the secret through timing.
-                # Compare BYTES — compare_digest raises TypeError on
-                # non-ASCII str (http.server hands headers latin-1-decoded),
-                # which would drop the connection instead of replying 401.
-                if hmac.compare_digest(
-                    got.encode("latin-1", "replace"),
-                    f"Bearer {agent.token}".encode("latin-1", "replace"),
-                ):
+                if check_bearer(self.headers, agent.token):
                     return True
                 self._reply(401, {"error": "missing or invalid bearer token"})
                 return False

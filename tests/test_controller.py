@@ -214,3 +214,64 @@ def test_submit_rolls_back_when_allocate_fails(stack, monkeypatch):
     for entry in status["nodes"].values():
         assert entry["kubedevice/tpu"]["free"] == 8  # fully rolled back
         assert entry["pods"] == []
+
+
+def test_reconcile_never_straddles_gang_across_slices():
+    """A gang member evicted by a node death must re-place only within its
+    surviving mates' slice: cross-slice chips are DCN, and an unconstrained
+    reschedule would silently wreck the gang's collectives."""
+    # slice0: hosts 0 and 2 (adjacent); sliceB: an unrelated slice with room
+    agents = [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=h)),
+            f"s0-h{h}",
+        )
+        for h in (0, 2)
+    ] + [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-64", host_index=0, slice_uid="sliceB")
+            ),
+            "sB-h0",
+        )
+    ]
+    for a in agents:
+        a.start()
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    try:
+        for a in agents:
+            _post(controller.address + "/nodes", {"url": a.address})
+        out = _post(
+            controller.address + "/pods",
+            {"gang": [pod_to_json(tpu_pod(f"w{i}", 8)) for i in range(2)]},
+        )
+        nodes = {p["pod"]: p["node"] for p in out["placements"]}
+        assert set(nodes.values()) == {"s0-h0", "s0-h2"}  # gang on slice0
+
+        victim = next(a for a in agents if a.node_name == nodes["w0"])
+        victim.shutdown()
+        result = controller.poll_once()
+        # sliceB has 8 free chips, but w0 must NOT land there: it stays
+        # pending rather than straddle its gang over DCN
+        assert result["rescheduled"] == []
+        assert result["pending"] == ["w0"]
+
+        # a replacement host joins slice0 -> w0 recovers INSIDE the slice
+        replacement = NodeAgentServer(
+            new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=1)),
+            "s0-h1",
+        )
+        replacement.start()
+        agents.append(replacement)
+        _post(controller.address + "/nodes", {"url": replacement.address})
+        result = controller.poll_once()
+        assert result["rescheduled"][0]["pod"] == "w0"
+        assert result["rescheduled"][0]["node"] == "s0-h1"
+    finally:
+        controller.shutdown()
+        for a in agents:
+            try:
+                a.shutdown()
+            except Exception:  # noqa: BLE001
+                pass

@@ -87,3 +87,38 @@ def test_flash_in_train_step():
     loss_flash = next_token_loss(params, tokens, targets, cfg, attn)
     loss_dense = next_token_loss(params, tokens, targets, cfg)
     np.testing.assert_allclose(float(loss_flash), float(loss_dense), rtol=1e-4)
+
+
+def test_noncausal_flash_matches_dense_bidirectional():
+    """flash_attention(causal=False): the encoder-style full-visibility
+    core must match a plain softmax over ALL positions, forward and grad."""
+    import jax
+    import jax.numpy as jnp
+
+    b, s, h, d = 2, 64, 2, 8
+    q, k, v = (
+        jax.random.normal(key, (b, s, h, d), jnp.float32)
+        for key in jax.random.split(jax.random.PRNGKey(0), 3)
+    )
+
+    def dense_full(q, k, v):
+        scale = d ** -0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    import functools
+
+    flash = functools.partial(flash_attention, block_q=16, block_k=16,
+                              interpret=True, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(flash(q, k, v)), np.asarray(dense_full(q, k, v)),
+        rtol=2e-4, atol=2e-5,
+    )
+    g_flash = jax.grad(lambda q, k, v: jnp.sum(flash(q, k, v) ** 2),
+                       argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(lambda q, k, v: jnp.sum(dense_full(q, k, v) ** 2),
+                       argnums=(0, 1, 2))(q, k, v)
+    for gf, gd in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=2e-3, atol=2e-4)
