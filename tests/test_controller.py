@@ -275,3 +275,66 @@ def test_reconcile_never_straddles_gang_across_slices():
                 a.shutdown()
             except Exception:  # noqa: BLE001
                 pass
+
+
+def test_whole_gang_reassembles_on_one_slice():
+    """When EVERY member of a gang is evicted (whole slice died), the
+    reconcile pass re-places them sequentially: the first lands freely, and
+    each subsequent member is slice-constrained to it — the gang reassembles
+    on ONE slice instead of scattering."""
+    s0 = [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=h)),
+            f"s0-h{h}",
+        )
+        for h in (0, 2)
+    ]
+    for a in s0:
+        a.start()
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    extra = []
+    try:
+        for a in s0:
+            _post(controller.address + "/nodes", {"url": a.address})
+        _post(
+            controller.address + "/pods",
+            {"gang": [pod_to_json(tpu_pod(f"w{i}", 8)) for i in range(2)]},
+        )
+        for a in s0:  # the whole slice dies
+            a.shutdown()
+        result = controller.poll_once()
+        assert sorted(result["pending"]) == ["w0", "w1"]
+
+        # two replacement slices appear. Node names INTERLEAVE the slices
+        # alphabetically (a/c = sliceX, b/d = sliceY): without the gang
+        # slice filter the scheduler's (-score, name) tie-break would place
+        # w0 on a-h0 (X) and w1 on b-h0 (Y) — scattered. The filter must
+        # force w1 to follow w0's slice instead.
+        slice_of = {"a": "sliceX", "b": "sliceY", "c": "sliceX", "d": "sliceY"}
+        host_of = {"a": 0, "b": 0, "c": 2, "d": 2}
+        for prefix in "abcd":
+            a = NodeAgentServer(
+                new_fake_tpu_dev_manager(
+                    make_fake_tpus_info(
+                        "v5e-64", host_index=host_of[prefix],
+                        slice_uid=slice_of[prefix],
+                    )
+                ),
+                f"{prefix}-h{host_of[prefix]}",
+            )
+            a.start()
+            extra.append(a)
+            _post(controller.address + "/nodes", {"url": a.address})
+        result = controller.poll_once()
+        placed_nodes = {r["pod"]: r["node"] for r in result["rescheduled"]}
+        assert sorted(placed_nodes) == ["w0", "w1"]
+        slices = {slice_of[n.split("-")[0]] for n in placed_nodes.values()}
+        assert len(slices) == 1  # reassembled on ONE slice, not scattered
+    finally:
+        controller.shutdown()
+        for a in s0 + extra:
+            try:
+                a.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
