@@ -17,7 +17,7 @@ KubeDevice core; kubetpu owns the core, so it owns this boundary too:
   processes with zero changes to the scheduling path.
 """
 
-from kubetpu.wire.client import AgentUnreachable, RemoteDevice
+from kubetpu.wire.client import AgentUnreachable, RemoteDevice, probe_remote_agent
 from kubetpu.wire.codec import (
     allocate_result_from_json,
     allocate_result_to_json,
@@ -26,11 +26,14 @@ from kubetpu.wire.codec import (
     pod_info_from_json,
     pod_info_to_json,
 )
+from kubetpu.wire.controller import ControllerServer
 from kubetpu.wire.server import NodeAgentServer
 
 __all__ = [
     "AgentUnreachable",
+    "ControllerServer",
     "NodeAgentServer",
+    "probe_remote_agent",
     "RemoteDevice",
     "allocate_result_from_json",
     "allocate_result_to_json",
