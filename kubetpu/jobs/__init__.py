@@ -26,5 +26,6 @@ __all__ = [
 #   kubetpu.jobs.pipeline   (pp training), kubetpu.jobs.decode (KV-cache
 #   generation), kubetpu.jobs.speculative (draft+verify decoding),
 #   kubetpu.jobs.serving (continuous batching),
+#   kubetpu.jobs.encoder (bidirectional masked-LM family),
 #   kubetpu.jobs.checkpoint (orbax), kubetpu.jobs.data,
 #   kubetpu.jobs.launch (jax.distributed wiring)

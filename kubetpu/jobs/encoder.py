@@ -1,0 +1,123 @@
+"""Bidirectional encoder (BERT-style masked-LM) — the second model family.
+
+Deliberately thin: the decoder's blocks, init, sharding specs and mesh
+plumbing are reused verbatim — an encoder IS ``model.forward`` with a
+full-visibility attention core instead of the causal one. The only new
+code is the masked-token objective and the train-step wiring. On TPU the
+bidirectional core is the same Pallas flash kernel with ``causal=False``
+(``kubetpu.ops.flash_attention``), so encoder attention gets the identical
+VMEM-tiled treatment as the decoder's.
+
+Reference: the reference has no models at all (SURVEY.md §2) — family
+breadth is a kubetpu extension.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubetpu.jobs import model as model_lib
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.train import TrainState, _filter_spec, make_optimizer
+
+
+def dense_bidirectional_attention(q, k, v):
+    """Full-visibility softmax attention — the XLA reference core for the
+    encoder ((B, S, H, D) in/out; ``model.dense_attention`` with the causal
+    mask off). On TPU prefer ``flash_attention(causal=False)``."""
+    return model_lib.dense_attention(q, k, v, causal=False)
+
+
+def encoder_forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: ModelConfig,
+    attn_fn=None,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Logits with every position attending to ALL positions — the shared
+    decoder blocks under a bidirectional core. tokens: (B, S) -> (B, S, V)."""
+    return model_lib.forward(
+        params, tokens, cfg,
+        attn_fn=attn_fn or dense_bidirectional_attention,
+        positions=positions,
+    )
+
+
+def masked_lm_loss(
+    params: Params,
+    tokens: jnp.ndarray,
+    mask_positions: jnp.ndarray,
+    mask_id: int,
+    cfg: ModelConfig,
+    attn_fn=None,
+) -> jnp.ndarray:
+    """BERT objective: corrupt the positions flagged in *mask_positions*
+    (bool (B, S)) with *mask_id*, predict the ORIGINAL tokens there; only
+    masked positions contribute to the loss. MoE configs get the same
+    load-balance auxiliary term as the decoder's next_token_loss."""
+    corrupted = jnp.where(mask_positions, mask_id, tokens)
+    attn = attn_fn or dense_bidirectional_attention
+    if cfg.n_experts > 0 and cfg.moe_aux_coeff > 0:
+        logits, aux = model_lib.forward(
+            params, corrupted, cfg, attn_fn=attn, return_aux=True
+        )
+        return (
+            model_lib.token_cross_entropy(logits, tokens, weights=mask_positions)
+            + cfg.moe_aux_coeff * aux
+        )
+    logits = model_lib.forward(params, corrupted, cfg, attn_fn=attn)
+    return model_lib.token_cross_entropy(logits, tokens, weights=mask_positions)
+
+
+def make_mlm_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    mask_id: int,
+    optimizer=None,
+    attention: str = "dense",
+    interpret: bool = False,
+):
+    """Jitted masked-LM train step over the mesh. Encoder batches shard
+    over dp ONLY (sequence replicated): there is no causal ring for
+    encoders, so sp-sharding the sequence would just force per-layer
+    all-gathers — and the opaque flash kernel cannot be sequence-partitioned
+    at all. ``attention``: 'dense' or 'flash' (the Pallas kernel with
+    causal=False)."""
+    optimizer = optimizer or make_optimizer()
+    if attention == "flash":
+        from kubetpu.ops import flash_attention
+
+        attn_fn = partial(flash_attention, block_q=128, block_k=128,
+                          interpret=interpret, causal=False)
+    elif attention == "dense":
+        attn_fn = dense_bidirectional_attention
+    else:
+        raise ValueError(f"unknown encoder attention {attention!r}")
+
+    def loss_fn(params, tokens, mask_positions):
+        return masked_lm_loss(params, tokens, mask_positions, mask_id, cfg,
+                              attn_fn=attn_fn)
+
+    # dp-only batch sharding (see docstring) — NOT the decoder's P(dp, sp)
+    bspec = NamedSharding(mesh, _filter_spec(mesh, P("dp", None)))
+
+    def train_step(state: TrainState, tokens, mask_positions):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, tokens, mask_positions
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(new_params, new_opt, state.step + 1), loss
+
+    return jax.jit(
+        train_step,
+        in_shardings=(None, bspec, bspec),
+        donate_argnums=(0,),
+    )

@@ -148,15 +148,25 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     return jnp.repeat(x, n_rep, axis=2)
 
 
-def dense_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
-    """Reference attention core: full causal softmax. (B, S, H, D) in/out."""
+def dense_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True
+) -> jnp.ndarray:
+    """Reference attention core: full softmax, causal or bidirectional —
+    ONE body so numerics fixes serve both (mirrors the flash kernel's
+    causal kwarg). (B, S, H, D) in/out."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    s = q.shape[1]
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(mask[None, None], scores, -1e30)
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dense_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Causal spelling of ``dense_attention`` (the decoder default)."""
+    return dense_attention(q, k, v, causal=True)
 
 
 def _moe_aux_from_probs(probs: jnp.ndarray) -> jnp.ndarray:
@@ -358,12 +368,21 @@ def forward_with_kv(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
     return logits.astype(jnp.float32), ks, vs
 
 
-def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
-    """Mean token-level cross-entropy in float32 — the shared loss tail of
-    the plain and pipelined training paths."""
+def token_cross_entropy(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    weights: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Token-level cross-entropy in float32 — the shared loss tail of the
+    causal, pipelined, and masked-LM training paths. Unweighted mean by
+    default; with *weights* (same shape as targets) a weighted mean over
+    the nonzero-weight positions (the masked-LM reduction)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    if weights is None:
+        return jnp.mean(nll)
+    w = weights.astype(jnp.float32)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 def next_token_loss(
