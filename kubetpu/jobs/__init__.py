@@ -27,5 +27,6 @@ __all__ = [
 #   generation), kubetpu.jobs.speculative (draft+verify decoding),
 #   kubetpu.jobs.serving (continuous batching),
 #   kubetpu.jobs.encoder (bidirectional masked-LM family),
+#   kubetpu.jobs.vision (ViT classification family),
 #   kubetpu.jobs.checkpoint (orbax), kubetpu.jobs.data,
 #   kubetpu.jobs.launch (jax.distributed wiring)

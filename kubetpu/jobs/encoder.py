@@ -19,12 +19,15 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubetpu.jobs import model as model_lib
 from kubetpu.jobs.model import ModelConfig, Params
-from kubetpu.jobs.train import TrainState, _filter_spec, make_optimizer
+from kubetpu.jobs.train import (
+    _filter_spec,
+    make_optimizer,
+    make_update_step,
+)
 
 
 def dense_bidirectional_attention(q, k, v):
@@ -107,17 +110,8 @@ def make_mlm_train_step(
 
     # dp-only batch sharding (see docstring) — NOT the decoder's P(dp, sp)
     bspec = NamedSharding(mesh, _filter_spec(mesh, P("dp", None)))
-
-    def train_step(state: TrainState, tokens, mask_positions):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, tokens, mask_positions
-        )
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return TrainState(new_params, new_opt, state.step + 1), loss
-
     return jax.jit(
-        train_step,
+        make_update_step(loss_fn, optimizer),
         in_shardings=(None, bspec, bspec),
         donate_argnums=(0,),
     )

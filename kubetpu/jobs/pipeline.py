@@ -183,14 +183,10 @@ def make_pipeline_train_step(
         return model_lib.token_cross_entropy(fwd(params, tokens), targets)
 
     bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+    from kubetpu.jobs.train import make_update_step
 
-    def train_step(state: TrainState, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return TrainState(new_params, new_opt, state.step + 1), loss
-
-    return jax.jit(train_step, in_shardings=(None, bspec, bspec), donate_argnums=(0,))
+    return jax.jit(make_update_step(loss_fn, optimizer),
+                   in_shardings=(None, bspec, bspec), donate_argnums=(0,))
 
 
 def init_pipeline_state(

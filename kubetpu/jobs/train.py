@@ -114,6 +114,21 @@ def init_state(
     return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)), optimizer
 
 
+def make_update_step(loss_fn, optimizer):
+    """The one train-step body (value_and_grad -> optimizer -> new state)
+    shared by the causal, pipelined, masked-LM, and ViT step builders —
+    a future change (grad clipping, loss scaling) lands everywhere at once.
+    ``loss_fn(params, *batch) -> scalar``; returns an un-jitted step."""
+
+    def train_step(state: TrainState, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, *batch)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(new_params, new_opt, state.step + 1), loss
+
+    return train_step
+
+
 def _resolve_attention(mesh: Mesh, attention: str):
     """Pick the attention core: 'ring' (sequence-parallel over sp),
     'ring_flash' (ring with the Pallas flash kernels inside every step —
@@ -160,15 +175,8 @@ def make_train_step(
         return model_lib.next_token_loss(params, tokens, targets, cfg, attn_fn)
 
     bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
-
-    def train_step(state: TrainState, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, targets)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return TrainState(new_params, new_opt, state.step + 1), loss
-
     return jax.jit(
-        train_step,
+        make_update_step(loss_fn, optimizer),
         in_shardings=(None, bspec, bspec),  # state keeps its own shardings
         donate_argnums=(0,),
     )

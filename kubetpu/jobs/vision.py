@@ -1,0 +1,202 @@
+"""Vision Transformer — the third model family, MXU-shaped.
+
+Patchify -> linear projection -> learned position embeddings -> the SAME
+transformer blocks as the decoder/encoder (``model._block`` under a
+bidirectional core) -> mean-pool -> classification head. Two TPU-first
+choices:
+
+- patchify is a reshape + one big matmul (no convolution: an (N, P*P*C) x
+  (P*P*C, D) einsum feeds the MXU directly);
+- rotary embeddings are neutralized by feeding position 0 everywhere
+  (rope at angle 0 is the identity), so the shared block body needs no
+  flag — image order comes from the learned position table, as in ViT.
+
+Reference: the reference has no models (SURVEY.md §2) — family breadth is
+a kubetpu extension.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubetpu.jobs import model as model_lib
+from kubetpu.jobs.encoder import dense_bidirectional_attention
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.train import (
+    TrainState,
+    _filter_spec,
+    make_optimizer,
+    make_update_step,
+    param_specs,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class VitConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    channels: int = 3
+    n_classes: int = 10
+    model: ModelConfig = dataclasses.field(
+        default_factory=lambda: ModelConfig(d_model=128, n_layers=4, n_heads=4,
+                                            d_ff=256)
+    )
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"patch_size ({self.patch_size}) must divide "
+                f"image_size ({self.image_size})"
+            )
+
+    @property
+    def n_patches(self) -> int:
+        side = self.image_size // self.patch_size
+        return side * side
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+
+def init_vit_params(rng: jax.Array, cfg: VitConfig) -> Params:
+    """Blocks come from the shared init (bit-identical machinery); the
+    vocab embed/head are replaced by patch projection, learned position
+    table, and the classification head."""
+    k_model, k_patch, k_pos, k_head = jax.random.split(rng, 4)
+    base = model_lib.init_params(k_model, cfg.model)
+    d = cfg.model.d_model
+    dt = cfg.model.dtype
+    return {
+        "patch_proj": jax.random.normal(k_patch, (cfg.patch_dim, d), dt)
+        * cfg.patch_dim ** -0.5,
+        "pos_embed": jax.random.normal(k_pos, (cfg.n_patches, d), dt) * 0.02,
+        "blocks": base["blocks"],
+        "ln_f": base["ln_f"],
+        "head_cls": jax.random.normal(k_head, (d, cfg.n_classes), dt) * d ** -0.5,
+    }
+
+
+def patchify(images: jnp.ndarray, cfg: VitConfig) -> jnp.ndarray:
+    """(B, H, W, C) -> (B, N, P*P*C) by pure reshape/transpose."""
+    b = images.shape[0]
+    p, side = cfg.patch_size, cfg.image_size // cfg.patch_size
+    x = images.reshape(b, side, p, side, p, cfg.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, side, side, p, p, C)
+    return x.reshape(b, cfg.n_patches, cfg.patch_dim)
+
+
+def vit_forward(
+    params: Params,
+    images: jnp.ndarray,
+    cfg: VitConfig,
+    attn_fn=None,
+    return_aux: bool = False,
+):
+    """Class logits. images: (B, H, W, C) float -> (B, n_classes); with
+    ``return_aux`` also the summed MoE load-balance term (mirrors
+    model.forward, including remat of the scanned block)."""
+    attn = attn_fn or dense_bidirectional_attention
+    x = patchify(images.astype(cfg.model.dtype), cfg) @ params["patch_proj"]
+    x = x + params["pos_embed"][None]
+    # position 0 everywhere -> rope is the identity inside the shared block
+    positions = jnp.zeros((cfg.n_patches,), jnp.int32)
+
+    def scan_body(carry, layer):
+        out, aux, _k, _v = model_lib._block_with_aux(
+            cfg.model, attn, positions, carry, layer
+        )
+        return out, aux
+
+    if cfg.model.remat:
+        scan_body = jax.checkpoint(scan_body)
+    x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
+    x = model_lib.rms_norm(jnp.mean(x, axis=1), params["ln_f"])  # mean-pool
+    logits = jnp.einsum("bd,dc->bc", x, params["head_cls"]).astype(jnp.float32)
+    if return_aux:
+        return logits, jnp.sum(auxes)
+    return logits
+
+
+def vit_loss(params, images, labels, cfg: VitConfig, attn_fn=None) -> jnp.ndarray:
+    """Classification cross-entropy; MoE configs get the same load-balance
+    auxiliary term as every other family."""
+    mcfg = cfg.model
+    if mcfg.n_experts > 0 and mcfg.moe_aux_coeff > 0:
+        logits, aux = vit_forward(params, images, cfg, attn_fn=attn_fn,
+                                  return_aux=True)
+        extra = mcfg.moe_aux_coeff * aux
+    else:
+        logits = vit_forward(params, images, cfg, attn_fn=attn_fn)
+        extra = 0.0
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1)) + extra
+
+
+def vit_param_specs(cfg: VitConfig) -> Params:
+    """Sharding: blocks reuse the shared spec tree (heads/ff on tp);
+    the small ViT-specific tensors stay replicated."""
+    blocks = param_specs(cfg.model)["blocks"]
+    return {
+        "patch_proj": P(None, None),
+        "pos_embed": P(None, None),
+        "blocks": blocks,
+        "ln_f": P(None),
+        "head_cls": P(None, None),
+    }
+
+
+def init_vit_state(
+    rng: jax.Array, cfg: VitConfig, mesh: Mesh, optimizer=None
+):
+    """Sharded params + opt state (mirrors train.init_state)."""
+    optimizer = optimizer or make_optimizer()
+    specs = vit_param_specs(cfg)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, _filter_spec(mesh, s)), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    params = jax.jit(init_vit_params, static_argnums=(1,),
+                     out_shardings=shardings)(rng, cfg)
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=jnp.zeros((), jnp.int32)), optimizer
+
+
+def make_vit_train_step(
+    cfg: VitConfig,
+    mesh: Mesh,
+    optimizer=None,
+    attention: str = "dense",
+    interpret: bool = False,
+):
+    """Jitted classification train step (batch over dp; blocks tp-sharded).
+    ``attention``: 'dense' or 'flash' (the Pallas kernel, causal=False)."""
+    optimizer = optimizer or make_optimizer()
+    if attention == "flash":
+        from functools import partial
+
+        from kubetpu.ops import flash_attention
+
+        attn_fn = partial(flash_attention, block_q=64, block_k=64,
+                          interpret=interpret, causal=False)
+    elif attention == "dense":
+        attn_fn = dense_bidirectional_attention
+    else:
+        raise ValueError(f"unknown vit attention {attention!r}")
+
+    bspec = NamedSharding(mesh, _filter_spec(mesh, P("dp", None, None, None)))
+    lspec = NamedSharding(mesh, _filter_spec(mesh, P("dp")))
+
+    def loss_fn(params, images, labels):
+        return vit_loss(params, images, labels, cfg, attn_fn=attn_fn)
+
+    return jax.jit(
+        make_update_step(loss_fn, optimizer),
+        in_shardings=(None, bspec, lspec),
+        donate_argnums=(0,),
+    )
