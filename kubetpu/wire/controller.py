@@ -14,7 +14,17 @@ agents, and serves a small operator HTTP API:
     POST   /pods             {"pod": PodInfo} or {"gang": [PodInfo, ...]}
                              -> placements + per-container AllocateResult
                              (the env/devices a launcher starts the job
-                             with); 409 when nothing fits
+                             with); 409 when nothing fits. A pod carrying
+                             the kubetpu/priority pseudo-resource may
+                             preempt lower-priority pods when nothing
+                             fits — victims are returned under "evicted"
+                             and join the pending queue for automatic
+                             re-placement
+    GET    /pods/<name>      launcher env for an already-placed pod
+    POST   /defrag           {"chips": N, "device"?, "max_migrations"?,
+                             "execute"?, "pending"?: PodInfo} -> migration
+                             plan (and its execution); 409 when no plan
+                             within budget opens the block
     DELETE /pods/<name>      release a placed pod
 
 A background poll loop refreshes every remote node on an interval; pods
@@ -37,6 +47,7 @@ from typing import Dict, List, Optional
 
 from kubetpu.api import utils
 from kubetpu.core import Cluster, SchedulingError
+from kubetpu.core.cluster import pod_priority
 from kubetpu.wire.codec import (
     allocate_result_to_json,
     pod_info_from_json,
@@ -135,6 +146,11 @@ class ControllerServer:
                         with controller._lock:
                             out = controller._submit(req)
                         self._reply(200, out)
+                    elif self.path == "/defrag":
+                        req = self._body()
+                        with controller._lock:
+                            out = controller._defrag(req)
+                        self._reply(200, out)
                     else:
                         self._reply(404, {"error": f"no route {self.path}"})
                 except SchedulingError as e:
@@ -230,12 +246,24 @@ class ControllerServer:
                 # record and leak its resources (Cluster.schedule keys
                 # node.pods by name)
                 raise SchedulingError(f"pod name {n!r} is already in use")
+        evicted: List = []
         if "gang" in req:
             placed = self.cluster.schedule_gang(pods)
             contiguity = self.cluster.gang_contiguity(placed)
         else:
-            placed = [self.cluster.schedule(pods[0])]
             contiguity = None
+            if pod_priority(pods[0]) > 0:
+                # the priority pseudo-resource opts the pod into preemption
+                # (no separate schedule try: schedule_preempting already
+                # places without evicting when the pod fits plainly);
+                # victims join the pending queue and re-place automatically
+                # on the next reconcile pass, wherever capacity allows
+                placed_pod, evicted = self.cluster.schedule_preempting(pods[0])
+                placed = [placed_pod]
+                self._pending.extend(evicted)
+            else:
+                placed = [self.cluster.schedule(pods[0])]
+        evicted_names = [p.name for p in evicted]
         out = {"placements": []}
         try:
             for p in placed:
@@ -248,14 +276,74 @@ class ControllerServer:
                     },
                 })
         except Exception:
-            for p in placed:  # no half-allocated capacity left behind
+            # all-or-nothing INCLUDING preemption: release what this request
+            # placed, then put the victims back where they were — a failed
+            # submit must not disrupt running workloads
+            node = placed[0].node_name if placed else ""
+            for p in placed:
                 try:
                     self.cluster.release(p.name)
                 except KeyError:
                     pass
+            if evicted:
+                self._pending = [
+                    p for p in self._pending if p.name not in evicted_names
+                ]
+                lost = self.cluster._restore_pods(evicted, node)
+                for p in lost:  # could not restore: keep for reconcile
+                    self._pending.append(p)
             raise
         if contiguity is not None:
             out["gang_contiguity"] = contiguity
+        if evicted_names:
+            out["evicted"] = evicted_names
+        return out
+
+    def _defrag(self, req: dict) -> dict:
+        """Plan (and optionally execute) a defragmentation. Caller holds
+        the lock. Body: {"chips": N, "device"?: "tpu"|"gpu",
+        "max_migrations"?: M, "execute"?: bool, "pending"?: PodInfo}."""
+        chips = int(req["chips"])
+        # the plan search is combinatorial in max_migrations and runs under
+        # the global lock — cap what a client may request
+        max_migrations = min(int(req.get("max_migrations", 3)), 5)
+        if "pending" in req:
+            pending_name = req["pending"].get("name", "")
+            if self._pod_name_in_use(pending_name) or any(
+                p.name == pending_name for p in self._pending
+            ):
+                raise SchedulingError(
+                    f"pod name {pending_name!r} is already in use"
+                )
+        plan = self.cluster.defrag_plan(
+            chips,
+            max_migrations=max_migrations,
+            device=req.get("device", "tpu"),
+        )
+        if plan is None:
+            raise SchedulingError(
+                f"no defrag plan within the migration budget opens a "
+                f"{chips}-device block"
+            )
+        out = {
+            "plan": [
+                {"pod": m.pod_name, "from": m.from_node, "to": m.to_node}
+                for m in plan
+            ]
+        }
+        if req.get("execute"):
+            pending = (
+                pod_info_from_json(req["pending"]) if "pending" in req else None
+            )
+            moved, placed_pending = self.cluster.execute_defrag(plan, pending)
+            out["moved"] = [
+                {"pod": p.name, "node": p.node_name} for p in moved
+            ]
+            if placed_pending is not None:
+                out["pending_pod"] = {
+                    "pod": placed_pending.name,
+                    "node": placed_pending.node_name,
+                }
         return out
 
     # -- reconcile loop ------------------------------------------------------

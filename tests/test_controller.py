@@ -338,3 +338,115 @@ def test_whole_gang_reassembles_on_one_slice():
                 a.shutdown()
             except Exception:  # noqa: BLE001
                 pass
+
+
+def test_priority_preemption_over_api(stack):
+    """A pod carrying kubetpu/priority preempts lower-priority pods when
+    nothing fits; victims surface under "evicted", wait pending, and
+    re-place automatically once capacity frees."""
+    controller, _agents = stack
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("low-a", 8))})
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("low-b", 8))})
+
+    high = tpu_pod("high", 4)
+    high.requests["kubetpu/priority"] = 10
+    out = _post(controller.address + "/pods", {"pod": pod_to_json(high)})
+    assert out["placements"][0]["pod"] == "high"
+    assert out["evicted"] in (["low-a"], ["low-b"])
+    victim = out["evicted"][0]
+    assert controller.pending_pods == [victim]
+
+    # evicted victim needs 8 chips; only 4 free next to `high` -> pending
+    assert controller.poll_once()["pending"] == [victim]
+    # release the other low pod: the victim recovers on the next pass
+    other = "low-b" if victim == "low-a" else "low-a"
+    req = urllib.request.Request(
+        controller.address + f"/pods/{other}", method="DELETE"
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+    result = controller.poll_once()
+    assert result["rescheduled"][0]["pod"] == victim
+    assert controller.pending_pods == []
+
+
+def test_defrag_over_api():
+    """POST /defrag plans and executes a migration that opens a perfect
+    block; the pending pod lands contiguity-1.0 on the opened block."""
+    agents = [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")), f"n{i}"
+        )
+        for i in range(2)
+    ]
+    for a in agents:
+        a.start()
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    try:
+        for a in agents:
+            _post(controller.address + "/nodes", {"url": a.address})
+        # fragment n0 exactly like schedsim config 7: keep two awkward chips
+        cluster = controller.cluster
+        placed = {}
+        for i in range(8):
+            p = cluster.schedule(tpu_pod(f"s{i}", 1), lambda n: n == "n0")
+            _t, coords = cluster.pod_chip_coords(p)
+            placed[coords[0]] = p.name
+        for coord, pname in placed.items():
+            if coord not in {(0, 1), (1, 2)}:
+                cluster.release(pname)
+        cluster.schedule(tpu_pod("n1pod", 4), lambda n: n == "n1")
+
+        out = _post(controller.address + "/defrag", {
+            "chips": 6, "execute": True, "pending": pod_to_json(tpu_pod("big6", 6)),
+        })
+        assert out["plan"]  # at least one migration was needed
+        assert out["pending_pod"]["pod"] == "big6"
+        big6 = next(
+            node.pods["big6"] for node in cluster.nodes.values()
+            if "big6" in node.pods
+        )
+        assert cluster.gang_contiguity([big6]) == 1.0
+
+        # a plan that cannot exist is a 409
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(controller.address + "/defrag", {"chips": 64})
+        assert e.value.code == 409
+    finally:
+        controller.shutdown()
+        for a in agents:
+            try:
+                a.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def test_preemption_submit_restores_victims_on_allocate_failure(stack, monkeypatch):
+    """If allocation fails AFTER a preemption placed the pod, the victims
+    must be restored to their node — a failed submit must not disrupt
+    running workloads."""
+    controller, _agents = stack
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("low-a", 8))})
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("low-b", 8))})
+
+    def dying_allocate(name):
+        raise ConnectionError("agent vanished mid-submit")
+
+    monkeypatch.setattr(controller.cluster, "allocate", dying_allocate)
+    high = tpu_pod("high", 4)
+    high.requests["kubetpu/priority"] = 10
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(controller.address + "/pods", {"pod": pod_to_json(high)})
+    assert e.value.code == 500
+
+    # both low pods back in place, nothing pending, no capacity lost
+    placed = {
+        name for node in controller.cluster.nodes.values() for name in node.pods
+    }
+    assert placed == {"low-a", "low-b"}
+    assert controller.pending_pods == []
+    status_free = sum(
+        node.info.allocatable["kubedevice/tpu"]
+        for node in controller.cluster.nodes.values()
+    )
+    assert status_free == 0  # 8 + 8 held by the restored low pods
