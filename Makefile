@@ -5,7 +5,7 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -Wall -Wextra -std=c++17
 
 .PHONY: all
-all: tpuinfo gpuinfo
+all: tpuinfo gpuinfo dataio
 
 .PHONY: tpuinfo
 tpuinfo: $(BUILD_DIR)/tpuinfo
@@ -21,8 +21,15 @@ $(BUILD_DIR)/gpuinfo: kubetpu/gpuinfo/gpuinfo.cc
 	@mkdir -p $(BUILD_DIR)
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
+.PHONY: dataio
+dataio: $(BUILD_DIR)/libkubetpu_dataio.so
+
+$(BUILD_DIR)/libkubetpu_dataio.so: kubetpu/dataio/loader.cc
+	@mkdir -p $(BUILD_DIR)
+	$(CXX) $(CXXFLAGS) -shared -fPIC -o $@ $<
+
 .PHONY: test
-test: tpuinfo gpuinfo
+test: tpuinfo gpuinfo dataio
 	python -m pytest tests/ -x -q
 
 .PHONY: bench

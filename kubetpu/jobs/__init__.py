@@ -29,4 +29,5 @@ __all__ = [
 #   kubetpu.jobs.encoder (bidirectional masked-LM family),
 #   kubetpu.jobs.vision (ViT classification family),
 #   kubetpu.jobs.checkpoint (orbax), kubetpu.jobs.data,
+#   kubetpu.jobs.native_data (C++ mmap corpus loader),
 #   kubetpu.jobs.launch (jax.distributed wiring)

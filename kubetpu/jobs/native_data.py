@@ -1,0 +1,126 @@
+"""Python side of the native data loader: ctypes over
+``_output/libkubetpu_dataio.so`` (see ``kubetpu/dataio/loader.cc``).
+
+``TokenFile`` wraps an mmap'd flat binary corpus of little-endian token
+ids; ``batches`` yields (tokens, targets) int32 arrays with targets
+shifted by one (reading seq+1-token windows — the same contract as
+``jobs.data``'s synthetic corpus, so a train loop swaps sources without
+changes). Window offsets are drawn by a seeded numpy RNG on the host; the
+gather itself is C-speed over the OS page cache.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import weakref
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.environ.get(
+            "KUBETPU_DATAIO_PATH",
+            os.path.join(repo, "_output", "libkubetpu_dataio.so"),
+        )
+        lib = ctypes.CDLL(path)
+        lib.ktpu_open.restype = ctypes.c_void_p
+        lib.ktpu_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ktpu_num_tokens.restype = ctypes.c_longlong
+        lib.ktpu_num_tokens.argtypes = [ctypes.c_void_p]
+        lib.ktpu_gather.restype = ctypes.c_int
+        lib.ktpu_gather.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ktpu_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    return _LIB
+
+
+def write_token_file(path: str, tokens: np.ndarray, dtype=np.uint16) -> None:
+    """Serialize a 1-D token array into the loader's flat binary format.
+    Refuses ids outside the dtype's range — a silent wraparound would
+    produce a corpus that loads fine and trains on scrambled tokens."""
+    tokens = np.asarray(tokens)
+    info = np.iinfo(dtype)
+    if tokens.size and (tokens.min() < info.min or tokens.max() > info.max):
+        raise ValueError(
+            f"token ids outside {np.dtype(dtype).name} range "
+            f"[{info.min}, {info.max}]: min={tokens.min()}, max={tokens.max()}"
+        )
+    np.ascontiguousarray(tokens, dtype=dtype).tofile(path)
+
+
+class TokenFile:
+    """An mmap'd token corpus served by the native loader."""
+
+    def __init__(self, path: str, dtype_bytes: int = 2):
+        if dtype_bytes not in (2, 4):
+            raise ValueError("dtype_bytes must be 2 (uint16) or 4 (uint32)")
+        self._handle = _lib().ktpu_open(path.encode(), dtype_bytes)
+        if not self._handle:
+            raise OSError(f"cannot open token file {path!r}")
+        self.num_tokens = int(_lib().ktpu_num_tokens(self._handle))
+        # GC backstop: a dropped TokenFile must not leak the mmap (a loop
+        # over many shards without close() would exhaust address space)
+        self._finalizer = weakref.finalize(
+            self, _lib().ktpu_close, self._handle
+        )
+
+    def close(self) -> None:
+        if self._handle:
+            self._finalizer.detach()
+            _lib().ktpu_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def gather(self, offsets: np.ndarray, seq: int) -> np.ndarray:
+        """Rows of ``seq`` tokens at the given token offsets -> (n, seq)
+        int32. Out-of-range offsets raise (the C side would skip them —
+        silent row loss is worse than an error)."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        if offsets.ndim != 1:
+            raise ValueError("offsets must be 1-D")
+        if ((offsets < 0) | (offsets + seq > self.num_tokens)).any():
+            raise ValueError("offset window out of range")
+        out = np.empty((len(offsets), seq), np.int32)
+        n = _lib().ktpu_gather(
+            self._handle,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            len(offsets),
+            seq,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if n != len(offsets):
+            raise RuntimeError(f"native gather wrote {n}/{len(offsets)} rows")
+        return out
+
+    def batches(
+        self, batch: int, seq: int, seed: int = 0
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Endless (tokens, targets) int32 batches; targets are tokens
+        shifted by one (seq+1-token windows). Deterministic per seed."""
+        rng = np.random.default_rng(seed)
+        hi = self.num_tokens - (seq + 1)
+        if hi < 0:
+            raise ValueError("corpus shorter than one sequence")
+        while True:
+            offsets = rng.integers(0, hi + 1, size=batch)
+            rows = self.gather(offsets, seq + 1)
+            yield rows[:, :-1], rows[:, 1:]

@@ -165,12 +165,27 @@ class ControllerServer:
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 name = self.path[len("/pods/"):]
-                try:
-                    with controller._lock:
+                with controller._lock:
+                    try:
                         controller.cluster.release(name)
-                    self._reply(200, {"released": name})
-                except KeyError:
+                        out = {"released": name}
+                    except KeyError:
+                        # a preemption/eviction victim waiting in the
+                        # pending queue is deletable too — otherwise the
+                        # next reconcile pass resurrects a pod the
+                        # operator tried to remove
+                        before = len(controller._pending)
+                        controller._pending = [
+                            p for p in controller._pending if p.name != name
+                        ]
+                        if len(controller._pending) < before:
+                            out = {"released": name, "was_pending": True}
+                        else:
+                            out = None
+                if out is None:
                     self._reply(404, {"error": f"no pod {name!r}"})
+                else:
+                    self._reply(200, out)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
 
@@ -224,7 +239,11 @@ class ControllerServer:
         return out
 
     def _pod_name_in_use(self, name: str) -> bool:
-        return any(name in node.pods for node in self.cluster.nodes.values())
+        """Placed anywhere OR waiting in the pending queue — the one
+        authoritative name check for every pod-accepting route."""
+        return any(
+            name in node.pods for node in self.cluster.nodes.values()
+        ) or any(p.name == name for p in self._pending)
 
     def _submit(self, req: dict) -> dict:
         """Place a pod or a gang and run container-start allocation — the
@@ -239,9 +258,7 @@ class ControllerServer:
         if len(set(names)) != len(names):
             raise SchedulingError(f"duplicate pod names in request: {names}")
         for n in names:
-            if self._pod_name_in_use(n) or any(
-                p.name == n for p in self._pending
-            ):
+            if self._pod_name_in_use(n):
                 # a duplicate submit would silently overwrite the placed
                 # record and leak its resources (Cluster.schedule keys
                 # node.pods by name)
@@ -309,9 +326,7 @@ class ControllerServer:
         max_migrations = min(int(req.get("max_migrations", 3)), 5)
         if "pending" in req:
             pending_name = req["pending"].get("name", "")
-            if self._pod_name_in_use(pending_name) or any(
-                p.name == pending_name for p in self._pending
-            ):
+            if self._pod_name_in_use(pending_name):
                 raise SchedulingError(
                     f"pod name {pending_name!r} is already in use"
                 )

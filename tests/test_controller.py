@@ -450,3 +450,30 @@ def test_preemption_submit_restores_victims_on_allocate_failure(stack, monkeypat
         for node in controller.cluster.nodes.values()
     )
     assert status_free == 0  # 8 + 8 held by the restored low pods
+
+
+def test_pending_pod_is_deletable(stack):
+    """An eviction victim waiting in the pending queue must be removable
+    via DELETE — otherwise the next reconcile resurrects it."""
+    controller, _agents = stack
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("low-a", 8))})
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("low-b", 8))})
+    high = tpu_pod("high", 4)
+    high.requests["kubetpu/priority"] = 10
+    out = _post(controller.address + "/pods", {"pod": pod_to_json(high)})
+    victim = out["evicted"][0]
+
+    req = urllib.request.Request(
+        controller.address + f"/pods/{victim}", method="DELETE"
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        body = json.loads(r.read())
+    assert body == {"released": victim, "was_pending": True}
+    assert controller.pending_pods == []
+    # free capacity elsewhere: the deleted pod must NOT come back
+    other = "low-b" if victim == "low-a" else "low-a"
+    req = urllib.request.Request(
+        controller.address + f"/pods/{other}", method="DELETE"
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+    assert controller.poll_once()["rescheduled"] == []

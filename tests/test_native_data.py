@@ -1,0 +1,117 @@
+"""The native data loader: C-speed mmap gather must agree with numpy
+slicing exactly, be deterministic per seed, and fail loudly on bad input."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "_output", "libkubetpu_dataio.so")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def dataio_lib():
+    # unconditional: make's own mtime check rebuilds after loader.cc edits
+    # (an exists() guard would silently test a stale binary)
+    subprocess.run(["make", "-C", REPO, "dataio"], check=True,
+                   capture_output=True)
+    return LIB
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    from kubetpu.jobs.native_data import write_token_file
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 50_000, size=10_000).astype(np.uint16)
+    path = tmp_path / "corpus.bin"
+    write_token_file(str(path), tokens)
+    return str(path), tokens
+
+
+def test_gather_matches_numpy(corpus):
+    from kubetpu.jobs.native_data import TokenFile
+
+    path, tokens = corpus
+    with TokenFile(path) as tf:
+        assert tf.num_tokens == len(tokens)
+        offsets = np.asarray([0, 17, 9000, len(tokens) - 64])
+        rows = tf.gather(offsets, 64)
+        for i, off in enumerate(offsets):
+            np.testing.assert_array_equal(
+                rows[i], tokens[off:off + 64].astype(np.int32)
+            )
+
+
+def test_uint32_corpus(tmp_path):
+    from kubetpu.jobs.native_data import TokenFile, write_token_file
+
+    tokens = np.arange(100_000, 100_500, dtype=np.uint32)
+    path = str(tmp_path / "c32.bin")
+    write_token_file(path, tokens, dtype=np.uint32)
+    with TokenFile(path, dtype_bytes=4) as tf:
+        rows = tf.gather(np.asarray([10]), 5)
+        np.testing.assert_array_equal(rows[0], tokens[10:15].astype(np.int32))
+
+
+def test_batches_shifted_and_deterministic(corpus):
+    from kubetpu.jobs.native_data import TokenFile
+
+    path, _tokens = corpus
+    with TokenFile(path) as tf:
+        it1 = tf.batches(4, 32, seed=7)
+        it2 = tf.batches(4, 32, seed=7)
+        for _ in range(3):
+            t1, y1 = next(it1)
+            t2, y2 = next(it2)
+            np.testing.assert_array_equal(t1, t2)
+            np.testing.assert_array_equal(y1, y2)
+            np.testing.assert_array_equal(t1[:, 1:], y1[:, :-1])  # shift-by-1
+
+
+def test_out_of_range_offsets_raise(corpus):
+    from kubetpu.jobs.native_data import TokenFile
+
+    path, tokens = corpus
+    with TokenFile(path) as tf:
+        with pytest.raises(ValueError):
+            tf.gather(np.asarray([len(tokens) - 3]), 8)
+        with pytest.raises(ValueError):
+            tf.gather(np.asarray([-1]), 8)
+
+
+def test_missing_file_and_bad_dtype(tmp_path):
+    from kubetpu.jobs.native_data import TokenFile
+
+    with pytest.raises(OSError):
+        TokenFile(str(tmp_path / "nope.bin"))
+    with pytest.raises(ValueError):
+        TokenFile(str(tmp_path / "x"), dtype_bytes=3)
+
+
+def test_feeds_the_train_step(corpus):
+    """End to end: native batches drive the real sharded train step."""
+    import jax
+
+    from kubetpu.jobs import ModelConfig, init_state, make_mesh, make_train_step
+    from kubetpu.jobs.native_data import TokenFile
+
+    path, _tokens = corpus
+    cfg = ModelConfig(vocab=50_000, d_model=32, n_layers=1, n_heads=4, d_ff=64)
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt)
+    with TokenFile(path) as tf:
+        for (tokens_np, targets_np), _ in zip(tf.batches(4, 32, seed=1), range(2)):
+            state, loss = step(state, tokens_np, targets_np)
+    assert np.isfinite(float(loss))
+
+
+def test_write_refuses_out_of_range_tokens(tmp_path):
+    from kubetpu.jobs.native_data import write_token_file
+
+    with pytest.raises(ValueError):
+        write_token_file(str(tmp_path / "bad.bin"),
+                         np.asarray([1, 70_000]))  # > uint16 max
