@@ -77,7 +77,10 @@ int ktpu_gather(void* handle, const long long* offsets, int n, int seq,
   int written = 0;
   for (int i = 0; i < n; i++) {
     long long off = offsets[i];
-    if (off < 0 || off + seq > total) continue;
+    // no-overflow form: total >= 0 and seq > 0, so `total - seq` cannot
+    // overflow, while `off + seq` would be UB for off near LLONG_MAX —
+    // a compiler may elide an overflowing check, gutting the backstop
+    if (off < 0 || off > total - seq) continue;
     int32_t* row = out + static_cast<long long>(written) * seq;
     if (h->dtype_bytes == 2) {
       const uint16_t* src = static_cast<const uint16_t*>(h->base) + off;

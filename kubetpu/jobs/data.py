@@ -69,3 +69,48 @@ def prefetch_to_mesh(
         yield ready
     while queue:
         yield queue.popleft()
+
+
+def mlm_batches(
+    corpus: "SyntheticCorpus", batch: int, seq: int,
+    mask_rate: float = 0.15, seed: int = 0,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """(tokens, mask_positions) batches for the encoder's masked-LM
+    objective (``jobs.encoder.make_mlm_train_step``): original tokens plus
+    a bool mask of the positions to corrupt/predict. Deterministic per
+    seed; every row has at least one masked position (an all-unmasked row
+    contributes nothing)."""
+    rng = np.random.RandomState(seed + 1)
+    for tokens, _targets in corpus.batches(batch, seq, seed=seed):
+        mask = rng.rand(batch, seq) < mask_rate
+        none = ~mask.any(axis=1)
+        mask[none, rng.randint(0, seq, size=int(none.sum()))] = True
+        yield tokens, mask
+
+
+class SyntheticImages:
+    """Deterministic labeled images for the ViT family: each class is a
+    distinct low-frequency pattern plus noise — separable enough that a
+    small ViT measurably learns, reproducible from (n_classes, seed)."""
+
+    def __init__(self, image_size: int = 16, channels: int = 3,
+                 n_classes: int = 10, seed: int = 0):
+        self.image_size = image_size
+        self.channels = channels
+        self.n_classes = n_classes
+        rng = np.random.RandomState(seed)
+        self._prototypes = rng.randn(
+            n_classes, image_size, image_size, channels
+        ).astype(np.float32)
+
+    def batches(
+        self, batch: int, seed: int = 0, noise: float = 0.3
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Endless (images (B,H,W,C) float32, labels (B,) int32)."""
+        rng = np.random.RandomState(seed)
+        while True:
+            labels = rng.randint(0, self.n_classes, size=batch)
+            images = self._prototypes[labels] + noise * rng.randn(
+                batch, self.image_size, self.image_size, self.channels
+            ).astype(np.float32)
+            yield images, labels.astype(np.int32)

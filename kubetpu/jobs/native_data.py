@@ -94,6 +94,8 @@ class TokenFile:
         """Rows of ``seq`` tokens at the given token offsets -> (n, seq)
         int32. Out-of-range offsets raise (the C side would skip them —
         silent row loss is worse than an error)."""
+        if not self._handle:
+            raise ValueError("TokenFile is closed")
         offsets = np.ascontiguousarray(offsets, dtype=np.int64)
         if offsets.ndim != 1:
             raise ValueError("offsets must be 1-D")

@@ -110,3 +110,16 @@ def test_mlm_flash_trains_with_sp_mesh():
     mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.15, (4, 32))
     state, loss = step(state, tokens, mask)
     assert np.isfinite(float(loss))
+
+
+def test_mlm_batches_feed_training():
+    from kubetpu.jobs.data import SyntheticCorpus, mlm_batches
+
+    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 2})
+    state, opt = init_state(jax.random.PRNGKey(0), CFG, mesh)
+    step = make_mlm_train_step(CFG, mesh, MASK_ID, optimizer=opt)
+    corpus = SyntheticCorpus(vocab=60)
+    for (tokens, mask), _ in zip(mlm_batches(corpus, 4, 32, seed=3), range(3)):
+        assert mask.any(axis=1).all()  # every row contributes
+        state, loss = step(state, tokens, mask)
+    assert np.isfinite(float(loss))

@@ -115,3 +115,13 @@ def test_write_refuses_out_of_range_tokens(tmp_path):
     with pytest.raises(ValueError):
         write_token_file(str(tmp_path / "bad.bin"),
                          np.asarray([1, 70_000]))  # > uint16 max
+
+
+def test_closed_tokenfile_raises_clearly(corpus):
+    from kubetpu.jobs.native_data import TokenFile
+
+    path, _tokens = corpus
+    tf = TokenFile(path)
+    tf.close()
+    with pytest.raises(ValueError, match="closed"):
+        tf.gather(np.asarray([0]), 4)
