@@ -336,15 +336,26 @@ def _place_rect(
 def find_perfect_block(
     free: Set[Coord], n: int, topo: TpuTopology
 ) -> Optional[List[Coord]]:
-    """An exact rectangular n-chip block within *free*, or None — unlike
-    ``find_contiguous_block`` this never falls back to a fragmented set, so
-    it answers "is a contiguity-1.0 placement possible?" (the
-    defragmentation criterion)."""
+    """A contiguity-1.0 rectangular n-chip block within *free*, or None —
+    unlike ``find_contiguous_block`` this never falls back to a fragmented
+    set, so it answers "is a contiguity-1.0 placement possible?" (the
+    defragmentation criterion). Only shapes whose internal links reach
+    ``max_internal_links`` qualify: a 1x4 line is an exact rectangle but
+    scores 0.75 where a 2x2 fits, and calling it perfect would both let
+    defrag declare victory early and make this function disagree with the
+    score it claims to certify."""
     if n <= 0:
         return []
     if len(free) < n:
         return None
+    ideal = max_internal_links(n, topo)
     for shape in factorizations(n, len(topo.mesh_shape)):
+        # links are translation-invariant on the torus: evaluate the shape
+        # anchored at the origin (cached via lru on host_block_links-style
+        # reuse is unnecessary; factorization lists are tiny)
+        cells = [tuple(c) for c in itertools.product(*(range(d) for d in shape))]
+        if internal_links(cells, topo) != ideal:
+            continue
         block = _place_rect(free, shape, topo)
         if block is not None:
             return sorted(block)

@@ -76,3 +76,144 @@ def test_contiguity_score_bounds_any_subset(chips):
     coords = {topo.index_coord(i) for i in chips}
     s = contiguity_score(coords, topo)
     assert 0.0 <= s <= 1.0
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    topo_name=st.sampled_from(TOPO_NAMES),
+    taken=st.sets(st.integers(min_value=0, max_value=15), max_size=12),
+    n=st.integers(min_value=1, max_value=16),
+)
+def test_perfect_block_is_perfect(topo_name, taken, n):
+    """find_perfect_block never lies: any block it returns has exactly n
+    distinct free coords AND contiguity exactly 1.0; and whenever it finds
+    one, find_contiguous_block must score 1.0 too (it tries perfect
+    first)."""
+    from kubetpu.plugintypes.mesh import find_perfect_block
+
+    topo = TOPOLOGIES[topo_name]
+    all_coords = set(topo.coords())
+    taken_coords = {topo.index_coord(i % topo.num_chips) for i in taken}
+    free = all_coords - taken_coords
+    block = find_perfect_block(set(free), n, topo)
+    if block is None:
+        return
+    assert len(block) == n and len(set(block)) == n
+    assert set(block) <= free
+    assert contiguity_score(block, topo) == 1.0
+    got = find_contiguous_block(set(free), n, topo)
+    assert got is not None and got[1] == 1.0
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    ops=st.lists(
+        st.tuples(st.booleans(), st.integers(min_value=1, max_value=8)),
+        min_size=1, max_size=20,
+    ),
+)
+def test_accounting_invariants_under_random_churn(ops):
+    """Any schedule/release sequence keeps the books exact: per node,
+    free + chips held by placed pods == capacity, and no advertised value
+    ever goes negative."""
+    cluster = Cluster()
+    for i in range(2):
+        cluster.register_node(
+            f"n{i}", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+        )
+    live = []
+    counter = 0
+    for is_schedule, size in ops:
+        if is_schedule or not live:
+            pod = PodInfo(
+                name=f"c{counter}",
+                running_containers={"m": ContainerInfo(requests={ResourceTPU: size})},
+            )
+            counter += 1
+            try:
+                placed = cluster.schedule(pod)
+                live.append(placed.name)
+            except SchedulingError:
+                pass
+        else:
+            cluster.release(live.pop(size % len(live)))
+        for node in cluster.nodes.values():
+            held = sum(
+                len(p.running_containers["m"].allocate_from)
+                for p in node.pods.values()
+            )
+            assert node.info.allocatable[ResourceTPU] + held == 8
+            assert all(v >= 0 for v in node.info.allocatable.values())
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    lows=st.lists(
+        st.tuples(st.integers(min_value=1, max_value=4),
+                  st.integers(min_value=0, max_value=5)),
+        min_size=1, max_size=4,
+    ),
+    high_size=st.integers(min_value=1, max_value=8),
+    high_prio=st.integers(min_value=0, max_value=10),
+)
+def test_preemption_never_drops_pods(lows, high_size, high_prio):
+    """Whatever the sizes/priorities, every pod is either placed, evicted
+    (returned to the caller), or the preemptor raises — nothing vanishes."""
+    from kubetpu.core.cluster import PriorityKey
+
+    cluster = Cluster()
+    cluster.register_node(
+        "n0", device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8"))
+    )
+    placed_lows = []
+    for i, (size, prio) in enumerate(lows):
+        pod = PodInfo(
+            name=f"low{i}",
+            running_containers={"m": ContainerInfo(requests={ResourceTPU: size})},
+        )
+        pod.requests[PriorityKey] = prio
+        try:
+            cluster.schedule(pod)
+            placed_lows.append(pod.name)
+        except SchedulingError:
+            pass
+
+    high = PodInfo(
+        name="high",
+        running_containers={"m": ContainerInfo(requests={ResourceTPU: high_size})},
+    )
+    high.requests[PriorityKey] = high_prio
+    try:
+        placed, evicted = cluster.schedule_preempting(high)
+        survivors = set(cluster.nodes["n0"].pods)
+        assert "high" in survivors
+        accounted = (survivors - {"high"}) | {p.name for p in evicted}
+    except SchedulingError:
+        accounted = set(cluster.nodes["n0"].pods)
+    assert accounted == set(placed_lows)  # every low pod placed or evicted
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    requests=st.dictionaries(
+        st.text(alphabet="abc/0123", min_size=1, max_size=12),
+        st.integers(min_value=0, max_value=1 << 30),
+        max_size=6,
+    ),
+    name=st.text(max_size=10),
+)
+def test_wire_codec_round_trips_any_pod(requests, name):
+    import json as json_lib
+
+    from kubetpu.wire import pod_info_from_json, pod_info_to_json
+
+    pod = PodInfo(
+        name=name,
+        requests=dict(requests),
+        running_containers={"m": ContainerInfo(requests=dict(requests))},
+    )
+    wire = json_lib.loads(json_lib.dumps(pod_info_to_json(pod)))
+    back = pod_info_from_json(wire)
+    assert back.name == name
+    assert back.requests == requests
+    assert back.running_containers["m"].requests == requests
