@@ -123,11 +123,19 @@ def _forward_one(cfg: ModelConfig, params: Params, token, k_cache, v_cache, pos)
     return logits[:, 0], k_cache, v_cache
 
 
-def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache):
+def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache,
+            attn_fn=None):
     """Fill the cache from one batched forward over the whole prompt (a
     single MXU-friendly pass, not a per-token loop), returning last-position
-    logits. tokens: (B, S_prompt)."""
-    logits, ks, vs = model_lib.forward_with_kv(params, tokens, cfg)
+    logits. tokens: (B, S_prompt). *attn_fn* swaps the attention core —
+    pass ``make_ring_attention(mesh)`` (or its flash impl) to shard a LONG
+    prompt's prefill over sp; the cache write then gathers the sharded K/V
+    into the (unsharded-seq) decode cache automatically under GSPMD.
+    NOTE: the ring requires S_prompt to divide evenly by the sp axis size
+    (shard_map partitions the sequence axis) — pad the prompt to a multiple
+    of sp (pad K/V positions are overwritten before any real query can
+    attend them, the serving-bucketing invariant)."""
+    logits, ks, vs = model_lib.forward_with_kv(params, tokens, cfg, attn_fn=attn_fn)
     k_cache = jax.lax.dynamic_update_slice(k_cache, ks.astype(k_cache.dtype),
                                            (0, 0, 0, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, vs.astype(v_cache.dtype),

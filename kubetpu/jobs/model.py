@@ -345,18 +345,25 @@ def forward(
     return logits
 
 
-def forward_with_kv(params: Params, tokens: jnp.ndarray, cfg: ModelConfig):
+def forward_with_kv(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: ModelConfig,
+    attn_fn: Optional[AttnFn] = None,
+):
     """Batched forward that also returns every layer's rotary-embedded K/V
     stacks — the prefill path of the decode cache. Uses the exact same
     block implementation as training (including the MoE dispatch mode), so
-    prefill can never drift from the trained model.
+    prefill can never drift from the trained model. *attn_fn* swaps the
+    causal core — e.g. ring attention over an sp mesh for LONG-context
+    prefill, where the prompt pass is the compute-heavy phase.
 
-    Returns (last-position logits (B, V) float32, ks (L, B, S, H, D),
-    vs (L, B, S, H, D)).
+    Returns (last-position logits (B, V) float32, ks (L, B, S, H_kv, D),
+    vs (L, B, S, H_kv, D)).
     """
     positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
     x = params["embed"][tokens]
-    body = partial(_block_with_aux, cfg, dense_causal_attention, positions)
+    body = partial(_block_with_aux, cfg, attn_fn or dense_causal_attention, positions)
 
     def scan_body(carry, layer):
         x, _aux, k, v = body(carry, layer)

@@ -143,3 +143,35 @@ def test_generate_on_mesh_matches_single_device():
     out_mesh = make_generate(CFG, mesh=mesh)(params, prompt, jax.random.PRNGKey(2), 5)
     out_plain = make_generate(CFG)(params, prompt, jax.random.PRNGKey(2), 5)
     np.testing.assert_array_equal(np.asarray(out_mesh), np.asarray(out_plain))
+
+
+def test_ring_prefill_matches_dense():
+    """Long-context prefill over sp (ring attention filling the decode
+    cache) must produce the same cache and logits as the dense prefill."""
+    from kubetpu.jobs import make_ring_attention
+
+    mesh = make_mesh({"dp": 1, "sp": 4, "tp": 2})
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, CFG.vocab)
+
+    k1, v1 = init_kv_cache(CFG, 2, 40)
+    ring = make_ring_attention(mesh)
+    logits_r, k1, v1 = jax.jit(
+        lambda p, t, k, v: prefill(CFG, p, t, k, v, attn_fn=ring)
+    )(params, tokens, k1, v1)
+
+    k2, v2 = init_kv_cache(CFG, 2, 40)
+    logits_d, k2, v2 = prefill(CFG, params, tokens, k2, v2)
+    np.testing.assert_allclose(np.asarray(logits_r), np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(k1), np.asarray(k2),
+                               rtol=2e-5, atol=2e-6)
+
+    # the ring-prefilled cache decodes identically from there on
+    from kubetpu.jobs.decode import forward_chunk
+
+    nxt = jnp.argmax(logits_r, axis=-1).astype(jnp.int32)
+    lr, _, _ = forward_chunk(CFG, params, nxt[:, None], k1, v1, 32)
+    ld, _, _ = forward_chunk(CFG, params, nxt[:, None], k2, v2, 32)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(ld),
+                               rtol=2e-4, atol=2e-5)
