@@ -309,9 +309,76 @@ def config10(rounds=None):
     }
 
 
+def config11(rounds=None):
+    """adversarial: controller API end-to-end (HTTP submit -> schedule -> wire allocate -> HTTP release) p50/p99 over live agent servers"""
+    import json as json_lib
+    import urllib.request
+
+    from kubetpu.wire import NodeAgentServer
+    from kubetpu.wire.controller import ControllerServer, pod_to_json
+
+    rounds = rounds or 60
+    agents = [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-64", host_index=h)
+            ),
+            f"h{h}",
+        )
+        for h in range(4)
+    ]
+    for a in agents:
+        a.start()
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+
+    def post(path, obj):
+        req = urllib.request.Request(
+            controller.address + path, data=json_lib.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json_lib.loads(r.read())
+
+    def delete(path):
+        req = urllib.request.Request(controller.address + path, method="DELETE")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+
+    try:
+        for a in agents:
+            post("/nodes", {"url": a.address})
+        lat = []
+        for r in range(rounds):
+            pod = pod_to_json(_tpu_pod(f"p{r}", 4))
+            t0 = time.perf_counter()
+            post("/pods", {"pod": pod})
+            lat.append((time.perf_counter() - t0) * 1e3)
+            delete(f"/pods/p{r}")
+        gang_lat = []
+        for r in range(max(3, rounds // 10)):
+            gang = [pod_to_json(_tpu_pod(f"g{r}w{i}", 8)) for i in range(4)]
+            t0 = time.perf_counter()
+            out = post("/pods", {"gang": gang})
+            gang_lat.append((time.perf_counter() - t0) * 1e3)
+            contig = out["gang_contiguity"]
+            for i in range(4):
+                delete(f"/pods/g{r}w{i}")
+        return {
+            "submit": _percentiles(lat),
+            "gang_submit": _percentiles(gang_lat),
+            "gang_contiguity": contig,
+        }
+    finally:
+        controller.shutdown()
+        for a in agents:
+            a.shutdown()
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9, 10: config10}
-TAKES_ROUNDS = {4, 8, 9, 10}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
+           11: config11}
+TAKES_ROUNDS = {4, 8, 9, 10, 11}
 
 
 def main(argv=None) -> int:
