@@ -477,3 +477,36 @@ def test_pending_pod_is_deletable(stack):
     )
     urllib.request.urlopen(req, timeout=10).read()
     assert controller.poll_once()["rescheduled"] == []
+
+
+def test_controller_cli_daemon_end_to_end():
+    """The kubetpu-controller CLI as a REAL process: registers spawned
+    agent processes at startup (skipping a dead URL with a warning instead
+    of crash-looping), serves the API, and schedules over the wire."""
+    import subprocess
+    import sys
+
+    from tests.test_wire import REPO, spawn_agent
+
+    agent_proc, agent_url, agent_name = spawn_agent(0, topo="v5e-8")
+    ctrl = subprocess.Popen(
+        [sys.executable, "-m", "kubetpu.cli.controller",
+         "--agents", agent_url, "http://127.0.0.1:1",  # second one is dead
+         "--port", "0", "--poll-interval", "3600"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO, text=True,
+    )
+    try:
+        hello = json.loads(ctrl.stdout.readline())
+        assert hello["nodes"] == [agent_name]
+        assert hello["skipped"] == ["http://127.0.0.1:1"]
+
+        out = _post(hello["listening"] + "/pods",
+                    {"pod": pod_to_json(tpu_pod("job", 4))})
+        assert out["placements"][0]["node"] == agent_name
+        assert _get(hello["listening"] + "/status")["nodes"][agent_name]["pods"] == ["job"]
+    finally:
+        ctrl.kill()
+        ctrl.wait(timeout=10)
+        if agent_proc.poll() is None:
+            agent_proc.kill()
+        agent_proc.wait(timeout=10)
