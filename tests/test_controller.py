@@ -483,17 +483,22 @@ def test_controller_cli_daemon_end_to_end():
     """The kubetpu-controller CLI as a REAL process: registers spawned
     agent processes at startup (skipping a dead URL with a warning instead
     of crash-looping), serves the API, and schedules over the wire."""
+    import os
     import subprocess
     import sys
 
     from tests.test_wire import REPO, spawn_agent
 
+    # a runner-level KUBETPU_WIRE_TOKEN would enable auth in the spawned
+    # daemon while the helpers below send no token: pin it off
+    env = {**os.environ, "KUBETPU_WIRE_TOKEN": ""}
     agent_proc, agent_url, agent_name = spawn_agent(0, topo="v5e-8")
     ctrl = subprocess.Popen(
         [sys.executable, "-m", "kubetpu.cli.controller",
          "--agents", agent_url, "http://127.0.0.1:1",  # second one is dead
          "--port", "0", "--poll-interval", "3600"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO, text=True,
+        env=env,
     )
     try:
         hello = json.loads(ctrl.stdout.readline())
