@@ -492,7 +492,7 @@ def test_controller_cli_daemon_end_to_end():
     # a runner-level KUBETPU_WIRE_TOKEN would enable auth in the spawned
     # daemon while the helpers below send no token: pin it off
     env = {**os.environ, "KUBETPU_WIRE_TOKEN": ""}
-    agent_proc, agent_url, agent_name = spawn_agent(0, topo="v5e-8")
+    agent_proc, agent_url, agent_name = spawn_agent(0, topo="v5e-8", env=env)
     ctrl = subprocess.Popen(
         [sys.executable, "-m", "kubetpu.cli.controller",
          "--agents", agent_url, "http://127.0.0.1:1",  # second one is dead

@@ -153,7 +153,7 @@ def test_agent_application_error_is_not_node_death(agent_server):
 # -- real agent processes ---------------------------------------------------
 
 
-def spawn_agent(host_index, topo="v5e-64"):
+def spawn_agent(host_index, topo="v5e-64", env=None):
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "kubetpu.cli.agent", "--serve",
@@ -163,6 +163,7 @@ def spawn_agent(host_index, topo="v5e-64"):
         stderr=subprocess.DEVNULL,
         cwd=REPO,
         text=True,
+        env=env,
     )
     line = proc.stdout.readline()
     hello = json.loads(line)
