@@ -37,6 +37,8 @@
 #include <string>
 #include <vector>
 
+#include "../native/json_escape.h"
+
 namespace {
 
 struct Gpu {
@@ -55,6 +57,8 @@ std::string EnvOr(const char* key, const char* fallback) {
   const char* v = getenv(key);
   return v ? std::string(v) : std::string(fallback);
 }
+
+using kubetpu::JsonEscape;
 
 std::string SysfsRoot() { return EnvOr("GPUINFO_SYSFS_ROOT", "/sys"); }
 
@@ -210,25 +214,26 @@ std::vector<Gpu> FakeBox(const std::string& kind) {
 
 void PrintJson(const std::vector<Gpu>& gpus) {
   printf("{\"Version\":{\"Driver\":\"%s\",\"CUDA\":\"%s\"},",
-         EnvOr("GPUINFO_DRIVER_VERSION", "sysfs").c_str(),
-         EnvOr("GPUINFO_CUDA_VERSION", "").c_str());
+         JsonEscape(EnvOr("GPUINFO_DRIVER_VERSION", "sysfs")).c_str(),
+         JsonEscape(EnvOr("GPUINFO_CUDA_VERSION", "")).c_str());
   printf("\"Devices\":[");
   for (size_t i = 0; i < gpus.size(); i++) {
     const Gpu& g = gpus[i];
     if (i) printf(",");
-    printf("{\"UUID\":\"%s\",\"Model\":\"%s\",\"Path\":\"%s\",", g.uuid.c_str(),
-           g.model.c_str(), g.path.c_str());
+    printf("{\"UUID\":\"%s\",\"Model\":\"%s\",\"Path\":\"%s\",",
+           JsonEscape(g.uuid).c_str(), JsonEscape(g.model).c_str(),
+           JsonEscape(g.path).c_str());
     printf("\"Memory\":{\"Global\":%lld},", g.mem_mib);
-    printf("\"PCI\":{\"BusID\":\"%s\",\"Bandwidth\":%d},", g.bus_id.c_str(),
-           g.bandwidth);
+    printf("\"PCI\":{\"BusID\":\"%s\",\"Bandwidth\":%d},",
+           JsonEscape(g.bus_id).c_str(), g.bandwidth);
     if (g.topology.empty()) {
       printf("\"Topology\":null}");
     } else {
       printf("\"Topology\":[");
       for (size_t t = 0; t < g.topology.size(); t++) {
         if (t) printf(",");
-        printf("{\"BusID\":\"%s\",\"Link\":%d}", g.topology[t].first.c_str(),
-               g.topology[t].second);
+        printf("{\"BusID\":\"%s\",\"Link\":%d}",
+               JsonEscape(g.topology[t].first).c_str(), g.topology[t].second);
       }
       printf("]}");
     }

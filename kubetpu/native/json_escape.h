@@ -1,0 +1,45 @@
+// Minimal JSON string escaper shared by the native probes (tpuinfo,
+// gpuinfo): quote, backslash, control chars, and EVERY byte >= 0x7f.
+// Sysfs fixtures feed arbitrary bytes into string fields; a raw quote
+// would break the JSON framing, and a stray non-UTF-8 byte (0xFF in a
+// fixture file) would make the Python json parser reject the whole
+// document. \u00XX-escaping all non-ASCII keeps the output parseable
+// bytes-for-bytes (multibyte UTF-8 arrives latin-1-mangled, which is the
+// right trade for a hardware prober: diagnostics stay readable, framing
+// never breaks).
+#ifndef KUBETPU_NATIVE_JSON_ESCAPE_H_
+#define KUBETPU_NATIVE_JSON_ESCAPE_H_
+
+#include <cstdio>
+#include <string>
+
+namespace kubetpu {
+
+inline std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20 || c >= 0x7f) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace kubetpu
+
+#endif  // KUBETPU_NATIVE_JSON_ESCAPE_H_

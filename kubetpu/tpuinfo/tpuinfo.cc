@@ -45,6 +45,8 @@
 #include <string>
 #include <vector>
 
+#include "../native/json_escape.h"
+
 namespace {
 
 struct Topology {
@@ -288,21 +290,25 @@ ProbeResult FakeProbe(const std::string& topo_name, int host_index,
   return r;
 }
 
+using kubetpu::JsonEscape;
+
 void PrintJson(const ProbeResult& r) {
-  printf("{\"Version\":{\"Runtime\":\"%s\",\"Libtpu\":\"%s\"},", r.runtime.c_str(),
-         r.libtpu.c_str());
+  printf("{\"Version\":{\"Runtime\":\"%s\",\"Libtpu\":\"%s\"},",
+         JsonEscape(r.runtime).c_str(), JsonEscape(r.libtpu).c_str());
   printf("\"Topology\":{\"Type\":\"%s\",\"HostIndex\":%d,\"NumHosts\":%d,\"SliceId\":\"%s\"},",
          r.topo ? r.topo->name : "", r.host_index, r.topo ? NumHosts(*r.topo) : 1,
-         r.slice_id.c_str());
+         JsonEscape(r.slice_id).c_str());
   printf("\"Devices\":[");
   for (size_t i = 0; i < r.chips.size(); i++) {
     const Chip& c = r.chips[i];
     if (i) printf(",");
-    printf("{\"ID\":\"%s\",\"Model\":\"%s\",\"Path\":\"%s\",\"Index\":%d,", c.id.c_str(),
-           c.model.empty() ? "TPU" : c.model.c_str(), c.path.c_str(), c.index);
+    printf("{\"ID\":\"%s\",\"Model\":\"%s\",\"Path\":\"%s\",\"Index\":%d,",
+           JsonEscape(c.id).c_str(),
+           c.model.empty() ? "TPU" : JsonEscape(c.model).c_str(),
+           JsonEscape(c.path).c_str(), c.index);
     if (!c.vendor.empty() || !c.device.empty())
-      printf("\"Pci\":{\"Vendor\":\"%s\",\"Device\":\"%s\"},", c.vendor.c_str(),
-             c.device.c_str());
+      printf("\"Pci\":{\"Vendor\":\"%s\",\"Device\":\"%s\"},",
+             JsonEscape(c.vendor).c_str(), JsonEscape(c.device).c_str());
     printf("\"Memory\":{\"Global\":%lld},", r.topo ? r.topo->hbm_bytes : 0LL);
     printf("\"Coords\":[");
     for (int d = 0; d < c.ndims; d++) {

@@ -108,6 +108,26 @@ def test_sysfs_probe_with_fixture_root(gpuinfo_binary, tmp_path):
     assert links0["0000:85:00.0"] == 1  # cross NUMA
 
 
+def test_sysfs_fixture_with_json_metachars_still_parses(gpuinfo_binary, tmp_path):
+    """A fixture whose device-id carries quotes/backslashes must still emit
+    valid JSON: all string fields are routed through the C++ JsonEscape
+    (ADVICE r2: unescaped interpolation produced malformed JSON)."""
+    d = tmp_path / "bus" / "pci" / "devices" / "0000:05:00.0"
+    d.mkdir(parents=True)
+    (d / "vendor").write_text("0x10de\n")
+    (d / "device").write_text('0xbad"id\\\n')
+    (d / "class").write_text("0x030000\n")
+
+    env = dict(os.environ)
+    env["GPUINFO_SYSFS_ROOT"] = str(tmp_path)
+    env["GPUINFO_DRIVER_VERSION"] = 'drv"ver\\'
+    out = subprocess.run([gpuinfo_binary, "json"], capture_output=True,
+                         check=True, env=env)
+    info = parse_gpus_info(out.stdout)  # must not raise
+    assert len(info.gpus) == 1
+    assert '"id\\' in info.gpus[0].model
+
+
 def test_human_mode_runs(gpuinfo_binary):
     out = subprocess.run([gpuinfo_binary, "--fake", "titan8", "--human"],
                          capture_output=True, check=True)
