@@ -47,7 +47,7 @@ from typing import Dict, List, Optional
 
 from kubetpu.api import utils
 from kubetpu.core import Cluster, SchedulingError
-from kubetpu.core.cluster import pod_priority
+from kubetpu.core.cluster import GangKey, pod_priority
 from kubetpu.wire.codec import (
     allocate_result_to_json,
     pod_info_from_json,
@@ -142,9 +142,11 @@ class ControllerServer:
                         )
                         self._reply(200, {"node": name})
                     elif self.path == "/pods":
-                        req = self._body()
-                        with controller._lock:
-                            out = controller._submit(req)
+                        # _submit manages the lock itself: placement commits
+                        # under it, the per-container agent wire calls run
+                        # OUTSIDE it (a slow-but-alive agent must not freeze
+                        # /status, /nodes, DELETE and the reconcile pass)
+                        out = controller._submit(self._body())
                         self._reply(200, out)
                     elif self.path == "/defrag":
                         req = self._body()
@@ -214,19 +216,28 @@ class ControllerServer:
             self._node_urls[info.name] = url
             return info.name
 
-    def _allocate_existing(self, name: str) -> dict:
-        """Launcher env for a placed pod. The snapshot (pod copy + device)
-        is taken under the lock; the per-container wire calls run outside
-        it, so a slow-but-alive agent cannot freeze the control plane."""
-        with self._lock:
-            for node in self.cluster.nodes.values():
-                placed = node.pods.get(name)
-                if placed is not None:
-                    device = node.device
-                    pod_copy = placed.copy()
-                    break
-            else:
+    def _snapshot_placed(self, name: str, node_name: Optional[str] = None):
+        """(device, pod copy) of a placed pod — caller holds the lock.
+        The copy is what the wire phase works from, so cluster state can
+        keep moving while agent HTTP calls are in flight. Pass *node_name*
+        when known (every just-placed pod carries it): the name-only scan
+        is O(nodes) and runs under the lock."""
+        if node_name is not None:
+            node = self.cluster.nodes.get(node_name)
+            placed = node.pods.get(name) if node is not None else None
+            if placed is None:
                 raise KeyError(name)
+            return node.device, placed.copy()
+        for node in self.cluster.nodes.values():
+            placed = node.pods.get(name)
+            if placed is not None:
+                return node.device, placed.copy()
+        raise KeyError(name)
+
+    @staticmethod
+    def _run_allocations(device, pod_copy) -> dict:
+        """Container-start allocation from a snapshot — wire calls, NO lock
+        held. Mirrors Cluster.allocate's container order."""
         out = {}
         for cname in sorted(pod_copy.init_containers):
             out[cname] = allocate_result_to_json(
@@ -238,6 +249,14 @@ class ControllerServer:
             )
         return out
 
+    def _allocate_existing(self, name: str) -> dict:
+        """Launcher env for a placed pod. The snapshot (pod copy + device)
+        is taken under the lock; the per-container wire calls run outside
+        it, so a slow-but-alive agent cannot freeze the control plane."""
+        with self._lock:
+            device, pod_copy = self._snapshot_placed(name)
+        return self._run_allocations(device, pod_copy)
+
     def _pod_name_in_use(self, name: str) -> bool:
         """Placed anywhere OR waiting in the pending queue — the one
         authoritative name check for every pod-accepting route."""
@@ -245,9 +264,28 @@ class ControllerServer:
             name in node.pods for node in self.cluster.nodes.values()
         ) or any(p.name == name for p in self._pending)
 
+    def _release_if_current(self, placed) -> bool:
+        """Rollback release with IDENTITY revalidation — caller holds the
+        lock. Releases only when the record at this name is still the very
+        placement we made: a DELETE (or DELETE + same-name resubmit) during
+        the lock-free wire phase wins, and our rollback must neither
+        resurrect the deleted pod nor kill the unrelated new one. Returns
+        True when this placement was released."""
+        node = self.cluster.nodes.get(placed.node_name)
+        if node is None or node.pods.get(placed.name) is not placed:
+            return False
+        self.cluster.release(placed.name)
+        return True
+
     def _submit(self, req: dict) -> dict:
         """Place a pod or a gang and run container-start allocation — the
-        caller gets everything a launcher needs. Caller holds the lock.
+        caller gets everything a launcher needs. Manages the lock itself,
+        in three phases (the _allocate_existing pattern, ADVICE r2):
+        placement commits under the lock; the per-container agent wire
+        calls run OUTSIDE it from snapshots; on allocate failure the lock
+        is re-acquired to roll back (release + restore victims). The
+        placement is visible to other routes during the wire phase — a
+        concurrent DELETE wins, and the rollback's release tolerates it.
         All-or-nothing: an allocate failure (e.g. the agent died since
         placement) releases everything placed here before re-raising."""
         if "gang" in req:
@@ -257,58 +295,66 @@ class ControllerServer:
         names = [p.name for p in pods]
         if len(set(names)) != len(names):
             raise SchedulingError(f"duplicate pod names in request: {names}")
-        for n in names:
-            if self._pod_name_in_use(n):
-                # a duplicate submit would silently overwrite the placed
-                # record and leak its resources (Cluster.schedule keys
-                # node.pods by name)
-                raise SchedulingError(f"pod name {n!r} is already in use")
         evicted: List = []
-        if "gang" in req:
-            placed = self.cluster.schedule_gang(pods)
-            contiguity = self.cluster.gang_contiguity(placed)
-        else:
-            contiguity = None
-            if pod_priority(pods[0]) > 0:
-                # the priority pseudo-resource opts the pod into preemption
-                # (no separate schedule try: schedule_preempting already
-                # places without evicting when the pod fits plainly);
-                # victims join the pending queue and re-place automatically
-                # on the next reconcile pass, wherever capacity allows
-                placed_pod, evicted = self.cluster.schedule_preempting(pods[0])
-                placed = [placed_pod]
-                self._pending.extend(evicted)
+        with self._lock:
+            for n in names:
+                if self._pod_name_in_use(n):
+                    # a duplicate submit would silently overwrite the placed
+                    # record and leak its resources (Cluster.schedule keys
+                    # node.pods by name)
+                    raise SchedulingError(f"pod name {n!r} is already in use")
+            if "gang" in req:
+                placed = self.cluster.schedule_gang(pods)
+                contiguity = self.cluster.gang_contiguity(placed)
             else:
-                placed = [self.cluster.schedule(pods[0])]
+                contiguity = None
+                if pod_priority(pods[0]) > 0:
+                    # the priority pseudo-resource opts the pod into
+                    # preemption (no separate schedule try:
+                    # schedule_preempting already places without evicting
+                    # when the pod fits plainly); victims join the pending
+                    # queue and re-place automatically on the next
+                    # reconcile pass, wherever capacity allows
+                    placed_pod, evicted = self.cluster.schedule_preempting(pods[0])
+                    placed = [placed_pod]
+                    self._pending.extend(evicted)
+                else:
+                    placed = [self.cluster.schedule(pods[0])]
+            snapshots = [
+                (p, *self._snapshot_placed(p.name, p.node_name))
+                for p in placed
+            ]
         evicted_names = [p.name for p in evicted]
         out = {"placements": []}
         try:
-            for p in placed:
-                alloc = self.cluster.allocate(p.name)
+            for p, device, pod_copy in snapshots:
                 out["placements"].append({
                     "pod": p.name,
                     "node": p.node_name,
-                    "containers": {
-                        c: allocate_result_to_json(r) for c, r in alloc.items()
-                    },
+                    "containers": self._run_allocations(device, pod_copy),
                 })
         except Exception:
             # all-or-nothing INCLUDING preemption: release what this request
             # placed, then put the victims back where they were — a failed
             # submit must not disrupt running workloads
-            node = placed[0].node_name if placed else ""
-            for p in placed:
-                try:
-                    self.cluster.release(p.name)
-                except KeyError:
-                    pass
-            if evicted:
-                self._pending = [
-                    p for p in self._pending if p.name not in evicted_names
-                ]
-                lost = self.cluster._restore_pods(evicted, node)
-                for p in lost:  # could not restore: keep for reconcile
-                    self._pending.append(p)
+            with self._lock:
+                node = placed[0].node_name if placed else ""
+                for p in placed:
+                    self._release_if_current(p)
+                if evicted:
+                    self._pending = [
+                        p for p in self._pending if p.name not in evicted_names
+                    ]
+                    # a victim the reconcile pass already re-placed during
+                    # the wire phase must not be restored AGAIN (double
+                    # placement); _pod_name_in_use now sees only placements
+                    # (the pending entries were just filtered out)
+                    to_restore = [
+                        p for p in evicted if not self._pod_name_in_use(p.name)
+                    ]
+                    lost = self.cluster._restore_pods(to_restore, node)
+                    for p in lost:  # could not restore: keep for reconcile
+                        self._pending.append(p)
             raise
         if contiguity is not None:
             out["gang_contiguity"] = contiguity
@@ -413,41 +459,99 @@ class ControllerServer:
             for name, fresh in probed.items():
                 if name in self.cluster.nodes:
                     self.cluster.refresh_node(name, probed=fresh)
-            rescheduled, still_pending = [], []
-            for pod in self._pending:
+            # Phase 1 (under the lock): commit placements and snapshot; pods
+            # that fit nowhere stay pending. Placed pods leave _pending NOW
+            # so a concurrent DELETE sees them as placed, not pending.
+            to_allocate, still_pending = [], []
+            pending, consumed = list(self._pending), set()
+            for i, pod in enumerate(pending):
+                if i in consumed:
+                    continue
+                slice_filter = self.cluster.gang_slice_filter(pod)
+                gid = pod.requests.get(GangKey)
+                if gid and slice_filter is None:
+                    # FULLY-evicted gang (no placed mates pin a slice):
+                    # gather every pending member and re-place atomically
+                    # via schedule_gang. Member-by-member would let the
+                    # first land on a slice too small for the whole gang,
+                    # pinning its mates to pend forever while it holds
+                    # chips (ADVICE r2).
+                    idxs = [
+                        j for j in range(i, len(pending))
+                        if j not in consumed
+                        and pending[j].requests.get(GangKey) == gid
+                    ]
+                    consumed.update(idxs)
+                    members = [pending[j] for j in idxs]
+                    try:
+                        placed_members = self.cluster.schedule_gang(members)
+                    except SchedulingError:
+                        still_pending.extend(members)
+                        continue
+                    orig = {m.name: m for m in members}
+                    for placed in placed_members:
+                        # schedule_gang stamped a FRESH gang id on the
+                        # placed copies; propagate it to the templates so a
+                        # member re-pended by an allocate failure still
+                        # finds its (re-stamped) mates and keeps the
+                        # single-slice affinity
+                        orig[placed.name].requests[GangKey] = (
+                            placed.requests[GangKey]
+                        )
+                        to_allocate.append((
+                            orig[placed.name], placed,
+                            *self._snapshot_placed(placed.name, placed.node_name),
+                        ))
+                    continue
+                consumed.add(i)
                 try:
-                    # gang members re-place ONLY within their surviving
+                    # surviving-gang members re-place ONLY within their
                     # mates' slice — an unconstrained reschedule would
                     # silently straddle the gang over DCN, the exact
                     # failure schedule_gang refuses (core gang invariant)
-                    placed = self.cluster.schedule(
-                        pod, self.cluster.gang_slice_filter(pod)
+                    placed = self.cluster.schedule(pod, slice_filter)
+                    to_allocate.append(
+                        (pod, placed,
+                         *self._snapshot_placed(placed.name, placed.node_name))
                     )
-                    alloc = self.cluster.allocate(placed.name)
-                    rescheduled.append({
-                        "pod": placed.name,
-                        "node": placed.node_name,
-                        "containers": {
-                            c: allocate_result_to_json(r)
-                            for c, r in alloc.items()
-                        },
-                    })
                 except SchedulingError:
                     still_pending.append(pod)
-                except Exception as e:  # noqa: BLE001 — allocate leg died
-                    utils.errorf("allocate after reschedule failed for %s: %s",
-                                 pod.name, e)
-                    try:
-                        self.cluster.release(pod.name)
-                    except KeyError:
-                        pass
-                    still_pending.append(pod)
             self._pending = still_pending
-            return {
-                "failed_nodes": sorted(failed),
-                "rescheduled": rescheduled,
-                "pending": [p.name for p in self._pending],
-            }
+            failed = sorted(failed)
+
+        # Phase 2 (NO lock): the per-container agent wire calls — a
+        # slow-but-alive agent must not freeze the operator API for
+        # timeout x containers (ADVICE r2).
+        rescheduled, rollbacks = [], []
+        for pod, placed, device, pod_copy in to_allocate:
+            try:
+                rescheduled.append({
+                    "pod": placed.name,
+                    "node": placed.node_name,
+                    "containers": self._run_allocations(device, pod_copy),
+                })
+            except Exception as e:  # noqa: BLE001 — allocate leg died
+                utils.errorf("allocate after reschedule failed for %s: %s",
+                             pod.name, e)
+                rollbacks.append((pod, placed))
+
+        # Phase 3 (under the lock): roll back failed allocations with
+        # IDENTITY revalidation — a pod the operator DELETEd (or DELETEd
+        # and resubmitted under the same name) during phase 2 must be
+        # neither resurrected into the pending queue nor have the new
+        # same-name pod released out from under it.
+        if rollbacks:
+            with self._lock:
+                for pod, placed in rollbacks:
+                    if self._release_if_current(placed):
+                        self._pending.append(pod)
+        with self._lock:
+            pending_names = [p.name for p in self._pending]
+        return {
+            "failed_nodes": failed,
+            "rescheduled": rescheduled,
+            "pending": pending_names,
+        }
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
