@@ -16,9 +16,8 @@ from kubetpu.api.types import DeviceGroupPrefix, NodeInfo, PodInfo
 from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import GPU
 from kubetpu.scheduler.translate import (
-    pod_device_count,
     pod_wants_device,
-    set_device_reqs,
+    prepare_pod,
     translate_device_resources,
     translate_pod_device_resources,
 )
@@ -56,20 +55,11 @@ class GpuScheduler(DeviceScheduler):
     def pod_fits_device(
         self, node_info: NodeInfo, pod_info: PodInfo, fill_allocate_from: bool
     ) -> FitResult:
-        # Scalar pre-filter before translation (same rationale as
-        # TpuScheduler.pod_fits_device: don't synthesize topology keys for a
-        # node that can't fit the count anyway).
-        for cont in list(pod_info.init_containers.values()) + list(
-            pod_info.running_containers.values()
-        ):
-            set_device_reqs(GPU, cont)
-        want = pod_device_count(GPU, pod_info)
-        if want == 0 and not any(
-            GPU.any_base_re.match(k)
-            for cont in list(pod_info.running_containers.values())
-            + list(pod_info.init_containers.values())
-            for k in cont.dev_requests
-        ):
+        # Pod-memoized shaping + scalar pre-filter before translation (same
+        # rationale as TpuScheduler.pod_fits_device: per-node work only for
+        # nodes that can actually host the pod).
+        want, has_base = prepare_pod(GPU, pod_info)
+        if want == 0 and not has_base:
             # TPU-only pod: GPU translation would be a no-op — skip the
             # per-node key scan entirely (see TpuScheduler.pod_fits_device).
             return True, [], 0.0

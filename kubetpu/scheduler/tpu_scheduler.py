@@ -20,9 +20,8 @@ from kubetpu.plugintypes.mesh import find_contiguous_block
 from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import TPU
 from kubetpu.scheduler.translate import (
-    pod_device_count,
     pod_wants_device,
-    set_device_reqs,
+    prepare_pod,
     translate_device_resources,
     translate_pod_device_resources,
 )
@@ -100,24 +99,18 @@ class TpuScheduler(DeviceScheduler):
         """Translate the pod's requests (reference PodFitsDevice,
         gpu_scheduler.go:34-44), then rank by achievable ICI contiguity.
 
-        A scalar pre-filter runs before the translation: a node whose free
-        scalar count can't cover the pod is rejected without synthesizing
-        topology keys — the predicate runs per (pod x node) and busy nodes
-        dominate large clusters (SURVEY.md §7 <100 ms p50)."""
-        for cont in list(pod_info.init_containers.values()) + list(
-            pod_info.running_containers.values()
-        ):
-            set_device_reqs(TPU, cont)
-        want = pod_device_count(TPU, pod_info)
-        if want == 0 and not any(
-            TPU.any_base_re.match(k)
-            for cont in list(pod_info.running_containers.values())
-            + list(pod_info.init_containers.values())
-            for k in cont.dev_requests
-        ):
+        Rejection is ordered cheapest-first — the predicate runs per
+        (pod x node) and failing nodes dominate large clusters (SURVEY.md
+        §7 <100 ms p50): (1) pod-memoized request shaping (prepare_pod —
+        pod-invariant, computed once per sweep, not once per node); (2)
+        scalar free-count check; (3) mesh geometry (per-n fit cache on the
+        node's mesh state); (4) only for nodes that can actually host the
+        pod, the grouped-key translation."""
+        want, has_base = prepare_pod(TPU, pod_info)
+        if want == 0 and not has_base:
             # No TPUs requested and no stale TPU keys to strip: translation
-            # would be a no-op — skip it (the predicate runs per pod x node;
-            # GPU-only pods must not pay the TPU translation on every node).
+            # would be a no-op — skip it (GPU-only pods must not pay the
+            # TPU translation on every node).
             return True, [], 0.0
         if want > 0 and node_info.allocatable.get(TPU.resource_name, 0) < want:
             reason = PredicateFailureReason(
@@ -127,12 +120,11 @@ class TpuScheduler(DeviceScheduler):
                 message="insufficient free TPU chips",
             )
             return False, [reason], 0.0
-        err, found = translate_pod_device_resources(TPU, self._cache, node_info, pod_info)
-        if err is not None or not found:
-            return False, [], 0.0
-        # (translation never changes the scalar count: want still holds)
         fits, score = self._mesh_fit(node_info, want)
         if not fits:
+            # fragmented node: reject on cached geometry BEFORE paying the
+            # translation — the saturated/fragmented full-sweep worst case
+            # is built from exactly these rejections
             reason = PredicateFailureReason(
                 resource_name=TPU.resource_name,
                 requested=want,
@@ -140,6 +132,10 @@ class TpuScheduler(DeviceScheduler):
                 message="insufficient free ICI-contiguous TPU chips",
             )
             return False, [reason], 0.0
+        err, found = translate_pod_device_resources(TPU, self._cache, node_info, pod_info)
+        if err is not None or not found:
+            return False, [], 0.0
+        # (translation never changes the scalar count: want still holds)
         return True, [], score
 
     def pod_allocate(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
