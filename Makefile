@@ -10,14 +10,14 @@ all: tpuinfo gpuinfo dataio
 .PHONY: tpuinfo
 tpuinfo: $(BUILD_DIR)/tpuinfo
 
-$(BUILD_DIR)/tpuinfo: kubetpu/tpuinfo/tpuinfo.cc
+$(BUILD_DIR)/tpuinfo: kubetpu/tpuinfo/tpuinfo.cc kubetpu/native/json_escape.h
 	@mkdir -p $(BUILD_DIR)
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
 .PHONY: gpuinfo
 gpuinfo: $(BUILD_DIR)/gpuinfo
 
-$(BUILD_DIR)/gpuinfo: kubetpu/gpuinfo/gpuinfo.cc
+$(BUILD_DIR)/gpuinfo: kubetpu/gpuinfo/gpuinfo.cc kubetpu/native/json_escape.h
 	@mkdir -p $(BUILD_DIR)
 	$(CXX) $(CXXFLAGS) -o $@ $<
 
@@ -42,7 +42,7 @@ schedsim:
 
 .PHONY: bench-adversarial
 bench-adversarial:
-	python -m kubetpu.cli.schedsim --config 8 9 10 11
+	python -m kubetpu.cli.schedsim --config 8 9 10 11 12
 
 .PHONY: demo
 demo:

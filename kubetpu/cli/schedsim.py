@@ -375,10 +375,88 @@ def config11(rounds=None):
             a.shutdown()
 
 
+def config12(rounds=None):
+    """adversarial: 2000-node full-sweep worst case — no perfect node anywhere (saturated scalar sweep, fragmented geometry sweep, needle-at-the-end placement): p50/p99 per sweep kind"""
+    import re
+
+    rounds = rounds or 15
+    n_nodes = 2000
+    c = Cluster()
+    for i in range(n_nodes):
+        c.register_node(
+            f"n{i:04d}",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-8", slice_uid=f"frag{i}")
+            ),
+        )
+    # Fragment EVERY node so a 4-chip pod never finds a contiguous block:
+    # hold chips {0,2,3,5} of the 2x4 grid, leaving free {1,4,6,7} —
+    # 4 free chips (scalar check passes) whose coords ((0,1),(1,0),(1,2),
+    # (1,3)) have no contiguous 4-set. The sweep must therefore visit all
+    # 2000 nodes and reject each on GEOMETRY — the documented worst case
+    # (BASELINE.md "no perfect node anywhere").
+    chip_re = re.compile(r"/tpu/(\d+)/cards$")
+    keep_free = {1, 4, 6, 7}
+    t0 = time.perf_counter()
+    for i in range(n_nodes):
+        name = f"n{i:04d}"
+        held = []
+        for s in range(8):
+            p = c.schedule(_tpu_pod(f"h{i}-{s}", 1), lambda n, nn=name: n == nn)
+            key = next(iter(p.running_containers["main"].allocate_from))
+            chip = int(chip_re.search(key).group(1))
+            held.append((chip, p.name))
+        for chip, pname in held:
+            if chip in keep_free:
+                c.release(pname)
+    setup_s = time.perf_counter() - t0
+
+    frag_lat = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        try:
+            c.schedule(_tpu_pod(f"f{r}", 4))
+            raise RuntimeError("fragmented cluster unexpectedly fit a 4-chip pod")
+        except SchedulingError:
+            frag_lat.append((time.perf_counter() - t0) * 1e3)
+
+    # saturated-style sweep: the request exceeds every node's capacity, so
+    # each node rejects on the SCALAR pre-filter alone
+    sat_lat = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        try:
+            c.schedule(_tpu_pod(f"s{r}", 9))
+        except SchedulingError:
+            sat_lat.append((time.perf_counter() - t0) * 1e3)
+
+    # needle at the end: ONE pristine node sorting last — the sweep scans
+    # all 2000 fragmented nodes, then places on the needle (and must reach
+    # it: perfect-score early exit only fires when the node is seen)
+    c.register_node(
+        "zz-needle",
+        device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8", slice_uid="needle")),
+    )
+    needle_lat = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        p = c.schedule(_tpu_pod(f"z{r}", 4))
+        needle_lat.append((time.perf_counter() - t0) * 1e3)
+        assert p.node_name == "zz-needle"
+        c.release(p.name)
+    return {
+        "nodes": n_nodes,
+        "setup_s": round(setup_s, 2),
+        "fragmented_sweep": _percentiles(frag_lat),
+        "saturated_sweep": _percentiles(sat_lat),
+        "needle_placement": _percentiles(needle_lat),
+    }
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11}
-TAKES_ROUNDS = {4, 8, 9, 10, 11}
+           11: config11, 12: config12}
+TAKES_ROUNDS = {4, 8, 9, 10, 11, 12}
 
 
 def main(argv=None) -> int:
