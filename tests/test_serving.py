@@ -113,3 +113,52 @@ def test_bucketed_prefill_exact_for_same_bucket_lengths():
     server.drain()
     for rid, p in zip(rids, prompts):
         assert server.result(rid) == plain_greedy(params, p, 4)
+
+
+def test_enqueue_admits_at_step_boundary_without_blocking():
+    """The non-blocking admission path: enqueue never blocks the caller,
+    queued requests enter free slots at the next step boundary, active
+    streams keep emitting meanwhile, and every request still matches its
+    dedicated greedy decode exactly."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    server = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=5)
+    server.warmup()  # no live request pays a compile
+
+    pa, pb, pc = [3, 14, 15], [26, 5], [35, 8, 9, 7]
+    ra = server.submit(pa)
+    # both further requests are queued instantly — no free-slot check, no
+    # prefill on the caller's clock
+    rb = server.enqueue(pb)
+    rc = server.enqueue(pc)
+    assert server.queued() == 2
+    assert not server.finished(rb)
+
+    out = server.step()      # admits b (one slot free), advances a and b
+    assert ra in out and rb in out
+    assert server.queued() == 1  # c still waits: both slots busy
+    server.drain()           # c admitted when a slot frees; all complete
+
+    for rid, p in ((ra, pa), (rb, pb), (rc, pc)):
+        assert server.finished(rid)
+        assert server.result(rid) == plain_greedy(params, p, 5)
+
+    stats = server.metrics_summary()
+    assert stats["admission_stall"]["count"] == 3  # a (submit), b, c
+    assert stats["step"]["count"] >= 5
+    assert stats["admission_stall"]["p50_ms"] >= 0
+
+
+def test_warmup_precompiles_every_bucket():
+    """After warmup, admissions hit cached executables: no admission may
+    take compile-scale time (compiles are >100x a cached dispatch)."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    server = DecodeServer(CFG, params, n_slots=2, max_seq=32, max_new_tokens=3)
+    server.warmup()
+    # buckets 1..32 are warm: time admissions across three bucket sizes
+    for p in ([4], [4, 5, 6], [1] * 9):
+        server.submit(p)
+        server.drain()
+    stats = server.metrics_summary()["admission_stall"]
+    assert stats["count"] == 3
+    # a compile on this config costs seconds; warmed dispatch is ms-scale
+    assert stats["p99_ms"] < 1000
