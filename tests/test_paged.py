@@ -1,0 +1,160 @@
+"""Paged KV cache: greedy decode through the page pool must match the
+dense-cache server EXACTLY (same math, different memory layout), pool
+memory must track live tokens, and exhaustion must park — not corrupt —
+requests (VERDICT r2 weak #4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer, init_page_pool
+from kubetpu.jobs.serving import DecodeServer
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def test_paged_greedy_parity_with_dense_server(params):
+    """Identical tokens from the paged and dense servers for staggered
+    requests crossing page boundaries mid-decode."""
+    prompts = [[3, 14, 15, 9, 2, 6], [26, 5], [35, 8, 9, 7, 9, 3, 2, 1, 4]]
+    dense = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=12)
+    paged = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                              max_new_tokens=12, page_size=8)
+
+    results = {}
+    for server, tag in ((dense, "dense"), (paged, "paged")):
+        ra = server.submit(prompts[0])
+        server.step()
+        rb = server.submit(prompts[1])
+        server.drain()
+        rc = server.submit(prompts[2])
+        server.drain()
+        results[tag] = [server.result(r) for r in (ra, rb, rc)]
+    assert results["paged"] == results["dense"]
+
+
+def test_page_accounting_tracks_live_tokens(params):
+    """pages_in_use == worst-case reservation while live; 0 after retire —
+    and the pool is provisioned below the dense equivalent."""
+    ps = 8
+    server = PagedDecodeServer(CFG, params, n_slots=4, max_seq=64,
+                               max_new_tokens=4, page_size=ps)
+    dense_equivalent_pages = 4 * (64 // ps)
+    assert server.pool_pages < dense_equivalent_pages
+
+    prompt = [1, 2, 3, 4, 5]
+    rid = server.submit(prompt)
+    worst = len(prompt) + 4 + 1
+    expect = (worst + ps - 1) // ps
+    assert server.pages_in_use() == expect
+    server.drain()
+    assert server.finished(rid)
+    assert server.pages_in_use() == 0  # retired slot returned its pages
+
+
+def test_pool_exhaustion_parks_requests_without_corruption(params):
+    """When the pool cannot cover a request's worst case, submit returns
+    None / the queue parks — and once capacity frees, the parked request
+    decodes to exactly the dense-server tokens."""
+    ps = 8
+    # pool with room for ONE worst-case request only
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                               max_new_tokens=8, page_size=ps, n_pages=3)
+    pa, pb = [7, 8, 9, 1], [11, 12, 13]
+    ra = server.submit(pa)
+    assert ra is not None
+    assert server.submit(pb) is None          # slots free, pages are not
+    rb = server.enqueue(pb)                   # parks in the queue
+    out = server.step()
+    assert rb not in out                      # still parked: pool full
+    server.drain()                            # a finishes -> pages free -> b runs
+    assert server.finished(ra) and server.finished(rb)
+
+    dense = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=8)
+    for rid, p in ((ra, pa), (rb, pb)):
+        d = dense.submit(p)
+        dense.drain()
+        assert server.result(rid) == dense.result(d)
+
+
+def test_warmup_and_queue_admission(params):
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=32,
+                               max_new_tokens=3, page_size=8)
+    server.warmup()
+    rids = [server.enqueue([i + 1, i + 2]) for i in range(3)]
+    server.drain()
+    assert all(server.finished(r) for r in rids)
+    stats = server.metrics_summary()
+    assert stats["admission_stall"]["count"] == 3
+    assert server.pages_in_use() == 0
+
+
+def test_pool_smaller_than_worst_case_rejects_up_front(params):
+    """A request whose worst case exceeds the WHOLE pool must raise at
+    enqueue/submit — accepted-but-never-admittable would park the queue
+    head forever and starve everything behind it."""
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                               max_new_tokens=8, page_size=8, n_pages=2)
+    with pytest.raises(ValueError, match="pool"):
+        server.enqueue([1] * 10)   # needs 3 pages worst-case, pool has 2
+    with pytest.raises(ValueError, match="pool"):
+        server.submit([1] * 10)
+    # a coverable request still flows
+    rid = server.submit([1, 2])
+    server.drain()
+    assert server.finished(rid)
+
+
+def test_pool_shapes():
+    k, v = init_page_pool(CFG, n_pages=10, page_size=8)
+    assert k.shape == (CFG.n_layers, 10, 8, CFG.kv_heads, CFG.head_dim)
+    assert v.shape == k.shape
+
+
+def test_pallas_kernel_matches_xla_attend(params):
+    """The Pallas paged-attention kernel (interpret mode) must match the
+    XLA gather reference on random pages/tables/positions."""
+    import jax.numpy as jnp
+
+    from kubetpu.jobs.paged import _attend_paged
+    from kubetpu.ops.paged_attention import paged_attention
+
+    b, h, h_kv, d, ps, n_pool, max_pages = 3, 4, 2, 8, 4, 10, 4
+    key = jax.random.PRNGKey(1)
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (b, h, d), jnp.float32)
+    kp = jax.random.normal(k2, (n_pool, ps, h_kv, d), jnp.float32)
+    vp = jax.random.normal(k3, (n_pool, ps, h_kv, d), jnp.float32)
+    table = np.array([
+        [5, 2, 7, -1],
+        [0, -1, -1, -1],
+        [9, 8, 1, 3],
+    ], np.int32)
+    pos = np.array([9, 2, 15], np.int32)  # mid-page, first-page, last slot full
+
+    ref = _attend_paged(q, kp, vp, jnp.asarray(table), jnp.asarray(pos))
+    out = paged_attention(q, kp, vp, jnp.asarray(table), jnp.asarray(pos),
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_server_with_pallas_kernel_parity(params):
+    """End-to-end: the paged server running the Pallas kernel (interpret)
+    produces exactly the dense server's greedy tokens."""
+    prompts = [[3, 14, 15, 9], [26, 5, 1]]
+    dense = DecodeServer(CFG, params, n_slots=2, max_seq=32, max_new_tokens=6)
+    paged = PagedDecodeServer(CFG, params, n_slots=2, max_seq=32,
+                              max_new_tokens=6, page_size=8,
+                              use_kernel=True, interpret=True)
+    outs = {}
+    for server, tag in ((dense, "dense"), (paged, "paged")):
+        rids = [server.submit(p) for p in prompts]
+        server.drain()
+        outs[tag] = [server.result(r) for r in rids]
+    assert outs["paged"] == outs["dense"]
