@@ -156,6 +156,7 @@ def make_train_step(
     optimizer=None,
     use_ring: bool = True,
     attention: Optional[str] = None,
+    jit: bool = True,
 ):
     """Build the jitted full training step: loss -> grads -> adamw update.
 
@@ -164,7 +165,9 @@ def make_train_step(
     hyperparameters. Donates the state buffers (in-place update on device).
     ``attention``: 'ring' (default; sequence-parallel over sp), 'flash'
     (Pallas kernel, for sp=1 meshes), or 'dense'; ``use_ring=False`` is the
-    legacy spelling of 'dense'.
+    legacy spelling of 'dense'. ``jit=False`` returns the raw traced-once
+    body instead, for callers that embed the step in a larger jitted
+    computation (the bench harness loops it inside one ``fori_loop``).
     """
     optimizer = optimizer or make_optimizer()
     if attention is None:
@@ -174,9 +177,12 @@ def make_train_step(
     def loss_fn(params, tokens, targets):
         return model_lib.next_token_loss(params, tokens, targets, cfg, attn_fn)
 
+    step = make_update_step(loss_fn, optimizer)
+    if not jit:
+        return step
     bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
     return jax.jit(
-        make_update_step(loss_fn, optimizer),
+        step,
         in_shardings=(None, bspec, bspec),  # state keeps its own shardings
         donate_argnums=(0,),
     )
