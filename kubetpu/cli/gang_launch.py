@@ -1,0 +1,160 @@
+"""``kubetpu-gang-launch`` — turn a scheduled gang into a REAL
+``jax.distributed`` process group.
+
+The launcher half of the multi-host story (the reference's analog is the
+CRI shim starting containers with the device plugin's env injection,
+nvidia_gpu_manager.go:216-241; kubetpu's controller returns that env over
+the wire). Flow:
+
+1. fetch each gang member's launcher env from the control plane
+   (``GET /pods/<name>`` — the same payload a container runtime would
+   inject);
+2. spawn one ``kubetpu.cli.gang_worker`` OS process per member, rank =
+   position in the gang, with that env;
+3. wait; verify every worker reports the SAME finite loss — the proof the
+   cross-process gradient all-reduce (and therefore the whole env
+   contract: coordinator reachability, rank ordering, device visibility)
+   works end to end.
+
+Single-machine by design (every worker spawns locally): this is the CI /
+smoke path. On a real multi-host slice, run rank i's command on host i —
+the printed ``commands`` list is exactly what to run where.
+
+    python -m kubetpu.cli.gang_launch --controller URL [--token T]
+        [--platform cpu] [--timeout S] POD [POD ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import urllib.request
+from typing import Dict, List, Optional
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fetch_pod_env(controller: str, pod: str, token: Optional[str]) -> Dict[str, str]:
+    """The TPU-bearing container's injected env for a placed pod."""
+    req = urllib.request.Request(controller.rstrip("/") + f"/pods/{pod}")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        body = json.loads(r.read())
+    env: Dict[str, str] = {}
+    for result in body.get("containers", {}).values():
+        cand = result.get("env", {}) if isinstance(result, dict) else {}
+        if cand.get("TPU_VISIBLE_DEVICES"):
+            return dict(cand)
+        env = env or dict(cand)
+    return env
+
+
+def launch_gang(
+    controller: str,
+    pod_names: List[str],
+    token: Optional[str] = None,
+    platform: Optional[str] = None,
+    coordinator_port: Optional[int] = None,
+    timeout: float = 240.0,
+) -> dict:
+    """Spawn one worker process per gang member and collect their reports.
+
+    Returns {"workers": [per-worker report...], "loss": common loss,
+    "commands": the argv each rank ran} — raises RuntimeError when a
+    worker fails or the losses disagree (a broken cross-process psum).
+    """
+    port = coordinator_port or _free_port()
+    # fetch EVERY env before spawning anything: a 404 on a later member
+    # must not leave earlier workers orphaned at the coordinator barrier
+    envs = []
+    for pod in pod_names:
+        env = dict(os.environ)
+        env.update(_fetch_pod_env(controller, pod, token))
+        envs.append(env)
+    procs = []
+    commands: List[List[str]] = []
+    reports = []
+    errors = []
+    try:
+        for rank, env in enumerate(envs):
+            cmd = [
+                sys.executable, "-m", "kubetpu.cli.gang_worker",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", str(len(pod_names)),
+                "--rank", str(rank),
+            ]
+            if platform:
+                cmd += ["--platform", platform]
+            commands.append(cmd)
+            procs.append(subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            ))
+        for rank, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+                errors.append(f"rank {rank}: timeout after {timeout}s")
+                continue
+            if p.returncode != 0:
+                errors.append(
+                    f"rank {rank}: exit {p.returncode}: {err.strip()[-500:]}"
+                )
+                continue
+            lines = [l for l in out.splitlines() if l.startswith("{")]
+            if not lines:
+                errors.append(f"rank {rank}: exit 0 but no JSON report")
+                continue
+            reports.append(json.loads(lines[-1]))
+    finally:
+        for p in procs:  # reap stragglers on any error path
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    if errors:
+        raise RuntimeError("gang launch failed: " + "; ".join(errors))
+    losses = sorted({round(r["loss"], 6) for r in reports})
+    if len(losses) != 1:
+        raise RuntimeError(
+            f"workers disagree on the all-reduced loss: {losses} — the "
+            "cross-process psum is broken"
+        )
+    return {
+        "workers": sorted(reports, key=lambda r: r["process_index"]),
+        "loss": losses[0],
+        "commands": commands,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--controller", required=True, help="controller base URL")
+    ap.add_argument("--token", default=os.environ.get("KUBETPU_WIRE_TOKEN"))
+    ap.add_argument("--platform", default=None,
+                    help="worker platform pin ('cpu' = hardware-free)")
+    ap.add_argument("--timeout", type=float, default=240.0)
+    ap.add_argument("pods", nargs="+", help="gang member pod names, rank order")
+    args = ap.parse_args(argv)
+    out = launch_gang(
+        args.controller, args.pods, token=args.token,
+        platform=args.platform, timeout=args.timeout,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

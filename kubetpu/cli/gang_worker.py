@@ -1,0 +1,63 @@
+"""``kubetpu-gang-worker`` — one gang member as a REAL OS process.
+
+The inside-the-container entry point a launcher starts once per gang pod:
+builds the worker's ``LaunchConfig`` from the injected allocation env
+(``TPU_VISIBLE_DEVICES``/``TPU_WORKER_ID`` — the env the device manager's
+Allocate emitted, SURVEY.md §3.4) plus the gang facts only the launcher
+knows (coordinator address, gang size, this worker's rank), joins the
+``jax.distributed`` process group, and runs one data-parallel train step
+whose gradient all-reduce crosses the process boundary
+(``kubetpu.jobs.launch.run_gang_worker``).
+
+Prints ONE JSON line::
+
+    {"process_index": 0, "process_count": 2, "global_devices": 2,
+     "loss": 5.01}
+
+identical ``loss`` on every member certifies the cross-process psum.
+
+    python -m kubetpu.cli.gang_worker --coordinator HOST:PORT \
+        --num-processes N --rank R [--platform cpu]
+
+``--platform cpu`` is the hardware-free CI path (gloo collectives over
+TCP); on a real multi-host TPU slice omit it and the libtpu backend rides
+ICI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--coordinator", required=True, help="rank-0 host:port")
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--rank", type=int, required=True,
+                    help="gang rank (position in the placed gang, NOT "
+                         "necessarily the host's TPU_WORKER_ID)")
+    ap.add_argument("--platform", default=None,
+                    help="pin a jax platform ('cpu' for hardware-free runs)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from kubetpu.jobs.launch import LaunchConfig, run_gang_worker
+
+    visible = os.environ.get("TPU_VISIBLE_DEVICES", "")
+    local_ids = [int(x) for x in visible.split(",") if x != ""] or [0]
+    config = LaunchConfig(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.rank,
+        local_device_ids=local_ids,
+    )
+    out = run_gang_worker(config, platform=args.platform, seed=args.seed)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

