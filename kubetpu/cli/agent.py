@@ -43,7 +43,24 @@ def _advertisement(dev) -> dict:
 
 
 def _make_device(args):
-    if args.fake:
+    if args.device_class == "gpu":
+        # GPU agents always probe through the native gpuinfo binary (the
+        # reference's nvmlinfo exec boundary); --fake pins a fixture box
+        from kubetpu.device.nvidia import new_native_nvidia_gpu_manager
+
+        extra = ["--fake", args.fake] if args.fake else None
+        dev = new_native_nvidia_gpu_manager(extra_args=extra)
+    elif args.fake and args.native:
+        # REAL exec boundary, fixture topology: tpuinfo --fake ... — the
+        # heterogeneous wire story (BASELINE config 5) runs exactly this
+        from kubetpu.device import new_tpu_dev_manager
+
+        extra = ["--fake", args.fake, "--host", str(args.host),
+                 "--slice", args.slice_uid]
+        if args.missing:
+            extra += ["--missing", args.missing]
+        dev = new_tpu_dev_manager(extra_args=extra)
+    elif args.fake:
         from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
 
         missing = tuple(int(x) for x in args.missing.split(",") if x) if args.missing else ()
@@ -63,7 +80,14 @@ def _make_device(args):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubetpu-agent", description=__doc__)
     ap.add_argument("--fake", metavar="TOPO", default=None,
-                    help="fake backend topology (e.g. v5e-8); default: native probe")
+                    help="fake backend topology (e.g. v5e-8, or titan8/k80x4 "
+                         "with --device-class gpu); default: native probe")
+    ap.add_argument("--device-class", choices=["tpu", "gpu"], default="tpu",
+                    help="which device family this node serves")
+    ap.add_argument("--native", action="store_true",
+                    help="probe through the native binary even in --fake "
+                         "mode (tpuinfo --fake TOPO behind the exec-JSON "
+                         "boundary)")
     ap.add_argument("--host", type=int, default=0)
     ap.add_argument("--slice-uid", default="slice0",
                     help="physical slice uid for the fake backend")
@@ -81,6 +105,20 @@ def main(argv=None) -> int:
     ap.add_argument("--iterations", type=int, default=0,
                     help="stream mode: stop after N refreshes (0 = run forever)")
     args = ap.parse_args(argv)
+
+    if args.device_class == "gpu":
+        # TPU-topology flags silently dropped on the floor would make a
+        # resilience test quietly test the wrong topology — reject them
+        bad = [
+            flag for flag, val, default in (
+                ("--missing", args.missing, ""),
+                ("--native", args.native, False),
+                ("--host", args.host, 0),
+                ("--slice-uid", args.slice_uid, "slice0"),
+            ) if val != default
+        ]
+        if bad:
+            ap.error(f"{', '.join(bad)} not supported with --device-class gpu")
 
     dev = _make_device(args)
 

@@ -45,19 +45,22 @@ def _free_port() -> int:
 
 
 def _fetch_pod_env(controller: str, pod: str, token: Optional[str]) -> Dict[str, str]:
-    """The TPU-bearing container's injected env for a placed pod."""
+    """The device-bearing container's injected env for a placed pod.
+    Raises (via select_device_env) when no container carries a device
+    env — a worker silently launched on default devices would mask the
+    env-contract breakage this launcher exists to certify."""
+    from kubetpu.jobs.launch import select_device_env
+
     req = urllib.request.Request(controller.rstrip("/") + f"/pods/{pod}")
     if token:
         req.add_header("Authorization", f"Bearer {token}")
     with urllib.request.urlopen(req, timeout=30) as r:
         body = json.loads(r.read())
-    env: Dict[str, str] = {}
-    for result in body.get("containers", {}).values():
-        cand = result.get("env", {}) if isinstance(result, dict) else {}
-        if cand.get("TPU_VISIBLE_DEVICES"):
-            return dict(cand)
-        env = env or dict(cand)
-    return env
+    envs = [
+        result.get("env", {}) if isinstance(result, dict) else {}
+        for result in body.get("containers", {}).values()
+    ]
+    return select_device_env(envs)
 
 
 def launch_gang(
@@ -101,13 +104,21 @@ def launch_gang(
                 cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 text=True,
             ))
+        # ONE shared deadline across ranks: a hung coordinator must cost
+        # ~timeout total, not timeout x N (the other ranks are blocked on
+        # the same barrier and die the moment it is gone)
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
         for rank, p in enumerate(procs):
             try:
-                out, err = p.communicate(timeout=timeout)
+                out, err = p.communicate(
+                    timeout=max(1.0, deadline - _time.monotonic())
+                )
             except subprocess.TimeoutExpired:
                 p.kill()
                 out, err = p.communicate()
-                errors.append(f"rank {rank}: timeout after {timeout}s")
+                errors.append(f"rank {rank}: timeout (shared {timeout}s deadline)")
                 continue
             if p.returncode != 0:
                 errors.append(

@@ -55,10 +55,16 @@ def local_block_index(topo: TpuTopology, host_index: int, coord: Tuple[int, ...]
 class TpuDevManager(Device):
     """Manages the local TPU chips (analog of NvidiaGPUManager)."""
 
-    def __init__(self, plugin: Optional[TpuPlugin] = None, tpuinfo_path: Optional[str] = None):
+    def __init__(
+        self,
+        plugin: Optional[TpuPlugin] = None,
+        tpuinfo_path: Optional[str] = None,
+        tpuinfo_args: Optional[List[str]] = None,
+    ):
         self._lock = threading.Lock()
         self._plugin = plugin          # None => exec the native probe
         self._tpuinfo_path = tpuinfo_path
+        self._tpuinfo_args = list(tpuinfo_args or [])
         self.tpus: Dict[str, tputypes.TpuChipInfo] = {}
         self.path_to_id: Dict[str, str] = {}
         self.index_to_id: Dict[int, str] = {}
@@ -93,7 +99,9 @@ class TpuDevManager(Device):
             return tputypes.parse_tpus_info(self._plugin.get_tpu_info())
         now = time.monotonic()
         if self._info is None or (now - self._last_probe_time) > PROBE_CACHE_SECONDS:
-            self._info = tputypes.get_devices(self._tpuinfo_path)
+            self._info = tputypes.get_devices(
+                self._tpuinfo_path, extra_args=self._tpuinfo_args
+            )
             self._last_probe_time = now
         return self._info
 
@@ -226,10 +234,11 @@ class TpuDevManager(Device):
         }
 
 
-def new_tpu_dev_manager() -> Device:
+def new_tpu_dev_manager(extra_args: Optional[List[str]] = None) -> Device:
     """Production manager: probes via the native tpuinfo binary (analog of
-    NewNvidiaGPUManager, :35-38)."""
-    mgr = TpuDevManager()
+    NewNvidiaGPUManager, :35-38). ``extra_args`` pins a fixture box (e.g.
+    ``["--fake", "v5e-8"]``) while keeping the real exec boundary."""
+    mgr = TpuDevManager(tpuinfo_args=extra_args)
     mgr.new()
     return mgr
 

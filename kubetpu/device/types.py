@@ -143,11 +143,19 @@ def dump_tpus_info(info: TpusInfo) -> str:
     )
 
 
-def get_devices(tpuinfo_path: Optional[str] = None, timeout: float = 30.0) -> TpusInfo:
+def get_devices(
+    tpuinfo_path: Optional[str] = None,
+    timeout: float = 30.0,
+    extra_args: Optional[List[str]] = None,
+) -> TpusInfo:
     """Exec the native probe and parse its JSON — the process boundary of
-    reference GetDevices (nvgputypes/types.go:45-58)."""
+    reference GetDevices (nvgputypes/types.go:45-58). ``extra_args`` pins
+    a fixture box (e.g. ``["--fake", "v5e-8"]``) while keeping the REAL
+    exec boundary — how heterogeneous wire tests run a native-probe agent
+    without hardware."""
     path = tpuinfo_path or default_tpuinfo_path()
     output = subprocess.run(
-        [path, "json"], capture_output=True, timeout=timeout, check=True
+        [path, "json", *(extra_args or [])],
+        capture_output=True, timeout=timeout, check=True,
     ).stdout
     return parse_tpus_info(output)

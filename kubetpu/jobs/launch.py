@@ -72,6 +72,23 @@ def launch_config(
     )
 
 
+def select_device_env(envs: Sequence[Mapping[str, str]]) -> Dict[str, str]:
+    """Pick the device-bearing container env out of a pod's per-container
+    allocation results — the ONE place that encodes "the container whose
+    env names visible devices wins; sidecars/init containers may have
+    empty allocations". Raises when no container carries a device env:
+    a gang worker launched without its allocation env would silently run
+    on default devices, masking the very contract breakage the launcher
+    exists to certify."""
+    for cand in envs:
+        if cand.get("TPU_VISIBLE_DEVICES") or cand.get("NVIDIA_VISIBLE_DEVICES"):
+            return dict(cand)
+    raise ValueError(
+        "no container env carries TPU_VISIBLE_DEVICES/NVIDIA_VISIBLE_DEVICES "
+        "— the pod's allocation env is missing or the injection regressed"
+    )
+
+
 def gang_launch_configs(
     cluster, placed_pods, coordinator_port: int = 8476
 ) -> List[LaunchConfig]:
@@ -83,13 +100,7 @@ def gang_launch_configs(
     configs: List[LaunchConfig] = []
     for rank, pod in enumerate(placed_pods):
         results = cluster.allocate(pod.name)
-        # the TPU-bearing container's env carries the device visibility; a
-        # pod may also have init/sidecar containers with empty allocations
-        env: Mapping[str, str] = {}
-        for _, _, cand in results.values():
-            if cand.get("TPU_VISIBLE_DEVICES"):
-                env = cand
-                break
+        env = select_device_env([cand for _, _, cand in results.values()])
         configs.append(launch_config(env, hosts, rank=rank, coordinator_port=coordinator_port))
     return configs
 
@@ -172,5 +183,6 @@ def run_gang_worker(
         "global_devices": world,
         "loss": float(loss),
     }
-    assert jnp.isfinite(loss), f"non-finite gang loss {loss}"
+    if not jnp.isfinite(loss):  # not assert: python -O must not skip this
+        raise RuntimeError(f"non-finite gang loss {loss}")
     return out
