@@ -143,9 +143,19 @@ def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache,
     return logits, k_cache, v_cache
 
 
-def make_generate(cfg: ModelConfig, mesh: Optional[Mesh] = None, temperature: float = 0.0):
+def make_generate(
+    cfg: ModelConfig,
+    mesh: Optional[Mesh] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
     """Jitted generate(params, prompt (B, S_p), rng, num_steps) ->
-    (B, S_p + num_steps) tokens. Greedy when temperature == 0."""
+    (B, S_p + num_steps) tokens. Greedy when temperature == 0; top-k /
+    nucleus truncation compose with temperature (kubetpu.jobs.sampling)."""
+    from kubetpu.jobs.sampling import make_sampler
+
+    sampler = make_sampler(temperature, top_k=top_k, top_p=top_p)
 
     def generate(params, prompt, rng, num_steps: int):
         b, s_prompt = prompt.shape
@@ -162,15 +172,10 @@ def make_generate(cfg: ModelConfig, mesh: Optional[Mesh] = None, temperature: fl
             v_cache = jax.lax.with_sharding_constraint(v_cache, cspec)
         logits, k_cache, v_cache = prefill(cfg, params, prompt, k_cache, v_cache)
 
-        def sample(logits, rng):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(rng, logits / temperature).astype(jnp.int32)
-
         def step(carry, i):
             k_cache, v_cache, prev_logits, rng = carry
             rng, sub = jax.random.split(rng)
-            token = sample(prev_logits, sub)
+            token = sampler(prev_logits, sub)
             logits, k_cache, v_cache = _forward_one(
                 cfg, params, token, k_cache, v_cache, s_prompt + i
             )

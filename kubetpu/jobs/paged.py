@@ -201,8 +201,14 @@ class PagedDecodeServer(SlotServerBase):
         eos_id: Optional[int] = None,
         use_kernel: bool = False,
         interpret: bool = False,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
     ) -> None:
-        super().__init__(cfg, params, n_slots, max_seq, max_new_tokens, eos_id)
+        super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
+                         eos_id, temperature=temperature, top_k=top_k,
+                         top_p=top_p, seed=seed)
         self.page_size = page_size
         self._min_bucket = page_size  # bucket >= one page keeps shapes few
         self.max_pages_per_slot = (max_seq + page_size - 1) // page_size
@@ -221,23 +227,24 @@ class PagedDecodeServer(SlotServerBase):
             attend = partial(paged_attention, interpret=interpret)
 
         cfg_ = cfg
+        sampler = self._sampler
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def step_all(params, k_pages, v_pages, table, last, pos, active):
+        def step_all(params, k_pages, v_pages, table, last, pos, active, rng):
             logits, k_pages, v_pages = paged_forward_one(
                 cfg_, params, last, k_pages, v_pages, table, pos, attend=attend
             )
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = sampler(logits, rng)
             nxt = jnp.where(active, nxt, last)
             pos = pos + active.astype(jnp.int32)
             return k_pages, v_pages, nxt, pos
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_slot(params, k_pages, v_pages, prompt, slot_row, prompt_len):
+        def prefill_slot(params, k_pages, v_pages, prompt, slot_row, prompt_len, rng):
             first, k_pages, v_pages = paged_prefill(
                 cfg_, params, prompt, k_pages, v_pages, slot_row, prompt_len
             )
-            return k_pages, v_pages, jnp.argmax(first).astype(jnp.int32)
+            return k_pages, v_pages, sampler(first, rng)
 
         self._step_all = step_all
         self._prefill_slot = prefill_slot
@@ -310,7 +317,7 @@ class PagedDecodeServer(SlotServerBase):
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(padded, jnp.int32),
             jnp.asarray(self._table[slot]),
-            jnp.int32(len(prompt)),
+            jnp.int32(len(prompt)), self._next_rng(),
         )
         return first
 
@@ -321,7 +328,7 @@ class PagedDecodeServer(SlotServerBase):
         self.k_pages, self.v_pages, nxt, self.pos = self._step_all(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table),
-            self.last, self.pos, jnp.asarray(self.active),
+            self.last, self.pos, jnp.asarray(self.active), self._next_rng(),
         )
         self.last = nxt
         return np.asarray(nxt)
@@ -344,6 +351,7 @@ class PagedDecodeServer(SlotServerBase):
             self.k_pages, self.v_pages, _ = self._prefill_slot(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(padded, jnp.int32), jnp.asarray(row), jnp.int32(1),
+                self._next_rng(),
             )
             if bucket >= self.max_seq:
                 break
@@ -351,5 +359,5 @@ class PagedDecodeServer(SlotServerBase):
         self.k_pages, self.v_pages, _n, _p = self._step_all(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table), self.last, self.pos,
-            jnp.asarray(np.zeros((self.n_slots,), bool)),
+            jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
         )

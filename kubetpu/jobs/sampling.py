@@ -1,0 +1,83 @@
+"""Token sampling for the decode paths: temperature, top-k, nucleus
+(top-p) — TPU-shaped.
+
+Everything here is built to live INSIDE a jitted decode scan: the sampler
+configuration is static (baked at trace time, no data-dependent control
+flow), the shapes are static (top-k via ``lax.top_k``, top-p via a full
+sort + cumulative mask — never a dynamic gather), and the filtering is
+expressed as masking logits to -inf so one ``jax.random.categorical``
+draws from the renormalized distribution implicitly.
+
+``make_sampler`` composes the three filters in the standard order
+(temperature -> top-k -> top-p) and returns ``sample(logits, rng) ->
+tokens`` for ``(..., V)`` logits. Greedy (temperature == 0) bypasses the
+filters entirely — argmax needs none of them.
+
+Reference: none (the reference has no inference stack, SURVEY.md §2);
+semantics follow the de-facto public sampling stack (temperature scaling,
+top-k truncation, nucleus sampling per Holtzman et al.).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask everything below the k-th largest logit to -inf.
+    Static-shape: one lax.top_k for the threshold, then a compare."""
+    if k <= 0:
+        raise ValueError("top_k must be positive")
+    k = min(k, logits.shape[-1])
+    thresh = jax.lax.top_k(logits, k)[0][..., -1:]       # (..., 1)
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest set of tokens whose
+    probabilities sum to >= p (the top token always survives). Full sort +
+    cumulative mask — static shapes, no host control flow."""
+    if not 0.0 < p <= 1.0:
+        raise ValueError("top_p must be in (0, 1]")
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]   # desc
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i (sorted) survives while the mass BEFORE it is < p — the
+    # boundary token that crosses p is kept (standard nucleus semantics)
+    keep_sorted = (cum - probs) < p
+    # threshold = smallest surviving logit; everything below is cut
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+def make_sampler(
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
+    """``sample(logits (..., V), rng) -> tokens (...)`` with the filters
+    baked statically. temperature == 0 is greedy (argmax; rng unused,
+    filters irrelevant — a truncated argmax is still the argmax)."""
+    if temperature < 0:
+        raise ValueError("temperature must be >= 0")
+
+    def sample(logits, rng):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        x = logits.astype(jnp.float32) / temperature
+        if top_k is not None:
+            x = apply_top_k(x, top_k)
+        if top_p is not None:
+            x = apply_top_p(x, top_p)
+        return jax.random.categorical(rng, x).astype(jnp.int32)
+
+    return sample

@@ -88,9 +88,17 @@ class SlotServerBase:
         max_seq: int,
         max_new_tokens: int,
         eos_id: Optional[int],
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
     ) -> None:
+        from kubetpu.jobs.sampling import make_sampler
+
         self.cfg = cfg
         self.params = params
+        self._sampler = make_sampler(temperature, top_k=top_k, top_p=top_p)
+        self._rng = jax.random.PRNGKey(seed)
         self.n_slots = n_slots
         self.max_seq = max_seq
         self.max_new_tokens = max_new_tokens
@@ -108,6 +116,10 @@ class SlotServerBase:
         self._queue: List[Tuple[int, List[int]]] = []  # awaiting a slot
         self._pending_first: Dict[int, object] = {}    # slot -> device scalar
         self._metrics = LatencyRecorder()
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
 
     # -- request lifecycle ---------------------------------------------------
 
@@ -308,17 +320,24 @@ class DecodeServer(SlotServerBase):
         max_seq: int = 512,
         max_new_tokens: int = 64,
         eos_id: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
     ) -> None:
-        super().__init__(cfg, params, n_slots, max_seq, max_new_tokens, eos_id)
+        super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
+                         eos_id, temperature=temperature, top_k=top_k,
+                         top_p=top_p, seed=seed)
         self.k_cache, self.v_cache = init_kv_cache(cfg, n_slots, max_seq)
 
         cfg_ = cfg
+        sampler = self._sampler
 
         # donate_argnums=(1, 2): the caller overwrites self.k_cache/v_cache
         # with the results, so XLA updates the (large) cache buffers in
         # place instead of holding input+output copies live per step
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_slot(params, k_cache, v_cache, prompt, slot, prompt_len):
+        def prefill_slot(params, k_cache, v_cache, prompt, slot, prompt_len, rng):
             # single-sequence chunk forward at pos 0, written into `slot`;
             # `prompt` is bucket-padded (see module docstring) — only
             # prompt_len is real, and the last REAL position's logits pick
@@ -334,17 +353,15 @@ class DecodeServer(SlotServerBase):
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v_s, (0, slot, 0, 0, 0)
             )
-            first = jnp.argmax(
-                jnp.take(logits[0], prompt_len - 1, axis=0)
-            ).astype(jnp.int32)
+            first = sampler(jnp.take(logits[0], prompt_len - 1, axis=0), rng)
             return k_cache, v_cache, first
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def step_all(params, k_cache, v_cache, last, pos, active):
+        def step_all(params, k_cache, v_cache, last, pos, active, rng):
             logits, k_cache, v_cache = forward_chunk_at(
                 cfg_, params, last[:, None], k_cache, v_cache, pos
             )
-            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            nxt = sampler(logits[:, 0], rng)
             nxt = jnp.where(active, nxt, last)     # inactive slots hold
             pos = pos + active.astype(jnp.int32)
             return k_cache, v_cache, nxt, pos
@@ -362,14 +379,14 @@ class DecodeServer(SlotServerBase):
         self.k_cache, self.v_cache, first = self._prefill_slot(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(padded, jnp.int32), jnp.int32(slot),
-            jnp.int32(len(prompt)),
+            jnp.int32(len(prompt)), self._next_rng(),
         )
         return first
 
     def _device_step(self) -> np.ndarray:
         self.k_cache, self.v_cache, nxt, self.pos = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
-            jnp.asarray(self.active),
+            jnp.asarray(self.active), self._next_rng(),
         )
         self.last = nxt
         return np.asarray(nxt)
@@ -391,11 +408,12 @@ class DecodeServer(SlotServerBase):
             self.k_cache, self.v_cache, _ = self._prefill_slot(
                 self.params, self.k_cache, self.v_cache,
                 jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
+                self._next_rng(),
             )
             if bucket >= self.max_seq:
                 break
             bucket *= 2
         self.k_cache, self.v_cache, _nxt, _pos = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
-            jnp.asarray(np.zeros((self.n_slots,), bool)),
+            jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
         )

@@ -23,20 +23,34 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(scope="module")
 def native_binaries():
-    for target in ("tpuinfo", "gpuinfo"):
-        if not os.path.exists(os.path.join(REPO, "_output", target)):
-            subprocess.run(["make", "-C", REPO, target], check=True,
-                           capture_output=True)
+    # unconditional make: a stale prebuilt binary (from before a .cc
+    # change) would otherwise run and fail confusingly; make no-ops when
+    # the artifacts are fresh
+    subprocess.run(["make", "-C", REPO, "tpuinfo", "gpuinfo"], check=True,
+                   capture_output=True)
 
 
 def spawn_agent(extra, env):
+    import selectors
+
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubetpu.cli.agent", "--serve", "--port", "0",
          *extra],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO,
         text=True, env=env,
     )
-    hello = json.loads(proc.stdout.readline())
+    # bounded wait for the hello line; on crash/hang, surface stderr
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    if not sel.select(timeout=30):
+        proc.kill()
+        _, err = proc.communicate()
+        raise AssertionError(f"agent never printed its hello line; stderr:\n{err[-800:]}")
+    line = proc.stdout.readline()
+    if not line.strip():
+        _, err = proc.communicate()
+        raise AssertionError(f"agent exited at startup; stderr:\n{err[-800:]}")
+    hello = json.loads(line)
     return proc, hello["listening"], hello["node"]
 
 

@@ -1,0 +1,108 @@
+"""Sampling (temperature / top-k / nucleus): filter semantics against
+numpy references, and the serving integration — greedy default stays
+token-exact (pinned elsewhere), stochastic samplers stay inside their
+truncated support."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.sampling import apply_top_k, apply_top_p, make_sampler
+
+
+def test_greedy_is_argmax():
+    logits = jnp.asarray([[0.1, 2.0, -1.0], [3.0, 0.0, 2.9]])
+    sample = make_sampler(0.0)
+    out = sample(logits, jax.random.PRNGKey(0))
+    assert out.tolist() == [1, 0]
+
+
+def test_top_k_masks_below_threshold():
+    logits = jnp.asarray([5.0, 4.0, 3.0, 2.0, 1.0])
+    masked = np.asarray(apply_top_k(logits, 2))
+    assert masked[0] == 5.0 and masked[1] == 4.0
+    assert all(m <= -1e29 for m in masked[2:])
+
+
+def test_top_k_draws_stay_in_support():
+    logits = jnp.asarray([2.0, 1.9, 1.8, -1.0, -2.0, -3.0])
+    sample = make_sampler(1.0, top_k=3)
+    keys = jax.random.split(jax.random.PRNGKey(1), 200)
+    draws = {int(sample(logits, k)) for k in keys}
+    assert draws <= {0, 1, 2} and len(draws) > 1
+
+
+def test_top_p_keeps_nucleus_and_boundary_token():
+    # probs ~ [0.6, 0.3, 0.06, ...]: p=0.8 keeps token0 (0.6 < 0.8) and
+    # token1 (the boundary crosser); token2 onward must be cut
+    logits = jnp.log(jnp.asarray([0.60, 0.30, 0.06, 0.03, 0.01]))
+    masked = np.asarray(apply_top_p(logits, 0.8))
+    assert masked[0] > -1e29 and masked[1] > -1e29
+    assert all(m <= -1e29 for m in masked[2:])
+
+
+def test_top_p_one_is_identity():
+    logits = jnp.asarray([1.0, 0.5, -0.5])
+    np.testing.assert_array_equal(np.asarray(apply_top_p(logits, 1.0)),
+                                  np.asarray(logits))
+
+
+def test_top_p_always_keeps_top_token():
+    # a spiked distribution with tiny p must still keep the argmax
+    logits = jnp.asarray([10.0, 0.0, -5.0])
+    sample = make_sampler(1.0, top_p=0.01)
+    keys = jax.random.split(jax.random.PRNGKey(2), 50)
+    draws = {int(sample(logits, k)) for k in keys}
+    assert draws == {0}
+
+
+def test_sampler_batched_shapes():
+    logits = jax.random.normal(jax.random.PRNGKey(3), (4, 7, 32))
+    sample = make_sampler(0.7, top_k=5, top_p=0.9)
+    out = sample(logits, jax.random.PRNGKey(4))
+    assert out.shape == (4, 7) and out.dtype == jnp.int32
+
+
+def test_invalid_params_rejected():
+    with pytest.raises(ValueError):
+        make_sampler(-1.0)
+    with pytest.raises(ValueError):
+        apply_top_k(jnp.zeros((3,)), 0)
+    with pytest.raises(ValueError):
+        apply_top_p(jnp.zeros((3,)), 0.0)
+
+
+def test_generate_with_sampling_is_seeded_and_valid():
+    from kubetpu.jobs.decode import make_generate
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[3, 14, 15]], jnp.int32)
+    gen = make_generate(cfg, temperature=0.9, top_k=8, top_p=0.95)
+    a = np.asarray(gen(params, prompt, jax.random.PRNGKey(7), 12))
+    b = np.asarray(gen(params, prompt, jax.random.PRNGKey(7), 12))
+    c = np.asarray(gen(params, prompt, jax.random.PRNGKey(8), 12))
+    np.testing.assert_array_equal(a, b)     # seeded: reproducible
+    assert (a != c).any()                   # different seed: different path
+    assert ((a >= 0) & (a < cfg.vocab)).all()
+
+
+def test_serving_with_sampler_runs_and_differs_from_greedy():
+    from kubetpu.jobs.serving import DecodeServer
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    greedy = DecodeServer(cfg, params, n_slots=2, max_seq=64, max_new_tokens=8)
+    warm = DecodeServer(cfg, params, n_slots=2, max_seq=64, max_new_tokens=8,
+                        temperature=1.3, top_k=16, seed=5)
+    prompt = [5, 6, 7]
+    rg = greedy.submit(prompt)
+    greedy.drain()
+    rw = warm.submit(prompt)
+    warm.drain()
+    g, w = greedy.result(rg), warm.result(rw)
+    assert len(g) == len(w) == len(prompt) + 8
+    assert all(0 <= t < cfg.vocab for t in w)
+    assert g != w                           # hot sampling took another path
