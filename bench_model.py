@@ -179,7 +179,8 @@ def flash_vs_dense(cfg, seqs):
         }
 
 
-def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads):
+def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads,
+                      int8: bool = False):
     import dataclasses
 
     from kubetpu.jobs import init_params
@@ -187,6 +188,10 @@ def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads):
 
     dcfg = dataclasses.replace(cfg, n_kv_heads=n_kv_heads, remat=False)
     params = init_params(jax.random.PRNGKey(0), dcfg)
+    if int8:
+        from kubetpu.jobs.quant import quantize_params
+
+        params = quantize_params(params)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len), 0,
                                 dcfg.vocab, jnp.int32)
     from kubetpu.jobs.profiling import marginal_ms
@@ -212,6 +217,7 @@ def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads):
         "prompt_len": prompt_len,
         "gen_steps": gen_steps,
         "n_kv_heads": n_kv_heads or cfg.n_heads,
+        "weights": "int8" if int8 else "bf16",
     }
 
 
@@ -263,7 +269,11 @@ def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma):
 def _result_key(r: dict) -> tuple:
     """Identity of a measurement variant — used to merge re-runs of a
     subset of sections (--only) into an existing artifact."""
-    return (r.get("metric"), r.get("seq"), r.get("n_kv_heads"), r.get("gamma"))
+    weights = r.get("weights")
+    if weights is None and r.get("metric") == "decode_tokens_per_s":
+        weights = "bf16"  # backfill: rows written before the int8 variant
+    return (r.get("metric"), r.get("seq"), r.get("n_kv_heads"), r.get("gamma"),
+            weights)
 
 
 def _merge_out(path: str, new: list) -> None:
@@ -373,6 +383,8 @@ def main() -> int:
     if "decode" in only:
         emit(decode_throughput(cfg, *dec, n_kv_heads=0))
         emit(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2))
+        emit(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2,
+                               int8=True))
     if "spec" in only:
         emit(speculative_throughput(cfg, *dec, gamma=4))
     if "serving" in only:

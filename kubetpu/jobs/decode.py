@@ -95,11 +95,17 @@ def forward_chunk(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache, po
     KV cache (T == 1: one decode step; T > 1: speculative verification in a
     single MXU-friendly pass). tokens: (B, T) -> logits (B, T, V) float32;
     caches are updated with the chunk's K/V."""
+    from kubetpu.jobs.quant import maybe_dequantize
+
     x = params["embed"][tokens]                        # (B, T, D)
 
     def layer_body(carry, inputs):
         x = carry
         layer, k_l, v_l = inputs
+        # int8 params dequantize PER LAYER here (the scan slices QTensors
+        # along the layer axis): the bf16 weights are a loop-body
+        # temporary fused into the matmuls, never a whole-tree copy
+        layer = maybe_dequantize(layer)
         x, k_l, v_l = _decode_block(cfg, layer, x, k_l, v_l, pos)
         return x, (k_l, v_l)
 
@@ -107,9 +113,10 @@ def forward_chunk(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache, po
         layer_body, x, (params["blocks"], k_cache, v_cache)
     )
     x = model_lib.rms_norm(x, params["ln_f"])
+    head = maybe_dequantize(params["head"])            # per-use dequant
     # float32 logits: matches prefill's and keeps the decode scan carry
     # dtype-stable for bfloat16 model configs
-    logits = jnp.einsum("bsd,dv->bsv", x, params["head"]).astype(jnp.float32)
+    logits = jnp.einsum("bsd,dv->bsv", x, head).astype(jnp.float32)
     return logits, k_cache, v_cache
 
 
@@ -135,6 +142,13 @@ def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache,
     (shard_map partitions the sequence axis) — pad the prompt to a multiple
     of sp (pad K/V positions are overwritten before any real query can
     attend them, the serving-bucketing invariant)."""
+    # quantized params are dequantized WHOLE here: prefill is one
+    # compute-bound batched pass through the training forward (which knows
+    # nothing of QTensors); the bandwidth-critical steady-state decode
+    # loop stays int8 (see forward_chunk)
+    from kubetpu.jobs.quant import maybe_dequantize
+
+    params = maybe_dequantize(params)
     logits, ks, vs = model_lib.forward_with_kv(params, tokens, cfg, attn_fn=attn_fn)
     k_cache = jax.lax.dynamic_update_slice(k_cache, ks.astype(k_cache.dtype),
                                            (0, 0, 0, 0, 0))

@@ -43,6 +43,7 @@ import numpy as np
 
 from kubetpu.jobs import model as model_lib
 from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.quant import maybe_dequantize
 from kubetpu.jobs.serving import SlotServerBase
 
 
@@ -114,6 +115,7 @@ def paged_forward_one(
     def layer_body(carry, inputs):
         x = carry
         layer, k_l, v_l = inputs
+        layer = maybe_dequantize(layer)   # per-layer int8 dequant (see quant.py)
         h = model_lib.rms_norm(x, layer["ln1"])
         q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
         k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
@@ -133,7 +135,8 @@ def paged_forward_one(
         layer_body, x, (params["blocks"], k_pages, v_pages)
     )
     x = model_lib.rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["head"]).astype(jnp.float32)
+    head = maybe_dequantize(params["head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head).astype(jnp.float32)
     return logits[:, 0], k_pages, v_pages
 
 

@@ -1,0 +1,126 @@
+"""Weight-only int8 quantization for the inference path.
+
+Decode is HBM-bound: every step streams the full parameter set through
+the MXU for one token. Storing the matmul weights as int8 with a
+per-output-channel float scale halves the resident weight bytes (bf16 ->
+int8 + a thin scale vector), which is the difference between a model
+fitting one chip or not. Crucially, dequantization happens PER LAYER
+inside the scan body (and per use for the head), never as a whole-tree
+copy before the loop — a whole-tree dequant would materialize a full
+bf16 parameter set as loop inputs and cost MORE memory and bandwidth
+than not quantizing. Inside the layer body the ``convert(int8->bf16) *
+scale`` chain is a producer XLA fuses into the dot's operand read.
+
+TPU-shaped choices:
+
+- symmetric per-OUTPUT-CHANNEL scales (one f32 per column of each matmul
+  weight): zero-points would break the MXU-friendly multiply-then-scale
+  form, and per-channel granularity keeps worst-case rounding error
+  ~1/127 of each channel's max — accurate enough that greedy decode on
+  the test model is token-identical;
+- norms, embeddings, and every 1-D tensor stay in the original dtype
+  (they are bandwidth-trivial and precision-critical);
+- ``QTensor`` is a registered pytree node, so quantized params flow
+  through ``jax.jit``/``lax.scan`` exactly like raw arrays — the decode
+  and serving code calls ``maybe_dequantize`` at the top of its jitted
+  body and is otherwise unchanged.
+
+Reference: none (the reference has no inference stack, SURVEY.md §2);
+the scheme is the public weight-only-int8 recipe used across JAX LLM
+serving stacks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class QTensor:
+    """int8 values + broadcastable f32 scales (keepdims reduction shape)."""
+
+    q: jnp.ndarray       # int8, same shape as the original weight
+    scale: jnp.ndarray   # f32, broadcastable against q
+    dtype: Any           # original dtype, restored on dequantize
+
+    def dequantize(self) -> jnp.ndarray:
+        return (self.q.astype(jnp.float32) * self.scale).astype(self.dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.size + self.scale.size * 4
+
+
+jax.tree_util.register_pytree_node(
+    QTensor,
+    lambda t: ((t.q, t.scale), t.dtype),
+    lambda dtype, children: QTensor(children[0], children[1], dtype),
+)
+
+
+def quantize_tensor(w: jnp.ndarray) -> QTensor:
+    """Symmetric int8 quantization. Granularity: per output channel for
+    2-D (in, out) weights; for stacked >= 3-D weights (leading layer —
+    or layer+expert — axes) the scale keeps the LEADING axis and the
+    LAST axis and reduces the middle, i.e. per-layer per-last-channel.
+    Any broadcastable scale dequantizes exactly — granularity only sets
+    the rounding error, and this uniform rule needs no per-tensor
+    contraction map while keeping each layer's dynamic range separate.
+    Zero channels stay exactly zero. scale = max|w| / 127."""
+    axes = (0,) if w.ndim == 2 else tuple(range(1, w.ndim - 1))
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return QTensor(q.astype(jnp.int8), scale.astype(jnp.float32), w.dtype)
+
+
+def quantize_params(params) -> Any:
+    """Quantize every matmul-shaped (ndim >= 2) leaf EXCEPT the embedding
+    table; 1-D tensors (norm gains, biases) keep their dtype. The
+    embedding stays raw: it is consumed by a gather (dequantizing it
+    would materialize the full table in bf16 per step) and a single
+    per-column scale across the whole vocabulary is the worst possible
+    granularity for it. The stacked-blocks layout quantizes fine: q and
+    scale both keep the leading layer axis, so a ``lax.scan`` over the
+    blocks slices QTensors per layer and the dequant happens INSIDE the
+    loop body (a per-layer bf16 temporary, never a whole-tree copy)."""
+    def one(path, w):
+        if not hasattr(w, "ndim") or w.ndim < 2:
+            return w
+        keys = {getattr(k, "key", None) for k in path}
+        if "embed" in keys:
+            return w
+        if "blocks" in keys and w.ndim < 3:
+            # a 2-D leaf under the stacked blocks is a per-layer 1-D gain
+            # (ln1/ln2, (L, D)) — precision-critical, and a QTensor's
+            # keepdims scale would lose the leading layer axis the block
+            # scan slices on
+            return w
+        return quantize_tensor(w)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def maybe_dequantize(params) -> Any:
+    """Restore full-precision leaves inside a jitted body — a no-op for
+    raw params, so decode/serving code handles both transparently. The
+    dequant chain fuses into each consuming matmul's operand read."""
+    return jax.tree_util.tree_map(
+        lambda w: w.dequantize() if isinstance(w, QTensor) else w,
+        params,
+        is_leaf=lambda w: isinstance(w, QTensor),
+    )
+
+
+def param_bytes(params) -> int:
+    """Resident bytes of a (possibly quantized) param tree."""
+    return sum(
+        leaf.nbytes
+        for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda w: isinstance(w, QTensor)
+        )
+    )
