@@ -76,8 +76,35 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
-def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01):
-    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+def make_optimizer(
+    lr: float = 3e-4,
+    weight_decay: float = 0.01,
+    warmup_steps: int = 0,
+    decay_steps: Optional[int] = None,
+    min_lr_ratio: float = 0.1,
+    clip_norm: Optional[float] = None,
+    b1: float = 0.9,
+    b2: float = 0.95,
+):
+    """AdamW with the standard LLM pretraining trimmings, all optional so
+    the bare default stays what every existing test/checkpoint expects:
+    linear warmup -> cosine decay to ``min_lr_ratio * lr`` (when
+    ``decay_steps`` is given; warmup alone holds peak lr after warmup),
+    and global-norm gradient clipping BEFORE the adamw update (the chain
+    order that actually bounds the step)."""
+    if decay_steps is not None:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=warmup_steps,
+            decay_steps=decay_steps, end_value=lr * min_lr_ratio,
+        )
+    elif warmup_steps:
+        schedule = optax.linear_schedule(0.0, lr, warmup_steps)
+    else:
+        schedule = lr
+    tx = optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay)
+    if clip_norm is not None:
+        tx = optax.chain(optax.clip_by_global_norm(clip_norm), tx)
+    return tx
 
 
 def _filter_spec(mesh: Mesh, spec: P) -> P:
@@ -114,14 +141,60 @@ def init_state(
     return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)), optimizer
 
 
-def make_update_step(loss_fn, optimizer):
+def make_update_step(loss_fn, optimizer, accum_steps: int = 1,
+                     chunk_constraint=None):
     """The one train-step body (value_and_grad -> optimizer -> new state)
     shared by the causal, pipelined, masked-LM, and ViT step builders —
-    a future change (grad clipping, loss scaling) lands everywhere at once.
-    ``loss_fn(params, *batch) -> scalar``; returns an un-jitted step."""
+    a future change (loss scaling, new regularizers) lands everywhere at
+    once. ``loss_fn(params, *batch) -> scalar``; returns an un-jitted step.
+
+    ``accum_steps > 1`` enables gradient accumulation: the global batch is
+    split into that many equal microbatches along axis 0 and scanned, so
+    activation memory scales with the MICRObatch while the update sees the
+    full-batch mean gradient — numerically the same update as one big
+    batch (equal-size chunks, mean of means), bought with recompute-free
+    sequential passes. The reshape alone does NOT keep the microbatch
+    batch axis dp-sharded (GSPMD moves the sharding to the new leading
+    accum axis, or drops it when indivisible — replicating microbatches
+    would defeat the memory saving); ``chunk_constraint``, a callable
+    applied to each reshaped batch leaf, pins it back
+    (make_train_step supplies the mesh-aware constraint)."""
 
     def train_step(state: TrainState, *batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, *batch)
+        if accum_steps <= 1:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, *batch)
+        else:
+            b = batch[0].shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch size {b} not divisible by accum_steps {accum_steps}"
+                )
+            chunks = tuple(
+                x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
+                for x in batch
+            )
+            if chunk_constraint is not None:
+                chunks = tuple(chunk_constraint(x) for x in chunks)
+            zero_grads = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params
+            )
+
+            def micro(acc, chunk):
+                acc_loss, acc_grads = acc
+                loss, grads = jax.value_and_grad(loss_fn)(state.params, *chunk)
+                acc_grads = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc_grads, grads
+                )
+                return (acc_loss + loss, acc_grads), None
+
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                micro, (jnp.zeros((), jnp.float32), zero_grads), chunks
+            )
+            loss = loss_sum / accum_steps
+            grads = jax.tree_util.tree_map(
+                lambda p, g: (g / accum_steps).astype(p.dtype),
+                state.params, grad_sum,
+            )
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return TrainState(new_params, new_opt, state.step + 1), loss
@@ -157,6 +230,7 @@ def make_train_step(
     use_ring: bool = True,
     attention: Optional[str] = None,
     jit: bool = True,
+    accum_steps: int = 1,
 ):
     """Build the jitted full training step: loss -> grads -> adamw update.
 
@@ -177,7 +251,17 @@ def make_train_step(
     def loss_fn(params, tokens, targets):
         return model_lib.next_token_loss(params, tokens, targets, cfg, attn_fn)
 
-    step = make_update_step(loss_fn, optimizer)
+    chunk_constraint = None
+    if accum_steps > 1:
+        def chunk_constraint(x):
+            # (accum, micro-B, S): batch on dp, seq on sp, per leaf rank
+            spec = P(*([None, "dp", "sp"][: x.ndim]))
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _filter_spec(mesh, spec))
+            )
+
+    step = make_update_step(loss_fn, optimizer, accum_steps=accum_steps,
+                            chunk_constraint=chunk_constraint)
     if not jit:
         return step
     bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))

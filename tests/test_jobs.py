@@ -402,3 +402,84 @@ def test_pipeline_train_step_ring_flash():
     step2 = make_pipeline_train_step(cfg, mesh, n_microbatches=2, optimizer=opt2)
     state2, loss2 = step2(state2, tokens, targets)
     np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-4)
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=2 over one batch must produce the SAME update as the
+    unaccumulated step (equal-size chunks: mean of chunk means == full
+    mean), up to float reassociation."""
+    import numpy as np
+
+    from kubetpu.jobs import ModelConfig, init_state, make_mesh, make_train_step
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                      dtype=jnp.float32)
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab,
+                                jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    results = {}
+    for accum in (1, 2):
+        state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+        step = make_train_step(cfg, mesh, optimizer=opt, use_ring=False,
+                               accum_steps=accum)
+        state, loss = step(state, tokens, targets)
+        results[accum] = (float(loss), state.params)
+
+    assert np.isclose(results[1][0], results[2][0], rtol=1e-5)
+    flat1 = jax.tree_util.tree_leaves(results[1][1])
+    flat2 = jax.tree_util.tree_leaves(results[2][1])
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_accumulation_rejects_indivisible_batch():
+    from kubetpu.jobs import ModelConfig, init_state, make_mesh, make_train_step
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt, use_ring=False,
+                           accum_steps=3)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab,
+                                jnp.int32)
+    with pytest.raises(ValueError, match="divisible"):
+        step(state, tokens, jnp.roll(tokens, -1, axis=1))
+
+
+def test_optimizer_schedule_and_clipping():
+    """Warmup+cosine: lr starts ~0, peaks after warmup, decays toward the
+    floor; clipping bounds the global update norm."""
+    import numpy as np
+    import optax
+
+    from kubetpu.jobs.train import make_optimizer
+
+    sched_tx = make_optimizer(lr=1.0, warmup_steps=10, decay_steps=100,
+                              min_lr_ratio=0.1)
+    # probe the schedule through the optimizer's update scale on a fixed
+    # gradient: adamw's normalized step magnitude tracks the lr
+    params = {"w": jnp.ones((4,))}
+    opt_state = sched_tx.init(params)
+    grads = {"w": jnp.ones((4,))}
+    mags = []
+    for _ in range(100):
+        updates, opt_state = sched_tx.update(grads, opt_state, params)
+        mags.append(float(jnp.abs(updates["w"]).max()))
+    assert mags[0] < mags[9] * 0.5        # warmup: early steps tiny
+    assert max(mags) == max(mags[5:15])   # peak right after warmup
+    assert mags[-1] < max(mags) * 0.5     # cosine decayed
+
+    # clipping: chain(clip, adamw) on an over-norm gradient must equal
+    # plain adamw on the PRE-clipped gradient — the probe fails if the
+    # clip link is dropped or chained after the update
+    clip_tx = make_optimizer(lr=1.0, clip_norm=0.5)
+    plain_tx = make_optimizer(lr=1.0)
+    big = {"w": jnp.full((4,), 1e6)}
+    gnorm = float(optax.global_norm(big))
+    pre_clipped = {"w": big["w"] * (0.5 / gnorm)}
+    u_clip, _ = clip_tx.update(big, clip_tx.init(params), params)
+    u_ref, _ = plain_tx.update(pre_clipped, plain_tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(u_clip["w"]), np.asarray(u_ref["w"]),
+                               rtol=1e-6)
