@@ -69,10 +69,13 @@ def chip_peak_flops():
     return None
 
 
-def train_throughput(cfg, batch, seq, steps, attention):
+def train_throughput(cfg, batch, seq, steps, attention, remat_policy="full"):
+    import dataclasses
+
     from kubetpu.jobs import init_state, make_mesh, make_train_step
     from kubetpu.jobs.profiling import marginal_ms
 
+    cfg = dataclasses.replace(cfg, remat_policy=remat_policy)
     mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
     state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
     n_params = param_count(state.params)
@@ -119,6 +122,7 @@ def train_throughput(cfg, batch, seq, steps, attention):
         "seq": seq,
         "params": n_params,
         "attention": attention,
+        "remat": remat_policy,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "device": getattr(jax.devices()[0], "device_kind", str(jax.devices()[0])),
     }
@@ -221,7 +225,13 @@ def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads,
     }
 
 
-def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma):
+def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma,
+                           self_draft=False):
+    """With random (untrained) weights a quarter-size draft almost never
+    agrees with the target, so acceptance sits at the ~1 token/round floor —
+    the honest LOWER bound (pure speculation overhead). *self_draft* uses the
+    target as its own draft: greedy agreement is total, acceptance hits the
+    gamma+1 ceiling — the UPPER bound. Trained pairs land in between."""
     import dataclasses
 
     from kubetpu.jobs import init_params
@@ -229,7 +239,7 @@ def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma):
 
     tcfg = dataclasses.replace(cfg, remat=False)
     # draft: a quarter-depth, quarter-width shrink of the target
-    dcfg = dataclasses.replace(
+    dcfg = tcfg if self_draft else dataclasses.replace(
         tcfg,
         d_model=max(64, cfg.d_model // 4),
         n_layers=max(1, cfg.n_layers // 4),
@@ -237,7 +247,7 @@ def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma):
         d_ff=max(128, cfg.d_ff // 4),
     )
     t_params = init_params(jax.random.PRNGKey(0), tcfg)
-    d_params = init_params(jax.random.PRNGKey(7), dcfg)
+    d_params = t_params if self_draft else init_params(jax.random.PRNGKey(7), dcfg)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len), 0,
                                 tcfg.vocab, jnp.int32)
     from kubetpu.jobs.profiling import marginal_ms
@@ -262,6 +272,7 @@ def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma):
         "batch": batch,
         "gen_steps": gen_steps,
         "gamma": gamma,
+        "draft": "self" if self_draft else "quarter",
         "mean_tokens_per_round": round(float(accept), 2),
     }
 
@@ -272,8 +283,14 @@ def _result_key(r: dict) -> tuple:
     weights = r.get("weights")
     if weights is None and r.get("metric") == "decode_tokens_per_s":
         weights = "bf16"  # backfill: rows written before the int8 variant
+    remat = r.get("remat")
+    if remat is None and r.get("metric") == "train_tokens_per_s":
+        remat = "full"  # backfill: rows written before the policy knob
+    draft = r.get("draft")
+    if draft is None and r.get("metric") == "speculative_decode_tokens_per_s":
+        draft = "quarter"  # backfill: rows written before the self-draft leg
     return (r.get("metric"), r.get("seq"), r.get("n_kv_heads"), r.get("gamma"),
-            weights)
+            weights, remat, draft)
 
 
 def _merge_out(path: str, new: list) -> None:
@@ -375,8 +392,12 @@ def main() -> int:
         dec = (8, 128, 128)
 
     if "train" in only:
-        emit(train_throughput(cfg, batch, seq, args.steps, "flash"
-                              if jax.default_backend() != "cpu" else "dense"))
+        attn = "flash" if jax.default_backend() != "cpu" else "dense"
+        emit(train_throughput(cfg, batch, seq, args.steps, attn))
+        # selective remat: save matmul outputs, recompute only elementwise —
+        # trades activation memory for the full-remat recompute pass
+        emit(train_throughput(cfg, batch, seq, args.steps, attn,
+                              remat_policy="dots"))
     if "flash" in only:
         for r in flash_vs_dense(cfg, seqs):
             emit(r)
@@ -387,6 +408,7 @@ def main() -> int:
                                int8=True))
     if "spec" in only:
         emit(speculative_throughput(cfg, *dec, gamma=4))
+        emit(speculative_throughput(cfg, *dec, gamma=4, self_draft=True))
     if "serving" in only:
         emit(serving_throughput(cfg, n_slots=4 if args.smoke else 8,
                                 prompt_len=16 if args.smoke else 128,

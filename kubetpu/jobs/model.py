@@ -40,6 +40,15 @@ class ModelConfig:
     rope_theta: float = 10000.0
     dtype: Any = jnp.float32  # bfloat16 on TPU
     remat: bool = False      # jax.checkpoint the scanned block
+    # rematerialisation policy when ``remat`` is set. "full" recomputes the
+    # whole block forward during backward (max memory savings, ~1 extra fwd
+    # of HARDWARE flops — an MFU ceiling of 3/4); "dots" saves every matmul
+    # output and recomputes only the cheap elementwise/norm ops (XLA's
+    # dots_with_no_batch_dims_saveable policy — near-zero recompute FLOPs,
+    # activation memory between full-remat and none). Measured on the v5e:
+    # the flagship 0.75B fits batch 4 x seq 2048 under "dots", trading the
+    # recompute pass for MFU (see BENCH_MODEL.json train rows).
+    remat_policy: str = "full"
     n_experts: int = 0       # 0 = dense SwiGLU; >0 = top-1 MoE in every block
     # 0.0 = dense one-hot dispatch (demo path: E-times activations, zero
     # collectives); > 0 = capacity-based dispatch (production path: each
@@ -58,6 +67,10 @@ class ModelConfig:
     n_kv_heads: int = 0
 
     def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
+            )
         if self.n_kv_heads and self.n_heads % self.n_kv_heads:
             raise ValueError(
                 f"n_kv_heads ({self.n_kv_heads}) must divide "
@@ -120,6 +133,13 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         "head": norm(k_out, d, cfg.vocab) * scale,
     }
     return params
+
+
+def remat_xla_policy(cfg: ModelConfig):
+    """The ``jax.checkpoint`` policy for *cfg* (None = save nothing)."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None
 
 
 def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
@@ -335,7 +355,7 @@ def forward(
         return x, aux
 
     if cfg.remat:
-        scan_body = jax.checkpoint(scan_body)
+        scan_body = jax.checkpoint(scan_body, policy=remat_xla_policy(cfg))
     x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
 
     x = rms_norm(x, params["ln_f"])

@@ -54,7 +54,7 @@ def _stage_forward(cfg: ModelConfig, attn_fn, positions, blocks_local, x):
         return body(carry, layer), None
 
     if cfg.remat:
-        scan_body = jax.checkpoint(scan_body)
+        scan_body = jax.checkpoint(scan_body, policy=model_lib.remat_xla_policy(cfg))
     x, _ = jax.lax.scan(scan_body, x, blocks_local)
     return x
 

@@ -417,3 +417,8 @@ class DecodeServer(SlotServerBase):
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
         )
+        # drain the dispatch queue: without this the FIRST live admission
+        # pays the wall time of every queued warmup execution and records
+        # it as admission stall (seen as a ~1.3 s p99 outlier on the
+        # tunneled backend, BENCH_MODEL.json serving row)
+        jax.block_until_ready((self.k_cache, self.v_cache))

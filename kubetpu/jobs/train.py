@@ -153,7 +153,11 @@ def make_update_step(loss_fn, optimizer, accum_steps: int = 1,
     activation memory scales with the MICRObatch while the update sees the
     full-batch mean gradient — numerically the same update as one big
     batch (equal-size chunks, mean of means), bought with recompute-free
-    sequential passes. The reshape alone does NOT keep the microbatch
+    sequential passes. That identity requires the loss to be an UNWEIGHTED
+    mean over examples: for a weighted mean (the masked-LM path, where
+    each chunk normalizes by its own mask count) chunk-equal averaging
+    biases toward sparse-mask microbatches — keep accum_steps == 1 there
+    unless the batch is mask-balanced. The reshape alone does NOT keep the microbatch
     batch axis dp-sharded (GSPMD moves the sharding to the new leading
     accum axis, or drops it when indivisible — replicating microbatches
     would defeat the memory saving); ``chunk_constraint``, a callable

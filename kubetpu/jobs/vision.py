@@ -113,7 +113,7 @@ def vit_forward(
         return out, aux
 
     if cfg.model.remat:
-        scan_body = jax.checkpoint(scan_body)
+        scan_body = jax.checkpoint(scan_body, policy=model_lib.remat_xla_policy(cfg.model))
     x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
     x = model_lib.rms_norm(jnp.mean(x, axis=1), params["ln_f"])  # mean-pool
     logits = jnp.einsum("bd,dc->bc", x, params["head_cls"]).astype(jnp.float32)

@@ -50,6 +50,27 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
 
 
+def test_remat_policy_dots_matches_full_in_gradient():
+    """Selective remat ('dots': save matmul outputs, recompute elementwise)
+    must be a pure scheduling choice — gradients identical to full remat."""
+    rng = jax.random.PRNGKey(0)
+    params = init_params(rng, CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, CFG.vocab)
+    cfg_full = ModelConfig(**{**CFG.__dict__, "remat": True})
+    cfg_dots = ModelConfig(**{**CFG.__dict__, "remat": True,
+                              "remat_policy": "dots"})
+
+    def loss(cfg):
+        return lambda p: jnp.sum(forward(p, tokens, cfg) ** 2)
+
+    gf = jax.grad(loss(cfg_full))(params)
+    gd = jax.grad(loss(cfg_dots))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gf), jax.tree_util.tree_leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+    with pytest.raises(ValueError):
+        ModelConfig(**{**CFG.__dict__, "remat_policy": "everything"})
+
+
 def test_ring_attention_matches_dense():
     """The load-bearing numerical test: exact causal attention through the
     ring (4-way sequence parallelism) must equal the dense reference."""
