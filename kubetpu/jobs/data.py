@@ -88,6 +88,70 @@ def mlm_batches(
         yield tokens, mask
 
 
+class ByteTokenizer:
+    """Byte-level tokenizer: UTF-8 bytes are token ids 0..255, with BOS=256
+    and EOS=257 (vocab 258). Zero vocabulary files, fully reversible, and
+    every possible input is in-distribution — the TPU-friendly baseline
+    tokenizer (fixed small vocab keeps the embedding/head matmuls modest;
+    models that need subwords plug their own encode/decode in, the train
+    loop only sees int32 arrays)."""
+
+    BOS = 256
+    EOS = 257
+    vocab = 258
+
+    def encode(self, text: str, bos: bool = True, eos: bool = True):
+        ids = list(text.encode("utf-8"))
+        return ([self.BOS] if bos else []) + ids + ([self.EOS] if eos else [])
+
+    def decode(self, ids) -> str:
+        data = bytes(i for i in ids if 0 <= int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def encode_file(self, text_path: str, out_path: str,
+                    doc_sep: str = "\n\n") -> int:
+        """Tokenize a text file into a flat binary corpus for the native
+        loader (``native_data.TokenFile``): documents split on *doc_sep*
+        each get BOS...EOS framing. Returns the token count. The output is
+        the same uint16 format ``write_token_file`` produces, so
+        ``TokenFile(out_path).batches(...)`` feeds the train loop
+        directly."""
+        from kubetpu.jobs.native_data import write_token_file
+
+        with open(text_path, encoding="utf-8") as f:
+            text = f.read()
+        ids: list = []
+        for doc in filter(None, text.split(doc_sep)):
+            ids.extend(self.encode(doc))
+        tokens = np.asarray(ids, np.int32)
+        write_token_file(out_path, tokens, dtype=np.uint16)
+        return int(tokens.size)
+
+
+def evaluate(eval_step, params, batches: Iterable[Batch], n_batches: int):
+    """Mean validation loss + perplexity over *n_batches* from *batches*.
+
+    *eval_step* is ``train.make_eval_step``'s jitted (params, tokens,
+    targets) -> scalar loss; batches come from any corpus source
+    (synthetic, TokenFile, or ``prefetch_to_mesh`` staging). Losses stay
+    on device until one final fetch so evaluation pipelines like
+    training does."""
+    losses = []
+    n_tokens = 0
+    for tokens, targets in itertools.islice(iter(batches), n_batches):
+        losses.append(eval_step(params, tokens, targets))
+        n_tokens += int(np.prod(tokens.shape))  # shape only: no device fetch
+    if not losses:
+        raise ValueError("evaluate: no batches")
+    mean = float(np.mean([float(l) for l in losses]))
+    return {
+        "loss": mean,
+        "perplexity": float(np.exp(min(mean, 80.0))),
+        "n_batches": len(losses),
+        "n_tokens": n_tokens,
+    }
+
+
 class SyntheticImages:
     """Deterministic labeled images for the ViT family: each class is a
     distinct low-frequency pattern plus noise — separable enough that a

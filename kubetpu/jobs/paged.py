@@ -208,6 +208,7 @@ class PagedDecodeServer(SlotServerBase):
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         seed: int = 0,
+        mesh=None,
     ) -> None:
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
@@ -219,6 +220,23 @@ class PagedDecodeServer(SlotServerBase):
         # callers size it to expected live tokens
         self.pool_pages = n_pages or (n_slots * self.max_pages_per_slot + 1) // 2
         self.k_pages, self.v_pages = init_page_pool(cfg, self.pool_pages, page_size)
+        if mesh is not None:
+            # Multi-chip paged serving: params tensor-parallel (training's
+            # specs), pool pages sharded on kv heads over tp. The PAGE axis
+            # stays unsharded — the host allocator hands pages to any slot,
+            # so a page split would turn every table gather cross-device;
+            # the kv-head split keeps gathers local (pairs with the dense
+            # server's layout, serving.DecodeServer).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from kubetpu.jobs.train import _filter_spec, _shardings, param_specs
+
+            self.params = jax.device_put(
+                params, _shardings(mesh, param_specs(cfg)))
+            psh = NamedSharding(
+                mesh, _filter_spec(mesh, P(None, None, None, "tp", None)))
+            self.k_pages = jax.device_put(self.k_pages, psh)
+            self.v_pages = jax.device_put(self.v_pages, psh)
         self._free: List[int] = list(range(self.pool_pages))
         self._table = np.full((n_slots, self.max_pages_per_slot), -1, np.int32)
         self._host_len = [0] * n_slots          # tokens stored per slot
@@ -364,3 +382,7 @@ class PagedDecodeServer(SlotServerBase):
             jnp.asarray(self._table), self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
         )
+        # drain the dispatch queue so the first live admission doesn't pay
+        # (and record) the queued warmup executions as admission stall —
+        # same rationale as serving.DecodeServer.warmup
+        jax.block_until_ready((self.k_pages, self.v_pages))

@@ -324,11 +324,34 @@ class DecodeServer(SlotServerBase):
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         seed: int = 0,
+        mesh=None,
     ) -> None:
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
                          top_p=top_p, seed=seed)
         self.k_cache, self.v_cache = init_kv_cache(cfg, n_slots, max_seq)
+        if mesh is not None:
+            # Multi-chip serving: params tensor-parallel over tp (same
+            # specs training uses — a trained checkpoint serves without a
+            # resharding step), KV cache kv-heads on tp and slots on dp
+            # (slots only when dp divides n_slots; otherwise replicated —
+            # correctness never depends on the slot split). Committed input
+            # shardings propagate through the donated jit legs, so every
+            # step keeps the layout without per-call constraints.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from kubetpu.jobs.decode import kv_cache_specs
+            from kubetpu.jobs.train import _filter_spec, _shardings, param_specs
+
+            self.params = jax.device_put(
+                params, _shardings(mesh, param_specs(cfg)))
+            cache_spec = kv_cache_specs()
+            dp = mesh.shape.get("dp", 1)
+            if n_slots % max(dp, 1):
+                cache_spec = P(None, None, *cache_spec[2:])
+            csh = NamedSharding(mesh, _filter_spec(mesh, cache_spec))
+            self.k_cache = jax.device_put(self.k_cache, csh)
+            self.v_cache = jax.device_put(self.v_cache, csh)
 
         cfg_ = cfg
         sampler = self._sampler

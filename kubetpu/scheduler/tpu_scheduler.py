@@ -11,7 +11,7 @@ nodes and TPU nodes coexist (BASELINE config 5).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Dict, Tuple
 
 from kubetpu.api import utils
 from kubetpu.api.devicescheduler import DeviceScheduler, FitResult, PredicateFailureReason
@@ -37,6 +37,30 @@ class TpuScheduler(DeviceScheduler):
 
     def __init__(self) -> None:
         self._cache = NodeTreeCache(TPU.grp_prefix, "cards", levels=1)
+
+    # (topology name, host index, n) -> find_contiguous_block result for a
+    # PRISTINE host (every chip free). Cold-start cost on a large cluster is
+    # the first sweep of a new gang size running the geometry search once
+    # per node; pristine hosts of the same topology+host-index are
+    # byte-identical searches, so one result serves them all (a 512-node
+    # v5e-256 cluster has 32 distinct host indices, not 512 searches).
+    # Results are shared read-only — the fit-cache contract above already
+    # forbids mutating them. Class-level: survives scheduler instances,
+    # bounded.
+    _pristine_fit: Dict[Tuple[str, int, int], object] = {}
+    _PRISTINE_FIT_MAX = 8192
+
+    def _pristine_or_search(self, state, n: int):
+        if len(state.free) != len(state.chip_coord):
+            return find_contiguous_block(state.free, n, state.topo)
+        key = (state.topo.name, state.host_index, n)
+        hit = self._pristine_fit.get(key)
+        if hit is None:
+            hit = find_contiguous_block(state.free, n, state.topo)
+            if len(self._pristine_fit) >= self._PRISTINE_FIT_MAX:
+                self._pristine_fit.clear()
+            self._pristine_fit[key] = hit
+        return hit
 
     # -- node lifecycle -----------------------------------------------------
 
@@ -86,7 +110,7 @@ class TpuScheduler(DeviceScheduler):
         if n in state.fit_cache:
             placed = state.fit_cache[n]
         else:
-            placed = find_contiguous_block(state.free, n, state.topo)
+            placed = self._pristine_or_search(state, n)
             state.fit_cache[n] = placed
         if placed is None:
             return False, 0.0

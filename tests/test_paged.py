@@ -158,3 +158,26 @@ def test_paged_server_with_pallas_kernel_parity(params):
         server.drain()
         outs[tag] = [server.result(r) for r in rids]
     assert outs["paged"] == outs["dense"]
+
+
+def test_mesh_sharded_paged_server_matches_unsharded(params):
+    """Multi-chip paged serving over a {dp:2, tp:2} mesh: params tensor-
+    parallel, pool kv-heads on tp — tokens identical to the single-chip
+    paged server."""
+    from kubetpu.jobs import make_mesh
+
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    prompts = [[3, 14, 15, 9, 2, 6], [26, 5]]
+
+    def run(server):
+        rids = [server.submit(p) for p in prompts]
+        server.drain()
+        return [server.result(r) for r in rids]
+
+    plain = run(PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                                  max_new_tokens=8, page_size=8))
+    sharded_server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                                       max_new_tokens=8, page_size=8,
+                                       mesh=mesh)
+    assert "tp" in str(sharded_server.k_pages.sharding.spec)
+    assert run(sharded_server) == plain

@@ -187,3 +187,48 @@ def test_tpu_scheduler_fragmented_scores_lower():
     _, _, score_b = s.pod_fits_device(node_b, pod(), False)
     assert score_a == 1.0
     assert score_b < score_a  # ICI ranking prefers the contiguous node
+
+
+def test_pristine_fit_cache_shares_without_staleness():
+    """Fully-free hosts of the same (topology, host-index) share one
+    geometry-search result across nodes and schedulers; a node that stops
+    being pristine falls back to a fresh per-state search."""
+    from kubetpu.api.types import NodeInfo
+    from kubetpu.scheduler import TpuScheduler
+
+    TpuScheduler._pristine_fit.clear()
+    s = TpuScheduler()
+    nodes = {}
+    for name in ("a", "b", "c"):
+        nodes[name] = NodeInfo(
+            name=name, allocatable=_v5e8_node_alloc(),
+            kube_alloc={TPU.resource_name: 8},
+        )
+        s.add_node(name, nodes[name])
+    pod = lambda: PodInfo(
+        running_containers={"m": ContainerInfo(requests={TPU.resource_name: 4})}
+    )
+    for name in ("a", "b", "c"):
+        fits, _, score = s.pod_fits_device(nodes[name], pod(), False)
+        assert fits and score == 1.0
+    # one search served all three pristine nodes
+    assert len(s._pristine_fit) == 1
+
+    # a non-pristine node must NOT touch the shared cache: its free set
+    # ({0, 2, 5, 7}, scattered) admits no 4-chip rectangle, so a stale
+    # pristine hit would report contiguity 1.0
+    frag = NodeInfo(
+        name="f", allocatable=_v5e8_node_alloc([0, 2, 5, 7]),
+        kube_alloc={TPU.resource_name: 4},
+    )
+    s.add_node("f", frag)
+    fits, _, score = s.pod_fits_device(frag, pod(), False)
+    assert fits and score < 1.0
+    assert len(s._pristine_fit) == 1  # fragmented search never cached
+
+    # a SIX-chip request on a pristine node adds a second entry (new n)
+    pod6 = PodInfo(
+        running_containers={"m": ContainerInfo(requests={TPU.resource_name: 6})}
+    )
+    fits, _, _ = s.pod_fits_device(nodes["a"], pod6, False)
+    assert fits and len(s._pristine_fit) == 2

@@ -162,3 +162,28 @@ def test_warmup_precompiles_every_bucket():
     assert stats["count"] == 3
     # a compile on this config costs seconds; warmed dispatch is ms-scale
     assert stats["p99_ms"] < 1000
+
+
+def test_mesh_sharded_server_matches_unsharded():
+    """Multi-chip serving: DecodeServer over a {dp:2, tp:2} mesh must emit
+    exactly the unsharded server's greedy tokens, with params tensor-
+    parallel and the KV cache sharded (slots on dp, kv heads on tp)."""
+    from kubetpu.jobs import make_mesh
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    prompts = {"a": [3, 14, 15, 9], "b": [26, 5]}
+
+    def run(server):
+        rids = {k: server.submit(p) for k, p in prompts.items()}
+        server.drain()
+        return {k: server.result(r) for k, r in rids.items()}
+
+    plain = run(DecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=6))
+    sharded_server = DecodeServer(CFG, params, n_slots=2, max_seq=64,
+                                  max_new_tokens=6, mesh=mesh)
+    assert "tp" in str(sharded_server.k_cache.sharding.spec)
+    assert sharded_server.params["blocks"]["wq"].sharding.spec != ()
+    sharded = run(sharded_server)
+    assert plain == sharded
