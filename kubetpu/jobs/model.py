@@ -342,6 +342,23 @@ def forward(
     ``positions`` defaults to 0..S-1; sequence-parallel callers pass global
     positions for their shard.
     """
+    x, aux = forward_hidden(params, tokens, cfg, attn_fn, positions)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])
+    if return_aux:
+        return logits, aux
+    return logits
+
+
+def forward_hidden(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: ModelConfig,
+    attn_fn: Optional[AttnFn] = None,
+    positions: Optional[jnp.ndarray] = None,
+):
+    """The block stack without the LM head: final-norm hidden states
+    (B, S, D) plus the summed MoE aux term. This is what an encoder
+    producing memory for cross-attention consumes (``jobs.seq2seq``)."""
     if attn_fn is None:
         attn_fn = dense_causal_attention
     if positions is None:
@@ -357,12 +374,7 @@ def forward(
     if cfg.remat:
         scan_body = jax.checkpoint(scan_body, policy=remat_xla_policy(cfg))
     x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
-
-    x = rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["head"])
-    if return_aux:
-        return logits, jnp.sum(auxes)
-    return logits
+    return rms_norm(x, params["ln_f"]), jnp.sum(auxes)
 
 
 def forward_with_kv(

@@ -1,0 +1,251 @@
+"""Encoder-decoder (seq2seq) — the fourth model family.
+
+Built the same way the encoder and ViT families were: the shared decoder
+blocks do all the heavy lifting (``model._block_with_aux`` is the self-
+attention + MLP body for BOTH stacks), and the only new math is the
+cross-attention branch that lets every decoder position read the encoder's
+memory. TPU-first choices:
+
+- the encoder is ``model.forward_hidden`` under a bidirectional core (the
+  Pallas ``flash_attention(causal=False)`` kernel on hardware);
+- the source is encoded ONCE per generate; the greedy loop re-runs only
+  the decoder prefix (see ``make_seq2seq_generate`` for the exact cost);
+- all per-layer weights (including the cross branch) are stacked on a
+  leading L axis and scanned, so compiles stay flat and remat applies
+  uniformly;
+- sharding reuses training's specs: cross projections shard heads on tp
+  exactly like self-attention, memory shards as activations ((dp, sp)).
+
+Reference: the reference has no models at all (SURVEY.md §2) — family
+breadth is a kubetpu extension.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubetpu.jobs import model as model_lib
+from kubetpu.jobs.encoder import dense_bidirectional_attention
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.train import _filter_spec, _shardings, make_optimizer, make_update_step
+
+
+def init_seq2seq_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """{"encoder": blocks+embed+ln_f, "decoder": blocks(+cross)+embed+
+    ln_f+head}. The encoder reuses the decoder-family init minus the LM
+    head; decoder blocks gain the cross-attention branch (ln_x, wq_x,
+    wk_x, wv_x, wo_x) with the same shapes/scaling as self-attention."""
+    k_enc, k_dec, k_cross = jax.random.split(rng, 3)
+    enc = model_lib.init_params(k_enc, cfg)
+    del enc["head"]  # memory, not logits
+    dec = model_lib.init_params(k_dec, cfg)
+
+    d, h, hd, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.n_layers
+    kv = cfg.kv_heads
+    ks = jax.random.split(k_cross, 4)
+    scale = d ** -0.5
+    dec["blocks"].update(
+        {
+            "ln_x": jnp.ones((L, d), cfg.dtype),
+            "wq_x": jax.random.normal(ks[0], (L, d, h, hd), cfg.dtype) * scale,
+            "wk_x": jax.random.normal(ks[1], (L, d, kv, hd), cfg.dtype) * scale,
+            "wv_x": jax.random.normal(ks[2], (L, d, kv, hd), cfg.dtype) * scale,
+            "wo_x": jax.random.normal(ks[3], (L, h, hd, d), cfg.dtype)
+            * (h * hd) ** -0.5,
+        }
+    )
+    return {"encoder": enc, "decoder": dec}
+
+
+def seq2seq_param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpecs matching init_seq2seq_params — training's specs for
+    both stacks, cross projections sharded like self-attention."""
+    from kubetpu.jobs.train import param_specs
+
+    enc = param_specs(cfg)
+    del enc["head"]
+    dec = param_specs(cfg)
+    dec["blocks"] = dict(dec["blocks"])
+    dec["blocks"].update(
+        {
+            "ln_x": P(None, None),
+            "wq_x": P(None, None, "tp", None),
+            "wk_x": P(None, None, "tp", None),
+            "wv_x": P(None, None, "tp", None),
+            "wo_x": P(None, "tp", None, None),
+        }
+    )
+    return {"encoder": enc, "decoder": dec}
+
+
+def _cross_attend(cfg: ModelConfig, h: jnp.ndarray, layer: Params,
+                  mem_k: jnp.ndarray, mem_v: jnp.ndarray) -> jnp.ndarray:
+    """Full-visibility attention of decoder states (B, T, D) over
+    precomputed memory projections mem_k/mem_v (B, S, Hkv, hd). No rope:
+    source and target positions live in different sequences (the encoder
+    already position-encoded its side)."""
+    q = jnp.einsum("btd,dhk->bthk", h, layer["wq_x"])
+    n_rep = cfg.n_heads // cfg.kv_heads
+    attn = dense_bidirectional_attention(
+        q, model_lib.repeat_kv(mem_k, n_rep), model_lib.repeat_kv(mem_v, n_rep)
+    )
+    return jnp.einsum("bthk,hkd->btd", attn, layer["wo_x"])
+
+
+def memory_projections(cfg: ModelConfig, dec_blocks: Params,
+                       memory: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-layer cross K/V from the encoder memory: (L, B, S, Hkv, hd)
+    pair. Computed once per (encode, generate) — the decode loop's cross
+    branch is then a pure read."""
+    k = jnp.einsum("bsd,ldhk->lbshk", memory, dec_blocks["wk_x"])
+    v = jnp.einsum("bsd,ldhk->lbshk", memory, dec_blocks["wv_x"])
+    return k, v
+
+
+def encode(params: Params, src: jnp.ndarray, cfg: ModelConfig,
+           attn_fn=None, return_aux: bool = False):
+    """Source tokens (B, S) -> memory (B, S, D) (bidirectional stack);
+    with ``return_aux`` also the encoder's summed MoE load-balance term."""
+    mem, aux = model_lib.forward_hidden(
+        params["encoder"], src, cfg,
+        attn_fn=attn_fn or dense_bidirectional_attention,
+    )
+    return (mem, aux) if return_aux else mem
+
+
+def decoder_forward(
+    params: Params,
+    tgt_in: jnp.ndarray,
+    memory: jnp.ndarray,
+    cfg: ModelConfig,
+    attn_fn=None,
+    positions: Optional[jnp.ndarray] = None,
+    return_aux: bool = False,
+):
+    """Teacher-forced decoder logits (B, T, V): causal self-attention over
+    *tgt_in* plus cross-attention into *memory* in every block. With
+    ``return_aux`` also the decoder's summed MoE load-balance term."""
+    dec = params["decoder"]
+    if attn_fn is None:
+        attn_fn = model_lib.dense_causal_attention
+    if positions is None:
+        positions = jnp.arange(tgt_in.shape[1], dtype=jnp.int32)
+    mem_k, mem_v = memory_projections(cfg, dec["blocks"], memory)
+
+    x = dec["embed"][tgt_in]
+    body = partial(model_lib._block_with_aux, cfg, attn_fn, positions)
+
+    def scan_body(carry, layer_and_mem):
+        layer, mk, mv = layer_and_mem
+        # block order: self-attention -> MLP (the shared block body,
+        # unchanged so all families stay on one implementation), then the
+        # cross branch as its own pre-normed residual read of the memory.
+        # Equivalent capacity to the classic self -> cross -> MLP order;
+        # chosen so _block_with_aux is reused verbatim.
+        x, aux, _k, _v = body(carry, layer)
+        h = model_lib.rms_norm(x, layer["ln_x"])
+        x = x + _cross_attend(cfg, h, layer, mk, mv)
+        return x, aux
+
+    if cfg.remat:
+        scan_body = jax.checkpoint(
+            scan_body, policy=model_lib.remat_xla_policy(cfg))
+    x, auxes = jax.lax.scan(scan_body, x, (dec["blocks"], mem_k, mem_v))
+    x = model_lib.rms_norm(x, dec["ln_f"])
+    logits = jnp.einsum("btd,dv->btv", x, dec["head"])
+    if return_aux:
+        return logits, jnp.sum(auxes)
+    return logits
+
+
+def seq2seq_loss(params: Params, src: jnp.ndarray, tgt_in: jnp.ndarray,
+                 tgt_out: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Mean next-token cross-entropy of tgt_out given (src, tgt_in); MoE
+    configs add the load-balance aux from BOTH stacks (the same
+    ``moe_aux_coeff`` contract as every other family)."""
+    memory, aux_enc = encode(params, src, cfg, return_aux=True)
+    logits, aux_dec = decoder_forward(params, tgt_in, memory, cfg,
+                                      return_aux=True)
+    loss = model_lib.token_cross_entropy(logits, tgt_out)
+    if cfg.moe_aux_coeff > 0:
+        loss = loss + cfg.moe_aux_coeff * (aux_enc + aux_dec)
+    return loss
+
+
+def make_seq2seq_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer=None,
+    accum_steps: int = 1,
+):
+    """Jitted (state, src, tgt_in, tgt_out) -> (state, loss) with training's
+    sharding discipline (batch on dp, sequence on sp, params per
+    seq2seq_param_specs)."""
+    optimizer = optimizer or make_optimizer()
+    bspec = NamedSharding(mesh, _filter_spec(mesh, P("dp", "sp")))
+
+    step = make_update_step(
+        lambda p, s, ti, to: seq2seq_loss(p, s, ti, to, cfg),
+        optimizer, accum_steps=accum_steps,
+    )
+    return jax.jit(step, donate_argnums=(0,),
+                   in_shardings=(None, bspec, bspec, bspec))
+
+
+def init_seq2seq_state(rng: jax.Array, cfg: ModelConfig, mesh: Mesh,
+                       optimizer=None):
+    """(TrainState, optimizer) with params born sharded on *mesh*."""
+    from kubetpu.jobs.train import TrainState
+
+    optimizer = optimizer or make_optimizer()
+    p_shardings = _shardings(mesh, seq2seq_param_specs(cfg))
+
+    @partial(jax.jit, out_shardings=p_shardings)
+    def _init(rng):
+        return init_seq2seq_params(rng, cfg)
+
+    params = _init(rng)
+    opt_state = jax.jit(optimizer.init)(params)  # inherits param shardings
+    return TrainState(params=params, opt_state=opt_state,
+                      step=jnp.zeros((), jnp.int32)), optimizer
+
+
+def make_seq2seq_generate(cfg: ModelConfig, bos_id: int = 1,
+                          eos_id: Optional[int] = None):
+    """Greedy generate(params, src (B, S), num_steps) -> (B, num_steps)
+    target tokens. The SOURCE is encoded once; each step re-runs the
+    decoder on the full prefix so far (an O(num_steps) passes exact path —
+    including the cross K/V einsums, which sit inside the loop body and
+    are hoisted only if XLA chooses to; a KV-cached decoder step is the
+    dense-server integration's job). Keep num_steps modest. With *eos_id*,
+    sequences that emit it keep emitting eos_id for their remaining steps
+    (the fixed-shape analog of stopping)."""
+
+    def generate(params, src, num_steps: int):
+        memory = encode(params, src, cfg)
+        b = src.shape[0]
+        out = jnp.full((b, num_steps + 1), bos_id, jnp.int32)
+        done0 = jnp.zeros((b,), bool)
+
+        def step(i, carry):
+            out, done = carry
+            logits = decoder_forward(params, out[:, : num_steps + 1], memory, cfg)
+            nxt = jnp.argmax(logits, axis=-1)  # (B, T)
+            pick = jnp.take_along_axis(nxt, i[None, None].astype(jnp.int32),
+                                       axis=1)[:, 0]
+            if eos_id is not None:
+                pick = jnp.where(done, eos_id, pick)
+                done = done | (pick == eos_id)
+            out = jax.lax.dynamic_update_slice(
+                out, pick[:, None].astype(jnp.int32), (0, i + 1))
+            return out, done
+
+        out, _ = jax.lax.fori_loop(0, num_steps, step, (out, done0))
+        return out[:, 1:]
+
+    return jax.jit(generate, static_argnums=(2,))

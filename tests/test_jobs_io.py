@@ -176,3 +176,38 @@ def test_evaluate_reports_loss_and_perplexity():
     assert np.isfinite(r["loss"]) and r["perplexity"] > 1.0
     # untrained model on a 64-token vocab: loss ~ ln(64)
     assert abs(r["loss"] - np.log(cfg.vocab)) < 1.0
+
+
+def test_async_checkpointer_overlaps_and_restores(tmp_path):
+    """AsyncCheckpointer.save returns before I/O completes, training
+    continues, and the flushed checkpoint restores exactly."""
+    from kubetpu.jobs.checkpoint import AsyncCheckpointer
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    state, _ = step(state, tokens, targets)
+
+    expected_head = np.asarray(jax.device_get(state.params["head"]))
+
+    ckpt = tmp_path / "async" / "1"
+    with AsyncCheckpointer() as ac:
+        ac.save(str(ckpt), state)
+        # train PAST the snapshot while the write drains — the step DONATES
+        # state's buffers, so this deletes them; save() must have
+        # host-snapshotted already or the background write would read
+        # deleted arrays
+        cont, _ = step(state, tokens, targets)
+        ac.wait()
+    fresh, _ = init_state(jax.random.PRNGKey(9), cfg, mesh)
+    restored = restore_checkpoint(str(ckpt), fresh)
+    # restored state is the SNAPSHOT (step 1), not the continued state
+    assert int(restored.step) == 1 and int(cont.step) == 2
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(restored.params["head"])),
+        expected_head, rtol=1e-6)
+    cont2, loss = step(restored, tokens, targets)
+    assert jnp.isfinite(loss) and int(cont2.step) == 2
