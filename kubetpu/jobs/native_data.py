@@ -114,15 +114,31 @@ class TokenFile:
         return out
 
     def batches(
-        self, batch: int, seq: int, seed: int = 0
+        self, batch: int, seq: int, seed: int = 0,
+        worker: int = 0, num_workers: int = 1,
     ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Endless (tokens, targets) int32 batches; targets are tokens
-        shifted by one (seq+1-token windows). Deterministic per seed."""
-        rng = np.random.default_rng(seed)
-        hi = self.num_tokens - (seq + 1)
-        if hi < 0:
-            raise ValueError("corpus shorter than one sequence")
+        shifted by one (seq+1-token windows). Deterministic per seed.
+
+        ``(worker, num_workers)`` shards the corpus for multi-process data
+        parallelism: each worker draws windows only from its contiguous
+        1/num_workers span of the token stream (disjoint data, not just
+        different seeds), with the worker id folded into the RNG. Pass
+        ``jax.process_index()/jax.process_count()`` after
+        ``initialize_distributed`` (jobs.launch) — the gang launcher's
+        workers then read disjoint shards of one corpus file."""
+        if not 0 <= worker < num_workers:
+            raise ValueError(f"worker {worker} not in [0, {num_workers})")
+        # plain `seed` for the single-worker default keeps pre-sharding
+        # streams byte-identical (replays of old runs stay reproducible)
+        rng = np.random.default_rng(
+            seed if num_workers == 1 else (seed, worker))
+        span = self.num_tokens // num_workers
+        lo = worker * span
+        hi = lo + span - (seq + 1)
+        if hi < lo:
+            raise ValueError("corpus shard shorter than one sequence")
         while True:
-            offsets = rng.integers(0, hi + 1, size=batch)
+            offsets = rng.integers(lo, hi + 1, size=batch)
             rows = self.gather(offsets, seq + 1)
             yield rows[:, :-1], rows[:, 1:]

@@ -1,4 +1,5 @@
-"""Ring attention: sequence-parallel causal attention over a device ring.
+"""Ring attention: sequence-parallel attention over a device ring —
+causal (decoder) by default, bidirectional (encoder) with ``causal=False``.
 
 Long-context first-class support: the sequence axis is sharded across the
 ``sp`` mesh axis; each device holds one contiguous block of queries and
@@ -36,8 +37,11 @@ def _ring_attention_local(
     k: jnp.ndarray,
     v: jnp.ndarray,
     axis_name: str,
+    causal: bool = True,
 ) -> jnp.ndarray:
-    """Per-device body (runs under shard_map). q/k/v: (B, S_local, H, D)."""
+    """Per-device body (runs under shard_map). q/k/v: (B, S_local, H, D).
+    ``causal=False`` is the bidirectional (encoder) ring: every block is
+    fully visible, so the mask machinery drops away entirely."""
     sp_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -52,18 +56,22 @@ def _ring_attention_local(
         src_idx = (my_idx - t) % sp_size
 
         scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
-        # blockwise causal mask in global positions
-        q_pos = my_idx * s_local + local_pos
-        k_pos = src_idx * s_local + local_pos
-        mask = q_pos[:, None] >= k_pos[None, :]  # (S_local, S_local)
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        if causal:
+            # blockwise causal mask in global positions
+            q_pos = my_idx * s_local + local_pos
+            k_pos = src_idx * s_local + local_pos
+            mask = q_pos[:, None] >= k_pos[None, :]  # (S_local, S_local)
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))  # (B, H, Q)
-        # exp under explicit mask: avoids exp(NEG_INF - NEG_INF) = 1 garbage
-        # on blocks where nothing is visible yet
-        p = jnp.where(
-            mask[None, None], jnp.exp(scores - m_new[..., None]), 0.0
-        )
+        if causal:
+            # exp under explicit mask: avoids exp(NEG_INF - NEG_INF) = 1
+            # garbage on blocks where nothing is visible yet
+            p = jnp.where(
+                mask[None, None], jnp.exp(scores - m_new[..., None]), 0.0
+            )
+        else:
+            p = jnp.exp(scores - m_new[..., None])
         correction = jnp.exp(m - m_new)
         l_new = l * correction + jnp.sum(p, axis=-1)
         o_new = o * correction[..., None] + jnp.einsum(
@@ -103,15 +111,18 @@ def _merge_weights(w, b, h, s_local):
     return w.reshape(b, h, s_local, 1).transpose(0, 2, 1, 3)
 
 
-def _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
+def _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret,
+                         causal=True):
     from kubetpu.ops.flash_attention import _flash_forward
 
     sp_size = jax.lax.psum(1, axis_name)  # static under shard_map
     my_idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
 
-    # step 0 is ALWAYS the diagonal block: causal kernel, always visible
-    o0, lse0 = _flash_forward(q, k, v, block_q, block_k, interpret, causal=True)
+    # step 0 is ALWAYS the diagonal block: causal kernel (bidirectional
+    # rings run it unmasked), always visible
+    o0, lse0 = _flash_forward(q, k, v, block_q, block_k, interpret,
+                              causal=causal)
 
     def rotate(x):
         return jax.lax.ppermute(
@@ -122,9 +133,10 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
         o_acc, lse, k_blk, v_blk = carry
         k_blk = rotate(k_blk)
         v_blk = rotate(v_blk)
-        # after t rotations we hold block (my_idx - t); visible iff j < i,
-        # i.e. t <= my_idx (wrapped blocks are future positions)
-        visible = (t <= my_idx)
+        # after t rotations we hold block (my_idx - t); causal rings see it
+        # iff j < i, i.e. t <= my_idx (wrapped blocks are future
+        # positions); bidirectional rings see every block
+        visible = (t <= my_idx) if causal else jnp.bool_(True)
         o_t, lse_t = _flash_forward(
             q, k_blk, v_blk, block_q, block_k, interpret, causal=False
         )
@@ -141,18 +153,21 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
     return o_acc.astype(q.dtype), lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash(q, k, v, axis_name, block_q, block_k, interpret):
-    out, _lse = _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret)
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, block_q, block_k, interpret, causal=True):
+    out, _lse = _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k,
+                                     interpret, causal)
     return out
 
 
-def _ring_flash_vjp_fwd(q, k, v, axis_name, block_q, block_k, interpret):
-    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret)
+def _ring_flash_vjp_fwd(q, k, v, axis_name, block_q, block_k, interpret,
+                        causal=True):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k,
+                                    interpret, causal)
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_vjp_bwd(axis_name, block_q, block_k, interpret, res, g):
+def _ring_flash_vjp_bwd(axis_name, block_q, block_k, interpret, causal, res, g):
     from kubetpu.ops.flash_attention import _flash_backward
 
     q, k, v, out, lse = res
@@ -164,9 +179,10 @@ def _ring_flash_vjp_bwd(axis_name, block_q, block_k, interpret, res, g):
             x, axis_name, [(i, (i + 1) % sp_size) for i in range(sp_size)]
         )
 
-    # diagonal step: causal kernels, contributions to MY home block
+    # diagonal step: causal kernels (unmasked for bidirectional rings),
+    # contributions to MY home block
     dq0, dk0, dv0 = _flash_backward(
-        q, k, v, out, lse, g, block_q, block_k, interpret, causal=True
+        q, k, v, out, lse, g, block_q, block_k, interpret, causal=causal
     )
 
     def step(t, carry):
@@ -176,7 +192,7 @@ def _ring_flash_vjp_bwd(axis_name, block_q, block_k, interpret, res, g):
         v_blk = rotate(v_blk)
         dk_blk = rotate(dk_blk)
         dv_blk = rotate(dv_blk)
-        visible = (t <= my_idx).astype(jnp.float32)
+        visible = ((t <= my_idx) if causal else jnp.bool_(True)).astype(jnp.float32)
         dq_t, dk_t, dv_t = _flash_backward(
             q, k_blk, v_blk, out, lse, g, block_q, block_k, interpret,
             causal=False,
@@ -207,17 +223,19 @@ def make_ring_local(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    causal: bool = True,
 ):
     """The per-device ring body (q, k, v) -> out, for callers that are
     ALREADY inside a manual region over *axis_name* (e.g. the pipeline's
-    {pp, sp} region) — the single place the impl dispatch lives."""
+    {pp, sp} region) — the single place the impl dispatch lives.
+    ``causal=False`` gives the bidirectional (encoder) ring."""
     if impl not in ("dense", "flash"):
         raise ValueError(f"unknown ring impl {impl!r} (expected 'dense' or 'flash')")
     if impl == "flash":
         return lambda q, k, v: _ring_flash(
-            q, k, v, axis_name, block_q, block_k, interpret
+            q, k, v, axis_name, block_q, block_k, interpret, causal
         )
-    return partial(_ring_attention_local, axis_name=axis_name)
+    return partial(_ring_attention_local, axis_name=axis_name, causal=causal)
 
 
 def make_ring_attention(
@@ -227,6 +245,7 @@ def make_ring_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool = False,
+    causal: bool = True,
 ):
     """An attention core (q, k, v) -> out with the sequence axis sharded over
     *axis_name*, drop-in for ``model.forward``'s ``attn_fn``.
@@ -240,9 +259,13 @@ def make_ring_attention(
     ``impl="flash"`` runs the Pallas flash kernels inside every ring step
     (VMEM-tiled scores instead of a dense per-step softmax; fused ring
     backward). ``interpret=True`` for CPU tests of the flash impl.
+    ``causal=False`` is the bidirectional ring for long-context ENCODER
+    stacks (and the seq2seq encoder): same rotation, no mask — drop-in for
+    ``encoder_forward``'s ``attn_fn``.
     """
     specs = P(None, axis_name, None, None)
-    local = make_ring_local(impl, axis_name, block_q, block_k, interpret)
+    local = make_ring_local(impl, axis_name, block_q, block_k, interpret,
+                            causal)
     return jax.shard_map(
         lambda q, k, v: local(q, k, v),
         mesh=mesh,
