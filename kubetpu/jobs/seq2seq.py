@@ -8,8 +8,10 @@ memory. TPU-first choices:
 
 - the encoder is ``model.forward_hidden`` under a bidirectional core (the
   Pallas ``flash_attention(causal=False)`` kernel on hardware);
-- the source is encoded ONCE per generate; the greedy loop re-runs only
-  the decoder prefix (see ``make_seq2seq_generate`` for the exact cost);
+- the source is encoded ONCE per generate and its per-layer cross K/V
+  precomputed; the default greedy loop decodes through a self-attention
+  KV cache (one T=1 block pass per layer per step), pinned exactly
+  against a full-recompute reference path;
 - all per-layer weights (including the cross branch) are stacked on a
   leading L axis and scanned, so compiles stay flat and remat applies
   uniformly;
@@ -215,16 +217,49 @@ def init_seq2seq_state(rng: jax.Array, cfg: ModelConfig, mesh: Mesh,
                       step=jnp.zeros((), jnp.int32)), optimizer
 
 
+def decoder_forward_chunk(cfg: ModelConfig, params: Params, tokens,
+                          mem_k, mem_v, k_cache, v_cache, pos):
+    """T-token chunk through the decoder's self-attention KV cache plus a
+    cross-attention read of the precomputed memory projections — the
+    seq2seq analog of ``decode.forward_chunk`` (same block body via
+    ``decode._decode_block``, cross branch appended exactly as in
+    ``decoder_forward``). tokens: (B, T); caches: (L, B, S_max, Hkv, D);
+    mem_k/mem_v from ``memory_projections``."""
+    from kubetpu.jobs import decode as decode_lib
+
+    dec = params["decoder"]
+    x = dec["embed"][tokens]
+
+    def layer_body(carry, inputs):
+        x = carry
+        layer, mk, mv, k_l, v_l = inputs
+        x, k_l, v_l = decode_lib._decode_block(cfg, layer, x, k_l, v_l, pos)
+        h = model_lib.rms_norm(x, layer["ln_x"])
+        x = x + _cross_attend(cfg, h, layer, mk, mv)
+        return x, (k_l, v_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        layer_body, x, (dec["blocks"], mem_k, mem_v, k_cache, v_cache)
+    )
+    x = model_lib.rms_norm(x, dec["ln_f"])
+    logits = jnp.einsum("btd,dv->btv", x, dec["head"]).astype(jnp.float32)
+    return logits, k_cache, v_cache
+
+
 def make_seq2seq_generate(cfg: ModelConfig, bos_id: int = 1,
-                          eos_id: Optional[int] = None):
+                          eos_id: Optional[int] = None,
+                          cached: bool = True):
     """Greedy generate(params, src (B, S), num_steps) -> (B, num_steps)
-    target tokens. The SOURCE is encoded once; each step re-runs the
-    decoder on the full prefix so far (an O(num_steps) passes exact path —
-    including the cross K/V einsums, which sit inside the loop body and
-    are hoisted only if XLA chooses to; a KV-cached decoder step is the
-    dense-server integration's job). Keep num_steps modest. With *eos_id*,
-    sequences that emit it keep emitting eos_id for their remaining steps
-    (the fixed-shape analog of stopping)."""
+    target tokens. The SOURCE is encoded once. ``cached=True`` (default)
+    decodes through the self-attention KV cache with the cross K/V
+    precomputed once — each step pays one T=1 block pass per layer.
+    ``cached=False`` re-runs the decoder on the full prefix each step
+    (O(num_steps) full passes — the correctness reference the cached path
+    is pinned against in tests). With *eos_id*, sequences that emit it
+    keep emitting eos_id for their remaining steps (the fixed-shape
+    analog of stopping)."""
+    if cached:
+        return _make_cached_generate(cfg, bos_id, eos_id)
 
     def generate(params, src, num_steps: int):
         memory = encode(params, src, cfg)
@@ -247,5 +282,38 @@ def make_seq2seq_generate(cfg: ModelConfig, bos_id: int = 1,
 
         out, _ = jax.lax.fori_loop(0, num_steps, step, (out, done0))
         return out[:, 1:]
+
+    return jax.jit(generate, static_argnums=(2,))
+
+
+def _make_cached_generate(cfg: ModelConfig, bos_id: int,
+                          eos_id: Optional[int]):
+    from kubetpu.jobs import decode as decode_lib
+
+    def generate(params, src, num_steps: int):
+        memory = encode(params, src, cfg)
+        mem_k, mem_v = memory_projections(cfg, params["decoder"]["blocks"],
+                                          memory)
+        b = src.shape[0]
+        k_cache, v_cache = decode_lib.init_kv_cache(cfg, b, num_steps + 1)
+        last = jnp.full((b,), bos_id, jnp.int32)
+        done0 = jnp.zeros((b,), bool)
+
+        def step(carry, i):
+            last, k_cache, v_cache, done = carry
+            logits, k_cache, v_cache = decoder_forward_chunk(
+                cfg, params, last[:, None], mem_k, mem_v, k_cache, v_cache, i
+            )
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            if eos_id is not None:
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id)
+            return (nxt, k_cache, v_cache, done), nxt
+
+        _, outs = jax.lax.scan(
+            step, (last, k_cache, v_cache, done0),
+            jnp.arange(num_steps, dtype=jnp.int32),
+        )
+        return outs.T  # (num_steps, B) -> (B, num_steps)
 
     return jax.jit(generate, static_argnums=(2,))

@@ -116,3 +116,16 @@ def test_generate_eos_pins_finished_sequences():
         hits = np.where(row == 0)[0]
         if hits.size:  # everything after the first EOS must stay EOS
             assert (row[hits[0]:] == 0).all()
+
+
+def test_cached_generate_matches_recompute_reference():
+    """The KV-cached decoder (cross K/V precomputed, T=1 steps) must emit
+    exactly the recompute-reference path's greedy tokens, with and
+    without EOS pinning."""
+    params, src, _ = _setup()
+    for eos in (None, 0):
+        ref = make_seq2seq_generate(CFG, bos_id=1, eos_id=eos, cached=False)
+        fast = make_seq2seq_generate(CFG, bos_id=1, eos_id=eos, cached=True)
+        np.testing.assert_array_equal(
+            np.asarray(ref(params, src, 7)), np.asarray(fast(params, src, 7)),
+            err_msg=f"eos={eos}")
