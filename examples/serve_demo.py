@@ -11,6 +11,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+if __name__ == "__main__":
+    # the environment may pin JAX to a hardware platform via sitecustomize;
+    # this demo is a CPU walkthrough (same pattern as tests/conftest)
+    jax.config.update("jax_platforms", "cpu")
+
 from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
 from kubetpu.jobs.serving import DecodeServer  # noqa: E402
 

@@ -113,6 +113,10 @@ def _run_demo(cluster, agents, wire):
 
     # --- 3. the allocation becomes a jax mesh; train + checkpoint --------
     import jax
+
+    # the environment may pin JAX to a hardware platform via sitecustomize;
+    # this demo is a CPU-mesh walkthrough (same pattern as tests/conftest)
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from kubetpu.jobs import ModelConfig, init_state, make_train_step, mesh_from_allocation
