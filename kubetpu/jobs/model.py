@@ -59,6 +59,13 @@ class ModelConfig:
     # coeff * E * sum_e(frac_tokens_e * mean_prob_e) to next_token_loss,
     # keeping the router from collapsing onto few experts (0 = off)
     moe_aux_coeff: float = 0.0
+    # experts per token on the capacity path (1 = switch routing, the
+    # default; 2 = GShard/Mixtral-style top-2). Gate weights are the RAW
+    # router probabilities for every k (no renormalization), so k=1
+    # reproduces switch exactly and the router always gets gradient
+    # through the gate. Primary choices claim capacity slots before
+    # secondary ones; size capacity_factor for k tokens-per-expert-slots.
+    moe_top_k: int = 1
     # grouped-query attention: number of K/V heads (0 = n_heads, plain MHA;
     # 1 = MQA). Must divide n_heads; the decode KV cache stores only these,
     # cutting its HBM footprint by n_heads/n_kv_heads. With tensor
@@ -67,6 +74,15 @@ class ModelConfig:
     n_kv_heads: int = 0
 
     def __post_init__(self):
+        if self.moe_top_k < 1 or (self.n_experts and self.moe_top_k > self.n_experts):
+            raise ValueError(
+                f"moe_top_k ({self.moe_top_k}) must be in [1, n_experts]"
+            )
+        if self.moe_top_k > 1 and self.n_experts > 0 and self.moe_capacity_factor <= 0:
+            raise ValueError(
+                "moe_top_k > 1 requires the capacity dispatch path "
+                "(set moe_capacity_factor > 0)"
+            )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
@@ -209,7 +225,8 @@ def _mlp(cfg: ModelConfig, h: jnp.ndarray, layer: Params):
     decode path so they can never diverge."""
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts > 0 and cfg.moe_capacity_factor > 0:
-        out, probs = _moe_mlp_capacity(h, layer, cfg.moe_capacity_factor)
+        out, probs = _moe_mlp_capacity(h, layer, cfg.moe_capacity_factor,
+                                       cfg.moe_top_k)
     elif cfg.n_experts > 0:
         out, probs = _moe_mlp(h, layer)
     else:
@@ -261,9 +278,11 @@ def _block_with_aux(
     return x + delta, aux, k, v
 
 
-def _moe_mlp_capacity(h: jnp.ndarray, layer: Params, capacity_factor: float) -> jnp.ndarray:
-    """Top-1 mixture-of-experts with capacity-based dispatch — the
-    production path.
+def _moe_mlp_capacity(
+    h: jnp.ndarray, layer: Params, capacity_factor: float, top_k: int = 1
+) -> "tuple[jnp.ndarray, jnp.ndarray]":
+    """Top-k mixture-of-experts with capacity-based dispatch — the
+    production path (k=1: switch routing; k=2: GShard/Mixtral-style).
 
     Tokens are assigned a slot inside their chosen expert's capacity buffer
     (position = running count of earlier tokens routed to that expert); the
@@ -278,30 +297,45 @@ def _moe_mlp_capacity(h: jnp.ndarray, layer: Params, capacity_factor: float) -> 
     b, s, d = h.shape
     n = b * s
     e = layer["moe_router"].shape[-1]
+    k = top_k
     tokens = h.reshape(n, d)
 
     router = (tokens @ layer["moe_router"]).astype(jnp.float32)   # (N, E)
     probs = jax.nn.softmax(router, axis=-1)
-    top1 = jnp.argmax(probs, axis=-1)                             # (N,)
-    gate_w = jnp.max(probs, axis=-1).astype(h.dtype)              # (N,)
-    onehot = jax.nn.one_hot(top1, e, dtype=jnp.float32)           # (N, E)
+    topw, topi = jax.lax.top_k(probs, k)                          # (N, K)
+    # RAW router probabilities as gate weights for every k: k=1 reproduces
+    # switch exactly, and the router gets gradient through the gate
+    # without an aux-loss dependency (a renormalized k=1 gate would be
+    # the constant 1.0 — gradient-dead)
+    gate_w = topw                                                 # (N, K) f32
+    onehot = jax.nn.one_hot(topi, e, dtype=jnp.float32)           # (N, K, E)
 
     capacity = max(1, int(capacity_factor * n / e))
-    # slot of each token within its expert (0-based); tokens beyond the
-    # expert's capacity are masked out of the dispatch entirely
-    position = jnp.cumsum(onehot, axis=0) * onehot                # 1-based
-    keep = (position <= capacity).astype(jnp.float32) * onehot
+    # slot of each (token, rank) within its expert. RANK-MAJOR cumsum:
+    # every token's primary choice claims its slot before any secondary
+    # choice competes — flatten (K, N, E) so rank-0 rows come first
+    flat = onehot.transpose(1, 0, 2).reshape(k * n, e)            # (K*N, E)
+    position = jnp.cumsum(flat, axis=0) * flat                    # 1-based
+    keep = (position <= capacity).astype(jnp.float32) * flat
     slot_onehot = jax.nn.one_hot(
         (position - 1.0).astype(jnp.int32), capacity, dtype=jnp.float32
-    )                                                             # (N, E, C)
-    dispatch = (keep[..., None] * slot_onehot).astype(h.dtype)    # (N, E, C)
+    )                                                             # (K*N, E, C)
+    dispatch = (
+        (keep[..., None] * slot_onehot)
+        .reshape(k, n, e, capacity)
+        .transpose(1, 0, 2, 3)
+        .astype(h.dtype)
+    )                                                             # (N, K, E, C)
 
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)       # (E, C, D)
+    expert_in = jnp.einsum("nkec,nd->ecd", dispatch, tokens)      # (E, C, D)
     gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"]))
     up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"])
     out = jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"])  # (E, C, D)
 
-    combined = jnp.einsum("nec,ecd->nd", dispatch, out) * gate_w[:, None]
+    combined = jnp.einsum(
+        "nkec,ecd,nk->nd", dispatch.astype(jnp.float32),
+        out.astype(jnp.float32), gate_w,
+    ).astype(h.dtype)
     return combined.reshape(b, s, d), probs
 
 
