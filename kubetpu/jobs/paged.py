@@ -251,21 +251,23 @@ class PagedDecodeServer(SlotServerBase):
         sampler = self._sampler
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def step_all(params, k_pages, v_pages, table, last, pos, active, rng):
+        def step_all(params, k_pages, v_pages, table, last, pos, active, rng,
+                     temp, tk, tp):
             logits, k_pages, v_pages = paged_forward_one(
                 cfg_, params, last, k_pages, v_pages, table, pos, attend=attend
             )
-            nxt = sampler(logits, rng)
+            nxt = sampler(logits, rng, temp, tk, tp)
             nxt = jnp.where(active, nxt, last)
             pos = pos + active.astype(jnp.int32)
             return k_pages, v_pages, nxt, pos
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_slot(params, k_pages, v_pages, prompt, slot_row, prompt_len, rng):
+        def prefill_slot(params, k_pages, v_pages, prompt, slot_row,
+                         prompt_len, rng, temp, tk, tp):
             first, k_pages, v_pages = paged_prefill(
                 cfg_, params, prompt, k_pages, v_pages, slot_row, prompt_len
             )
-            return k_pages, v_pages, sampler(first, rng)
+            return k_pages, v_pages, sampler(first, rng, temp, tk, tp)
 
         self._step_all = step_all
         self._prefill_slot = prefill_slot
@@ -339,6 +341,9 @@ class PagedDecodeServer(SlotServerBase):
             jnp.asarray(padded, jnp.int32),
             jnp.asarray(self._table[slot]),
             jnp.int32(len(prompt)), self._next_rng(),
+            jnp.float32(self._slot_temp[slot]),
+            jnp.int32(self._slot_topk[slot]),
+            jnp.float32(self._slot_topp[slot]),
         )
         return first
 
@@ -350,6 +355,8 @@ class PagedDecodeServer(SlotServerBase):
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table),
             self.last, self.pos, jnp.asarray(self.active), self._next_rng(),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+            jnp.asarray(self._slot_topp),
         )
         self.last = nxt
         return np.asarray(nxt)
@@ -361,6 +368,7 @@ class PagedDecodeServer(SlotServerBase):
         assert not self.active.any() and not self._queue, (
             "warmup() must run before serving: it scribbles on pool pages"
         )
+        d_temp, d_tk, d_tp = self._default_sampling
         row = np.full((self.max_pages_per_slot,), -1, np.int32)
         row[: self._pages_needed(self.max_seq)] = np.arange(
             self._pages_needed(self.max_seq)
@@ -372,7 +380,8 @@ class PagedDecodeServer(SlotServerBase):
             self.k_pages, self.v_pages, _ = self._prefill_slot(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(padded, jnp.int32), jnp.asarray(row), jnp.int32(1),
-                self._next_rng(),
+                self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
+                jnp.float32(d_tp),
             )
             if bucket >= self.max_seq:
                 break
@@ -381,6 +390,8 @@ class PagedDecodeServer(SlotServerBase):
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table), self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+            jnp.asarray(self._slot_topp),
         )
         # drain the dispatch queue so the first live admission doesn't pay
         # (and record) the queued warmup executions as admission stall —

@@ -81,3 +81,61 @@ def make_sampler(
         return jax.random.categorical(rng, x).astype(jnp.int32)
 
     return sample
+
+
+def apply_top_k_rows(logits: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Per-row top-k: *k* is a traced (...) int32 array broadcast over the
+    leading dims (0 = filter off for that row). Static shapes: one full
+    descending sort, then a per-row threshold gather."""
+    v = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    idx = jnp.clip(k - 1, 0, v - 1)
+    thresh = jnp.take_along_axis(sorted_desc, idx[..., None], axis=-1)
+    masked = jnp.where(logits < thresh, NEG_INF, logits)
+    return jnp.where((k > 0)[..., None], masked, logits)
+
+
+def apply_top_p_rows(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Per-row nucleus filtering: *p* is a traced (...) float array
+    (>= 1 = filter off for that row). Same boundary semantics as
+    ``apply_top_p``."""
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < p[..., None]
+    cutoff = jnp.min(
+        jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    masked = jnp.where(logits < cutoff, NEG_INF, logits)
+    return jnp.where((p < 1.0)[..., None], masked, logits)
+
+
+def make_slot_sampler():
+    """Per-request sampling inside ONE compiled step:
+    ``sample(logits (..., V), rng, temperature, top_k, top_p) -> (...)``
+    where temperature/top_k/top_p are traced arrays broadcast over the
+    leading dims — every slot of a serving batch draws with its own
+    settings, no per-config recompile. Rows with temperature <= 0 are
+    greedy (exact argmax, filters bypassed), matching ``make_sampler``'s
+    static greedy path token-for-token."""
+
+    def sample(logits, rng, temperature, top_k, top_p):
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def stochastic(_):
+            # two full-vocab sorts (one per filter) — acceptable at serving
+            # batch sizes; the all-greedy fast path below skips them all
+            x = logits.astype(jnp.float32) / jnp.maximum(
+                temperature, 1e-6)[..., None]
+            x = apply_top_k_rows(x, top_k)
+            x = apply_top_p_rows(x, top_p)
+            drawn = jax.random.categorical(rng, x).astype(jnp.int32)
+            return jnp.where(temperature <= 0.0, greedy, drawn)
+
+        # all-greedy batches (the server default) execute ONLY the argmax:
+        # lax.cond skips the sort/softmax/categorical machinery at runtime
+        return jax.lax.cond(
+            jnp.all(temperature <= 0.0), lambda _: greedy, stochastic, None
+        )
+
+    return sample

@@ -93,11 +93,27 @@ class SlotServerBase:
         top_p: Optional[float] = None,
         seed: int = 0,
     ) -> None:
-        from kubetpu.jobs.sampling import make_sampler
+        from kubetpu.jobs.sampling import make_slot_sampler
 
         self.cfg = cfg
         self.params = params
-        self._sampler = make_sampler(temperature, top_k=top_k, top_p=top_p)
+        # Per-request sampling: one compiled step serves every (temperature,
+        # top_k, top_p) combination — the settings are traced per-slot
+        # arrays, not baked constants. Server-level arguments are the
+        # defaults a request inherits unless submit/enqueue overrides them.
+        self._sampler = make_slot_sampler()
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if top_k is not None and top_k <= 0:
+            raise ValueError("top_k must be positive (or None)")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        self._default_sampling = (
+            float(temperature), int(top_k or 0), float(top_p or 1.0))
+        self._slot_temp = np.full((n_slots,), temperature, np.float32)
+        self._slot_topk = np.full((n_slots,), top_k or 0, np.int32)
+        self._slot_topp = np.full((n_slots,), top_p or 1.0, np.float32)
+        self._rid_sampling: Dict[int, Tuple[float, int, float]] = {}
         self._rng = jax.random.PRNGKey(seed)
         self.n_slots = n_slots
         self.max_seq = max_seq
@@ -147,6 +163,12 @@ class SlotServerBase:
         path, which must not serialize prefill-complete before the decode
         dispatch."""
         t0 = time.perf_counter()
+        # slot sampling settings BEFORE the prefill — it samples the first
+        # token under them
+        temp, tk, tp = self._rid_sampling.get(rid, self._default_sampling)
+        self._slot_temp[slot] = temp
+        self._slot_topk[slot] = tk
+        self._slot_topp[slot] = tp
         first = self._admit_device(prompt, slot)
         if first is None:
             return False
@@ -166,29 +188,63 @@ class SlotServerBase:
         self._metrics.record("admission_stall", time.perf_counter() - t0)
         return True
 
-    def submit(self, prompt: List[int]) -> Optional[int]:
+    def _normalize_sampling(
+        self, sampling: Optional[dict]
+    ) -> Tuple[float, int, float]:
+        if sampling is None:
+            return self._default_sampling
+        unknown = set(sampling) - {"temperature", "top_k", "top_p"}
+        if unknown:
+            raise ValueError(f"unknown sampling keys {sorted(unknown)}")
+        d_temp, d_tk, d_tp = self._default_sampling
+        # explicit falsy overrides are MEANINGFUL: top_k=0 / top_p=1.0 turn
+        # the filter off for this request (None defers to the default)
+        tk = sampling.get("top_k", d_tk)
+        tp = sampling.get("top_p", d_tp)
+        temp, tk, tp = (
+            float(sampling.get("temperature", d_temp)),
+            int(d_tk if tk is None else tk),
+            float(d_tp if tp is None else tp),
+        )
+        if temp < 0:
+            raise ValueError("temperature must be >= 0")
+        if tk < 0:
+            raise ValueError("top_k must be >= 0 (0 = off)")
+        if not 0.0 < tp <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        return temp, tk, tp
+
+    def submit(self, prompt: List[int],
+               sampling: Optional[dict] = None) -> Optional[int]:
         """Admit into a free slot; None when slots (or, for the paged
         server, pool pages) are unavailable. Synchronous admission; see
-        ``enqueue`` for the non-blocking path."""
+        ``enqueue`` for the non-blocking path. *sampling* overrides the
+        server defaults for THIS request: a dict with any of temperature /
+        top_k / top_p."""
         self._check_prompt(prompt)
         free = [i for i in range(self.n_slots) if not self.active[i]]
         if not free:
             return None
         rid = self._next_rid
         self._next_rid += 1
+        self._rid_sampling[rid] = self._normalize_sampling(sampling)
         if not self._try_admit(rid, prompt, free[0]):
             self._next_rid -= 1
+            del self._rid_sampling[rid]
             return None
         return rid
 
-    def enqueue(self, prompt: List[int]) -> int:
+    def enqueue(self, prompt: List[int],
+                sampling: Optional[dict] = None) -> int:
         """Non-blocking admission: host-side bookkeeping ONLY — the caller
         never waits on a compile or a prefill. The request enters a slot at
         the next ``step`` boundary with one free (decode keeps emitting for
-        active streams in the meantime). Always returns a request id."""
+        active streams in the meantime). Always returns a request id.
+        *sampling* as in ``submit``."""
         self._check_prompt(prompt)
         rid = self._next_rid
         self._next_rid += 1
+        self._rid_sampling[rid] = self._normalize_sampling(sampling)
         self._prompts[rid] = list(prompt)
         self._emitted[rid] = []
         self._done[rid] = False
@@ -290,6 +346,7 @@ class SlotServerBase:
             raise KeyError(f"request {rid} is not finished")
         out = self._prompts.pop(rid) + self._emitted.pop(rid)
         del self._done[rid]
+        self._rid_sampling.pop(rid, None)
         return out
 
     def drain(self, max_steps: int = 10_000) -> None:
@@ -360,7 +417,8 @@ class DecodeServer(SlotServerBase):
         # with the results, so XLA updates the (large) cache buffers in
         # place instead of holding input+output copies live per step
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_slot(params, k_cache, v_cache, prompt, slot, prompt_len, rng):
+        def prefill_slot(params, k_cache, v_cache, prompt, slot, prompt_len,
+                         rng, temp, tk, tp):
             # single-sequence chunk forward at pos 0, written into `slot`;
             # `prompt` is bucket-padded (see module docstring) — only
             # prompt_len is real, and the last REAL position's logits pick
@@ -376,15 +434,17 @@ class DecodeServer(SlotServerBase):
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v_s, (0, slot, 0, 0, 0)
             )
-            first = sampler(jnp.take(logits[0], prompt_len - 1, axis=0), rng)
+            first = sampler(jnp.take(logits[0], prompt_len - 1, axis=0), rng,
+                            temp, tk, tp)
             return k_cache, v_cache, first
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def step_all(params, k_cache, v_cache, last, pos, active, rng):
+        def step_all(params, k_cache, v_cache, last, pos, active, rng,
+                     temp, tk, tp):
             logits, k_cache, v_cache = forward_chunk_at(
                 cfg_, params, last[:, None], k_cache, v_cache, pos
             )
-            nxt = sampler(logits[:, 0], rng)
+            nxt = sampler(logits[:, 0], rng, temp, tk, tp)
             nxt = jnp.where(active, nxt, last)     # inactive slots hold
             pos = pos + active.astype(jnp.int32)
             return k_cache, v_cache, nxt, pos
@@ -403,6 +463,9 @@ class DecodeServer(SlotServerBase):
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(padded, jnp.int32), jnp.int32(slot),
             jnp.int32(len(prompt)), self._next_rng(),
+            jnp.float32(self._slot_temp[slot]),
+            jnp.int32(self._slot_topk[slot]),
+            jnp.float32(self._slot_topp[slot]),
         )
         return first
 
@@ -410,6 +473,8 @@ class DecodeServer(SlotServerBase):
         self.k_cache, self.v_cache, nxt, self.pos = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
             jnp.asarray(self.active), self._next_rng(),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+            jnp.asarray(self._slot_topp),
         )
         self.last = nxt
         return np.asarray(nxt)
@@ -424,6 +489,7 @@ class DecodeServer(SlotServerBase):
             "warmup() must run before serving: it scribbles on slot 0's "
             "cache rows"
         )
+        d_temp, d_tk, d_tp = self._default_sampling
         bucket = 1
         while True:
             dummy = [0] * min(bucket, self.max_seq)
@@ -431,7 +497,8 @@ class DecodeServer(SlotServerBase):
             self.k_cache, self.v_cache, _ = self._prefill_slot(
                 self.params, self.k_cache, self.v_cache,
                 jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
-                self._next_rng(),
+                self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
+                jnp.float32(d_tp),
             )
             if bucket >= self.max_seq:
                 break
@@ -439,6 +506,8 @@ class DecodeServer(SlotServerBase):
         self.k_cache, self.v_cache, _nxt, _pos = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
+            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
+            jnp.asarray(self._slot_topp),
         )
         # drain the dispatch queue: without this the FIRST live admission
         # pays the wall time of every queued warmup execution and records

@@ -181,3 +181,18 @@ def test_mesh_sharded_paged_server_matches_unsharded(params):
                                        mesh=mesh)
     assert "tp" in str(sharded_server.k_pages.sharding.spec)
     assert run(sharded_server) == plain
+
+
+def test_paged_per_request_sampling(params):
+    """Per-request sampling flows through the paged legs too: temp=3
+    truncated to top_k=1 == greedy."""
+    prompt = [3, 14, 15, 9, 2, 6]
+    ref = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=6, page_size=8)
+    rr = ref.submit(prompt)
+    ref.drain()
+    srv = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=6, page_size=8)
+    rs = srv.submit(prompt, sampling={"temperature": 3.0, "top_k": 1})
+    srv.drain()
+    assert srv.result(rs) == ref.result(rr)

@@ -106,3 +106,41 @@ def test_serving_with_sampler_runs_and_differs_from_greedy():
     assert len(g) == len(w) == len(prompt) + 8
     assert all(0 <= t < cfg.vocab for t in w)
     assert g != w                           # hot sampling took another path
+
+
+def test_per_row_filters_match_static_filters():
+    """apply_top_k_rows/apply_top_p_rows with uniform settings must equal
+    the static per-call filters; 0 / >=1 disable per row."""
+    from kubetpu.jobs.sampling import (
+        apply_top_k, apply_top_k_rows, apply_top_p, apply_top_p_rows,
+    )
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+    np.testing.assert_allclose(
+        np.asarray(apply_top_k_rows(logits, jnp.full((4,), 3, jnp.int32))),
+        np.asarray(apply_top_k(logits, 3)))
+    np.testing.assert_allclose(
+        np.asarray(apply_top_p_rows(logits, jnp.full((4,), 0.7))),
+        np.asarray(apply_top_p(logits, 0.7)), rtol=1e-6)
+    # disabled rows pass through untouched
+    np.testing.assert_allclose(
+        np.asarray(apply_top_k_rows(logits, jnp.zeros((4,), jnp.int32))),
+        np.asarray(logits))
+    np.testing.assert_allclose(
+        np.asarray(apply_top_p_rows(logits, jnp.ones((4,)))),
+        np.asarray(logits))
+    # mixed rows: each row obeys ITS setting
+    mixed = apply_top_k_rows(logits, jnp.asarray([0, 1, 3, 16], jnp.int32))
+    np.testing.assert_allclose(np.asarray(mixed[0]), np.asarray(logits[0]))
+    assert (np.asarray(mixed[1]) <= -1e29).sum() == 15  # only argmax survives
+
+
+def test_slot_sampler_greedy_rows_are_exact_argmax():
+    from kubetpu.jobs.sampling import make_slot_sampler
+
+    sampler = make_slot_sampler()
+    logits = jax.random.normal(jax.random.PRNGKey(1), (6, 32))
+    toks = sampler(logits, jax.random.PRNGKey(2),
+                   jnp.zeros((6,)), jnp.zeros((6,), jnp.int32), jnp.ones((6,)))
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  np.asarray(jnp.argmax(logits, axis=-1)))

@@ -187,3 +187,64 @@ def test_mesh_sharded_server_matches_unsharded():
     assert sharded_server.params["blocks"]["wq"].sharding.spec != ()
     sharded = run(sharded_server)
     assert plain == sharded
+
+
+def test_per_request_sampling_applies_per_slot():
+    """Two concurrent requests with different sampling settings share one
+    compiled step: a temperature=3 request truncated to top_k=1 must emit
+    exactly the greedy stream (truncated argmax == argmax), proving the
+    slot's own settings — not the server default, not its neighbor's —
+    drove its draw."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = {"a": [3, 14, 15, 9], "b": [26, 5]}
+
+    ref = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=6)
+    ra, rb = ref.submit(prompts["a"]), ref.submit(prompts["b"])
+    ref.drain()
+    greedy = {"a": ref.result(ra), "b": ref.result(rb)}
+
+    srv = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=6)
+    sa = srv.submit(prompts["a"], sampling={"temperature": 3.0, "top_k": 1})
+    sb = srv.submit(prompts["b"])   # server default: greedy
+    srv.drain()
+    assert srv.result(sa) == greedy["a"]
+    assert srv.result(sb) == greedy["b"]
+
+    # an actually-stochastic request stays in-vocab and finite-length
+    srv2 = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=6)
+    sc = srv2.submit(prompts["a"], sampling={"temperature": 1.0, "top_p": 0.9})
+    srv2.drain()
+    toks = srv2.result(sc)
+    assert len(toks) == len(prompts["a"]) + 6
+    assert all(0 <= t < CFG.vocab for t in toks)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        srv2.submit(prompts["a"], sampling={"temp": 1.0})  # unknown key
+
+
+def test_sampling_override_falsy_values_and_validation():
+    """top_k=0 / top_p=1.0 explicitly DISABLE the server-default filter;
+    bad values raise instead of silently corrupting the distribution."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    greedy_ref = DecodeServer(CFG, params, n_slots=1, max_seq=64,
+                              max_new_tokens=4)
+    rg = greedy_ref.submit([3, 14, 15, 9])
+    greedy_ref.drain()
+
+    # server default top_k=5; the request turns the filter OFF (top_k=0)
+    # at temperature 0 -> still exact greedy (argmax needs no filter)
+    srv = DecodeServer(CFG, params, n_slots=1, max_seq=64, max_new_tokens=4,
+                       top_k=5)
+    r = srv.submit([3, 14, 15, 9], sampling={"top_k": 0, "top_p": 1.0})
+    srv.drain()
+    assert srv.result(r) == greedy_ref.result(rg)
+
+    import pytest as _pytest
+    for bad in ({"temperature": -1.0}, {"top_p": -0.5}, {"top_p": 0.0},
+                {"top_k": -2}):
+        with _pytest.raises(ValueError):
+            srv.submit([1, 2], sampling=bad)
+    with _pytest.raises(ValueError):
+        DecodeServer(CFG, params, n_slots=1, max_seq=64, max_new_tokens=4,
+                     temperature=-0.5)
