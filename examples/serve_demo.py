@@ -46,6 +46,15 @@ def main():
 
     for rid in (r0, r1, r2):
         print(f"request {rid}: {server.pop_result(rid)}")
+
+    # per-request sampling: one greedy, one nucleus-sampled — both decode
+    # in the SAME compiled step, each slot under its own settings
+    rg = server.submit([7, 7, 7, 7])
+    rs = server.submit([7, 7, 7, 7],
+                       sampling={"temperature": 1.0, "top_p": 0.9})
+    server.drain()
+    print(f"greedy    {rg}: {server.pop_result(rg)}")
+    print(f"sampled   {rs}: {server.pop_result(rs)} (temperature 1.0, top-p 0.9)")
     print("serve demo OK")
 
 
