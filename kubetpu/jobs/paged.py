@@ -365,27 +365,21 @@ class PagedDecodeServer(SlotServerBase):
         """Pre-compile every prompt bucket + the step (serving.warmup's
         rationale). Only valid while NO request is active: the dummy
         prefill scribbles on pool pages a live sequence may have mapped."""
-        assert not self.active.any() and not self._queue, (
-            "warmup() must run before serving: it scribbles on pool pages"
-        )
         d_temp, d_tk, d_tp = self._default_sampling
         row = np.full((self.max_pages_per_slot,), -1, np.int32)
         row[: self._pages_needed(self.max_seq)] = np.arange(
             self._pages_needed(self.max_seq)
         ) % self.pool_pages
-        bucket = self.page_size
-        while True:
-            dummy = [0] * min(bucket, self.max_seq)
-            padded = dummy + [0] * (self._bucket(len(dummy)) - len(dummy))
+
+        def prefill_dummy(padded):
             self.k_pages, self.v_pages, _ = self._prefill_slot(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(padded, jnp.int32), jnp.asarray(row), jnp.int32(1),
                 self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
                 jnp.float32(d_tp),
             )
-            if bucket >= self.max_seq:
-                break
-            bucket *= 2
+
+        self._warmup_buckets(prefill_dummy)
         self.k_pages, self.v_pages, _n, _p = self._step_all(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table), self.last, self.pos,

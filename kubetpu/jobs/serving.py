@@ -266,12 +266,7 @@ class SlotServerBase:
         the queue THIS step emits two tokens (its prefill's first + this
         step's decode) — the list shape keeps both visible to streaming
         consumers."""
-        while self._queue and not self.active.all():
-            free = [i for i in range(self.n_slots) if not self.active[i]]
-            rid, prompt = self._queue[0]
-            if not self._try_admit(rid, prompt, free[0], defer=True):
-                break              # resources exhausted: retry next step
-            self._queue.pop(0)
+        self._drain_queue_into_slots()
         if not self.active.any():
             return self._materialize_pending()
         t0 = time.perf_counter()
@@ -288,6 +283,32 @@ class SlotServerBase:
             out.setdefault(rid, []).append(tok)
             self._retire_if_done(slot)
         return out
+
+    def _warmup_buckets(self, prefill_dummy) -> None:
+        """Shared warmup skeleton: call *prefill_dummy(padded_prompt)* for
+        every power-of-two prompt bucket from ``_min_bucket`` to
+        ``max_seq`` — a bucketing change lands in every server at once."""
+        assert not self.active.any() and not self._queue, (
+            "warmup() must run before serving: it scribbles on slot 0's "
+            "device state"
+        )
+        bucket = self._min_bucket
+        while True:
+            dummy = [0] * min(bucket, self.max_seq)
+            prefill_dummy(dummy + [0] * (self._bucket(len(dummy)) - len(dummy)))
+            if bucket >= self.max_seq:
+                break
+            bucket *= 2
+
+    def _drain_queue_into_slots(self) -> None:
+        """Admit queued requests into free slots (resources permitting),
+        first-token fetch deferred — shared by every subclass's step."""
+        while self._queue and not self.active.all():
+            free = [i for i in range(self.n_slots) if not self.active[i]]
+            rid, prompt = self._queue[0]
+            if not self._try_admit(rid, prompt, free[0], defer=True):
+                break              # resources exhausted: retry next step
+            self._queue.pop(0)
 
     def _materialize_pending(self) -> Dict[int, List[int]]:
         """Fetch deferred first tokens (one sync AFTER the step's decode
@@ -485,24 +506,17 @@ class DecodeServer(SlotServerBase):
         of each bucket size blocked every active stream). Only valid while
         NO request is active: the dummy prefill rewrites slot 0's cache
         rows, which a live occupant still reads every step."""
-        assert not self.active.any() and not self._queue, (
-            "warmup() must run before serving: it scribbles on slot 0's "
-            "cache rows"
-        )
         d_temp, d_tk, d_tp = self._default_sampling
-        bucket = 1
-        while True:
-            dummy = [0] * min(bucket, self.max_seq)
-            padded = dummy + [0] * (self._bucket(len(dummy)) - len(dummy))
+
+        def prefill_dummy(padded):
             self.k_cache, self.v_cache, _ = self._prefill_slot(
                 self.params, self.k_cache, self.v_cache,
                 jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
                 self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
                 jnp.float32(d_tp),
             )
-            if bucket >= self.max_seq:
-                break
-            bucket *= 2
+
+        self._warmup_buckets(prefill_dummy)
         self.k_cache, self.v_cache, _nxt, _pos = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),

@@ -1,0 +1,238 @@
+"""Speculative decoding inside the continuous-batching server.
+
+``SpeculativeDecodeServer`` is ``serving.DecodeServer``'s request
+lifecycle (slots, queue, deferred admission, retire/EOS) with the decode
+step replaced by a speculative ROUND: a draft model proposes ``gamma``
+tokens per slot, the target verifies them in one (gamma+1)-chunk cached
+forward (``decode.forward_chunk_at`` — the same block implementation as
+plain decoding), and each slot emits its longest agreeing prefix plus the
+target's correction/bonus token. Per-slot positions diverge naturally
+(slots accept different counts per round); rejected cache entries need no
+rollback — positions rewind and the position-bounded attention mask never
+reads them (``jobs.speculative``'s argument, per slot).
+
+Greedy only: speculative acceptance is exactly-greedy-equivalent, so the
+server's output is token-identical to ``DecodeServer``'s greedy stream —
+the parity test pins this. Sampling overrides are rejected at admission.
+
+The win is rounds, not tokens: decode is memory-bound, and the target's
+weights stream once per ROUND instead of once per token; a slot with mean
+acceptance a emits a+1 tokens per round. ``mean_tokens_per_round()``
+reports the measured rate.
+
+Reference: none (the reference has no inference stack, SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubetpu.jobs.decode import forward_chunk, forward_chunk_at, init_kv_cache
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.serving import SlotServerBase
+
+import time
+
+
+class SpeculativeDecodeServer(SlotServerBase):
+    """Continuous batching with draft+verify rounds (greedy-exact).
+
+    ``target_cfg``/``draft_cfg`` must share a vocabulary; the draft is
+    typically a few-layer shrink of the target. Public surface matches
+    ``DecodeServer`` (submit/enqueue/step/drain/result), except sampling
+    overrides are rejected (greedy only) and ``step`` may emit up to
+    ``gamma + 1`` tokens per request.
+    """
+
+    def __init__(
+        self,
+        target_cfg: ModelConfig,
+        draft_cfg: ModelConfig,
+        target_params: Params,
+        draft_params: Params,
+        n_slots: int = 8,
+        max_seq: int = 512,
+        max_new_tokens: int = 64,
+        eos_id: Optional[int] = None,
+        gamma: int = 4,
+        seed: int = 0,
+    ) -> None:
+        if target_cfg.vocab != draft_cfg.vocab:
+            raise ValueError("target and draft must share a vocabulary")
+        super().__init__(target_cfg, target_params, n_slots, max_seq,
+                         max_new_tokens, eos_id, seed=seed)
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        self.gamma = gamma
+        # margin: a round's verify chunk may write up to gamma tokens past
+        # a sequence's final accepted position before the host retires it
+        cache_len = max_seq + gamma + 1
+        self.k_cache, self.v_cache = init_kv_cache(target_cfg, n_slots, cache_len)
+        self.dk_cache, self.dv_cache = init_kv_cache(draft_cfg, n_slots, cache_len)
+        self._rounds = 0
+        self._round_tokens = 0
+
+        tcfg, dcfg = target_cfg, draft_cfg
+
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+        def prefill_slot(t_params, d_params, tk, tv, dk, dv, prompt, slot,
+                         prompt_len):
+            # both models prefill the same bucket-padded prompt into their
+            # slot rows; the target's last REAL position picks token 0
+            k_s = jnp.take(tk, slot[None], axis=1)
+            v_s = jnp.take(tv, slot[None], axis=1)
+            t_logits, k_s, v_s = forward_chunk(tcfg, t_params, prompt[None],
+                                               k_s, v_s, 0)
+            tk = jax.lax.dynamic_update_slice(tk, k_s, (0, slot, 0, 0, 0))
+            tv = jax.lax.dynamic_update_slice(tv, v_s, (0, slot, 0, 0, 0))
+
+            kd = jnp.take(dk, slot[None], axis=1)
+            vd = jnp.take(dv, slot[None], axis=1)
+            _dl, kd, vd = forward_chunk(dcfg, d_params, prompt[None], kd, vd, 0)
+            dk = jax.lax.dynamic_update_slice(dk, kd, (0, slot, 0, 0, 0))
+            dv = jax.lax.dynamic_update_slice(dv, vd, (0, slot, 0, 0, 0))
+
+            first = jnp.argmax(
+                jnp.take(t_logits[0], prompt_len - 1, axis=0)
+            ).astype(jnp.int32)
+            return tk, tv, dk, dv, first
+
+        @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+        def round_all(t_params, d_params, tk, tv, dk, dv, last, pos, active):
+            def draft_step(c, _):
+                dk, dv, tok, p = c
+                logits, dk, dv = forward_chunk_at(
+                    dcfg, d_params, tok[:, None], dk, dv, p
+                )
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                return (dk, dv, nxt, p + 1), nxt
+
+            (dk, dv, last_draft, _p), drafts = jax.lax.scan(
+                draft_step, (dk, dv, last, pos), None, length=gamma
+            )
+            drafts = drafts.transpose(1, 0)                  # (B, gamma)
+
+            # write the LAST draft's K/V too (position pos+gamma): the scan
+            # fed only [last, d_0..d_{gamma-2}] — without this, a fully-
+            # accepted round leaves a hole the draft attends next round,
+            # silently decaying acceptance. If d_{gamma-1} is rejected the
+            # entry is overwritten when that position is next fed.
+            _lg, dk, dv = forward_chunk_at(
+                dcfg, d_params, last_draft[:, None], dk, dv, pos + gamma
+            )
+
+            chunk = jnp.concatenate([last[:, None], drafts], axis=1)
+            t_logits, tk, tv = forward_chunk_at(
+                tcfg, t_params, chunk, tk, tv, pos
+            )
+            target_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+            agree = (drafts == target_tok[:, :gamma]).astype(jnp.int32)
+            accepted = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+            n_emit = jnp.where(active, accepted + 1, 0)      # (B,)
+
+            new_last = jnp.take_along_axis(
+                target_tok, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+            )[:, 0]
+            new_last = jnp.where(active, new_last, last)
+            new_pos = pos + n_emit
+            return tk, tv, dk, dv, new_last, new_pos, target_tok, n_emit
+
+        self._prefill_jit = prefill_slot
+        self._round_jit = round_all
+
+    # -- device legs ---------------------------------------------------------
+
+    def _normalize_sampling(self, sampling):
+        if sampling is not None:
+            raise ValueError(
+                "SpeculativeDecodeServer is greedy-exact; per-request "
+                "sampling is not supported"
+            )
+        return self._default_sampling
+
+    def _admit_device(self, prompt: List[int], slot: int):
+        bucket = self._bucket(len(prompt))
+        padded = prompt + [0] * (bucket - len(prompt))
+        (self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
+         first) = self._prefill_jit(
+            self.params, self.draft_params,
+            self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
+            jnp.asarray(padded, jnp.int32), jnp.int32(slot),
+            jnp.int32(len(prompt)),
+        )
+        return first
+
+    def _device_round(self):
+        (self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
+         self.last, self.pos, toks, n_emit) = self._round_jit(
+            self.params, self.draft_params,
+            self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
+            self.last, self.pos, jnp.asarray(self.active),
+        )
+        return np.asarray(toks), np.asarray(n_emit)
+
+    def _device_step(self):  # pragma: no cover — step() is overridden
+        raise NotImplementedError("speculative serving steps in rounds")
+
+    def step(self) -> Dict[int, List[int]]:
+        """One speculative round for every active slot -> {rid: [tokens]};
+        each request receives 1..gamma+1 tokens (clipped at EOS and
+        max_new_tokens host-side; the device overshoot is never read)."""
+        self._drain_queue_into_slots()
+        if not self.active.any():
+            return self._materialize_pending()
+        t0 = time.perf_counter()
+        toks, n_emit = self._device_round()
+        out = self._materialize_pending()
+        self._metrics.record("step", time.perf_counter() - t0)
+        for slot in range(self.n_slots):
+            if not self.active[slot]:
+                continue
+            rid = self._slot_rid[slot]
+            accepted = [int(t) for t in toks[slot][: int(n_emit[slot])]]
+            room = self.max_new_tokens - len(self._emitted[rid])
+            accepted = accepted[:room]
+            if self.eos_id is not None and self.eos_id in accepted:
+                accepted = accepted[: accepted.index(self.eos_id) + 1]
+            if not accepted:
+                self._retire_if_done(slot)
+                continue
+            self._rounds += 1
+            self._round_tokens += len(accepted)
+            self._emitted[rid].extend(accepted)
+            self._note_emitted(slot)
+            out.setdefault(rid, []).extend(accepted)
+            self._retire_if_done(slot)
+        return out
+
+    def mean_tokens_per_round(self) -> float:
+        """Measured accepted tokens per live (slot, round) — the speedup
+        factor over one-token decoding for a memory-bound target."""
+        return self._round_tokens / self._rounds if self._rounds else 0.0
+
+    def warmup(self) -> None:
+        """Pre-compile every prompt bucket's dual prefill and the round."""
+
+        def prefill_dummy(padded):
+            (self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
+             _f) = self._prefill_jit(
+                self.params, self.draft_params,
+                self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
+                jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
+            )
+
+        self._warmup_buckets(prefill_dummy)
+        (self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
+         _l, _p, _t, _n) = self._round_jit(
+            self.params, self.draft_params,
+            self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
+            self.last, self.pos,
+            jnp.asarray(np.zeros((self.n_slots,), bool)),
+        )
+        jax.block_until_ready((self.k_cache, self.v_cache))

@@ -81,10 +81,20 @@ def make_speculative_generate(
                 nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
                 return (dk, dv, nxt, p + 1), nxt
 
-            (dk, dv, _tok, _), drafts = jax.lax.scan(
+            (dk, dv, last_draft, _), drafts = jax.lax.scan(
                 draft_step, (dk, dv, last, pos), None, length=gamma
             )
             drafts = drafts.transpose(1, 0)                     # (B, gamma)
+
+            # write the LAST draft's K/V too (position pos+gamma): the scan
+            # fed only [last, d_0..d_{gamma-2}] — without this, a fully-
+            # accepted round leaves a hole the draft attends every later
+            # round, silently decaying acceptance. A rejected d_{gamma-1}'s
+            # entry is overwritten when that position is next fed.
+            _lg, dk, dv = _forward_chunk_at(
+                draft_cfg, draft_params, last_draft[:, None], dk, dv,
+                pos + gamma,
+            )
 
             # -- verify: ONE (gamma+1)-chunk forward [last, d_0..d_{g-1}] --
             chunk = jnp.concatenate([last[:, None], drafts], axis=1)

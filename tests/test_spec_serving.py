@@ -1,0 +1,120 @@
+"""Speculative continuous batching: greedy-exact parity with the plain
+dense server under staggered admissions, EOS clipping inside a round, and
+the measured tokens-per-round stat."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.serving import DecodeServer
+from kubetpu.jobs.spec_serving import SpeculativeDecodeServer
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+DCFG = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2, d_ff=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return (init_params(jax.random.PRNGKey(0), CFG),
+            init_params(jax.random.PRNGKey(7), DCFG))
+
+
+def _spec(params, **kw):
+    t, d = params
+    return SpeculativeDecodeServer(CFG, DCFG, t, d, **kw)
+
+
+def test_spec_server_matches_dense_greedy_staggered(params):
+    """Same tokens as DecodeServer for staggered requests — speculation
+    must be invisible in the output stream."""
+    t, _d = params
+    prompts = [[3, 14, 15, 9], [26, 5], [35, 8, 9, 7, 9]]
+
+    dense = DecodeServer(CFG, t, n_slots=2, max_seq=64, max_new_tokens=10)
+    spec = _spec(params, n_slots=2, max_seq=64, max_new_tokens=10, gamma=3)
+    results = {}
+    for server, tag in ((dense, "dense"), (spec, "spec")):
+        ra = server.submit(prompts[0])
+        server.step()
+        rb = server.submit(prompts[1])
+        server.drain()
+        rc = server.submit(prompts[2])
+        server.drain()
+        results[tag] = [server.result(r) for r in (ra, rb, rc)]
+    assert results["spec"] == results["dense"]
+    assert spec.mean_tokens_per_round() >= 1.0
+
+
+def test_spec_server_self_draft_accepts_everything(params):
+    """Target as its own draft: every round accepts gamma+1 tokens, so a
+    max_new_tokens=8, gamma=3 request finishes in ceil(7/4)+prefill
+    rounds and the stat shows the ceiling."""
+    t, _d = params
+    srv = SpeculativeDecodeServer(CFG, CFG, t, t, n_slots=1, max_seq=64,
+                                  max_new_tokens=9, gamma=3)
+    rid = srv.submit([3, 14, 15, 9])
+    steps = 0
+    while not srv.finished(rid):
+        srv.step()
+        steps += 1
+    assert steps <= 3  # 8 post-first tokens / 4-per-round = 2 (+ slack)
+    # parity with plain greedy too
+    dense = DecodeServer(CFG, t, n_slots=1, max_seq=64, max_new_tokens=9)
+    rd = dense.submit([3, 14, 15, 9])
+    dense.drain()
+    assert srv.result(rid) == dense.result(rd)
+    assert srv.mean_tokens_per_round() > 2.0
+
+
+def test_spec_server_eos_and_queue(params):
+    """EOS emitted mid-round clips the request there; queued requests
+    enter freed slots at round boundaries."""
+    t, _d = params
+    probe = _spec(params, n_slots=1, max_seq=64, max_new_tokens=6, gamma=3)
+    r = probe.submit([3, 14, 15, 9])
+    probe.drain()
+    eos = probe.result(r)[4 + 2]  # the 3rd emitted token becomes "EOS"
+
+    srv = _spec(params, n_slots=1, max_seq=64, max_new_tokens=6, gamma=3,
+                eos_id=int(eos))
+    ra = srv.submit([3, 14, 15, 9])
+    rb = srv.enqueue([26, 5])
+    srv.drain()
+    out_a = srv.result(ra)
+    assert out_a[-1] == eos and len(out_a) <= 4 + 6
+    assert out_a == probe.result(r)[: len(out_a)]
+    assert srv.finished(rb)
+
+
+def test_spec_server_rejects_sampling_and_mismatched_vocab(params):
+    t, d = params
+    srv = _spec(params, n_slots=1, max_seq=64, max_new_tokens=4)
+    with pytest.raises(ValueError):
+        srv.submit([1, 2], sampling={"temperature": 1.0})
+    with pytest.raises(ValueError):
+        SpeculativeDecodeServer(
+            CFG, ModelConfig(vocab=32, d_model=32, n_layers=1, n_heads=2,
+                             d_ff=32), t, d)
+
+
+def test_spec_server_acceptance_sustains_over_long_generation(params):
+    """Self-draft acceptance must hold the gamma+1 ceiling across MANY
+    rounds — regression for the draft-cache hole: the scan fed only
+    [last, d_0..d_{gamma-2}], so a fully-accepted round left position
+    pos+gamma unwritten in the draft cache and acceptance decayed."""
+    t, _d = params
+    srv = SpeculativeDecodeServer(CFG, CFG, t, t, n_slots=1, max_seq=128,
+                                  max_new_tokens=41, gamma=3)
+    rid = srv.submit([3, 14, 15, 9])
+    rounds = 0
+    while not srv.finished(rid):
+        srv.step()
+        rounds += 1
+    # 40 post-first tokens at exactly 4/round = 10 rounds, no decay slack
+    assert rounds == 10, rounds
+    assert srv.mean_tokens_per_round() == 4.0
+    dense = DecodeServer(CFG, t, n_slots=1, max_seq=128, max_new_tokens=41)
+    rd = dense.submit([3, 14, 15, 9])
+    dense.drain()
+    assert srv.result(rid) == dense.result(rd)
