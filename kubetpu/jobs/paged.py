@@ -44,6 +44,7 @@ import numpy as np
 from kubetpu.jobs import model as model_lib
 from kubetpu.jobs.model import ModelConfig, Params
 from kubetpu.jobs.quant import maybe_dequantize
+from kubetpu.jobs.sampling import chosen_logprob
 from kubetpu.jobs.serving import SlotServerBase
 
 
@@ -258,8 +259,9 @@ class PagedDecodeServer(SlotServerBase):
             )
             nxt = sampler(logits, rng, temp, tk, tp)
             nxt = jnp.where(active, nxt, last)
+            lp = chosen_logprob(logits, nxt)
             pos = pos + active.astype(jnp.int32)
-            return k_pages, v_pages, nxt, pos
+            return k_pages, v_pages, nxt, pos, lp
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def prefill_slot(params, k_pages, v_pages, prompt, slot_row,
@@ -267,7 +269,8 @@ class PagedDecodeServer(SlotServerBase):
             first, k_pages, v_pages = paged_prefill(
                 cfg_, params, prompt, k_pages, v_pages, slot_row, prompt_len
             )
-            return k_pages, v_pages, sampler(first, rng, temp, tk, tp)
+            tok = sampler(first, rng, temp, tk, tp)
+            return k_pages, v_pages, tok, chosen_logprob(first, tok)
 
         self._step_all = step_all
         self._prefill_slot = prefill_slot
@@ -336,7 +339,7 @@ class PagedDecodeServer(SlotServerBase):
             return None
         bucket = self._bucket(len(prompt))
         padded = prompt + [0] * (bucket - len(prompt))
-        self.k_pages, self.v_pages, first = self._prefill_slot(
+        self.k_pages, self.v_pages, first, first_lp = self._prefill_slot(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(padded, jnp.int32),
             jnp.asarray(self._table[slot]),
@@ -345,13 +348,13 @@ class PagedDecodeServer(SlotServerBase):
             jnp.int32(self._slot_topk[slot]),
             jnp.float32(self._slot_topp[slot]),
         )
-        return first
+        return first, first_lp
 
-    def _device_step(self) -> np.ndarray:
+    def _device_step(self) -> "tuple[np.ndarray, np.ndarray]":
         # worst-case pages were reserved at admission, so boundary
         # crossings never fail; the REAL table (with -1 sentinels) flows
         # to the device — the attention core masks unmapped pages
-        self.k_pages, self.v_pages, nxt, self.pos = self._step_all(
+        self.k_pages, self.v_pages, nxt, self.pos, lp = self._step_all(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table),
             self.last, self.pos, jnp.asarray(self.active), self._next_rng(),
@@ -359,7 +362,7 @@ class PagedDecodeServer(SlotServerBase):
             jnp.asarray(self._slot_topp),
         )
         self.last = nxt
-        return np.asarray(nxt)
+        return np.asarray(nxt), np.asarray(lp)
 
     def warmup(self) -> None:
         """Pre-compile every prompt bucket + the step (serving.warmup's
@@ -372,7 +375,7 @@ class PagedDecodeServer(SlotServerBase):
         ) % self.pool_pages
 
         def prefill_dummy(padded):
-            self.k_pages, self.v_pages, _ = self._prefill_slot(
+            self.k_pages, self.v_pages, _f, _lp = self._prefill_slot(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(padded, jnp.int32), jnp.asarray(row), jnp.int32(1),
                 self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
@@ -380,7 +383,7 @@ class PagedDecodeServer(SlotServerBase):
             )
 
         self._warmup_buckets(prefill_dummy)
-        self.k_pages, self.v_pages, _n, _p = self._step_all(
+        self.k_pages, self.v_pages, _n, _p, _lps = self._step_all(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table), self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),

@@ -110,6 +110,15 @@ def apply_top_p_rows(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     return jnp.where((p < 1.0)[..., None], masked, logits)
 
 
+def chosen_logprob(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """log P(token) under the RAW model distribution (log-softmax of the
+    unfiltered, untempered logits) — the serving-API logprob convention,
+    in ONE place so every server reports identically. logits (..., V),
+    tokens (...) -> (...)."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(lp, tokens[..., None], axis=-1)[..., 0]
+
+
 def make_slot_sampler():
     """Per-request sampling inside ONE compiled step:
     ``sample(logits (..., V), rng, temperature, top_k, top_p) -> (...)``

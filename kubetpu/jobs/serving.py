@@ -61,6 +61,7 @@ import numpy as np
 
 from kubetpu.core.metrics import LatencyRecorder
 from kubetpu.jobs.decode import forward_chunk, forward_chunk_at, init_kv_cache
+from kubetpu.jobs.sampling import chosen_logprob
 from kubetpu.jobs.model import ModelConfig, Params
 
 
@@ -68,11 +69,12 @@ class SlotServerBase:
     """Host-side continuous-batching lifecycle over ``n_slots`` slots.
 
     Subclass contract:
-    - ``_admit_device(prompt, slot) -> Optional[int]``: reserve resources
-      and prefill; the first generated token, or None when resources are
-      unavailable (the request stays queued — nothing may be mutated);
-    - ``_device_step() -> np.ndarray``: one decode step for all slots,
-      updating device state and returning the per-slot next tokens;
+    - ``_admit_device(prompt, slot) -> Optional[(token, logprob)]``:
+      reserve resources and prefill; the first generated token and its
+      raw-distribution logprob as device scalars, or None when resources
+      are unavailable (the request stays queued — nothing may be mutated);
+    - ``_device_step() -> (np tokens, np logprobs)``: one decode step for
+      all slots, updating device state;
     - ``warmup()``: pre-compile; only valid while no request is active;
     - optional hooks ``_note_admitted(slot, prompt)``, ``_note_emitted
       (slot)``, ``_on_retire(slot)``.
@@ -128,6 +130,7 @@ class SlotServerBase:
         self._slot_rid: List[Optional[int]] = [None] * n_slots
         self._prompts: Dict[int, List[int]] = {}
         self._emitted: Dict[int, List[int]] = {}
+        self._logprobs: Dict[int, List[float]] = {}
         self._done: Dict[int, bool] = {}
         self._queue: List[Tuple[int, List[int]]] = []  # awaiting a slot
         self._pending_first: Dict[int, object] = {}    # slot -> device scalar
@@ -169,9 +172,10 @@ class SlotServerBase:
         self._slot_temp[slot] = temp
         self._slot_topk[slot] = tk
         self._slot_topp[slot] = tp
-        first = self._admit_device(prompt, slot)
-        if first is None:
+        admitted = self._admit_device(prompt, slot)
+        if admitted is None:
             return False
+        first, first_lp = admitted
         self.pos = self.pos.at[slot].set(len(prompt))
         self.last = self.last.at[slot].set(first)
         self.active[slot] = True
@@ -181,9 +185,11 @@ class SlotServerBase:
         self._note_admitted(slot, prompt)
         if defer:
             self._emitted[rid] = []
-            self._pending_first[slot] = first
+            self._logprobs[rid] = []
+            self._pending_first[slot] = (first, first_lp)
         else:
             self._emitted[rid] = [int(first)]
+            self._logprobs[rid] = [float(first_lp)]
             self._retire_if_done(slot)
         self._metrics.record("admission_stall", time.perf_counter() - t0)
         return True
@@ -247,6 +253,7 @@ class SlotServerBase:
         self._rid_sampling[rid] = self._normalize_sampling(sampling)
         self._prompts[rid] = list(prompt)
         self._emitted[rid] = []
+        self._logprobs[rid] = []
         self._done[rid] = False
         self._queue.append((rid, list(prompt)))
         return rid
@@ -270,7 +277,7 @@ class SlotServerBase:
         if not self.active.any():
             return self._materialize_pending()
         t0 = time.perf_counter()
-        tokens = self._device_step()   # dispatched; synced below
+        tokens, lps = self._device_step()   # dispatched; synced below
         out = self._materialize_pending()
         self._metrics.record("step", time.perf_counter() - t0)
         for slot in range(self.n_slots):
@@ -279,6 +286,7 @@ class SlotServerBase:
             rid = self._slot_rid[slot]
             tok = int(tokens[slot])
             self._emitted[rid].append(tok)
+            self._logprobs[rid].append(float(lps[slot]))
             self._note_emitted(slot)
             out.setdefault(rid, []).append(tok)
             self._retire_if_done(slot)
@@ -316,12 +324,13 @@ class SlotServerBase:
         on the first token / max_new_tokens == 1) drops out of the routing
         loop, discarding the step token it no longer needs."""
         out: Dict[int, List[int]] = {}
-        for slot, first in sorted(self._pending_first.items()):
+        for slot, (first, lp) in sorted(self._pending_first.items()):
             rid = self._slot_rid[slot]
             if rid is None:
                 continue
             tok = int(np.asarray(first))
             self._emitted[rid] = [tok] + self._emitted[rid]
+            self._logprobs[rid] = [float(np.asarray(lp))] + self._logprobs[rid]
             out.setdefault(rid, []).append(tok)
             self._retire_if_done(slot)
         self._pending_first.clear()
@@ -359,6 +368,12 @@ class SlotServerBase:
         retained until ``pop_result`` — a long-running server must pop."""
         return self._prompts[rid] + self._emitted[rid]
 
+    def result_logprobs(self, rid: int) -> List[float]:
+        """Model log-probability (log-softmax of the RAW logits, before
+        any sampling filter) of each EMITTED token, parallel to the
+        emitted part of ``result`` — the serving-API convention."""
+        return list(self._logprobs[rid])
+
     def pop_result(self, rid: int) -> List[int]:
         """Collect AND evict a finished request's tokens — the bookkeeping
         for a request is dropped so an indefinitely-running server doesn't
@@ -368,6 +383,7 @@ class SlotServerBase:
         out = self._prompts.pop(rid) + self._emitted.pop(rid)
         del self._done[rid]
         self._rid_sampling.pop(rid, None)
+        self._logprobs.pop(rid, None)
         return out
 
     def drain(self, max_steps: int = 10_000) -> None:
@@ -455,9 +471,9 @@ class DecodeServer(SlotServerBase):
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v_s, (0, slot, 0, 0, 0)
             )
-            first = sampler(jnp.take(logits[0], prompt_len - 1, axis=0), rng,
-                            temp, tk, tp)
-            return k_cache, v_cache, first
+            row = jnp.take(logits[0], prompt_len - 1, axis=0)
+            first = sampler(row, rng, temp, tk, tp)
+            return k_cache, v_cache, first, chosen_logprob(row, first)
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def step_all(params, k_cache, v_cache, last, pos, active, rng,
@@ -467,8 +483,9 @@ class DecodeServer(SlotServerBase):
             )
             nxt = sampler(logits[:, 0], rng, temp, tk, tp)
             nxt = jnp.where(active, nxt, last)     # inactive slots hold
+            lp = chosen_logprob(logits[:, 0], nxt)
             pos = pos + active.astype(jnp.int32)
-            return k_cache, v_cache, nxt, pos
+            return k_cache, v_cache, nxt, pos, lp
 
         self._prefill_slot = prefill_slot
         self._step_all = step_all
@@ -480,7 +497,7 @@ class DecodeServer(SlotServerBase):
         scalar (no host sync — the defer path depends on it)."""
         bucket = self._bucket(len(prompt))
         padded = prompt + [0] * (bucket - len(prompt))
-        self.k_cache, self.v_cache, first = self._prefill_slot(
+        self.k_cache, self.v_cache, first, first_lp = self._prefill_slot(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(padded, jnp.int32), jnp.int32(slot),
             jnp.int32(len(prompt)), self._next_rng(),
@@ -488,17 +505,17 @@ class DecodeServer(SlotServerBase):
             jnp.int32(self._slot_topk[slot]),
             jnp.float32(self._slot_topp[slot]),
         )
-        return first
+        return first, first_lp
 
-    def _device_step(self) -> np.ndarray:
-        self.k_cache, self.v_cache, nxt, self.pos = self._step_all(
+    def _device_step(self) -> "tuple[np.ndarray, np.ndarray]":
+        self.k_cache, self.v_cache, nxt, self.pos, lp = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
             jnp.asarray(self.active), self._next_rng(),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp),
         )
         self.last = nxt
-        return np.asarray(nxt)
+        return np.asarray(nxt), np.asarray(lp)
 
     def warmup(self) -> None:
         """Pre-compile every prompt bucket's prefill and the decode step so
@@ -509,7 +526,7 @@ class DecodeServer(SlotServerBase):
         d_temp, d_tk, d_tp = self._default_sampling
 
         def prefill_dummy(padded):
-            self.k_cache, self.v_cache, _ = self._prefill_slot(
+            self.k_cache, self.v_cache, _f, _lp = self._prefill_slot(
                 self.params, self.k_cache, self.v_cache,
                 jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
                 self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
@@ -517,7 +534,7 @@ class DecodeServer(SlotServerBase):
             )
 
         self._warmup_buckets(prefill_dummy)
-        self.k_cache, self.v_cache, _nxt, _pos = self._step_all(
+        self.k_cache, self.v_cache, _nxt, _pos, _lps = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),

@@ -34,7 +34,9 @@ import numpy as np
 
 from kubetpu.jobs.decode import forward_chunk, forward_chunk_at, init_kv_cache
 from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.sampling import chosen_logprob
 from kubetpu.jobs.serving import SlotServerBase
+from kubetpu.jobs.speculative import draft_and_verify
 
 import time
 
@@ -97,43 +99,19 @@ class SpeculativeDecodeServer(SlotServerBase):
             dk = jax.lax.dynamic_update_slice(dk, kd, (0, slot, 0, 0, 0))
             dv = jax.lax.dynamic_update_slice(dv, vd, (0, slot, 0, 0, 0))
 
-            first = jnp.argmax(
-                jnp.take(t_logits[0], prompt_len - 1, axis=0)
-            ).astype(jnp.int32)
-            return tk, tv, dk, dv, first
+            row = jnp.take(t_logits[0], prompt_len - 1, axis=0)
+            first = jnp.argmax(row).astype(jnp.int32)
+            return tk, tv, dk, dv, first, chosen_logprob(row, first)
 
         @partial(jax.jit, donate_argnums=(2, 3, 4, 5))
         def round_all(t_params, d_params, tk, tv, dk, dv, last, pos, active):
-            def draft_step(c, _):
-                dk, dv, tok, p = c
-                logits, dk, dv = forward_chunk_at(
-                    dcfg, d_params, tok[:, None], dk, dv, p
-                )
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-                return (dk, dv, nxt, p + 1), nxt
-
-            (dk, dv, last_draft, _p), drafts = jax.lax.scan(
-                draft_step, (dk, dv, last, pos), None, length=gamma
+            # the round's device math is speculative.draft_and_verify —
+            # ONE implementation for the batch generate loop and this
+            # server; here we only add inactive-slot masking and logprobs
+            tk, tv, dk, dv, target_tok, accepted, t_logits = draft_and_verify(
+                tcfg, dcfg, gamma, t_params, d_params,
+                tk, tv, dk, dv, last, pos,
             )
-            drafts = drafts.transpose(1, 0)                  # (B, gamma)
-
-            # write the LAST draft's K/V too (position pos+gamma): the scan
-            # fed only [last, d_0..d_{gamma-2}] — without this, a fully-
-            # accepted round leaves a hole the draft attends next round,
-            # silently decaying acceptance. If d_{gamma-1} is rejected the
-            # entry is overwritten when that position is next fed.
-            _lg, dk, dv = forward_chunk_at(
-                dcfg, d_params, last_draft[:, None], dk, dv, pos + gamma
-            )
-
-            chunk = jnp.concatenate([last[:, None], drafts], axis=1)
-            t_logits, tk, tv = forward_chunk_at(
-                tcfg, t_params, chunk, tk, tv, pos
-            )
-            target_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-
-            agree = (drafts == target_tok[:, :gamma]).astype(jnp.int32)
-            accepted = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
             n_emit = jnp.where(active, accepted + 1, 0)      # (B,)
 
             new_last = jnp.take_along_axis(
@@ -141,7 +119,8 @@ class SpeculativeDecodeServer(SlotServerBase):
             )[:, 0]
             new_last = jnp.where(active, new_last, last)
             new_pos = pos + n_emit
-            return tk, tv, dk, dv, new_last, new_pos, target_tok, n_emit
+            lps = chosen_logprob(t_logits, target_tok)       # (B, gamma+1)
+            return tk, tv, dk, dv, new_last, new_pos, target_tok, n_emit, lps
 
         self._prefill_jit = prefill_slot
         self._round_jit = round_all
@@ -160,22 +139,22 @@ class SpeculativeDecodeServer(SlotServerBase):
         bucket = self._bucket(len(prompt))
         padded = prompt + [0] * (bucket - len(prompt))
         (self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
-         first) = self._prefill_jit(
+         first, first_lp) = self._prefill_jit(
             self.params, self.draft_params,
             self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
             jnp.asarray(padded, jnp.int32), jnp.int32(slot),
             jnp.int32(len(prompt)),
         )
-        return first
+        return first, first_lp
 
     def _device_round(self):
         (self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
-         self.last, self.pos, toks, n_emit) = self._round_jit(
+         self.last, self.pos, toks, n_emit, lps) = self._round_jit(
             self.params, self.draft_params,
             self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
             self.last, self.pos, jnp.asarray(self.active),
         )
-        return np.asarray(toks), np.asarray(n_emit)
+        return np.asarray(toks), np.asarray(n_emit), np.asarray(lps)
 
     def _device_step(self):  # pragma: no cover — step() is overridden
         raise NotImplementedError("speculative serving steps in rounds")
@@ -188,7 +167,7 @@ class SpeculativeDecodeServer(SlotServerBase):
         if not self.active.any():
             return self._materialize_pending()
         t0 = time.perf_counter()
-        toks, n_emit = self._device_round()
+        toks, n_emit, lps = self._device_round()
         out = self._materialize_pending()
         self._metrics.record("step", time.perf_counter() - t0)
         for slot in range(self.n_slots):
@@ -206,6 +185,8 @@ class SpeculativeDecodeServer(SlotServerBase):
             self._rounds += 1
             self._round_tokens += len(accepted)
             self._emitted[rid].extend(accepted)
+            self._logprobs[rid].extend(
+                float(x) for x in lps[slot][: len(accepted)])
             self._note_emitted(slot)
             out.setdefault(rid, []).extend(accepted)
             self._retire_if_done(slot)
@@ -221,7 +202,7 @@ class SpeculativeDecodeServer(SlotServerBase):
 
         def prefill_dummy(padded):
             (self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
-             _f) = self._prefill_jit(
+             _f, _lp) = self._prefill_jit(
                 self.params, self.draft_params,
                 self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
                 jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
@@ -229,7 +210,7 @@ class SpeculativeDecodeServer(SlotServerBase):
 
         self._warmup_buckets(prefill_dummy)
         (self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
-         _l, _p, _t, _n) = self._round_jit(
+         _l, _p, _t, _n, _lps) = self._round_jit(
             self.params, self.draft_params,
             self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
             self.last, self.pos,

@@ -34,6 +34,52 @@ from kubetpu.jobs.decode import init_kv_cache, prefill
 from kubetpu.jobs.model import ModelConfig
 
 
+def draft_and_verify(target_cfg, draft_cfg, gamma, target_params,
+                     draft_params, tk, tv, dk, dv, last, pos):
+    """One speculative round's device math, shared by the batch generate
+    loop and the continuous-batching server (a fix here lands in both):
+    draft ``gamma`` tokens sequentially through the draft cache, verify
+    them in ONE (gamma+1)-chunk target forward, and compute the longest
+    agreeing prefix. Returns
+    ``(tk, tv, dk, dv, target_tok (B, gamma+1), accepted (B,), t_logits)``
+    — per sequence, tokens ``target_tok[:, :accepted+1]`` are the round's
+    greedy-exact emissions."""
+
+    def draft_step(c, _):
+        dk, dv, tok, p = c
+        logits, dk, dv = _forward_chunk_at(
+            draft_cfg, draft_params, tok[:, None], dk, dv, p
+        )
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (dk, dv, nxt, p + 1), nxt
+
+    (dk, dv, last_draft, _), drafts = jax.lax.scan(
+        draft_step, (dk, dv, last, pos), None, length=gamma
+    )
+    drafts = drafts.transpose(1, 0)                     # (B, gamma)
+
+    # write the LAST draft's K/V too (position pos+gamma): the scan fed
+    # only [last, d_0..d_{gamma-2}] — without this, a fully-accepted round
+    # leaves a hole the draft attends every later round, silently decaying
+    # acceptance. A rejected d_{gamma-1}'s entry is overwritten when that
+    # position is next fed.
+    _lg, dk, dv = _forward_chunk_at(
+        draft_cfg, draft_params, last_draft[:, None], dk, dv, pos + gamma
+    )
+
+    # verify: ONE (gamma+1)-chunk forward of [last, d_0..d_{gamma-1}]
+    chunk = jnp.concatenate([last[:, None], drafts], axis=1)
+    t_logits, tk, tv = _forward_chunk_at(
+        target_cfg, target_params, chunk, tk, tv, pos
+    )                                                   # (B, gamma+1, V)
+    target_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+    # longest agreeing prefix
+    agree = (drafts == target_tok[:, :gamma]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)   # (B,)
+    return tk, tv, dk, dv, target_tok, accepted, t_logits
+
+
 def make_speculative_generate(
     target_cfg: ModelConfig,
     draft_cfg: ModelConfig,
@@ -72,40 +118,10 @@ def make_speculative_generate(
             tk, tv, dk, dv, last, out, pos, count, stats = carry
             live = count < num_steps                            # (B,)
 
-            # -- draft gamma tokens sequentially through the draft cache --
-            def draft_step(c, _):
-                dk, dv, tok, p = c
-                logits, dk, dv = _forward_chunk_at(
-                    draft_cfg, draft_params, tok[:, None], dk, dv, p
-                )
-                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-                return (dk, dv, nxt, p + 1), nxt
-
-            (dk, dv, last_draft, _), drafts = jax.lax.scan(
-                draft_step, (dk, dv, last, pos), None, length=gamma
+            tk, tv, dk, dv, target_tok, accepted, _tl = draft_and_verify(
+                target_cfg, draft_cfg, gamma, target_params, draft_params,
+                tk, tv, dk, dv, last, pos,
             )
-            drafts = drafts.transpose(1, 0)                     # (B, gamma)
-
-            # write the LAST draft's K/V too (position pos+gamma): the scan
-            # fed only [last, d_0..d_{gamma-2}] — without this, a fully-
-            # accepted round leaves a hole the draft attends every later
-            # round, silently decaying acceptance. A rejected d_{gamma-1}'s
-            # entry is overwritten when that position is next fed.
-            _lg, dk, dv = _forward_chunk_at(
-                draft_cfg, draft_params, last_draft[:, None], dk, dv,
-                pos + gamma,
-            )
-
-            # -- verify: ONE (gamma+1)-chunk forward [last, d_0..d_{g-1}] --
-            chunk = jnp.concatenate([last[:, None], drafts], axis=1)
-            t_logits, tk, tv = _forward_chunk_at(
-                target_cfg, target_params, chunk, tk, tv, pos
-            )                                               # (B, gamma+1, V)
-            target_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-
-            # longest agreeing prefix, then one correction/bonus token
-            agree = (drafts == target_tok[:, :gamma]).astype(jnp.int32)
-            accepted = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)  # (B,)
             n_emit = accepted + 1                           # 1..gamma+1
 
             # emit target_tok[:, :n_emit] at out[count:count+n_emit]; writes

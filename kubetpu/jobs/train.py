@@ -142,7 +142,7 @@ def init_state(
 
 
 def make_update_step(loss_fn, optimizer, accum_steps: int = 1,
-                     chunk_constraint=None):
+                     chunk_constraint=None, skip_nonfinite: bool = False):
     """The one train-step body (value_and_grad -> optimizer -> new state)
     shared by the causal, pipelined, masked-LM, and ViT step builders —
     a future change (loss scaling, new regularizers) lands everywhere at
@@ -162,7 +162,14 @@ def make_update_step(loss_fn, optimizer, accum_steps: int = 1,
     accum axis, or drops it when indivisible — replicating microbatches
     would defeat the memory saving); ``chunk_constraint``, a callable
     applied to each reshaped batch leaf, pins it back
-    (make_train_step supplies the mesh-aware constraint)."""
+    (make_train_step supplies the mesh-aware constraint).
+
+    ``skip_nonfinite`` guards multi-day runs against loss spikes and
+    hardware glitches: when the loss or ANY gradient leaf is non-finite,
+    params and optimizer state are left untouched (the step counter still
+    advances, so checkpoints/schedules stay monotonic) and the non-finite
+    loss is returned so the caller can count skips. The guard is one
+    fused select per leaf — no host round-trip, no recompile."""
 
     def train_step(state: TrainState, *batch):
         if accum_steps <= 1:
@@ -201,6 +208,14 @@ def make_update_step(loss_fn, optimizer, accum_steps: int = 1,
             )
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if skip_nonfinite:
+            ok = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                ok = ok & jnp.isfinite(g).all()
+            pick = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new, old)
+            new_params = pick(new_params, state.params)
+            new_opt = pick(new_opt, state.opt_state)
         return TrainState(new_params, new_opt, state.step + 1), loss
 
     return train_step
@@ -235,6 +250,7 @@ def make_train_step(
     attention: Optional[str] = None,
     jit: bool = True,
     accum_steps: int = 1,
+    skip_nonfinite: bool = False,
 ):
     """Build the jitted full training step: loss -> grads -> adamw update.
 
@@ -265,7 +281,8 @@ def make_train_step(
             )
 
     step = make_update_step(loss_fn, optimizer, accum_steps=accum_steps,
-                            chunk_constraint=chunk_constraint)
+                            chunk_constraint=chunk_constraint,
+                            skip_nonfinite=skip_nonfinite)
     if not jit:
         return step
     bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))

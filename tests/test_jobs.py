@@ -626,3 +626,38 @@ def test_moe_top_k_validation():
         ModelConfig(n_experts=2, moe_top_k=2)  # needs capacity path
     with pytest.raises(ValueError):
         ModelConfig(moe_top_k=0)
+
+
+def test_skip_nonfinite_guards_the_update():
+    """A poisoned batch (non-finite grads via inf-scaled params path) must
+    leave params and optimizer state untouched while the step counter
+    advances; clean batches update normally under the same compiled step."""
+    from kubetpu.jobs.train import make_optimizer, make_update_step
+
+    cfg = CFG
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt = make_optimizer(lr=1e-2)
+    opt_state = opt.init(params)
+    from kubetpu.jobs import TrainState
+    state = TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    def loss_fn(p, tokens, poison):
+        from kubetpu.jobs import next_token_loss
+        clean = next_token_loss(p, tokens, jnp.roll(tokens, -1, axis=1), cfg)
+        return clean + poison * jnp.sum(p["head"])  # poison=inf -> inf loss
+
+    step = make_update_step(loss_fn, opt, skip_nonfinite=True)
+    step = jax.jit(step)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab)
+
+    poisoned, loss_bad = step(state, tokens, jnp.float32(jnp.inf))
+    assert not np.isfinite(float(loss_bad))
+    assert int(poisoned.step) == 1  # counter still advances
+    for a, b in zip(jax.tree_util.tree_leaves(poisoned.params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    clean, loss_ok = step(poisoned, tokens, jnp.float32(0.0))
+    assert np.isfinite(float(loss_ok)) and int(clean.step) == 2
+    assert not np.allclose(np.asarray(clean.params["head"]),
+                           np.asarray(state.params["head"]))

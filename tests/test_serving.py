@@ -248,3 +248,52 @@ def test_sampling_override_falsy_values_and_validation():
     with _pytest.raises(ValueError):
         DecodeServer(CFG, params, n_slots=1, max_seq=64, max_new_tokens=4,
                      temperature=-0.5)
+
+
+def test_result_logprobs_parallel_and_consistent():
+    """Every emitted token carries its raw-distribution logprob: list
+    parallel to the emitted stream, non-positive, identical across the
+    dense and paged servers (same math, different memory layout)."""
+    from kubetpu.jobs.paged import PagedDecodeServer
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompt = [3, 14, 15, 9]
+
+    servers = {
+        "dense": DecodeServer(CFG, params, n_slots=2, max_seq=64,
+                              max_new_tokens=6),
+        "paged": PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                                   max_new_tokens=6, page_size=8),
+    }
+    lps = {}
+    for tag, srv in servers.items():
+        rid = srv.submit(prompt)
+        srv.step()          # exercise the deferred/step path too
+        rid2 = srv.enqueue([26, 5])
+        srv.drain()
+        emitted = srv.result(rid)[len(prompt):]
+        lp = srv.result_logprobs(rid)
+        assert len(lp) == len(emitted) == 6
+        assert all(x <= 0.0 for x in lp)
+        assert len(srv.result_logprobs(rid2)) == len(srv.result(rid2)) - 2
+        lps[tag] = lp
+    np.testing.assert_allclose(lps["dense"], lps["paged"], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_spec_server_logprobs_match_dense():
+    from kubetpu.jobs.spec_serving import SpeculativeDecodeServer
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompt = [3, 14, 15, 9]
+    dense = DecodeServer(CFG, params, n_slots=1, max_seq=64, max_new_tokens=6)
+    rd = dense.submit(prompt)
+    dense.drain()
+    spec = SpeculativeDecodeServer(CFG, CFG, params, params, n_slots=1,
+                                   max_seq=64, max_new_tokens=6, gamma=3)
+    rs = spec.submit(prompt)
+    spec.drain()
+    assert spec.result(rs) == dense.result(rd)
+    np.testing.assert_allclose(spec.result_logprobs(rs),
+                               dense.result_logprobs(rd), rtol=1e-3,
+                               atol=1e-4)
