@@ -342,10 +342,38 @@ class SlotServerBase:
         if len(emitted) >= self.max_new_tokens or (
             self.eos_id is not None and emitted[-1] == self.eos_id
         ):
-            self._done[rid] = True
-            self.active[slot] = False       # slot immediately reusable
-            self._slot_rid[slot] = None
-            self._on_retire(slot)
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        rid = self._slot_rid[slot]
+        self._done[rid] = True
+        self.active[slot] = False           # slot immediately reusable
+        self._slot_rid[slot] = None
+        self._on_retire(slot)
+
+    def cancel(self, rid: int) -> bool:
+        """Stop a request wherever it is: queued requests are dropped, an
+        active request's slot is freed (its cache region is dead until the
+        next occupant's prefill overwrites it — the standard reuse
+        invariant). Tokens emitted so far remain readable via ``result``;
+        the request reports finished. Returns False for unknown/finished
+        ids. A slot freed mid-step is handled like EOS retirement: the
+        in-flight step's token for it is discarded by the routing loop."""
+        if self._done.get(rid, False) or rid not in self._prompts:
+            return False
+        for i, (qrid, _p) in enumerate(self._queue):
+            if qrid == rid:
+                self._queue.pop(i)
+                self._done[rid] = True
+                return True
+        for slot in range(self.n_slots):
+            if self._slot_rid[slot] == rid:
+                # a deferred first token for this slot must not be routed
+                # to the next occupant
+                self._pending_first.pop(slot, None)
+                self._retire(slot)
+                return True
+        return False
 
     # hooks ------------------------------------------------------------------
 

@@ -297,3 +297,45 @@ def test_spec_server_logprobs_match_dense():
     np.testing.assert_allclose(spec.result_logprobs(rs),
                                dense.result_logprobs(rd), rtol=1e-3,
                                atol=1e-4)
+
+
+def test_cancel_queued_active_and_finished():
+    """cancel() drops a queued request, frees an active slot mid-decode
+    (partial tokens stay readable; the neighbor stream is unaffected and
+    the slot is reusable), and returns False for finished/unknown ids."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    srv = DecodeServer(CFG, params, n_slots=1, max_seq=64, max_new_tokens=8)
+
+    ra = srv.submit([3, 14, 15, 9])
+    rq = srv.enqueue([26, 5])
+    srv.step()
+    assert srv.cancel(rq) is True          # still queued -> dropped
+    assert srv.finished(rq) and srv.queued() == 0
+
+    srv.step()
+    partial = list(srv.result(ra))
+    assert srv.cancel(ra) is True          # active -> slot freed
+    assert srv.finished(ra) and not srv.active.any()
+    assert srv.result(ra) == partial       # tokens so far retained
+    assert srv.cancel(ra) is False         # already finished
+
+    # freed slot serves a new request; its stream matches a fresh server
+    rc = srv.submit([7, 7])
+    srv.drain()
+    fresh = DecodeServer(CFG, params, n_slots=1, max_seq=64, max_new_tokens=8)
+    rf = fresh.submit([7, 7])
+    fresh.drain()
+    assert srv.result(rc) == fresh.result(rf)
+
+
+def test_cancel_releases_paged_pool_pages():
+    from kubetpu.jobs.paged import PagedDecodeServer
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    srv = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=8, page_size=8)
+    rid = srv.submit([3, 14, 15, 9])
+    srv.step()
+    assert srv.pages_in_use() > 0
+    assert srv.cancel(rid) is True
+    assert srv.pages_in_use() == 0         # pool fully reclaimed
