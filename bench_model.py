@@ -69,13 +69,15 @@ def chip_peak_flops():
     return None
 
 
-def train_throughput(cfg, batch, seq, steps, attention, remat_policy="full"):
+def train_throughput(cfg, batch, seq, steps, attention, remat_policy="full",
+                     loss_chunk=0):
     import dataclasses
 
     from kubetpu.jobs import init_state, make_mesh, make_train_step
     from kubetpu.jobs.profiling import marginal_ms
 
-    cfg = dataclasses.replace(cfg, remat_policy=remat_policy)
+    cfg = dataclasses.replace(cfg, remat_policy=remat_policy,
+                              loss_chunk=loss_chunk)
     mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
     state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
     n_params = param_count(state.params)
@@ -123,6 +125,7 @@ def train_throughput(cfg, batch, seq, steps, attention, remat_policy="full"):
         "params": n_params,
         "attention": attention,
         "remat": remat_policy,
+        "loss_chunk": loss_chunk,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "device": getattr(jax.devices()[0], "device_kind", str(jax.devices()[0])),
     }
@@ -290,7 +293,7 @@ def _result_key(r: dict) -> tuple:
     if draft is None and r.get("metric") == "speculative_decode_tokens_per_s":
         draft = "quarter"  # backfill: rows written before the self-draft leg
     return (r.get("metric"), r.get("seq"), r.get("n_kv_heads"), r.get("gamma"),
-            weights, remat, draft)
+            weights, remat, draft, r.get("batch"), r.get("loss_chunk", 0))
 
 
 def _merge_out(path: str, new: list) -> None:
@@ -443,6 +446,20 @@ def main() -> int:
         # trades activation memory for the full-remat recompute pass
         emit(train_throughput(cfg, batch, seq, args.steps, attn,
                               remat_policy="dots"))
+        # chunked CE tail: stream the LM head over 256-token chunks instead
+        # of materializing (B, S, 32k) f32 logits — the freed HBM is what
+        # admits the doubled batch (same model, same seq)
+        chunk = 64 if args.smoke else 256
+        emit(train_throughput(cfg, batch, seq, args.steps, attn,
+                              remat_policy="dots", loss_chunk=chunk))
+        try:
+            emit(train_throughput(cfg, batch * 2, seq, args.steps, attn,
+                                  remat_policy="dots", loss_chunk=chunk))
+        except Exception as e:  # noqa: BLE001 — batch 2x may OOM; keep artifact
+            emit({"metric": "train_tokens_per_s", "value": None,
+                  "unit": "tokens/s", "batch": batch * 2, "seq": seq,
+                  "attention": attn, "remat": "dots", "loss_chunk": chunk,
+                  "error": type(e).__name__})
     if "flash" in only:
         for r in flash_vs_dense(cfg, seqs):
             emit(r)

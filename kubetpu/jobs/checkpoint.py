@@ -11,6 +11,7 @@ practical.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Any, Optional
 
 import jax
@@ -54,8 +55,8 @@ class AsyncCheckpointer:
         # dispatched async first (they overlap), then collected — save()
         # costs one host transfer, never the filesystem write. Leaves that
         # are not fully addressable (multi-host shards) cannot be
-        # host-snapshotted here and are passed through; on multi-host,
-        # don't donate the state you checkpoint.
+        # host-snapshotted here — for those the save degrades to
+        # synchronous below (warned), so donation stays safe either way.
         def start(x):
             if isinstance(x, jax.Array) and x.is_fully_addressable:
                 x.copy_to_host_async()
@@ -66,6 +67,26 @@ class AsyncCheckpointer:
                 return np.asarray(x)
             return x
 
+        has_remote = any(
+            isinstance(x, jax.Array) and not x.is_fully_addressable
+            for x in jax.tree.leaves(state)
+        )
+        if has_remote:
+            # Non-addressable (multi-host) leaves cannot be host-snapshotted
+            # here: orbax's background thread reads the live device buffers,
+            # so a donating train step could free them mid-write. Degrade to
+            # a synchronous save (the blocking wait alone protects every
+            # leaf, so skip the snapshot copies entirely) rather than race.
+            warnings.warn(
+                "AsyncCheckpointer.save: state has non-fully-addressable "
+                "leaves; falling back to synchronous save to avoid a "
+                "use-after-donation race (don't donate checkpointed state "
+                "on multi-host, or accept the blocking save).",
+                stacklevel=2,
+            )
+            self._ckptr.save(os.path.abspath(path), args=_standard_save_args(state))
+            self._ckptr.wait_until_finished()
+            return
         state = jax.tree.map(collect, jax.tree.map(start, state))
         self._ckptr.save(os.path.abspath(path), args=_standard_save_args(state))
 

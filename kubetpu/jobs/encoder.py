@@ -67,16 +67,12 @@ def masked_lm_loss(
     load-balance auxiliary term as the decoder's next_token_loss."""
     corrupted = jnp.where(mask_positions, mask_id, tokens)
     attn = attn_fn or dense_bidirectional_attention
+    x, aux = model_lib.forward_hidden(params, corrupted, cfg, attn_fn=attn)
+    loss = model_lib.lm_loss_tail(x, params["head"], tokens, cfg,
+                                  weights=mask_positions)
     if cfg.n_experts > 0 and cfg.moe_aux_coeff > 0:
-        logits, aux = model_lib.forward(
-            params, corrupted, cfg, attn_fn=attn, return_aux=True
-        )
-        return (
-            model_lib.token_cross_entropy(logits, tokens, weights=mask_positions)
-            + cfg.moe_aux_coeff * aux
-        )
-    logits = model_lib.forward(params, corrupted, cfg, attn_fn=attn)
-    return model_lib.token_cross_entropy(logits, tokens, weights=mask_positions)
+        loss = loss + cfg.moe_aux_coeff * aux
+    return loss
 
 
 def make_mlm_train_step(

@@ -66,6 +66,15 @@ class ModelConfig:
     # through the gate. Primary choices claim capacity slots before
     # secondary ones; size capacity_factor for k tokens-per-expert-slots.
     moe_top_k: int = 1
+    # 0 = compute the full (B, S, V) logits at the loss (small models);
+    # > 0 = stream the LM-head matmul + cross-entropy over sequence chunks
+    # of this size (must divide S; under sp, keep S/chunk a multiple of
+    # sp). Cuts peak loss-tail HBM from O(S*V) to O(chunk*V) — for the
+    # 32k-vocab flagship that is ~2 GB of f32 logits+softmax freed, which
+    # is what lets the larger batch fit (see chunked_token_cross_entropy).
+    # Honored by every training tail: next_token_loss, the pipelined step,
+    # seq2seq_loss, and masked_lm_loss.
+    loss_chunk: int = 0
     # grouped-query attention: number of K/V heads (0 = n_heads, plain MHA;
     # 1 = MQA). Must divide n_heads; the decode KV cache stores only these,
     # cutting its HBM footprint by n_heads/n_kv_heads. With tensor
@@ -83,6 +92,8 @@ class ModelConfig:
                 "moe_top_k > 1 requires the capacity dispatch path "
                 "(set moe_capacity_factor > 0)"
             )
+        if self.loss_chunk < 0:
+            raise ValueError(f"loss_chunk must be >= 0, got {self.loss_chunk}")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
@@ -205,15 +216,18 @@ def dense_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jn
     return dense_attention(q, k, v, causal=True)
 
 
-def _moe_aux_from_probs(probs: jnp.ndarray) -> jnp.ndarray:
+def _moe_aux_from_probs(probs: jnp.ndarray, top_k: int = 1) -> jnp.ndarray:
     """Switch-transformer load-balance term from router probs (B, S, E) or
     (N, E): E * sum_e(f_e * P_e), minimized (= 1) when routing is uniform.
-    f_e = fraction of tokens routed to e (non-differentiable), P_e = mean
-    router probability (carries the gradient)."""
+    f_e = fraction of token-assignments routed to e (non-differentiable),
+    P_e = mean router probability (carries the gradient). With top_k > 1,
+    f_e counts ALL k assignments per token (mean of the k one-hots), so
+    balance pressure sees secondary-expert load too — argmax-only would
+    understate real expert load under top-2 routing."""
     probs = probs.reshape(-1, probs.shape[-1])
     e = probs.shape[-1]
-    top1 = jnp.argmax(probs, axis=-1)
-    frac = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    _, topk_idx = jax.lax.top_k(probs, top_k)          # (N, k)
+    frac = jnp.mean(jax.nn.one_hot(topk_idx, e, dtype=jnp.float32), axis=(0, 1))
     mean_prob = jnp.mean(probs, axis=0)
     return e * jnp.sum(jax.lax.stop_gradient(frac) * mean_prob)
 
@@ -234,7 +248,7 @@ def _mlp(cfg: ModelConfig, h: jnp.ndarray, layer: Params):
         up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
         return jnp.einsum("bsf,fd->bsd", gate * up, layer["w_down"]), aux
     if cfg.moe_aux_coeff > 0:
-        aux = _moe_aux_from_probs(probs)
+        aux = _moe_aux_from_probs(probs, cfg.moe_top_k)
     return out, aux
 
 
@@ -458,6 +472,73 @@ def token_cross_entropy(
     return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
+def chunked_token_cross_entropy(
+    x: jnp.ndarray,
+    head: jnp.ndarray,
+    targets: jnp.ndarray,
+    chunk: int,
+    weights: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Cross-entropy from HIDDEN states without ever materializing the full
+    (B, S, V) logits: scan over sequence chunks, each computing its
+    (B, chunk, V) head matmul + log-softmax and reducing to scalars. The
+    chunk body is ``jax.checkpoint``-ed, so backward recomputes one chunk's
+    logits at a time too — peak loss-tail memory drops from O(S*V) to
+    O(chunk*V) at the cost of one extra head matmul (a few % of step FLOPs
+    for the flagship, bought back by the larger batch the freed HBM
+    admits; see BENCH_MODEL.json loss_chunk rows).
+
+    ``chunk`` must divide S. Under sequence parallelism pick a chunk count
+    that is a multiple of sp so the (B, S, D) -> (nc, B, chunk, D) reshape
+    lands on shard boundaries and GSPMD inserts no resharding.
+    """
+    b, s, d = x.shape
+    if s % chunk:
+        raise ValueError(f"chunk ({chunk}) must divide sequence length ({s})")
+    nc = s // chunk
+    xc = x.reshape(b, nc, chunk, d).transpose(1, 0, 2, 3)        # (nc, B, C, D)
+    tc = targets.reshape(b, nc, chunk).transpose(1, 0, 2)        # (nc, B, C)
+    if weights is None:
+        wc = jnp.ones((nc, b, chunk), jnp.float32)
+    else:
+        wc = weights.reshape(b, nc, chunk).transpose(1, 0, 2).astype(jnp.float32)
+
+    @jax.checkpoint
+    def body(carry, ch):
+        nll_sum, w_sum = carry
+        xi, ti, wi = ch
+        logits = jnp.einsum("bcd,dv->bcv", xi, head)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, ti[..., None], axis=-1)[..., 0]
+        return (nll_sum + jnp.sum(nll * wi), w_sum + jnp.sum(wi)), None
+
+    (nll_sum, w_sum), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xc, tc, wc),
+    )
+    return nll_sum / jnp.maximum(w_sum, 1.0)
+
+
+def lm_loss_tail(
+    x: jnp.ndarray,
+    head: jnp.ndarray,
+    targets: jnp.ndarray,
+    cfg: ModelConfig,
+    weights: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """THE loss tail: final-norm hidden states -> mean cross-entropy, as
+    either one materialized (B, S, V) logits tensor or the chunked stream
+    (``cfg.loss_chunk``). Every LM-shaped family (causal, pipelined,
+    seq2seq decoder, masked-LM) ends here, so a tail change — z-loss,
+    label smoothing — lands everywhere at once and the two memory modes
+    can never diverge."""
+    if cfg.loss_chunk > 0:
+        return chunked_token_cross_entropy(x, head, targets, cfg.loss_chunk,
+                                           weights)
+    logits = jnp.einsum("bsd,dv->bsv", x, head)
+    return token_cross_entropy(logits, targets, weights)
+
+
 def next_token_loss(
     params: Params,
     tokens: jnp.ndarray,
@@ -471,9 +552,14 @@ def next_token_loss(
     ``targets`` is ``tokens`` shifted by one (the data pipeline's job): with
     the sequence axis sharded for sequence parallelism, an in-model
     ``[:, 1:]`` shift would need a cross-shard halo exchange for nothing.
+
+    With ``cfg.loss_chunk > 0`` the loss tail streams over sequence chunks
+    (``chunked_token_cross_entropy``) instead of materializing (B, S, V)
+    logits — numerically identical (same f32 log-softmax per position, same
+    mean), different memory/FLOPs trade.
     """
+    x, aux = forward_hidden(params, tokens, cfg, attn_fn, positions)
+    loss = lm_loss_tail(x, params["head"], targets, cfg)
     if cfg.n_experts > 0 and cfg.moe_aux_coeff > 0:
-        logits, aux = forward(params, tokens, cfg, attn_fn, positions, return_aux=True)
-        return token_cross_entropy(logits, targets) + cfg.moe_aux_coeff * aux
-    logits = forward(params, tokens, cfg, attn_fn, positions)
-    return token_cross_entropy(logits, targets)
+        loss = loss + cfg.moe_aux_coeff * aux
+    return loss

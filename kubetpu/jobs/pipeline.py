@@ -133,7 +133,7 @@ def make_pipeline_forward(
         check_vma=False,
     )
 
-    def forward(params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    def forward_hidden(params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
         mb, seq = tokens.shape
         assert mb % n_microbatches == 0, (mb, n_microbatches)
         b = mb // n_microbatches
@@ -144,9 +144,13 @@ def make_pipeline_forward(
         out_stack = region_sm(params["blocks"], h_stack, positions)
 
         x = out_stack.reshape(mb, seq, -1)
-        x = model_lib.rms_norm(x, params["ln_f"])
+        return model_lib.rms_norm(x, params["ln_f"])
+
+    def forward(params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+        x = forward_hidden(params, tokens)
         return jnp.einsum("bsd,dv->bsv", x, params["head"])
 
+    forward.hidden = forward_hidden
     return forward
 
 
@@ -180,7 +184,10 @@ def make_pipeline_train_step(
                                 block_k=block_k, interpret=interpret)
 
     def loss_fn(params, tokens, targets):
-        return model_lib.token_cross_entropy(fwd(params, tokens), targets)
+        # the head runs outside the manual pp region, so the shared loss
+        # tail (materialized or chunked per cfg.loss_chunk) drops in as-is
+        x = fwd.hidden(params, tokens)
+        return model_lib.lm_loss_tail(x, params["head"], targets, cfg)
 
     bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
     from kubetpu.jobs.train import make_update_step

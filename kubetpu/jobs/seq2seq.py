@@ -128,10 +128,13 @@ def decoder_forward(
     attn_fn=None,
     positions: Optional[jnp.ndarray] = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ):
     """Teacher-forced decoder logits (B, T, V): causal self-attention over
     *tgt_in* plus cross-attention into *memory* in every block. With
-    ``return_aux`` also the decoder's summed MoE load-balance term."""
+    ``return_aux`` also the decoder's summed MoE load-balance term; with
+    ``return_hidden`` the final-norm hidden states (B, T, D) + aux instead
+    of logits (the chunked-CE tail consumes these, cfg.loss_chunk)."""
     dec = params["decoder"]
     if attn_fn is None:
         attn_fn = model_lib.dense_causal_attention
@@ -159,6 +162,8 @@ def decoder_forward(
             scan_body, policy=model_lib.remat_xla_policy(cfg))
     x, auxes = jax.lax.scan(scan_body, x, (dec["blocks"], mem_k, mem_v))
     x = model_lib.rms_norm(x, dec["ln_f"])
+    if return_hidden:
+        return x, jnp.sum(auxes)
     logits = jnp.einsum("btd,dv->btv", x, dec["head"])
     if return_aux:
         return logits, jnp.sum(auxes)
@@ -171,9 +176,9 @@ def seq2seq_loss(params: Params, src: jnp.ndarray, tgt_in: jnp.ndarray,
     configs add the load-balance aux from BOTH stacks (the same
     ``moe_aux_coeff`` contract as every other family)."""
     memory, aux_enc = encode(params, src, cfg, return_aux=True)
-    logits, aux_dec = decoder_forward(params, tgt_in, memory, cfg,
-                                      return_aux=True)
-    loss = model_lib.token_cross_entropy(logits, tgt_out)
+    x, aux_dec = decoder_forward(params, tgt_in, memory, cfg,
+                                 return_hidden=True)
+    loss = model_lib.lm_loss_tail(x, params["decoder"]["head"], tgt_out, cfg)
     if cfg.moe_aux_coeff > 0:
         loss = loss + cfg.moe_aux_coeff * (aux_enc + aux_dec)
     return loss

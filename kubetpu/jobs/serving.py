@@ -358,13 +358,18 @@ class SlotServerBase:
         invariant). Tokens emitted so far remain readable via ``result``;
         the request reports finished. Returns False for unknown/finished
         ids. A slot freed mid-step is handled like EOS retirement: the
-        in-flight step's token for it is discarded by the routing loop."""
+        in-flight step's token for it is discarded by the routing loop.
+        Result bookkeeping (prompt/emitted/logprobs) is retained until
+        ``pop_result`` — same contract as ``result`` — so clients that
+        cancel must still pop to reclaim memory; only the sampling params
+        are evicted here (never consulted again once canceled)."""
         if self._done.get(rid, False) or rid not in self._prompts:
             return False
         for i, (qrid, _p) in enumerate(self._queue):
             if qrid == rid:
                 self._queue.pop(i)
                 self._done[rid] = True
+                self._rid_sampling.pop(rid, None)
                 return True
         for slot in range(self.n_slots):
             if self._slot_rid[slot] == rid:
@@ -372,6 +377,7 @@ class SlotServerBase:
                 # to the next occupant
                 self._pending_first.pop(slot, None)
                 self._retire(slot)
+                self._rid_sampling.pop(rid, None)
                 return True
         return False
 

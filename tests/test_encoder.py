@@ -123,3 +123,21 @@ def test_mlm_batches_feed_training():
         assert mask.any(axis=1).all()  # every row contributes
         state, loss = step(state, tokens, mask)
     assert np.isfinite(float(loss))
+
+
+def test_mlm_chunked_loss_matches_unchunked():
+    """cfg.loss_chunk on the masked-LM tail: the weighted (masked-position)
+    reduction must survive chunking — value and grads identical."""
+    import dataclasses
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, MASK_ID)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.3, tokens.shape)
+    cfgc = dataclasses.replace(CFG, loss_chunk=4)
+    f = lambda p, c: masked_lm_loss(p, tokens, mask, MASK_ID, c)
+    l0, g0 = jax.value_and_grad(f)(params, CFG)
+    l1, g1 = jax.value_and_grad(f)(params, cfgc)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for p0, p1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
+                                   rtol=2e-4, atol=2e-5)

@@ -122,6 +122,49 @@ def test_train_step_runs_and_learns():
     assert int(state.step) == 10
 
 
+def test_chunked_loss_matches_unchunked_value_and_grad():
+    """loss_chunk streams the CE tail over sequence chunks — the value and
+    the parameter gradients must match the materialized-logits path (same
+    f32 log-softmax per position, same mean)."""
+    from kubetpu.jobs.model import next_token_loss
+
+    import dataclasses
+    cfg = dataclasses.replace(CFG, loss_chunk=0)
+    cfg_chunked = dataclasses.replace(CFG, loss_chunk=8)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    l0, g0 = jax.value_and_grad(next_token_loss)(params, tokens, targets, cfg)
+    l1, g1 = jax.value_and_grad(next_token_loss)(params, tokens, targets, cfg_chunked)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for p0, p1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
+                                   rtol=2e-4, atol=2e-5)
+
+    with pytest.raises(ValueError):  # chunk must divide S
+        next_token_loss(params, tokens, targets,
+                        dataclasses.replace(CFG, loss_chunk=7))
+
+
+def test_chunked_loss_trains_on_sharded_mesh():
+    """The chunked tail under GSPMD: the (B, S, D) -> chunks reshape must
+    compile and train on a dp x sp x tp mesh (chunk count divisible by sp)."""
+    import dataclasses
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    cfg = dataclasses.replace(CFG, loss_chunk=8)  # S=32 -> 4 chunks, sp=2 | 4
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = []
+    for _ in range(10):
+        state, loss = step(state, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
 def test_param_shardings_are_applied():
     mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
     state, _ = init_state(jax.random.PRNGKey(0), CFG, mesh)
@@ -173,6 +216,36 @@ def test_pipeline_forward_matches_reference():
     got = jax.jit(pf)(params, tokens)
     want = forward(params, tokens, cfg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_chunked_loss_matches_unchunked():
+    """The pipelined step honors cfg.loss_chunk (head runs outside the
+    manual region): one update from the same state must produce the same
+    loss and parameters as the materialized-logits pipeline."""
+    import dataclasses
+
+    from kubetpu.jobs.pipeline import (
+        init_pipeline_state,
+        make_pipeline_train_step,
+    )
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=4, n_heads=4, d_ff=64)
+    cfgc = dataclasses.replace(cfg, loss_chunk=8)
+    mesh = make_mesh({"dp": 2, "pp": 2, "sp": 2, "tp": 1, "ep": 1})
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    losses, leaves = [], []
+    for c in (cfg, cfgc):
+        state, opt = init_pipeline_state(jax.random.PRNGKey(0), c, mesh)
+        step = make_pipeline_train_step(c, mesh, n_microbatches=4, optimizer=opt)
+        state, loss = step(state, tokens, targets)
+        losses.append(float(loss))
+        leaves.append(jax.tree.leaves(state.params))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+    for p0, p1 in zip(*leaves):
+        np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
+                                   rtol=2e-4, atol=2e-5)
 
 
 def test_pipeline_train_step_five_axes():
@@ -262,6 +335,24 @@ def test_bfloat16_model_config():
     loss = next_token_loss(params, tokens, targets, cfg)
     assert loss.dtype == jnp.float32  # CE tail always accumulates in f32
     assert bool(jnp.isfinite(loss))
+
+
+def test_moe_aux_top_k_counts_secondary_assignments():
+    """Under top-2 routing, f_e must see second-choice experts: probs where
+    every token prefers expert 0 and second-prefers expert 1 give
+    f=[.5,.5,0,0] at k=2 (vs [1,0,0,0] at k=1) — hand-check both."""
+    from kubetpu.jobs.model import _moe_aux_from_probs
+
+    probs = jnp.tile(jnp.array([[0.5, 0.3, 0.1, 0.1]], jnp.float32), (8, 1))
+    e, p = 4, jnp.array([0.5, 0.3, 0.1, 0.1])
+    np.testing.assert_allclose(
+        float(_moe_aux_from_probs(probs, top_k=1)), e * float(p[0] * 1.0), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(_moe_aux_from_probs(probs, top_k=2)),
+        e * float(0.5 * p[0] + 0.5 * p[1]),
+        rtol=1e-6,
+    )
 
 
 def test_moe_aux_loss_balances_router():

@@ -129,3 +129,21 @@ def test_cached_generate_matches_recompute_reference():
         np.testing.assert_array_equal(
             np.asarray(ref(params, src, 7)), np.asarray(fast(params, src, 7)),
             err_msg=f"eos={eos}")
+
+
+def test_seq2seq_chunked_loss_matches_unchunked():
+    """cfg.loss_chunk streams the decoder CE tail — value and grads must
+    match the materialized-logits path (tgt len 8, chunk 4)."""
+    import dataclasses
+
+    params, src, tgt = _setup()
+    tgt_in, tgt_out = tgt[:, :-1], tgt[:, 1:]  # len 7 -> pad to 8
+    tgt_in = jnp.pad(tgt_in, ((0, 0), (0, 1)))
+    tgt_out = jnp.pad(tgt_out, ((0, 0), (0, 1)))
+    cfgc = dataclasses.replace(CFG, loss_chunk=4)
+    l0, g0 = jax.value_and_grad(seq2seq_loss)(params, src, tgt_in, tgt_out, CFG)
+    l1, g1 = jax.value_and_grad(seq2seq_loss)(params, src, tgt_in, tgt_out, cfgc)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for p0, p1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
+                                   rtol=2e-4, atol=2e-5)
