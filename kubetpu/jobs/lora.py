@@ -1,0 +1,238 @@
+"""LoRA fine-tuning: low-rank adapters over the flagship model family.
+
+TPU-first shape of the idea: adapters are STACKED on the leading layer axis
+exactly like the base blocks (one lax.scan body, one pair of einsums per
+target), and fine-tuning is expressed *functionally* — the base parameters
+are an untouched input of the jitted step, the effective weights
+``W + (alpha/r) * A @ B`` are materialized inside the traced computation
+(XLA fuses the rank-r update into the surrounding graph; no model-code
+changes, no module surgery), and ONLY the adapters carry gradients and
+optimizer state. Memory cost of training therefore scales with the adapter
+count (two rank-r factors per target per layer) instead of the model: for
+the 0.75B flagship at rank 8 the trainable fraction is ~0.1%, which is the
+entire point — adamw moments for the full model are 2 x 4 bytes/param,
+LoRA's are negligible, so fine-tuning fits where pretraining wouldn't.
+
+The reference (microsoft/KubeGPU) has no training stack at all — this
+module extends the framework's job layer the same way the other families
+do, reusing ``make_update_step`` so every step-level feature (grad
+accumulation, non-finite guard) applies to LoRA runs unchanged.
+
+Merging for export is the same function the train step traces
+(``merge_lora``): serving/decode consume the merged params with zero
+inference-time overhead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubetpu.jobs import model as model_lib
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.train import (
+    TrainState,
+    _filter_spec,
+    _resolve_attention,
+    _shardings,
+    batch_spec,
+    make_optimizer,
+    make_update_step,
+)
+
+# per-target factor layout: (A einsum, B einsum) contract over rank r with
+# the layer axis batched. A carries the IN dims, B the OUT dims of the base
+# weight, so delta = A @ B lands in the base's exact shape.
+_MERGE_EINSUM = {
+    "wq": "ldr,lrhk->ldhk",
+    "wk": "ldr,lrhk->ldhk",
+    "wv": "ldr,lrhk->ldhk",
+    "wo": "lhkr,lrd->lhkd",
+    "w_gate": "ldr,lrf->ldf",
+    "w_up": "ldr,lrf->ldf",
+    "w_down": "lfr,lrd->lfd",
+}
+_MLP_TARGETS = ("w_gate", "w_up", "w_down")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """rank/alpha and which base weights get adapters. Default targets are
+    the attention projections (the standard LoRA recipe); MLP targets are
+    valid for DENSE models only (MoE expert weights carry an expert axis
+    the rank-r factorization here doesn't model)."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        unknown = [t for t in self.targets if t not in _MERGE_EINSUM]
+        if unknown:
+            raise ValueError(
+                f"unknown LoRA target(s) {unknown}; choose from "
+                f"{sorted(_MERGE_EINSUM)}"
+            )
+        if not self.targets:
+            raise ValueError("LoRA needs at least one target")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _check_targets(cfg: ModelConfig, lcfg: LoraConfig) -> None:
+    if cfg.n_experts > 0 and any(t in _MLP_TARGETS for t in lcfg.targets):
+        raise ValueError(
+            "MLP LoRA targets are unsupported for MoE configs (expert-axis "
+            "weights); restrict targets to the attention projections"
+        )
+
+
+def _factor_shapes(cfg: ModelConfig, target: str, r: int):
+    """(A shape, B shape) for one target, mirroring init_params layouts."""
+    L, d, h, hd, f = (cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.head_dim,
+                      cfg.d_ff)
+    kv = cfg.kv_heads
+    if target in ("wq", "wk", "wv"):
+        heads = h if target == "wq" else kv
+        return (L, d, r), (L, r, heads, hd)
+    if target == "wo":
+        return (L, h, hd, r), (L, r, d)
+    if target in ("w_gate", "w_up"):
+        return (L, d, r), (L, r, f)
+    return (L, f, r), (L, r, d)  # w_down
+
+
+def init_lora_params(rng: jax.Array, cfg: ModelConfig,
+                     lcfg: LoraConfig) -> Params:
+    """A ~ N(0, 1/in_dim), B = 0 — the adapter delta starts at exactly
+    zero, so step 0 of fine-tuning reproduces the base model bit-for-bit
+    (pinned by tests)."""
+    _check_targets(cfg, lcfg)
+    blocks: Params = {}
+    for i, t in enumerate(lcfg.targets):
+        a_shape, b_shape = _factor_shapes(cfg, t, lcfg.rank)
+        in_dim = 1
+        for s in a_shape[1:-1]:
+            in_dim *= s
+        k = jax.random.fold_in(rng, i)
+        blocks[f"{t}_a"] = (
+            jax.random.normal(k, a_shape, cfg.dtype) * in_dim ** -0.5
+        )
+        blocks[f"{t}_b"] = jnp.zeros(b_shape, cfg.dtype)
+    return {"blocks": blocks}
+
+
+def lora_param_specs(cfg: ModelConfig, lcfg: LoraConfig) -> Params:
+    """Shardings consistent with train.param_specs: whichever base axis is
+    on tp stays on tp in the factor that carries it; the rank axis is tiny
+    and always replicated."""
+    specs: Params = {}
+    for t in lcfg.targets:
+        if t in ("wq", "wk", "wv"):
+            a, b = P(None, None, None), P(None, None, "tp", None)
+        elif t == "wo":
+            a, b = P(None, "tp", None, None), P(None, None, None)
+        elif t in ("w_gate", "w_up"):
+            a, b = P(None, None, None), P(None, None, "tp")
+        else:  # w_down
+            a, b = P(None, "tp", None), P(None, None, None)
+        specs[f"{t}_a"], specs[f"{t}_b"] = a, b
+    return {"blocks": specs}
+
+
+def merge_lora(base: Params, lora: Params, lcfg: LoraConfig) -> Params:
+    """Effective parameters ``W + (alpha/r) * A @ B`` for every target;
+    non-target leaves pass through by reference (no copy). This is both
+    what the train step traces AND the export path — serving/decode take
+    the merged tree with zero inference-time overhead."""
+    blocks = dict(base["blocks"])
+    for t in lcfg.targets:
+        a, b = lora["blocks"][f"{t}_a"], lora["blocks"][f"{t}_b"]
+        delta = jnp.einsum(_MERGE_EINSUM[t], a, b) * lcfg.scale
+        blocks[t] = blocks[t] + delta.astype(blocks[t].dtype)
+    return {**base, "blocks": blocks}
+
+
+def lora_param_count(lora: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(lora))
+
+
+def init_lora_state(
+    rng: jax.Array,
+    cfg: ModelConfig,
+    lcfg: LoraConfig,
+    mesh: Mesh,
+    optimizer=None,
+) -> Tuple[TrainState, Any]:
+    """TrainState over the ADAPTERS only (the base model is not part of the
+    optimized state — pass it to the step)."""
+    _check_targets(cfg, lcfg)
+    optimizer = optimizer or make_optimizer()
+    shardings = _shardings(mesh, lora_param_specs(cfg, lcfg))
+
+    @partial(jax.jit, out_shardings=shardings)
+    def _init(rng):
+        return init_lora_params(rng, cfg, lcfg)
+
+    lora = _init(rng)
+    opt_state = jax.jit(optimizer.init)(lora)
+    return (
+        TrainState(params=lora, opt_state=opt_state,
+                   step=jnp.zeros((), jnp.int32)),
+        optimizer,
+    )
+
+
+def make_lora_train_step(
+    cfg: ModelConfig,
+    lcfg: LoraConfig,
+    mesh: Mesh,
+    optimizer=None,
+    attention: Optional[str] = None,
+    accum_steps: int = 1,
+    skip_nonfinite: bool = False,
+):
+    """Jitted ``(state, base_params, tokens, targets) -> (state, loss)``.
+
+    The base is an ordinary (non-donated) argument: it stays live in HBM
+    across steps, gradients flow through the merge into A/B only, and the
+    optimizer updates only the adapter state (which IS donated). All of
+    ``make_update_step``'s features (accumulation, non-finite skip) apply.
+    """
+    _check_targets(cfg, lcfg)
+    optimizer = optimizer or make_optimizer()
+    attn_fn = _resolve_attention(mesh, attention) if attention else None
+
+    def loss_fn(lora, base, tokens, targets):
+        merged = merge_lora(base, lora, lcfg)
+        return model_lib.next_token_loss(merged, tokens, targets, cfg,
+                                         attn_fn=attn_fn)
+
+    if accum_steps > 1:
+        # make_update_step's accumulation reshapes every batch arg into
+        # microbatches — base_params rides in the batch position and must
+        # not be; LoRA's activation memory equals the base model's anyway,
+        # so shrink the batch instead.
+        raise NotImplementedError(
+            "accum_steps > 1 with LoRA: use a smaller batch — the adapter "
+            "state is tiny, activation memory matches the base model's"
+        )
+    # make_update_step's contract is (params, *batch): base_params rides as
+    # the first batch element (constant wrt grad, never donated/reshaped)
+    inner = make_update_step(loss_fn, optimizer, skip_nonfinite=skip_nonfinite)
+
+    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+    return jax.jit(
+        inner,
+        in_shardings=(None, None, bspec, bspec),
+        donate_argnums=(0,),
+    )
