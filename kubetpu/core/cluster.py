@@ -357,6 +357,14 @@ class Cluster:
 
     # -- gang scheduling ----------------------------------------------------
 
+    def new_gang_id(self) -> int:
+        """Fresh gang-identity stamp (a ``GangKey`` value) for pods that
+        enter a pending queue as a gang BEFORE any placement (the
+        controller's queued submissions). ``schedule_gang`` re-stamps on
+        placement, so uniqueness is all that matters here."""
+        self._gang_seq += 1
+        return self._gang_seq
+
     def schedule_gang(self, pods: Sequence[PodInfo]) -> List[PodInfo]:
         """All-or-nothing placement of a gang (one pod per host of a
         multi-host job): either every pod lands or none does.

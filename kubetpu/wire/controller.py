@@ -48,6 +48,8 @@ from typing import Dict, List, Optional
 from kubetpu.api import utils
 from kubetpu.core import Cluster, SchedulingError
 from kubetpu.core.cluster import GangKey, pod_priority
+from kubetpu.scheduler.deviceclass import GPU, TPU
+from kubetpu.scheduler.translate import pod_device_count, pod_wants_device
 from kubetpu.wire.codec import (
     allocate_result_to_json,
     pod_info_from_json,
@@ -66,13 +68,27 @@ class ControllerServer:
         port: int = 0,
         poll_interval: float = 5.0,
         token: Optional[str] = None,
+        reserve_after: int = 3,
     ) -> None:
         self.cluster = cluster or Cluster()
         self.poll_interval = poll_interval
         self.token = token or None
+        # head-of-line gang reservation: a pending gang that has survived
+        # this many reconcile passes claims the device classes it requests —
+        # later pending work and new submissions of those classes queue
+        # behind it instead of cherry-picking freed chips out from under it
+        # (the classic big-gang starvation). 0 disables.
+        self.reserve_after = reserve_after
+        # a reservation expires after this many passes without assembling
+        # (the gang is likely infeasible right now — e.g. sized for a node
+        # that left): its aging restarts, blocked work flows again, and it
+        # re-reserves if it keeps waiting. 0 = hold forever.
+        self.reserve_hold = 10
+        self._reserve_held: Dict[int, int] = {}  # gang id -> passes held
         self._lock = threading.Lock()
         self._node_urls: Dict[str, str] = {}
         self._pending: List = []  # evicted pods awaiting capacity
+        self._pending_age: Dict[str, int] = {}  # name -> reconcile passes
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
         controller = self
@@ -181,6 +197,9 @@ class ControllerServer:
                             p for p in controller._pending if p.name != name
                         ]
                         if len(controller._pending) < before:
+                            # drop the age too: a same-name resubmission
+                            # must not inherit it and reserve instantly
+                            controller._pending_age.pop(name, None)
                             out = {"released": name, "was_pending": True}
                         else:
                             out = None
@@ -277,6 +296,85 @@ class ControllerServer:
         self.cluster.release(placed.name)
         return True
 
+    # -- gang reservation (starvation guard) ---------------------------------
+
+    def _active_reservation(self) -> Optional[dict]:
+        """Call under the lock. The FIRST pending gang aged
+        ``reserve_after``+ reconcile passes holds the reservation:
+        {"gang": id, "classes": {resource names}, "priority": max}."""
+        if not self.reserve_after:
+            return None
+        for p in self._pending:
+            gid = p.requests.get(GangKey)
+            if gid is None:
+                continue
+            if self.cluster.gang_slice_filter(p) is not None:
+                # surviving member of a PARTIALLY-PLACED gang: it can only
+                # re-join its mates' slice, so it must not freeze the whole
+                # device class cluster-wide
+                continue
+            if self._pending_age.get(p.name, 0) >= self.reserve_after:
+                members = [
+                    q for q in self._pending
+                    if q.requests.get(GangKey) == gid
+                ]
+                classes = {
+                    dc.resource_name
+                    for dc in (TPU, GPU)
+                    for q in members
+                    if pod_wants_device(dc, q)
+                }
+                prio = max(pod_priority(q) for q in members)
+                return {"gang": gid, "classes": classes, "priority": prio}
+        return None
+
+    def _reservation_blocks(self, res: Optional[dict], pods) -> bool:
+        """Does the active reservation forbid placing *pods* now? The
+        reserved gang itself always passes; so do pods of other device
+        classes and pods that OUTRANK the gang (priority preemption keeps
+        working during a reservation)."""
+        if not res:
+            return False
+        if all(p.requests.get(GangKey) == res["gang"] for p in pods):
+            return False
+        wants = {
+            dc.resource_name
+            for dc in (TPU, GPU)
+            for p in pods
+            if pod_wants_device(dc, p)
+        }
+        if not (wants & res["classes"]):
+            return False
+        return max(pod_priority(p) for p in pods) <= res["priority"]
+
+    def _enqueue_locked(self, req: dict, pods) -> dict:
+        """Queue a submission instead of placing it (``"queue": true``).
+        Gang submissions get a fresh gang-identity stamp NOW so the
+        reconcile pass re-places the members atomically (and so the gang
+        can itself age into a reservation). Requests exceeding the
+        cluster's TOTAL capacity of a class are refused outright — they
+        could never leave the queue, but could age into a reservation that
+        soft-locks the class (resubmit after adding nodes)."""
+        for dc in (TPU, GPU):
+            want = sum(pod_device_count(dc, p) for p in pods)
+            if want > 0:
+                have = sum(
+                    int(n.info.capacity.get(dc.resource_name, 0))
+                    for n in self.cluster.nodes.values()
+                )
+                if want > have:
+                    raise SchedulingError(
+                        f"request for {want} x {dc.resource_name} exceeds "
+                        f"total cluster capacity ({have}); refusing to "
+                        f"queue a submission that cannot ever place"
+                    )
+        if "gang" in req:
+            gid = self.cluster.new_gang_id()
+            for p in pods:
+                p.requests[GangKey] = gid
+        self._pending.extend(pods)
+        return {"queued": [p.name for p in pods]}
+
     def _submit(self, req: dict) -> dict:
         """Place a pod or a gang and run container-start allocation — the
         caller gets everything a launcher needs. Manages the lock itself,
@@ -296,6 +394,7 @@ class ControllerServer:
         if len(set(names)) != len(names):
             raise SchedulingError(f"duplicate pod names in request: {names}")
         evicted: List = []
+        queue = bool(req.get("queue"))
         with self._lock:
             for n in names:
                 if self._pod_name_in_use(n):
@@ -303,23 +402,40 @@ class ControllerServer:
                     # record and leak its resources (Cluster.schedule keys
                     # node.pods by name)
                     raise SchedulingError(f"pod name {n!r} is already in use")
-            if "gang" in req:
-                placed = self.cluster.schedule_gang(pods)
-                contiguity = self.cluster.gang_contiguity(placed)
-            else:
-                contiguity = None
-                if pod_priority(pods[0]) > 0:
-                    # the priority pseudo-resource opts the pod into
-                    # preemption (no separate schedule try:
-                    # schedule_preempting already places without evicting
-                    # when the pod fits plainly); victims join the pending
-                    # queue and re-place automatically on the next
-                    # reconcile pass, wherever capacity allows
-                    placed_pod, evicted = self.cluster.schedule_preempting(pods[0])
-                    placed = [placed_pod]
-                    self._pending.extend(evicted)
+            res = self._active_reservation()
+            if self._reservation_blocks(res, pods):
+                if queue:
+                    return self._enqueue_locked(req, pods)
+                raise SchedulingError(
+                    f"capacity is reserved for pending gang {res['gang']} "
+                    f"(waiting {self.reserve_after}+ reconcile passes); "
+                    f'submit with "queue": true to wait behind it, or '
+                    f"outrank it via the priority pseudo-resource"
+                )
+            try:
+                if "gang" in req:
+                    placed = self.cluster.schedule_gang(pods)
+                    contiguity = self.cluster.gang_contiguity(placed)
                 else:
-                    placed = [self.cluster.schedule(pods[0])]
+                    contiguity = None
+                    if pod_priority(pods[0]) > 0:
+                        # the priority pseudo-resource opts the pod into
+                        # preemption (no separate schedule try:
+                        # schedule_preempting already places without evicting
+                        # when the pod fits plainly); victims join the
+                        # pending queue and re-place automatically on the
+                        # next reconcile pass, wherever capacity allows
+                        placed_pod, evicted = self.cluster.schedule_preempting(
+                            pods[0])
+                        placed = [placed_pod]
+                        self._pending.extend(evicted)
+                    else:
+                        placed = [self.cluster.schedule(pods[0])]
+            except SchedulingError:
+                if queue:
+                    # doesn't fit NOW: wait for capacity instead of erroring
+                    return self._enqueue_locked(req, pods)
+                raise
             snapshots = [
                 (p, *self._snapshot_placed(p.name, p.node_name))
                 for p in placed
@@ -462,6 +578,29 @@ class ControllerServer:
             # Phase 1 (under the lock): commit placements and snapshot; pods
             # that fit nowhere stay pending. Placed pods leave _pending NOW
             # so a concurrent DELETE sees them as placed, not pending.
+            # An aged head-of-line gang reservation blocks later same-class
+            # pending work this pass (starvation guard; the reserved gang
+            # itself is tried in its FIFO turn). A reservation held past
+            # reserve_hold passes without assembling expires: its aging
+            # restarts so blocked work flows again (automatic recovery from
+            # gangs the current cluster cannot satisfy).
+            reservation = self._active_reservation()
+            if reservation is not None:
+                gid = reservation["gang"]
+                held = self._reserve_held.get(gid, 0) + 1
+                if self.reserve_hold and held > self.reserve_hold:
+                    for q in self._pending:
+                        if q.requests.get(GangKey) == gid:
+                            # end-of-pass aging adds 1; land at 0
+                            self._pending_age[q.name] = -1
+                    self._reserve_held = {}
+                    reservation = None
+                    utils.logf(2, "reservation for gang %s expired after "
+                               "%d passes; re-aging", gid, held - 1)
+                else:
+                    self._reserve_held = {gid: held}
+            else:
+                self._reserve_held = {}
             to_allocate, still_pending = [], []
             pending, consumed = list(self._pending), set()
             for i, pod in enumerate(pending):
@@ -483,6 +622,9 @@ class ControllerServer:
                     ]
                     consumed.update(idxs)
                     members = [pending[j] for j in idxs]
+                    if self._reservation_blocks(reservation, members):
+                        still_pending.extend(members)
+                        continue
                     try:
                         placed_members = self.cluster.schedule_gang(members)
                     except SchedulingError:
@@ -504,12 +646,28 @@ class ControllerServer:
                         ))
                     continue
                 consumed.add(i)
+                if slice_filter is None and self._reservation_blocks(
+                        reservation, [pod]):
+                    # plain pods wait behind the reserved gang; surviving-
+                    # gang members (slice_filter set) are exempt — they
+                    # re-join an already-placed gang, and stranding them
+                    # would break it
+                    still_pending.append(pod)
+                    continue
                 try:
                     # surviving-gang members re-place ONLY within their
                     # mates' slice — an unconstrained reschedule would
                     # silently straddle the gang over DCN, the exact
                     # failure schedule_gang refuses (core gang invariant)
-                    placed = self.cluster.schedule(pod, slice_filter)
+                    if slice_filter is None and pod_priority(pod) > 0:
+                        # a queued/evicted priority pod keeps its preemption
+                        # semantics here, same as the direct-submit path —
+                        # otherwise lower-priority work placed after it
+                        # could pin it pending forever (priority inversion)
+                        placed, victims = self.cluster.schedule_preempting(pod)
+                        still_pending.extend(victims)
+                    else:
+                        placed = self.cluster.schedule(pod, slice_filter)
                     to_allocate.append(
                         (pod, placed,
                          *self._snapshot_placed(placed.name, placed.node_name))
@@ -546,11 +704,18 @@ class ControllerServer:
                     if self._release_if_current(placed):
                         self._pending.append(pod)
         with self._lock:
+            # age the queue: one pass survived = one tick; rebuilding the
+            # dict drops entries for pods that placed (or were deleted)
+            self._pending_age = {
+                p.name: self._pending_age.get(p.name, 0) + 1
+                for p in self._pending
+            }
             pending_names = [p.name for p in self._pending]
         return {
             "failed_nodes": failed,
             "rescheduled": rescheduled,
             "pending": pending_names,
+            "reserved_gang": reservation["gang"] if reservation else None,
         }
 
     def _poll_loop(self) -> None:

@@ -689,3 +689,213 @@ def test_controller_cli_daemon_end_to_end():
         if agent_proc.poll() is None:
             agent_proc.kill()
         agent_proc.wait(timeout=10)
+
+
+def _delete(addr, name):
+    req = urllib.request.Request(addr + f"/pods/{name}", method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_queued_submission_waits_for_capacity(stack):
+    """POST /pods with "queue": true pends instead of 409ing when the pod
+    doesn't fit, and the reconcile pass places it once capacity frees."""
+    controller, _ = stack
+    for i in range(4):
+        _post(controller.address + "/pods",
+              {"pod": pod_to_json(tpu_pod(f"s{i}", 4))})
+    out = _post(controller.address + "/pods",
+                {"pod": pod_to_json(tpu_pod("late", 4)), "queue": True})
+    assert out == {"queued": ["late"]}
+    assert controller.poll_once()["pending"] == ["late"]
+    _delete(controller.address, "s0")
+    res = controller.poll_once()
+    assert res["pending"] == []
+    assert res["rescheduled"][0]["pod"] == "late"
+    # the launcher env came along, same as any reconcile re-place
+    assert "TPU_VISIBLE_DEVICES" in (
+        res["rescheduled"][0]["containers"]["main"]["env"]
+    )
+
+
+def test_gang_reservation_prevents_starvation(stack):
+    """The classic failure: a big gang waits while small pods keep grabbing
+    every freed chip. After reserve_after passes the head-of-line gang
+    claims the device class — new small submissions 409 (or queue BEHIND
+    it), pending small pods stop placing, and when the gang finally
+    assembles the queue drains normally."""
+    controller, _ = stack
+    assert controller.reserve_after == 3
+    for i in range(4):
+        _post(controller.address + "/pods",
+              {"pod": pod_to_json(tpu_pod(f"s{i}", 4))})
+    # 2-host gang needs all 16 chips; queue it
+    out = _post(controller.address + "/pods",
+                {"gang": [pod_to_json(tpu_pod("g0", 8)),
+                          pod_to_json(tpu_pod("g1", 8))],
+                 "queue": True})
+    assert out == {"queued": ["g0", "g1"]}
+
+    # age the gang past the threshold
+    for _ in range(3):
+        assert controller.poll_once()["reserved_gang"] is None
+    assert controller.poll_once()["reserved_gang"] is not None
+
+    # free 4 chips: a small pod WOULD fit, but the reservation refuses it
+    _delete(controller.address, "s0")
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(controller.address + "/pods",
+              {"pod": pod_to_json(tpu_pod("sneak", 4))})
+    assert err.value.code == 409
+    assert "reserved" in json.loads(err.value.read())["error"]
+
+    # ...but it may queue behind the gang; the reconcile pass must NOT
+    # place it while the reservation holds
+    out = _post(controller.address + "/pods",
+                {"pod": pod_to_json(tpu_pod("sneak", 4)), "queue": True})
+    assert out == {"queued": ["sneak"]}
+    res = controller.poll_once()
+    assert res["rescheduled"] == []
+    assert set(res["pending"]) == {"g0", "g1", "sneak"}
+
+    # free the rest: the gang assembles on this pass (sneak still waits)
+    for i in (1, 2, 3):
+        _delete(controller.address, f"s{i}")
+    res = controller.poll_once()
+    assert {r["pod"] for r in res["rescheduled"]} == {"g0", "g1"}
+    assert res["pending"] == ["sneak"]
+
+    # reservation is gone; once chips free again the queued pod places
+    assert controller.poll_once()["reserved_gang"] is None
+    _delete(controller.address, "g0")
+    res = controller.poll_once()
+    assert {r["pod"] for r in res["rescheduled"]} == {"sneak"}
+
+
+def test_priority_outranks_reservation(stack):
+    """Reservation blocks same-or-lower priority work only: a pod that
+    outranks the waiting gang still places immediately (preemption keeps
+    working during a reservation)."""
+    controller, _ = stack
+    for i in range(4):
+        _post(controller.address + "/pods",
+              {"pod": pod_to_json(tpu_pod(f"s{i}", 4))})
+    _post(controller.address + "/pods",
+          {"gang": [pod_to_json(tpu_pod("g0", 8)),
+                    pod_to_json(tpu_pod("g1", 8))],
+           "queue": True})
+    for _ in range(4):
+        controller.poll_once()
+    _delete(controller.address, "s0")
+    high = tpu_pod("vip", 4)
+    high.requests["kubetpu/priority"] = 10
+    out = _post(controller.address + "/pods", {"pod": pod_to_json(high)})
+    assert out["placements"][0]["pod"] == "vip"
+
+
+def test_queue_refuses_request_beyond_total_capacity(stack):
+    """A queued gang bigger than the whole cluster could never place but
+    WOULD age into a class-wide reservation — refuse it at submit time."""
+    controller, _ = stack
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(controller.address + "/pods",
+              {"gang": [pod_to_json(tpu_pod(f"g{i}", 8)) for i in range(4)],
+               "queue": True})
+    assert err.value.code == 409
+    assert "capacity" in json.loads(err.value.read())["error"]
+
+
+def test_reservation_expires_and_reacquires(stack):
+    """A reservation the cluster can't satisfy within reserve_hold passes
+    expires (blocked work flows again), then re-acquires if the gang keeps
+    waiting — no permanent soft-lock."""
+    controller, _ = stack
+    controller.reserve_hold = 2
+    for i in range(4):
+        _post(controller.address + "/pods",
+              {"pod": pod_to_json(tpu_pod(f"s{i}", 4))})
+    _post(controller.address + "/pods",
+          {"gang": [pod_to_json(tpu_pod("g0", 8)),
+                    pod_to_json(tpu_pod("g1", 8))],
+           "queue": True})
+    for _ in range(3):
+        controller.poll_once()
+    # held pass 1, pass 2, then expiry
+    assert controller.poll_once()["reserved_gang"] is not None
+    assert controller.poll_once()["reserved_gang"] is not None
+    res = controller.poll_once()
+    assert res["reserved_gang"] is None  # expired: small work flows again
+    _delete(controller.address, "s0")
+    out = _post(controller.address + "/pods",
+                {"pod": pod_to_json(tpu_pod("flow", 4))})
+    assert out["placements"][0]["pod"] == "flow"
+    # it re-ages and re-reserves
+    for _ in range(3):
+        controller.poll_once()
+    assert controller.poll_once()["reserved_gang"] is not None
+
+
+def test_deleted_pending_age_not_inherited(stack):
+    """DELETE of an aged queued pod drops its age: a same-name
+    resubmission must wait the full reserve_after again."""
+    controller, _ = stack
+    for i in range(4):
+        _post(controller.address + "/pods",
+              {"pod": pod_to_json(tpu_pod(f"s{i}", 4))})
+    _post(controller.address + "/pods",
+          {"gang": [pod_to_json(tpu_pod("g0", 8)),
+                    pod_to_json(tpu_pod("g1", 8))],
+           "queue": True})
+    for _ in range(4):
+        controller.poll_once()
+    assert controller._active_reservation() is not None
+    _delete(controller.address, "g0")
+    _delete(controller.address, "g1")
+    _post(controller.address + "/pods",
+          {"gang": [pod_to_json(tpu_pod("g0", 8)),
+                    pod_to_json(tpu_pod("g1", 8))],
+           "queue": True})
+    res = controller.poll_once()
+    assert res["reserved_gang"] is None  # fresh gang starts aging at 1
+
+
+def test_surviving_gang_member_does_not_reserve(stack):
+    """A pending member of a PARTIALLY-placed gang is slice-pinned — it
+    must never hold a cluster-wide reservation (one evicted pod must not
+    freeze the device class)."""
+    controller, agents = stack
+    out = _post(controller.address + "/pods",
+                {"gang": [pod_to_json(tpu_pod("g0", 8)),
+                          pod_to_json(tpu_pod("g1", 8))]})
+    assert len(out["placements"]) == 2
+    # find which agent hosts g0 and kill it; reconcile evicts g0 to pending
+    node_of_g0 = next(p["node"] for p in out["placements"] if p["pod"] == "g0")
+    victim = next(a for a in agents if a.node_name == node_of_g0)
+    victim.shutdown()
+    res = controller.poll_once()
+    assert node_of_g0 in res["failed_nodes"]
+    # age the survivor far past the threshold: its mates' slice is full
+    # (g1 holds all 8 chips of the remaining host)
+    for _ in range(5):
+        res = controller.poll_once()
+    assert res["reserved_gang"] is None
+    assert "g0" in res["pending"]
+
+
+def test_evicted_priority_pod_preempts_on_reconcile(stack):
+    """A priority pod evicted by a node failure keeps its preemption
+    rights when the reconcile pass re-places it — plain schedule would
+    pin it pending behind lower-priority work forever."""
+    controller, agents = stack
+    vip = tpu_pod("vip", 8)
+    vip.requests["kubetpu/priority"] = 10
+    out = _post(controller.address + "/pods", {"pod": pod_to_json(vip)})
+    vip_node = out["placements"][0]["node"]
+    for i in range(2):  # fill the OTHER host with low-priority work
+        _post(controller.address + "/pods",
+              {"pod": pod_to_json(tpu_pod(f"low{i}", 4))})
+    next(a for a in agents if a.node_name == vip_node).shutdown()
+    res = controller.poll_once()
+    assert vip_node in res["failed_nodes"]
+    assert {r["pod"] for r in res["rescheduled"]} == {"vip"}
+    assert set(res["pending"]) == {"low0", "low1"}  # preempted victims
