@@ -1,0 +1,157 @@
+"""Beam search over the KV-cached decode path.
+
+TPU-shaped beam search: beams fold into the batch axis (the cache is
+(L, B*K, S_max, H_kv, D) — every matmul stays as large and batched as
+plain decoding with batch B*K), the whole search is ONE ``lax.scan``
+inside a single jit, and each step is two fused stages: a flattened
+top-k over (K*V) continuations per example, then a parent-beam gather
+that reorders the cache along the beam axis (``take_along_axis`` on a
+(L, B, K, S, H, D) view — the standard seq2seq-framework cache shuffle,
+static shapes throughout).
+
+EOS semantics: a finished beam is pinned — its only continuation is EOS
+at log-probability 0, so its cumulative score freezes while the search
+keeps shapes static. Final ranking applies the GNMT length penalty
+``((5 + len) / 6) ** alpha`` when ``length_penalty > 0`` (neutral at 0).
+
+Reuses ``decode.prefill`` / ``decode._forward_one`` — the same chunk
+forward as greedy decoding and speculative verification, so the three
+paths cannot diverge.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubetpu.jobs.decode import (
+    _forward_one,
+    init_kv_cache,
+    kv_cache_specs,
+    prefill,
+)
+from kubetpu.jobs.model import ModelConfig, Params
+
+NEG_INF = -1e30
+
+
+def _gnmt_penalty(length: jnp.ndarray, alpha: float) -> jnp.ndarray:
+    return ((5.0 + length.astype(jnp.float32)) / 6.0) ** alpha
+
+
+def make_beam_search(
+    cfg: ModelConfig,
+    beam_size: int,
+    mesh: Optional[Mesh] = None,
+    length_penalty: float = 0.0,
+    eos_id: Optional[int] = None,
+):
+    """Jitted ``beam_search(params, prompt (B, S_p), num_steps) ->
+    (tokens (B, K, S_p + num_steps), scores (B, K))``, beams sorted
+    best-first. ``scores`` are summed token log-probabilities
+    (length-penalized iff ``length_penalty > 0``); finished beams pad
+    with EOS at frozen score."""
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    k = beam_size
+
+    def beam_search(params, prompt, num_steps: int):
+        b, s_p = prompt.shape
+        max_seq = s_p + num_steps
+        k_cache, v_cache = init_kv_cache(cfg, b, max_seq)
+        logits, k_cache, v_cache = prefill(cfg, params, prompt,
+                                           k_cache, v_cache)
+        # tile prompt cache/logits across beams: beam axis rides INSIDE
+        # the batch axis (L, B*K, ...)
+        k_cache = jnp.repeat(k_cache, k, axis=1)
+        v_cache = jnp.repeat(v_cache, k, axis=1)
+        logits = jnp.repeat(logits, k, axis=0)          # (B*K, V)
+        if mesh is not None:
+            from kubetpu.jobs.train import _filter_spec
+
+            cspec = NamedSharding(mesh, _filter_spec(mesh, kv_cache_specs()))
+            k_cache = jax.lax.with_sharding_constraint(k_cache, cspec)
+            v_cache = jax.lax.with_sharding_constraint(v_cache, cspec)
+        # beam 0 starts at score 0, the rest at -inf: the first flattened
+        # top-k then draws K DISTINCT tokens from beam 0 (the uniform-loop
+        # trick — no special first step)
+        scores = jnp.tile(
+            jnp.array([0.0] + [NEG_INF] * (k - 1), jnp.float32), (b, 1)
+        )
+        finished = jnp.zeros((b, k), bool)
+        gen_len = jnp.zeros((b, k), jnp.int32)
+
+        def step(carry, i):
+            k_cache, v_cache, prev_logits, scores, finished, gen_len = carry
+            logp = jax.nn.log_softmax(
+                prev_logits.astype(jnp.float32), axis=-1
+            ).reshape(b, k, -1)
+            v = logp.shape[-1]
+            if eos_id is not None:
+                # pin finished beams: only continuation is EOS at logp 0
+                pin = jnp.full((v,), NEG_INF).at[eos_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], pin[None, None], logp)
+            flat = (scores[:, :, None] + logp).reshape(b, k * v)
+            new_scores, idx = jax.lax.top_k(flat, k)     # (B, K)
+            parent = idx // v
+            token = (idx % v).astype(prompt.dtype)
+            was_finished = jnp.take_along_axis(finished, parent, axis=1)
+            gen_len = jnp.take_along_axis(gen_len, parent, axis=1)
+            gen_len = jnp.where(was_finished, gen_len, gen_len + 1)
+            if eos_id is not None:
+                finished = was_finished | (token == eos_id)
+            else:
+                finished = was_finished
+            # reorder the cache to each new beam's parent
+            def reorder(cache):
+                l, bk, s, h, d = cache.shape
+                view = cache.reshape(l, b, k, s, h, d)
+                pidx = parent[None, :, :, None, None, None]
+                return jnp.take_along_axis(view, pidx, axis=2).reshape(
+                    l, bk, s, h, d
+                )
+
+            k_cache, v_cache = reorder(k_cache), reorder(v_cache)
+            logits, k_cache, v_cache = _forward_one(
+                cfg, params, token.reshape(b * k), k_cache, v_cache, s_p + i
+            )
+            return (k_cache, v_cache, logits, new_scores, finished,
+                    gen_len), (token, parent)
+
+        carry = (k_cache, v_cache, logits, scores, finished, gen_len)
+        (_, _, _, scores, finished, gen_len), (tokens, parents) = jax.lax.scan(
+            step, carry, jnp.arange(num_steps)
+        )
+        # backtrack: tokens[t] were selected for the beams of step t, but
+        # later steps reorder ancestry — walk parents from the last step
+        def back(carry, tp):
+            beam_idx = carry
+            token_t, parent_t = tp
+            tok = jnp.take_along_axis(token_t, beam_idx, axis=1)
+            beam_idx = jnp.take_along_axis(parent_t, beam_idx, axis=1)
+            return beam_idx, tok
+
+        last_idx = jnp.tile(jnp.arange(k)[None], (b, 1))
+        _, rev = jax.lax.scan(back, last_idx, (tokens, parents), reverse=True)
+        seq = jnp.moveaxis(rev, 0, -1)                   # (B, K, num_steps)
+
+        final = scores
+        if length_penalty > 0:
+            final = scores / _gnmt_penalty(gen_len, length_penalty)
+        order = jnp.argsort(-final, axis=1)
+        seq = jnp.take_along_axis(seq, order[:, :, None], axis=1)
+        final = jnp.take_along_axis(final, order, axis=1)
+        prompt_k = jnp.repeat(prompt[:, None], k, axis=1)
+        return jnp.concatenate([prompt_k, seq], axis=-1), final
+
+    in_shardings = None
+    if mesh is not None:
+        bspec = NamedSharding(
+            mesh, P("dp", None) if "dp" in mesh.axis_names else P()
+        )
+        in_shardings = (None, bspec)
+    return jax.jit(beam_search, static_argnums=(2,),
+                   in_shardings=in_shardings)

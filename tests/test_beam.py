@@ -1,0 +1,124 @@
+"""Beam search: greedy equivalence at K=1, exact score accounting,
+ordering, EOS pinning, and mesh execution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, forward, init_params, make_mesh
+from kubetpu.jobs.beam import make_beam_search
+from kubetpu.jobs.decode import make_generate
+
+CFG = ModelConfig(vocab=32, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                  max_seq=64)
+
+
+def _setup(seed=0, b=2, s=5):
+    params = init_params(jax.random.PRNGKey(seed), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (b, s), 1, CFG.vocab)
+    return params, prompt
+
+
+def _recompute_score(params, full, s_prompt, eos_id=None):
+    """Teacher-forced sum of log-probs of the generated part, stopping at
+    (and including) the first EOS — the invariant the search maintains."""
+    logits = forward(params, full[:, :-1], CFG)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    out = []
+    for row_lp, row_tok in zip(np.asarray(logp), np.asarray(full)):
+        total, done = 0.0, False
+        for pos in range(s_prompt, full.shape[1]):
+            if done:
+                break
+            tok = row_tok[pos]
+            total += float(row_lp[pos - 1, tok])
+            if eos_id is not None and tok == eos_id:
+                done = True
+        out.append(total)
+    return np.array(out)
+
+
+def test_beam_one_is_greedy():
+    params, prompt = _setup()
+    gen = make_generate(CFG)  # temperature 0 = greedy
+    want = gen(params, prompt, jax.random.PRNGKey(0), 8)
+    beam = make_beam_search(CFG, beam_size=1)
+    got, scores = beam(params, prompt, 8)
+    assert got.shape == (2, 1, prompt.shape[1] + 8)
+    np.testing.assert_array_equal(np.asarray(got[:, 0]), np.asarray(want))
+    np.testing.assert_allclose(
+        np.asarray(scores[:, 0]),
+        _recompute_score(params, got[:, 0], prompt.shape[1]),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_beam_scores_exact_and_sorted():
+    params, prompt = _setup()
+    beam = make_beam_search(CFG, beam_size=4)
+    seqs, scores = beam(params, prompt, 6)
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-6).all()  # best-first
+    for j in range(4):  # every beam's score is its true sum of log-probs
+        np.testing.assert_allclose(
+            s[:, j],
+            _recompute_score(params, seqs[:, j], prompt.shape[1]),
+            rtol=1e-4, atol=1e-4,
+        )
+    # beams are distinct hypotheses
+    flat = {tuple(np.asarray(seqs[0, j]).tolist()) for j in range(4)}
+    assert len(flat) == 4
+
+
+def test_beam_beats_or_matches_greedy():
+    params, prompt = _setup()
+    greedy = make_beam_search(CFG, beam_size=1)
+    wide = make_beam_search(CFG, beam_size=4)
+    _, s1 = greedy(params, prompt, 6)
+    _, s4 = wide(params, prompt, 6)
+    assert (np.asarray(s4[:, 0]) >= np.asarray(s1[:, 0]) - 1e-5).all()
+
+
+def test_beam_eos_pins_finished():
+    params, prompt = _setup()
+    eos = 3
+    beam = make_beam_search(CFG, beam_size=4, eos_id=eos)
+    seqs, scores = beam(params, prompt, 10)
+    s_p = prompt.shape[1]
+    arr = np.asarray(seqs)
+    for bi in range(arr.shape[0]):
+        for j in range(arr.shape[1]):
+            gen = arr[bi, j, s_p:]
+            where = np.where(gen == eos)[0]
+            if len(where):
+                # everything after the first EOS is EOS (pinned beam)
+                assert (gen[where[0]:] == eos).all(), gen
+    # scores still exact under pinning (frozen at first EOS)
+    np.testing.assert_allclose(
+        np.asarray(scores[:, 0]),
+        _recompute_score(params, seqs[:, 0], s_p, eos_id=eos),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_beam_length_penalty_ranks_by_normalized_score():
+    params, prompt = _setup()
+    beam = make_beam_search(CFG, beam_size=3, length_penalty=0.6)
+    _, scores = beam(params, prompt, 6)
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-6).all()
+
+
+def test_beam_runs_on_mesh():
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    params, prompt = _setup()
+    beam = make_beam_search(CFG, beam_size=2, mesh=mesh)
+    seqs, scores = beam(params, prompt, 4)
+    assert seqs.shape == (2, 2, prompt.shape[1] + 4)
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_beam_size_validation():
+    with pytest.raises(ValueError):
+        make_beam_search(CFG, beam_size=0)
