@@ -546,6 +546,7 @@ def next_token_loss(
     cfg: ModelConfig,
     attn_fn: Optional[AttnFn] = None,
     positions: Optional[jnp.ndarray] = None,
+    weights: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Mean causal LM cross-entropy.
 
@@ -557,9 +558,12 @@ def next_token_loss(
     (``chunked_token_cross_entropy``) instead of materializing (B, S, V)
     logits — numerically identical (same f32 log-softmax per position, same
     mean), different memory/FLOPs trade.
+
+    ``weights`` (B, S) masks positions out of the mean — the packed-batch
+    path (``data.pack_documents(mode="greedy")``) zeroes pad positions.
     """
     x, aux = forward_hidden(params, tokens, cfg, attn_fn, positions)
-    loss = lm_loss_tail(x, params["head"], targets, cfg)
+    loss = lm_loss_tail(x, params["head"], targets, cfg, weights)
     if cfg.n_experts > 0 and cfg.moe_aux_coeff > 0:
         loss = loss + cfg.moe_aux_coeff * aux
     return loss

@@ -251,6 +251,7 @@ def make_train_step(
     jit: bool = True,
     accum_steps: int = 1,
     skip_nonfinite: bool = False,
+    weighted: bool = False,
 ):
     """Build the jitted full training step: loss -> grads -> adamw update.
 
@@ -262,14 +263,24 @@ def make_train_step(
     legacy spelling of 'dense'. ``jit=False`` returns the raw traced-once
     body instead, for callers that embed the step in a larger jitted
     computation (the bench harness loops it inside one ``fori_loop``).
+    ``weighted=True`` makes the step ``(state, tokens, targets, weights)``
+    with per-position loss weights — the packed-batch path (pad masking;
+    note the gradient-accumulation caveat on weighted means in
+    ``make_update_step``).
     """
     optimizer = optimizer or make_optimizer()
     if attention is None:
         attention = "ring" if use_ring else "dense"
     attn_fn = _resolve_attention(mesh, attention)
 
-    def loss_fn(params, tokens, targets):
-        return model_lib.next_token_loss(params, tokens, targets, cfg, attn_fn)
+    if weighted:
+        def loss_fn(params, tokens, targets, weights):
+            return model_lib.next_token_loss(params, tokens, targets, cfg,
+                                             attn_fn, weights=weights)
+    else:
+        def loss_fn(params, tokens, targets):
+            return model_lib.next_token_loss(params, tokens, targets, cfg,
+                                             attn_fn)
 
     chunk_constraint = None
     if accum_steps > 1:
@@ -286,9 +297,10 @@ def make_train_step(
     if not jit:
         return step
     bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+    n_batch = 3 if weighted else 2
     return jax.jit(
         step,
-        in_shardings=(None, bspec, bspec),  # state keeps its own shardings
+        in_shardings=(None,) + (bspec,) * n_batch,  # state keeps its shardings
         donate_argnums=(0,),
     )
 
