@@ -66,15 +66,38 @@ def _attend_cached(q, k_cache, v_cache, pos):
     return out.reshape(b, t, h, d).astype(q.dtype)
 
 
-def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos):
+def _lora_in_delta(h, a, b, scale):
+    """Per-example LoRA delta for an input-projection target: h (B, T, D)
+    through a (B, D, r) then b (B, r, H, hd) — the rank-r bottleneck makes
+    this a near-free pair of skinny matmuls per step."""
+    t = jnp.einsum("btd,bdr->btr", h, a)
+    return jnp.einsum("btr,brhk->bthk", t, b) * scale
+
+
+def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos, lora_l=None,
+                  lora_scale=1.0):
     """One transformer block over a T-token chunk at positions
     pos..pos+T-1, writing the chunk's K/V into this layer's cache.
     x: (B, T, D); caches: (B, S_max, H_kv, D). T == 1 is plain
-    token-at-a-time decoding; T > 1 is speculative verification."""
+    token-at-a-time decoding; T > 1 is speculative verification.
+
+    ``lora_l``: PER-EXAMPLE adapter factors for this layer (the multi-LoRA
+    serving path, ``kubetpu.jobs.multi_lora``): a dict of (B, ...) tensors
+    keyed ``<target>_a`` / ``<target>_b`` for attention targets — each
+    example in the batch applies ITS OWN adapter while the base matmuls
+    stay batched."""
+    def proj(name, hh, base):
+        out = jnp.einsum("bsd,dhk->bshk", hh, base)
+        if lora_l is not None and f"{name}_a" in lora_l:
+            out = out + _lora_in_delta(
+                hh, lora_l[f"{name}_a"], lora_l[f"{name}_b"], lora_scale
+            ).astype(out.dtype)
+        return out
+
     h = model_lib.rms_norm(x, layer["ln1"])
-    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+    q = proj("wq", h, layer["wq"])
+    k = proj("wk", h, layer["wk"])
+    v = proj("wv", h, layer["wv"])
     positions = pos + jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
     positions = jnp.broadcast_to(positions, (x.shape[0], x.shape[1]))
     q = model_lib.rope(q, positions, cfg.rope_theta)
@@ -83,34 +106,55 @@ def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos):
     k_cache_l = jax.lax.dynamic_update_slice(k_cache_l, k, (0, pos, 0, 0))
     v_cache_l = jax.lax.dynamic_update_slice(v_cache_l, v, (0, pos, 0, 0))
     attn = _attend_cached(q, k_cache_l, v_cache_l, pos)
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
+    o = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
+    if lora_l is not None and "wo_a" in lora_l:
+        t = jnp.einsum("bshk,bhkr->bsr", attn, lora_l["wo_a"])
+        o = o + (jnp.einsum("bsr,brd->bsd", t, lora_l["wo_b"])
+                 * lora_scale).astype(o.dtype)
+    x = x + o
 
     h = model_lib.rms_norm(x, layer["ln2"])
     delta, _aux = model_lib._mlp(cfg, h, layer)
     return x + delta, k_cache_l, v_cache_l
 
 
-def forward_chunk(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache, pos):
+def forward_chunk(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache,
+                  pos, lora=None, adapter_ids=None, lora_scale=1.0):
     """Logits for a T-token chunk fed at positions pos..pos+T-1 through the
     KV cache (T == 1: one decode step; T > 1: speculative verification in a
     single MXU-friendly pass). tokens: (B, T) -> logits (B, T, V) float32;
-    caches are updated with the chunk's K/V."""
+    caches are updated with the chunk's K/V.
+
+    ``lora`` + ``adapter_ids`` (B,): STACKED adapters (leaves (N, L, ...),
+    ``multi_lora.stack_adapters``) with a per-example adapter choice — the
+    batched multi-tenant serving path. The (N, ...) gather happens once
+    per chunk, then the per-layer factors ride the layer scan."""
     from kubetpu.jobs.quant import maybe_dequantize
 
     x = params["embed"][tokens]                        # (B, T, D)
 
+    # per-example factor selection: (N, L, ...) -> (L, B, ...), the layer
+    # axis leading so the factors ride the scan with the blocks. An empty
+    # dict is a valid leafless scan xs (length comes from the blocks), so
+    # the no-lora path shares the ONE scan body.
+    sel = {} if lora is None else {
+        k: jnp.moveaxis(v[adapter_ids], 1, 0)
+        for k, v in lora["blocks"].items()
+    }
+
     def layer_body(carry, inputs):
         x = carry
-        layer, k_l, v_l = inputs
+        layer, k_l, v_l, lora_l = inputs
         # int8 params dequantize PER LAYER here (the scan slices QTensors
         # along the layer axis): the bf16 weights are a loop-body
         # temporary fused into the matmuls, never a whole-tree copy
         layer = maybe_dequantize(layer)
-        x, k_l, v_l = _decode_block(cfg, layer, x, k_l, v_l, pos)
+        x, k_l, v_l = _decode_block(cfg, layer, x, k_l, v_l, pos,
+                                    lora_l or None, lora_scale)
         return x, (k_l, v_l)
 
     x, (k_cache, v_cache) = jax.lax.scan(
-        layer_body, x, (params["blocks"], k_cache, v_cache)
+        layer_body, x, (params["blocks"], k_cache, v_cache, sel)
     )
     x = model_lib.rms_norm(x, params["ln_f"])
     head = maybe_dequantize(params["head"])            # per-use dequant
@@ -208,16 +252,31 @@ def make_generate(
     return jax.jit(generate, static_argnums=(3,), in_shardings=(None, bspec, None))
 
 
-def forward_chunk_at(cfg, params, chunk, k_cache, v_cache, pos):
+def forward_chunk_at(cfg, params, chunk, k_cache, v_cache, pos, lora=None,
+                     adapter_ids=None, lora_scale=1.0):
     """``forward_chunk`` with PER-BATCH positions (vmapped over the
     batch: speculative rounds advance each sequence unevenly, so the cache
-    write offset differs per example)."""
-    def one(params, chunk, k_c, v_c, p):
+    write offset differs per example). ``lora``/``adapter_ids`` as in
+    ``forward_chunk`` — each example applies its own adapter."""
+    sel = None if lora is None else jax.tree.map(
+        lambda t: t[adapter_ids], lora["blocks"]
+    )  # (B, L, ...)
+
+    def one(params, chunk, k_c, v_c, p, lsel):
+        lora1 = (
+            None if lsel is None
+            else {"blocks": jax.tree.map(lambda t: t[None], lsel)}
+        )
         logits, k_c, v_c = forward_chunk(
-            cfg, params, chunk[None], k_c[:, None], v_c[:, None], p
+            cfg, params, chunk[None], k_c[:, None], v_c[:, None], p,
+            lora=lora1,
+            adapter_ids=None if lora1 is None else jnp.zeros((1,), jnp.int32),
+            lora_scale=lora_scale,
         )
         return logits[0], k_c[:, 0], v_c[:, 0]
 
     return jax.vmap(
-        one, in_axes=(None, 0, 1, 1, 0), out_axes=(0, 1, 1)
-    )(params, chunk, k_cache, v_cache, pos)
+        one,
+        in_axes=(None, 0, 1, 1, 0, None if sel is None else 0),
+        out_axes=(0, 1, 1),
+    )(params, chunk, k_cache, v_cache, pos, sel)

@@ -483,13 +483,17 @@ class DecodeServer(SlotServerBase):
 
         cfg_ = cfg
         sampler = self._sampler
+        lora_scale = getattr(self, "_lora_scale", 1.0)
 
         # donate_argnums=(1, 2): the caller overwrites self.k_cache/v_cache
         # with the results, so XLA updates the (large) cache buffers in
-        # place instead of holding input+output copies live per step
+        # place instead of holding input+output copies live per step.
+        # The trailing (lora, aid/aids) pair is the multi-LoRA hook
+        # (kubetpu.jobs.multi_lora): None/zeros for the plain server — an
+        # empty pytree arg, zero trace cost.
         @partial(jax.jit, donate_argnums=(1, 2))
         def prefill_slot(params, k_cache, v_cache, prompt, slot, prompt_len,
-                         rng, temp, tk, tp):
+                         rng, temp, tk, tp, lora, aid):
             # single-sequence chunk forward at pos 0, written into `slot`;
             # `prompt` is bucket-padded (see module docstring) — only
             # prompt_len is real, and the last REAL position's logits pick
@@ -497,7 +501,9 @@ class DecodeServer(SlotServerBase):
             k_s = jnp.take(k_cache, slot[None], axis=1)      # (L,1,S,Hkv,D)
             v_s = jnp.take(v_cache, slot[None], axis=1)
             logits, k_s, v_s = forward_chunk(
-                cfg_, params, prompt[None], k_s, v_s, 0
+                cfg_, params, prompt[None], k_s, v_s, 0,
+                lora=lora, adapter_ids=None if lora is None else aid[None],
+                lora_scale=lora_scale,
             )
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache, k_s, (0, slot, 0, 0, 0)
@@ -511,9 +517,10 @@ class DecodeServer(SlotServerBase):
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def step_all(params, k_cache, v_cache, last, pos, active, rng,
-                     temp, tk, tp):
+                     temp, tk, tp, lora, aids):
             logits, k_cache, v_cache = forward_chunk_at(
-                cfg_, params, last[:, None], k_cache, v_cache, pos
+                cfg_, params, last[:, None], k_cache, v_cache, pos,
+                lora=lora, adapter_ids=aids, lora_scale=lora_scale,
             )
             nxt = sampler(logits[:, 0], rng, temp, tk, tp)
             nxt = jnp.where(active, nxt, last)     # inactive slots hold
@@ -524,6 +531,16 @@ class DecodeServer(SlotServerBase):
         self._prefill_slot = prefill_slot
         self._step_all = step_all
 
+    # -- multi-LoRA hooks (overridden by MultiLoraDecodeServer) ---------------
+
+    def _admit_lora(self, slot: int):
+        """(adapter stack, adapter id) for an admission — base: none."""
+        return None, jnp.int32(0)
+
+    def _step_lora(self):
+        """(adapter stack, per-slot adapter ids) for a step — base: none."""
+        return None, jnp.zeros((self.n_slots,), jnp.int32)
+
     # -- device legs ---------------------------------------------------------
 
     def _admit_device(self, prompt: List[int], slot: int):
@@ -531,6 +548,7 @@ class DecodeServer(SlotServerBase):
         scalar (no host sync — the defer path depends on it)."""
         bucket = self._bucket(len(prompt))
         padded = prompt + [0] * (bucket - len(prompt))
+        lora, aid = self._admit_lora(slot)
         self.k_cache, self.v_cache, first, first_lp = self._prefill_slot(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(padded, jnp.int32), jnp.int32(slot),
@@ -538,15 +556,18 @@ class DecodeServer(SlotServerBase):
             jnp.float32(self._slot_temp[slot]),
             jnp.int32(self._slot_topk[slot]),
             jnp.float32(self._slot_topp[slot]),
+            lora, aid,
         )
         return first, first_lp
 
     def _device_step(self) -> "tuple[np.ndarray, np.ndarray]":
+        lora, aids = self._step_lora()
         self.k_cache, self.v_cache, nxt, self.pos, lp = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
             jnp.asarray(self.active), self._next_rng(),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp),
+            lora, aids,
         )
         self.last = nxt
         return np.asarray(nxt), np.asarray(lp)
@@ -560,19 +581,21 @@ class DecodeServer(SlotServerBase):
         d_temp, d_tk, d_tp = self._default_sampling
 
         def prefill_dummy(padded):
+            lora, aid = self._admit_lora(0)
             self.k_cache, self.v_cache, _f, _lp = self._prefill_slot(
                 self.params, self.k_cache, self.v_cache,
                 jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
                 self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
-                jnp.float32(d_tp),
+                jnp.float32(d_tp), lora, aid,
             )
 
         self._warmup_buckets(prefill_dummy)
+        lora, aids = self._step_lora()
         self.k_cache, self.v_cache, _nxt, _pos, _lps = self._step_all(
             self.params, self.k_cache, self.v_cache, self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
-            jnp.asarray(self._slot_topp),
+            jnp.asarray(self._slot_topp), lora, aids,
         )
         # drain the dispatch queue: without this the FIRST live admission
         # pays the wall time of every queued warmup execution and records
