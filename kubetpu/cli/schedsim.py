@@ -453,10 +453,89 @@ def config12(rounds=None):
     }
 
 
+def config13(rounds=None):
+    """reservation vs starvation: a 16-chip gang queued under small-pod churn — passes-to-assemble with the head-of-line reservation on vs the demonstrated starvation with it off"""
+    from kubetpu.wire.controller import ControllerServer, pod_to_json
+
+    # floor of 10: the reserved branch needs ~6 passes to age + drain the
+    # four holders; fewer rounds would fail the assembly assertion below
+    rounds = max(rounds or 40, 10)
+    out = {}
+    for label, reserve_after in (("reserved", 2), ("unreserved", 0)):
+        c = Cluster()
+        for h in (0, 2):
+            c.register_node(
+                f"h{h}",
+                device=new_fake_tpu_dev_manager(
+                    make_fake_tpus_info("v5e-64", host_index=h)
+                ),
+            )
+        ctl = ControllerServer(cluster=c, poll_interval=3600,
+                               reserve_after=reserve_after)
+        try:
+            # steady state: four 4-chip pods hold all 16 chips
+            for i in range(4):
+                ctl._submit({"pod": pod_to_json(_tpu_pod(f"s{i}", 4))})
+            ctl._submit({
+                "gang": [pod_to_json(_tpu_pod("g0", 8)),
+                         pod_to_json(_tpu_pod("g1", 8))],
+                "queue": True,
+            })
+            # churn: every pass one small job finishes, a new one arrives
+            placed_smalls = [f"s{i}" for i in range(4)]
+            next_small = 4
+            assembled_at = None
+            poll_lat = []
+            for r in range(rounds):
+                if placed_smalls:
+                    done = placed_smalls.pop(0)
+                    with ctl._lock:
+                        try:
+                            c.release(done)
+                        except KeyError:
+                            pass
+                sub = ctl._submit(
+                    {"pod": pod_to_json(_tpu_pod(f"s{next_small}", 4)),
+                     "queue": True})
+                # before the reservation activates (or with it off), the
+                # new small places DIRECTLY at submit — track it for
+                # later release
+                placed_smalls.extend(
+                    p["pod"] for p in sub.get("placements", [])
+                )
+                next_small += 1
+                t0 = time.perf_counter()
+                res = ctl.poll_once()
+                poll_lat.append((time.perf_counter() - t0) * 1e3)
+                placed_smalls.extend(
+                    e["pod"] for e in res["rescheduled"]
+                    if e["pod"].startswith("s")
+                )
+                if assembled_at is None and any(
+                    e["pod"] == "g0" for e in res["rescheduled"]
+                ):
+                    assembled_at = r + 1
+                    break
+            out[label] = {
+                "gang_assembled": assembled_at is not None,
+                "passes_to_assemble": assembled_at,
+                "poll": _percentiles(poll_lat),
+            }
+        finally:
+            # never start()ed, so no serve loop to shutdown() — just
+            # release the listening socket __init__ bound
+            ctl._httpd.server_close()
+    # the whole point: reservation assembles the gang, FIFO-without-
+    # reservation starves it under identical churn
+    assert out["reserved"]["gang_assembled"]
+    assert not out["unreserved"]["gang_assembled"]
+    return out
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12}
-TAKES_ROUNDS = {4, 8, 9, 10, 11, 12}
+           11: config11, 12: config12, 13: config13}
+TAKES_ROUNDS = {4, 8, 9, 10, 11, 12, 13}
 
 
 def main(argv=None) -> int:
