@@ -75,6 +75,14 @@ class ModelConfig:
     # Honored by every training tail: next_token_loss, the pipelined step,
     # seq2seq_loss, and masked_lm_loss.
     loss_chunk: int = 0
+    # uniform label smoothing mass (0 = off): per-position loss becomes
+    # (1-e)*nll - e*mean(logp). Applied in BOTH loss-tail memory modes
+    # (lm_loss_tail / _position_losses), every LM family.
+    label_smoothing: float = 0.0
+    # PaLM-style z-loss coefficient (0 = off): + z * logsumexp(logits)^2
+    # per position — pins the softmax normalizer near 1 so bf16 logits
+    # don't drift over long runs. Same scope as label_smoothing.
+    z_loss: float = 0.0
     # grouped-query attention: number of K/V heads (0 = n_heads, plain MHA;
     # 1 = MQA). Must divide n_heads; the decode KV cache stores only these,
     # cutting its HBM footprint by n_heads/n_kv_heads. With tensor
@@ -94,6 +102,12 @@ class ModelConfig:
             )
         if self.loss_chunk < 0:
             raise ValueError(f"loss_chunk must be >= 0, got {self.loss_chunk}")
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError(
+                f"label_smoothing must be in [0, 1), got {self.label_smoothing}"
+            )
+        if self.z_loss < 0:
+            raise ValueError(f"z_loss must be >= 0, got {self.z_loss}")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
@@ -455,17 +469,41 @@ def forward_with_kv(
     return logits.astype(jnp.float32), ks, vs
 
 
+def _position_losses(logits, targets, label_smoothing, z_loss):
+    """Per-position loss in f32 from raw logits — THE formula both loss
+    tails (materialized and chunked) share, so they cannot diverge:
+
+    - cross-entropy, optionally label-smoothed: ``(1-e)*nll - e*mean(logp)``
+      (uniform smoothing mass over the vocab);
+    - PaLM-style z-loss ``z * logsumexp(logits)^2`` — pulls the softmax
+      normalizer toward 1, keeping bf16 logits from drifting large over
+      long runs (a stability term, near-zero gradient when healthy).
+    """
+    f32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(f32, axis=-1)
+    logp = f32 - lse[..., None]
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if label_smoothing > 0:
+        nll = ((1.0 - label_smoothing) * nll
+               - label_smoothing * jnp.mean(logp, axis=-1))
+    if z_loss > 0:
+        nll = nll + z_loss * jnp.square(lse)
+    return nll
+
+
 def token_cross_entropy(
     logits: jnp.ndarray,
     targets: jnp.ndarray,
     weights: Optional[jnp.ndarray] = None,
+    label_smoothing: float = 0.0,
+    z_loss: float = 0.0,
 ) -> jnp.ndarray:
     """Token-level cross-entropy in float32 — the shared loss tail of the
     causal, pipelined, and masked-LM training paths. Unweighted mean by
     default; with *weights* (same shape as targets) a weighted mean over
-    the nonzero-weight positions (the masked-LM reduction)."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    the nonzero-weight positions (the masked-LM reduction). Optional
+    label smoothing and z-loss per ``_position_losses``."""
+    nll = _position_losses(logits, targets, label_smoothing, z_loss)
     if weights is None:
         return jnp.mean(nll)
     w = weights.astype(jnp.float32)
@@ -478,6 +516,8 @@ def chunked_token_cross_entropy(
     targets: jnp.ndarray,
     chunk: int,
     weights: Optional[jnp.ndarray] = None,
+    label_smoothing: float = 0.0,
+    z_loss: float = 0.0,
 ) -> jnp.ndarray:
     """Cross-entropy from HIDDEN states without ever materializing the full
     (B, S, V) logits: scan over sequence chunks, each computing its
@@ -508,8 +548,7 @@ def chunked_token_cross_entropy(
         nll_sum, w_sum = carry
         xi, ti, wi = ch
         logits = jnp.einsum("bcd,dv->bcv", xi, head)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, ti[..., None], axis=-1)[..., 0]
+        nll = _position_losses(logits, ti, label_smoothing, z_loss)
         return (nll_sum + jnp.sum(nll * wi), w_sum + jnp.sum(wi)), None
 
     (nll_sum, w_sum), _ = jax.lax.scan(
@@ -533,10 +572,13 @@ def lm_loss_tail(
     label smoothing — lands everywhere at once and the two memory modes
     can never diverge."""
     if cfg.loss_chunk > 0:
-        return chunked_token_cross_entropy(x, head, targets, cfg.loss_chunk,
-                                           weights)
+        return chunked_token_cross_entropy(
+            x, head, targets, cfg.loss_chunk, weights,
+            label_smoothing=cfg.label_smoothing, z_loss=cfg.z_loss)
     logits = jnp.einsum("bsd,dv->bsv", x, head)
-    return token_cross_entropy(logits, targets, weights)
+    return token_cross_entropy(logits, targets, weights,
+                               label_smoothing=cfg.label_smoothing,
+                               z_loss=cfg.z_loss)
 
 
 def next_token_loss(

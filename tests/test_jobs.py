@@ -752,3 +752,44 @@ def test_skip_nonfinite_guards_the_update():
     assert np.isfinite(float(loss_ok)) and int(clean.step) == 2
     assert not np.allclose(np.asarray(clean.params["head"]),
                            np.asarray(state.params["head"]))
+
+
+def test_label_smoothing_and_z_loss_formulas():
+    """Hand-check both regularizers against their definitions, and pin
+    chunked/materialized parity with both active."""
+    import dataclasses
+
+    from kubetpu.jobs.model import next_token_loss, token_cross_entropy
+
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(rng, (2, 8, 16)) * 3.0
+    targets = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 16)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+
+    eps, z = 0.1, 1e-2
+    want = jnp.mean((1 - eps) * nll - eps * jnp.mean(logp, -1) + z * lse**2)
+    got = token_cross_entropy(logits, targets, label_smoothing=eps, z_loss=z)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+    # off = plain CE
+    np.testing.assert_allclose(
+        float(token_cross_entropy(logits, targets)), float(jnp.mean(nll)),
+        rtol=1e-6)
+
+    cfg = dataclasses.replace(CFG, label_smoothing=0.1, z_loss=1e-3)
+    cfgc = dataclasses.replace(cfg, loss_chunk=8)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, cfg.vocab)
+    tgt = jnp.roll(tokens, -1, axis=1)
+    l0, g0 = jax.value_and_grad(next_token_loss)(params, tokens, tgt, cfg)
+    l1, g1 = jax.value_and_grad(next_token_loss)(params, tokens, tgt, cfgc)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for p0, p1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
+                                   rtol=2e-4, atol=2e-5)
+
+    with pytest.raises(ValueError):
+        ModelConfig(label_smoothing=1.0)
+    with pytest.raises(ValueError):
+        ModelConfig(z_loss=-0.1)
