@@ -105,6 +105,7 @@ class Cluster:
             list(schedulers) if schedulers is not None else [TpuScheduler(), GpuScheduler()]
         )
         self.nodes: Dict[str, ClusterNode] = {}
+        self.cordoned: set = set()  # unschedulable (maintenance) nodes
         self.metrics = LatencyRecorder()
         self.events: List[Dict[str, object]] = []
         self._gang_seq = 0  # gang-identity stamps (GangKey)
@@ -140,6 +141,41 @@ class Cluster:
         for s in self.schedulers:
             s.remove_node(name)
         self.nodes.pop(name, None)
+        self.cordoned.discard(name)
+
+    def cordon(self, name: str, on: bool = True) -> None:
+        """Mark a node unschedulable (maintenance): existing pods keep
+        running, but no placement path (schedule, gangs, preemption,
+        defrag migrations, reconcile re-placement) targets it until
+        ``cordon(name, on=False)``."""
+        if name not in self.nodes:
+            raise KeyError(name)
+        (self.cordoned.add if on else self.cordoned.discard)(name)
+        self._event("cordon" if on else "uncordon", node=name)
+
+    def drain(self, name: str):
+        """Cordon + migrate every pod off the node. Returns
+        (migrated, unplaced): migrated = freshly-placed copies on other
+        nodes; unplaced = pods that fit nowhere else — they are EVICTED
+        (resources released), the caller decides whether to queue them
+        (the controller pends them for its reconcile loop) or restore.
+        Surviving gang members migrate only within their mates' slice
+        (the core gang invariant)."""
+        self.cordon(name)
+        node = self.nodes[name]
+        migrated, unplaced = [], []
+        for pname in utils.sorted_string_keys(node.pods):
+            template = _reset_for_reschedule(node.pods[pname])
+            self.release(pname)
+            try:
+                migrated.append(
+                    self.schedule(template, self.gang_slice_filter(template))
+                )
+            except SchedulingError:
+                unplaced.append(template)
+        self._event("drain", node=name, migrated=len(migrated),
+                    unplaced=len(unplaced))
+        return migrated, unplaced
 
     def refresh_node(self, name: str, probed: Optional[NodeInfo] = None) -> NodeInfo:
         """Re-probe a node's device manager and re-advertise, preserving the
@@ -261,7 +297,8 @@ class Cluster:
         names = [
             n
             for n in utils.sorted_string_keys(self.nodes)
-            if node_filter is None or node_filter(n)
+            if n not in self.cordoned
+            and (node_filter is None or node_filter(n))
         ]
         candidates: List[tuple] = []  # (-score, name)
         tried: set = set()
@@ -393,6 +430,13 @@ class Cluster:
                 pod_wants_device(TPU, pod) for pod in pods
             )
             for slice_nodes in slices.values():
+                # cordoned hosts never host gang members; NOTE a slice with
+                # fewer (uncordoned) hosts than pods can still fit the gang
+                # by co-locating sub-host pods — no count-based skip here
+                slice_nodes = [n for n in slice_nodes
+                               if n not in self.cordoned]
+                if not slice_nodes:
+                    continue
                 # Best case: assign pods to a *geometrically contiguous set of
                 # host blocks* (a 2-host gang on a v5e-64 should get two
                 # vertically adjacent hosts forming a 4x4 square, not a 2x8
@@ -585,6 +629,8 @@ class Cluster:
             raise SchedulingError(f"pod {pod.name!r}: no node fits (nothing to preempt for)")
 
         for name in utils.sorted_string_keys(self.nodes):
+            if name in self.cordoned:
+                continue  # maintenance nodes take no new pods, even by force
             node = self.nodes[name]
             state = meshstate.parse_mesh_state(node.info.allocatable)
             if n_tpu > 0 and state is None:
@@ -690,7 +736,13 @@ class Cluster:
         if device == GPU.base:
             return self._defrag_plan_tree(chips, max_migrations)
         states = {}
+        # cordoned nodes are invisible to the plan: neither their free
+        # blocks (an "already fits" there is unplaceable — schedule skips
+        # them) nor as migration destinations (execute_defrag's pinned
+        # schedule would refuse), matching cordon()'s contract
         for name in utils.sorted_string_keys(self.nodes):
+            if name in self.cordoned:
+                continue
             st = meshstate.parse_mesh_state(self.nodes[name].info.allocatable)
             if st is not None:
                 states[name] = st
@@ -763,12 +815,13 @@ class Cluster:
         free_by = {
             name: group_scheduler.free_cards_by_group(self.nodes[name].info, GPU.base)
             for name in utils.sorted_string_keys(self.nodes)
+            if name not in self.cordoned  # same contract as the TPU plan
         }
         for name, groups in free_by.items():
             if any(len(keys) >= cards for keys in groups.values()):
                 return []  # some group already holds a full block
 
-        for name in utils.sorted_string_keys(self.nodes):
+        for name in utils.sorted_string_keys(free_by):
             node = self.nodes[name]
             # victims by group: GPU-only pods holding cards in that group,
             # largest in-group holdings first (fewest migrations)
@@ -947,6 +1000,8 @@ class Cluster:
             entry: Dict[str, object] = {
                 "pods": sorted(node.pods),
             }
+            if name in self.cordoned:
+                entry["cordoned"] = True
             for scalar in (ResourceTPU, ResourceGPU):
                 if scalar in node.info.capacity:
                     entry[scalar] = {

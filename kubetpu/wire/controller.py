@@ -47,7 +47,7 @@ from typing import Dict, List, Optional
 
 from kubetpu.api import utils
 from kubetpu.core import Cluster, SchedulingError
-from kubetpu.core.cluster import GangKey, pod_priority
+from kubetpu.core.cluster import GangKey, _reset_for_reschedule, pod_priority
 from kubetpu.scheduler.deviceclass import GPU, TPU
 from kubetpu.scheduler.translate import pod_device_count, pod_wants_device
 from kubetpu.wire.codec import (
@@ -169,6 +169,25 @@ class ControllerServer:
                         with controller._lock:
                             out = controller._defrag(req)
                         self._reply(200, out)
+                    elif (
+                        len(parts := self.path.split("/")) == 4
+                        and parts[1] == "nodes"
+                        and parts[3] in ("cordon", "uncordon", "drain")
+                    ):
+                        # exactly /nodes/<name>/<action> — a malformed path
+                        # must 404, never flip a cordon by accident
+                        name, action = parts[2], parts[3]
+                        try:
+                            if action == "drain":
+                                out = controller._drain(name)
+                            else:
+                                with controller._lock:
+                                    controller.cluster.cordon(
+                                        name, on=action == "cordon")
+                                out = {action: name}
+                            self._reply(200, out)
+                        except KeyError:
+                            self._reply(404, {"error": f"no node {name!r}"})
                     else:
                         self._reply(404, {"error": f"no route {self.path}"})
                 except SchedulingError as e:
@@ -295,6 +314,58 @@ class ControllerServer:
             return False
         self.cluster.release(placed.name)
         return True
+
+    def _allocate_batch(self, items) -> list:
+        """The shared wire tail of reconcile re-placement and drain:
+        per-container agent allocations run OUTSIDE the lock (a
+        slow-but-alive agent must not freeze the operator API); a failed
+        allocation rolls back under the lock with identity revalidation
+        (a pod DELETEd — or DELETEd and resubmitted under the same name —
+        during the wire phase is neither resurrected into the pending
+        queue nor released out from under the new owner), and its
+        *pending_template* joins the queue for the next pass.
+
+        ``items``: (pending_template, placed, device, pod_copy) tuples;
+        returns {pod, node, containers} dicts for the successes."""
+        done, rollbacks = [], []
+        for template, placed, device, pod_copy in items:
+            try:
+                done.append({
+                    "pod": placed.name,
+                    "node": placed.node_name,
+                    "containers": self._run_allocations(device, pod_copy),
+                })
+            except Exception as e:  # noqa: BLE001 — allocate leg died
+                utils.errorf("allocate failed for %s: %s", placed.name, e)
+                rollbacks.append((template, placed))
+        if rollbacks:
+            with self._lock:
+                for template, placed in rollbacks:
+                    if self._release_if_current(placed):
+                        self._pending.append(template)
+        return done
+
+    def _drain(self, name: str) -> dict:
+        """Cordon + migrate a node's pods (operator maintenance). The
+        _submit pattern: migrations commit under the lock, the agent wire
+        allocations for the NEW placements run outside it, failed
+        allocations roll back into the pending queue. Pods that fit
+        nowhere else pend for the reconcile loop (they re-place the moment
+        capacity appears — the node is already cordoned, so never back
+        onto it)."""
+        with self._lock:
+            migrated, unplaced = self.cluster.drain(name)  # KeyError -> 404
+            self._pending.extend(unplaced)
+            snapshots = [
+                (_reset_for_reschedule(p), p,
+                 *self._snapshot_placed(p.name, p.node_name))
+                for p in migrated
+            ]
+        out = {"drained": name,
+               "migrated": self._allocate_batch(snapshots)}
+        with self._lock:
+            out["pending"] = [q.name for q in self._pending]
+        return out
 
     # -- gang reservation (starvation guard) ---------------------------------
 
@@ -677,32 +748,10 @@ class ControllerServer:
             self._pending = still_pending
             failed = sorted(failed)
 
-        # Phase 2 (NO lock): the per-container agent wire calls — a
-        # slow-but-alive agent must not freeze the operator API for
-        # timeout x containers (ADVICE r2).
-        rescheduled, rollbacks = [], []
-        for pod, placed, device, pod_copy in to_allocate:
-            try:
-                rescheduled.append({
-                    "pod": placed.name,
-                    "node": placed.node_name,
-                    "containers": self._run_allocations(device, pod_copy),
-                })
-            except Exception as e:  # noqa: BLE001 — allocate leg died
-                utils.errorf("allocate after reschedule failed for %s: %s",
-                             pod.name, e)
-                rollbacks.append((pod, placed))
-
-        # Phase 3 (under the lock): roll back failed allocations with
-        # IDENTITY revalidation — a pod the operator DELETEd (or DELETEd
-        # and resubmitted under the same name) during phase 2 must be
-        # neither resurrected into the pending queue nor have the new
-        # same-name pod released out from under it.
-        if rollbacks:
-            with self._lock:
-                for pod, placed in rollbacks:
-                    if self._release_if_current(placed):
-                        self._pending.append(pod)
+        # Phases 2+3 (the _allocate_batch pattern): per-container agent
+        # wire calls outside the lock, failed allocations rolled back
+        # under it with identity revalidation, templates re-pended.
+        rescheduled = self._allocate_batch(to_allocate)
         with self._lock:
             # age the queue: one pass survived = one tick; rebuilding the
             # dict drops entries for pods that placed (or were deleted)

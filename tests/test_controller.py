@@ -899,3 +899,56 @@ def test_evicted_priority_pod_preempts_on_reconcile(stack):
     assert vip_node in res["failed_nodes"]
     assert {r["pod"] for r in res["rescheduled"]} == {"vip"}
     assert set(res["pending"]) == {"low0", "low1"}  # preempted victims
+
+
+def test_cordon_drain_over_api(stack):
+    """Operator maintenance over the wire: cordon blocks placement, drain
+    migrates with fresh launcher env, unplaceable pods pend and re-place
+    after uncordon."""
+    controller, _ = stack
+    out = _post(controller.address + "/pods",
+                {"pod": pod_to_json(tpu_pod("keep", 4))})
+    node = out["placements"][0]["node"]
+    other = "h0" if node == "h2" else "h2"
+
+    # cordon the OTHER node: next pod must land on `node`
+    _post(controller.address + f"/nodes/{other}/cordon", {})
+    out2 = _post(controller.address + "/pods",
+                 {"pod": pod_to_json(tpu_pod("second", 2))})
+    assert out2["placements"][0]["node"] == node
+    _post(controller.address + f"/nodes/{other}/uncordon", {})
+
+    # drain the busy node: both pods migrate to the other host, env included
+    res = _post(controller.address + f"/nodes/{node}/drain", {})
+    assert res["drained"] == node
+    moved = {m["pod"]: m for m in res["migrated"]}
+    assert set(moved) == {"keep", "second"} and res["pending"] == []
+    for m in moved.values():
+        assert m["node"] == other
+        assert "TPU_VISIBLE_DEVICES" in m["containers"]["main"]["env"]
+    # the drained node takes nothing new until uncordoned
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("x", 2))})
+    status = _get(controller.address + "/status")
+    assert status["nodes"][node]["pods"] == []
+
+    # unknown node -> 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(controller.address + "/nodes/ghost/drain", {})
+    assert e.value.code == 404
+
+
+def test_drain_unplaceable_pods_pend_and_recover(stack):
+    controller, _ = stack
+    # fill BOTH hosts so a drained pod has nowhere to go
+    a = _post(controller.address + "/pods",
+              {"pod": pod_to_json(tpu_pod("a", 8))})
+    _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("b", 8))})
+    node_a = a["placements"][0]["node"]
+    res = _post(controller.address + f"/nodes/{node_a}/drain", {})
+    assert res["migrated"] == [] and res["pending"] == ["a"]
+    # capacity appears elsewhere: the reconcile loop re-places "a" — but
+    # never back onto the cordoned node
+    _delete(controller.address, "b")
+    poll = controller.poll_once()
+    assert {r["pod"] for r in poll["rescheduled"]} == {"a"}
+    assert poll["rescheduled"][0]["node"] != node_a

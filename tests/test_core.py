@@ -324,3 +324,110 @@ def test_early_exit_resumes_when_fill_disagrees(monkeypatch):
     assert placed.node_name == "n1"
     assert attempts == ["n0", "n1"]
     assert not cluster.nodes["n0"].pods and "p" in cluster.nodes["n1"].pods
+
+
+# -- cordon / drain -----------------------------------------------------------
+
+
+def _fresh_two_hosts():
+    from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+
+    c = Cluster()
+    for h in (0, 2):
+        c.register_node(f"h{h}", device=new_fake_tpu_dev_manager(
+            make_fake_tpus_info("v5e-64", host_index=h)))
+    return c
+
+
+def test_cordon_excludes_every_placement_path():
+    from kubetpu.core.cluster import PriorityKey
+
+    c = _fresh_two_hosts()
+    c.cordon("h0")
+    # plain scheduling avoids the cordoned node
+    for i in range(2):
+        p = c.schedule(tpu_pod(f"p{i}", 4))
+        assert p.node_name == "h2"
+    # preemption must not force onto it either
+    high = tpu_pod("vip", 8)
+    high.requests[PriorityKey] = 10
+    placed, evicted = c.schedule_preempting(high)
+    assert placed.node_name == "h2" and evicted
+    # gangs cannot use the cordoned host: a 2-host gang no longer fits
+    c2 = _fresh_two_hosts()
+    c2.cordon("h0")
+    with pytest.raises(SchedulingError):
+        c2.schedule_gang([tpu_pod("g0", 8), tpu_pod("g1", 8)])
+    # uncordon restores it
+    c2.cordon("h0", on=False)
+    assert len(c2.schedule_gang([tpu_pod("g0", 8), tpu_pod("g1", 8)])) == 2
+
+
+def test_drain_migrates_and_reports_unplaced():
+    c = _fresh_two_hosts()
+    a = c.schedule(tpu_pod("a", 4), lambda n: n == "h0")
+    b = c.schedule(tpu_pod("b", 8), lambda n: n == "h2")
+    assert a.node_name == "h0" and b.node_name == "h2"
+    migrated, unplaced = c.drain("h0")
+    # "a" cannot move (h2 is full): evicted, reported unplaced
+    assert [p.name for p in unplaced] == ["a"] and migrated == []
+    assert "h0" in c.cordoned and not c.nodes["h0"].pods
+    # free h2 and the next drain-style migration works
+    c.release("b")
+    c.cordon("h0", on=False)
+    a2 = c.schedule(tpu_pod("a2", 4), lambda n: n == "h0")
+    migrated, unplaced = c.drain("h0")
+    assert [p.name for p in migrated] == ["a2"] and not unplaced
+    assert migrated[0].node_name == "h2"
+
+
+def test_drain_keeps_gang_member_in_slice():
+    """A drained gang member may only land inside its mates' slice — if
+    that slice has no room, it is unplaced, never straddled elsewhere."""
+    from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+
+    c = Cluster()
+    for h in (0, 2):
+        c.register_node(f"s1h{h}", device=new_fake_tpu_dev_manager(
+            make_fake_tpus_info("v5e-64", host_index=h, slice_uid="sliceA")))
+    c.register_node("other", device=new_fake_tpu_dev_manager(
+        make_fake_tpus_info("v5e-8", slice_uid="sliceB")))
+    placed = c.schedule_gang([tpu_pod("g0", 8), tpu_pod("g1", 8)])
+    victim = placed[0].node_name
+    migrated, unplaced = c.drain(victim)
+    # mates' slice is full (the surviving member holds its host whole) and
+    # the other slice is out of bounds for a gang member
+    assert [p.name for p in unplaced] == [placed[0].name]
+    assert migrated == []
+    assert not any(p.name == placed[0].name for n in c.nodes.values()
+                   for p in n.pods.values())
+
+
+def test_defrag_ignores_cordoned_nodes():
+    """A cordoned node's free chips must not count as 'already fits'
+    (schedule would refuse to place there), nor serve as a migration
+    destination."""
+    c = Cluster()
+    for i in range(2):
+        c.register_node(f"n{i}", device=new_fake_tpu_dev_manager(
+            make_fake_tpus_info("v5e-8")))
+    # fragment n1: hold chips so no contiguous 4-block remains
+    held = {}
+    for i in range(8):
+        p = c.schedule(tpu_pod(f"s{i}", 1), lambda n: n == "n1")
+        _t, coords = c.pod_chip_coords(p)
+        held[coords[0]] = p.name
+    for coord, pname in held.items():
+        if coord not in {(0, 1), (1, 2)}:
+            c.release(pname)
+    # n0 pristine but cordoned: WITHOUT the fix defrag_plan returns []
+    # ("already fits") and the follow-up schedule fails
+    c.cordon("n0")
+    plan = c.defrag_plan(4)
+    assert plan != []  # cordoned free space is not a fit
+    if plan is not None:
+        moved, pending = c.execute_defrag(plan, pending=tpu_pod("big", 4))
+        assert pending is not None and pending.node_name == "n1"
+        assert all(m.to_node != "n0" for m in plan)
+    c.cordon("n0", on=False)
+    assert c.defrag_plan(4) == []  # uncordoned pristine node fits plainly
