@@ -184,6 +184,23 @@ def flash_vs_dense(cfg, seqs):
             "value": round(dms / fms, 2) if dms else None,
             "unit": "x",
         }
+        if seq >= 4096:
+            # sliding window at long seq: per-position work is O(window),
+            # so the kernel's block skip should show ~seq/(2*window)-ish
+            # gains over full causal flash
+            W = 1024
+            wms = timeit(
+                lambda q, k, v: flash_attention(q, k, v, window=W)
+            )
+            yield {
+                "metric": "flash_window_speedup",
+                "seq": seq,
+                "window": W,
+                "window_ms": round(wms, 3),
+                "full_ms": round(fms, 3),
+                "value": round(fms / wms, 2),
+                "unit": "x",
+            }
 
 
 def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads,

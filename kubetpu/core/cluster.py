@@ -153,20 +153,26 @@ class Cluster:
         (self.cordoned.add if on else self.cordoned.discard)(name)
         self._event("cordon" if on else "uncordon", node=name)
 
-    def drain(self, name: str):
+    def drain(self, name: str, may_place=None):
         """Cordon + migrate every pod off the node. Returns
         (migrated, unplaced): migrated = freshly-placed copies on other
         nodes; unplaced = pods that fit nowhere else — they are EVICTED
         (resources released), the caller decides whether to queue them
         (the controller pends them for its reconcile loop) or restore.
         Surviving gang members migrate only within their mates' slice
-        (the core gang invariant)."""
+        (the core gang invariant). ``may_place(pod) -> bool`` lets the
+        caller veto individual migrations (the controller's gang
+        reservation: drained pods must not cherry-pick chips held for an
+        aged pending gang) — vetoed pods go straight to unplaced."""
         self.cordon(name)
         node = self.nodes[name]
         migrated, unplaced = [], []
         for pname in utils.sorted_string_keys(node.pods):
             template = _reset_for_reschedule(node.pods[pname])
             self.release(pname)
+            if may_place is not None and not may_place(template):
+                unplaced.append(template)
+                continue
             try:
                 migrated.append(
                     self.schedule(template, self.gang_slice_filter(template))

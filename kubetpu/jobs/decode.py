@@ -43,9 +43,11 @@ def kv_cache_specs() -> P:
     return P(None, "dp", None, "tp", None)
 
 
-def _attend_cached(q, k_cache, v_cache, pos):
+def _attend_cached(q, k_cache, v_cache, pos, window: int = 0):
     """Chunk attention through the cache: query t (of T new positions
-    starting at *pos*) sees cache entries 0..pos+t. Grouped-query aware:
+    starting at *pos*) sees cache entries 0..pos+t — bounded below by the
+    sliding ``window`` when set (cfg.window; the cache still stores all
+    positions, only the read is banded). Grouped-query aware:
     the query's H heads attend against H_kv cached heads in groups of
     G = H/H_kv WITHOUT expanding the cache (expansion would materialize the
     full-head cache per step and erase GQA's memory win).
@@ -60,6 +62,8 @@ def _attend_cached(q, k_cache, v_cache, pos):
     k_pos = jnp.arange(k_cache.shape[1])
     q_pos = pos + jnp.arange(t)
     mask = k_pos[None, :] <= q_pos[:, None]            # (T, S_max)
+    if window > 0:  # sliding window: band the cache read
+        mask &= q_pos[:, None] - k_pos[None, :] < window
     scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v_cache.astype(jnp.float32))
@@ -105,7 +109,7 @@ def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos, lora_l=None,
 
     k_cache_l = jax.lax.dynamic_update_slice(k_cache_l, k, (0, pos, 0, 0))
     v_cache_l = jax.lax.dynamic_update_slice(v_cache_l, v, (0, pos, 0, 0))
-    attn = _attend_cached(q, k_cache_l, v_cache_l, pos)
+    attn = _attend_cached(q, k_cache_l, v_cache_l, pos, window=cfg.window)
     o = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
     if lora_l is not None and "wo_a" in lora_l:
         t = jnp.einsum("bshk,bhkr->bsr", attn, lora_l["wo_a"])

@@ -210,7 +210,9 @@ def make_lora_train_step(
     """
     _check_targets(cfg, lcfg)
     optimizer = optimizer or make_optimizer()
-    attn_fn = _resolve_attention(mesh, attention) if attention else None
+    attn_fn = (
+        _resolve_attention(mesh, attention, cfg.window) if attention else None
+    )
 
     def loss_fn(lora, base, tokens, targets):
         merged = merge_lora(base, lora, lcfg)

@@ -83,6 +83,13 @@ class ModelConfig:
     # per position — pins the softmax normalizer near 1 so bf16 logits
     # don't drift over long runs. Same scope as label_smoothing.
     z_loss: float = 0.0
+    # sliding-window (local) attention: each position attends to the
+    # previous `window` positions including itself (0 = full causal).
+    # Honored by the default dense core, the flash kernel (which then
+    # skips out-of-window key blocks in BOTH directions — O(window) work
+    # per position), and the decode cache read. Ring attention does not
+    # compose with a window (validated at step build).
+    window: int = 0
     # grouped-query attention: number of K/V heads (0 = n_heads, plain MHA;
     # 1 = MQA). Must divide n_heads; the decode KV cache stores only these,
     # cutting its HBM footprint by n_heads/n_kv_heads. With tensor
@@ -108,6 +115,8 @@ class ModelConfig:
             )
         if self.z_loss < 0:
             raise ValueError(f"z_loss must be >= 0, got {self.z_loss}")
+        if self.window < 0:
+            raise ValueError(f"window must be >= 0, got {self.window}")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
@@ -210,16 +219,24 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 
 
 def dense_attention(
-    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Reference attention core: full softmax, causal or bidirectional —
     ONE body so numerics fixes serve both (mirrors the flash kernel's
-    causal kwarg). (B, S, H, D) in/out."""
+    causal kwarg). ``window > 0`` (causal only): sliding-window band —
+    each position sees the previous ``window`` positions including
+    itself. (B, S, H, D) in/out."""
+    if window > 0 and not causal:
+        raise ValueError("window > 0 requires causal attention")
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         s = q.shape[1]
         mask = jnp.tril(jnp.ones((s, s), bool))
+        if window > 0:
+            pos = jnp.arange(s)
+            mask &= pos[:, None] - pos[None, :] < window
         scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -228,6 +245,17 @@ def dense_attention(
 def dense_causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
     """Causal spelling of ``dense_attention`` (the decoder default)."""
     return dense_attention(q, k, v, causal=True)
+
+
+def default_attn_fn(cfg: ModelConfig) -> AttnFn:
+    """THE default attention core for a config — every path that lets
+    ``attn_fn`` default (training forward, prefill, seq2seq decoder,
+    train-step dense branch) resolves through here, so a window (or any
+    future default-attention knob) can never be honored in one path and
+    silently dropped in another."""
+    if cfg.window > 0:
+        return partial(dense_attention, causal=True, window=cfg.window)
+    return dense_causal_attention
 
 
 def _moe_aux_from_probs(probs: jnp.ndarray, top_k: int = 1) -> jnp.ndarray:
@@ -422,7 +450,7 @@ def forward_hidden(
     (B, S, D) plus the summed MoE aux term. This is what an encoder
     producing memory for cross-attention consumes (``jobs.seq2seq``)."""
     if attn_fn is None:
-        attn_fn = dense_causal_attention
+        attn_fn = default_attn_fn(cfg)
     if positions is None:
         positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
 
@@ -457,7 +485,8 @@ def forward_with_kv(
     """
     positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)
     x = params["embed"][tokens]
-    body = partial(_block_with_aux, cfg, attn_fn or dense_causal_attention, positions)
+    body = partial(_block_with_aux, cfg, attn_fn or default_attn_fn(cfg),
+                   positions)
 
     def scan_body(carry, layer):
         x, _aux, k, v = body(carry, layer)

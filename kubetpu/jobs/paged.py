@@ -211,6 +211,12 @@ class PagedDecodeServer(SlotServerBase):
         seed: int = 0,
         mesh=None,
     ) -> None:
+        if cfg.window > 0:
+            raise NotImplementedError(
+                "cfg.window (sliding-window attention) is not implemented in "
+                "the paged-attention path; serve windowed models with "
+                "DecodeServer (its cache read is banded)"
+            )
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
                          top_p=top_p, seed=seed)

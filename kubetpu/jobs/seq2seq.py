@@ -137,7 +137,9 @@ def decoder_forward(
     of logits (the chunked-CE tail consumes these, cfg.loss_chunk)."""
     dec = params["decoder"]
     if attn_fn is None:
-        attn_fn = model_lib.dense_causal_attention
+        # honors cfg.window — the cached generate path bands its cache
+        # read with the same window, and the two must agree
+        attn_fn = model_lib.default_attn_fn(cfg)
     if positions is None:
         positions = jnp.arange(tgt_in.shape[1], dtype=jnp.int32)
     mem_k, mem_v = memory_projections(cfg, dec["blocks"], memory)

@@ -221,23 +221,38 @@ def make_update_step(loss_fn, optimizer, accum_steps: int = 1,
     return train_step
 
 
-def _resolve_attention(mesh: Mesh, attention: str):
+def _resolve_attention(mesh: Mesh, attention: str, window: int = 0):
     """Pick the attention core: 'ring' (sequence-parallel over sp),
     'ring_flash' (ring with the Pallas flash kernels inside every step —
     VMEM-tiled scores, fused ring backward; append '_interpret' for the CPU
     Pallas interpreter in tests), 'flash' (the Pallas kernel —
-    single-sequence-shard paths), or 'dense'."""
-    if attention == "ring":
-        return make_ring_attention(mesh)
-    if attention in ("ring_flash", "ring_flash_interpret"):
+    single-sequence-shard paths), or 'dense'. ``window`` (cfg.window) makes
+    the dense and flash cores sliding-window; the ring does not compose
+    with a window (its rotation schedule assumes full causal visibility)."""
+    if attention in ("ring", "ring_flash", "ring_flash_interpret"):
+        if window > 0:
+            raise ValueError(
+                "ring attention does not support sliding-window (cfg.window); "
+                "use attention='flash' — O(window) work needs no sp sharding"
+            )
+        if attention == "ring":
+            return make_ring_attention(mesh)
         return make_ring_attention(
             mesh, impl="flash", interpret=attention.endswith("_interpret")
         )
-    if attention == "flash":
+    if attention in ("flash", "flash_interpret"):
         from kubetpu.ops import flash_attention
 
-        return partial(flash_attention, block_q=128, block_k=128)
+        return partial(flash_attention, block_q=128, block_k=128,
+                       interpret=attention.endswith("_interpret"),
+                       window=window)
     if attention == "dense":
+        if window > 0:
+            # None would fall to the model default, which already honors
+            # the window via default_attn_fn — being explicit here keeps
+            # the resolver self-contained
+            return partial(model_lib.dense_attention, causal=True,
+                           window=window)
         return None
     raise ValueError(f"unknown attention {attention!r}")
 
@@ -270,8 +285,21 @@ def make_train_step(
     """
     optimizer = optimizer or make_optimizer()
     if attention is None:
-        attention = "ring" if use_ring else "dense"
-    attn_fn = _resolve_attention(mesh, attention)
+        if use_ring and cfg.window > 0:
+            import warnings
+
+            # not silent: the ring request (the use_ring default) cannot
+            # honor a window; flash is the windowed long-context core
+            warnings.warn(
+                "cfg.window > 0: defaulting to dense attention instead of "
+                "the ring (ring does not compose with a sliding window); "
+                "pass attention='flash' for the O(window) kernel on TPU",
+                stacklevel=2,
+            )
+        attention = (
+            "ring" if use_ring and cfg.window == 0 else "dense"
+        )
+    attn_fn = _resolve_attention(mesh, attention, cfg.window)
 
     if weighted:
         def loss_fn(params, tokens, targets, weights):

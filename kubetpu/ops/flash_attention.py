@@ -39,7 +39,8 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
-                  block_k: int, scale: float, seq_len: int, causal: bool):
+                  block_k: int, scale: float, seq_len: int, causal: bool,
+                  window: int = 0):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
     d = q.shape[-1]
@@ -58,6 +59,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                 jnp.int32, (block_q, block_k), 1
             )
             mask = q_pos >= k_pos
+            if window > 0:  # sliding window: see the last `window` positions
+                mask &= q_pos - k_pos < window
             s = jnp.where(mask, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -78,7 +81,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         num_k_blocks = (qi * block_q) // block_k + (block_q + block_k - 1) // block_k
     else:       # full visibility (ring attention's sub-diagonal blocks)
         num_k_blocks = seq_len // block_k
-    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    # sliding window skips key blocks wholly LEFT of every row's window —
+    # work per query block becomes O(window), not O(position)
+    first_k = (
+        jnp.maximum(0, qi * block_q - (window - 1)) // block_k
+        if causal and window > 0 else 0
+    )
+    m, l, acc = jax.lax.fori_loop(first_k, num_k_blocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # log-sum-exp per row (the softmax residual the backward kernels need);
     # stored (bq, 1) — TPU block tiling wants a trailing lane axis
@@ -91,9 +100,11 @@ def _heads_layout(x):
 
 
 def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool,
-                   causal: bool = True):
+                   causal: bool = True, window: int = 0):
     """Returns (out (B,S,H,D), lse (B*H, S, 1)) — lse is the backward
     residual and the merge weight for ring-attention block combination."""
+    if window > 0 and not causal:
+        raise ValueError("window > 0 requires causal attention")
     b, s, h, d = q.shape
     scale = d ** -0.5
     # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head)
@@ -106,7 +117,7 @@ def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool,
     out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel, block_q=bq, block_k=bk, scale=scale, seq_len=s,
-            causal=causal,
+            causal=causal, window=window,
         ),
         grid=(b * h, s // bq),
         in_specs=[
@@ -129,7 +140,7 @@ def _flash_forward(q, k, v, block_q: int, block_k: int, interpret: bool,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_q: int, block_k: int, scale: float,
-                         seq_len: int, causal: bool):
+                         seq_len: int, causal: bool, window: int = 0):
     """dQ for one query block: stream the (causal or all) k/v blocks,
     recompute P from the saved log-sum-exp (FlashAttention-2 backward, dQ
     pass)."""
@@ -156,7 +167,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+            mask = q_pos >= k_pos
+            if window > 0:
+                mask &= q_pos - k_pos < window
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -169,13 +183,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         num_k_blocks = (qi * block_q) // block_k + (block_q + block_k - 1) // block_k
     else:
         num_k_blocks = seq_len // block_k
-    dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32))
+    first_k = (
+        jnp.maximum(0, qi * block_q - (window - 1)) // block_k
+        if causal and window > 0 else 0
+    )
+    dq = jax.lax.fori_loop(first_k, num_k_blocks, body,
+                           jnp.zeros((block_q, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, block_k: int,
-                          scale: float, num_q_blocks: int, causal: bool):
+                          scale: float, num_q_blocks: int, causal: bool,
+                          window: int = 0):
     """dK/dV for one key block: stream the query blocks at or below the
     diagonal — or all of them when non-causal (FlashAttention-2 backward,
     dK/dV pass)."""
@@ -200,7 +220,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+            mask = q_pos >= k_pos
+            if window > 0:
+                mask &= q_pos - k_pos < window
+            p = jnp.where(mask, p, 0.0)
         dv = dv + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -213,17 +236,25 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale
         return dk, dv
 
-    # first query block whose rows can see this key block
+    # first query block whose rows can see this key block; with a window,
+    # also the LAST one (rows beyond k_pos + window - 1 see nothing here)
     first_qi = (kj * block_k) // block_q if causal else 0
+    if causal and window > 0:
+        last_qi = jnp.minimum(
+            num_q_blocks,
+            ((kj + 1) * block_k - 1 + (window - 1)) // block_q + 1,
+        )
+    else:
+        last_qi = num_q_blocks
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first_qi, num_q_blocks, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(first_qi, last_qi, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret,
-                    causal: bool = True):
+                    causal: bool = True, window: int = 0):
     """Fused backward. With ``causal=False`` this also serves the ring
     attention's off-diagonal steps: *out*/*lse*/*g* are then the GLOBAL
     (merged) output, log-sum-exp and cotangent — the FlashAttention-2
@@ -242,7 +273,8 @@ def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=bq, block_k=bk,
-                          scale=scale, seq_len=s, causal=causal),
+                          scale=scale, seq_len=s, causal=causal,
+                          window=window),
         grid=(b * h, s // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),   # q
@@ -260,7 +292,7 @@ def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, block_q=bq, block_k=bk, scale=scale,
-            num_q_blocks=s // bq, causal=causal,
+            num_q_blocks=s // bq, causal=causal, window=window,
         ),
         grid=(b * h, s // bk),
         in_specs=[
@@ -288,30 +320,37 @@ def _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret,
     return back(dq), back(dk), back(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False, causal: bool = True):
+                    interpret: bool = False, causal: bool = True,
+                    window: int = 0):
     """Flash attention: (B, S, H, D) -> (B, S, H, D), drop-in for
     ``model.forward``'s ``attn_fn`` (wrap block sizes with functools.partial).
     Causal by default; ``causal=False`` is full bidirectional visibility —
     the encoder/ViT-style core (and the ring's off-diagonal steps).
-    Training uses the fused FlashAttention-2-style backward kernels (dQ pass
-    + dK/dV pass over the saved log-sum-exp) — no O(S^2) materialization in
-    either direction.
+    ``window > 0`` (causal only) is sliding-window attention: each position
+    sees the previous ``window`` positions including itself, and key blocks
+    wholly outside every row's window are never read in EITHER direction —
+    per-position work becomes O(window), the long-context local-attention
+    trade. Training uses the fused FlashAttention-2-style backward kernels
+    (dQ pass + dK/dV pass over the saved log-sum-exp) — no O(S^2)
+    materialization in either direction.
     """
-    out, _lse = _flash_forward(q, k, v, block_q, block_k, interpret, causal)
+    out, _lse = _flash_forward(q, k, v, block_q, block_k, interpret, causal,
+                               window)
     return out
 
 
-def _fwd(q, k, v, block_q, block_k, interpret, causal):
-    out, lse = _flash_forward(q, k, v, block_q, block_k, interpret, causal)
+def _fwd(q, k, v, block_q, block_k, interpret, causal, window):
+    out, lse = _flash_forward(q, k, v, block_q, block_k, interpret, causal,
+                              window)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(block_q, block_k, interpret, causal, res, g):
+def _bwd(block_q, block_k, interpret, causal, window, res, g):
     q, k, v, out, lse = res
     return _flash_backward(q, k, v, out, lse, g, block_q, block_k, interpret,
-                           causal)
+                           causal, window)
 
 
 flash_attention.defvjp(_fwd, _bwd)

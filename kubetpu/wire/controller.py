@@ -354,7 +354,13 @@ class ControllerServer:
         capacity appears — the node is already cordoned, so never back
         onto it)."""
         with self._lock:
-            migrated, unplaced = self.cluster.drain(name)  # KeyError -> 404
+            res = self._active_reservation()
+            migrated, unplaced = self.cluster.drain(  # KeyError -> 404
+                name,
+                # drained pods respect the gang reservation like every
+                # other placement path; blocked ones pend behind the gang
+                may_place=lambda p: not self._reservation_blocks(res, [p]),
+            )
             self._pending.extend(unplaced)
             snapshots = [
                 (_reset_for_reschedule(p), p,

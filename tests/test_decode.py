@@ -175,3 +175,39 @@ def test_ring_prefill_matches_dense():
     ld, _, _ = forward_chunk(CFG, params, nxt[:, None], k2, v2, 32)
     np.testing.assert_allclose(np.asarray(lr), np.asarray(ld),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_windowed_decode_matches_windowed_forward():
+    """cfg.window bands the cache read: KV-cached chunk logits must equal
+    the teacher-forced windowed forward at every position."""
+    import dataclasses
+
+    from kubetpu.jobs.decode import forward_chunk, init_kv_cache
+    from kubetpu.jobs.model import forward as full_forward
+
+    cfg = dataclasses.replace(CFG, window=5)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, cfg.vocab)
+    kc, vc = init_kv_cache(cfg, 2, 24)
+    got, _kc, _vc = forward_chunk(cfg, params, tokens, kc, vc, 0)
+    want = full_forward(params, tokens, cfg)  # default attn honors window
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    # and the window genuinely changes the result vs full attention
+    full = full_forward(params, tokens, dataclasses.replace(CFG, window=0))
+    assert not np.allclose(np.asarray(want), np.asarray(full), atol=1e-3)
+
+
+def test_windowed_generate_runs_past_window():
+    """Generation longer than the window stays finite and well-formed (the
+    band keeps sliding; early cache rows fall out of every later read)."""
+    import dataclasses
+
+    from kubetpu.jobs.decode import make_generate
+
+    cfg = dataclasses.replace(CFG, window=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    gen = make_generate(cfg)
+    out = gen(params, jnp.array([[1, 2, 3]]), jax.random.PRNGKey(0), 16)
+    assert out.shape == (1, 19)
+    assert int(out.max()) < cfg.vocab and int(out.min()) >= 0

@@ -793,3 +793,46 @@ def test_label_smoothing_and_z_loss_formulas():
         ModelConfig(label_smoothing=1.0)
     with pytest.raises(ValueError):
         ModelConfig(z_loss=-0.1)
+
+
+def test_windowed_training_learns_and_ring_refuses():
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, window=8)
+    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 2})
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    # attention=None: window forces the dense core (ring would be wrong)
+    step = make_train_step(cfg, mesh, optimizer=opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = []
+    for _ in range(10):
+        state, loss = step(state, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.95
+
+    with pytest.raises(ValueError):
+        make_train_step(cfg, mesh, optimizer=opt, attention="ring")
+    with pytest.raises(ValueError):
+        ModelConfig(window=-1)
+
+
+def test_pipeline_honors_window_or_refuses_ring():
+    import dataclasses
+
+    from kubetpu.jobs.pipeline import make_pipeline_forward
+
+    cfg = dataclasses.replace(
+        ModelConfig(vocab=64, d_model=32, n_layers=4, n_heads=4, d_ff=64),
+        window=4)
+    mesh = make_mesh({"dp": 2, "pp": 2, "sp": 2, "tp": 1, "ep": 1})
+    with pytest.raises(ValueError):
+        make_pipeline_forward(cfg, mesh, n_microbatches=4, use_ring=True)
+    mesh2 = make_mesh({"dp": 2, "pp": 2, "sp": 1, "tp": 2, "ep": 1})
+    pf = make_pipeline_forward(cfg, mesh2, n_microbatches=4, use_ring=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+    got = jax.jit(pf)(params, tokens)
+    want = forward(params, tokens, cfg)  # default attn honors the window
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)

@@ -147,3 +147,18 @@ def test_seq2seq_chunked_loss_matches_unchunked():
     for p0, p1 in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(np.asarray(p0), np.asarray(p1),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_windowed_cached_generate_matches_recompute():
+    """cfg.window must band BOTH decoder paths identically: the cached
+    (decode._decode_block) and recompute (decoder_forward) generations
+    agree past the window boundary."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, window=3)
+    params = init_seq2seq_params(jax.random.PRNGKey(0), cfg)
+    src = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab)
+    ref = make_seq2seq_generate(cfg, bos_id=1, cached=False)
+    fast = make_seq2seq_generate(cfg, bos_id=1, cached=True)
+    np.testing.assert_array_equal(
+        np.asarray(ref(params, src, 9)), np.asarray(fast(params, src, 9)))
