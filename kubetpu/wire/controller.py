@@ -358,8 +358,15 @@ class ControllerServer:
             migrated, unplaced = self.cluster.drain(  # KeyError -> 404
                 name,
                 # drained pods respect the gang reservation like every
-                # other placement path; blocked ones pend behind the gang
-                may_place=lambda p: not self._reservation_blocks(res, [p]),
+                # other placement path; blocked ones pend behind the gang.
+                # Slice-pinned SURVIVORS of a placed gang are exempt (as on
+                # the reconcile path): they can only re-place inside their
+                # mates' slice, which cannot cherry-pick reserved capacity,
+                # and stranding them would break a running gang.
+                may_place=lambda p: (
+                    self.cluster.gang_slice_filter(p) is not None
+                    or not self._reservation_blocks(res, [p])
+                ),
             )
             self._pending.extend(unplaced)
             snapshots = [

@@ -952,3 +952,26 @@ def test_drain_unplaceable_pods_pend_and_recover(stack):
     poll = controller.poll_once()
     assert {r["pod"] for r in poll["rescheduled"]} == {"a"}
     assert poll["rescheduled"][0]["node"] != node_a
+
+
+def test_drain_exempts_gang_survivors_from_reservation(stack):
+    """Draining a node that hosts a RUNNING gang's member while a
+    reservation is active must migrate the member within its mates'
+    slice (slice-pinned placement cannot consume reserved capacity) —
+    not evict it."""
+    controller, _ = stack
+    out = _post(controller.address + "/pods",
+                {"gang": [pod_to_json(tpu_pod("g0", 4)),
+                          pod_to_json(tpu_pod("g1", 4))]})
+    nodes = {p["pod"]: p["node"] for p in out["placements"]}
+    _post(controller.address + "/pods",
+          {"gang": [pod_to_json(tpu_pod("big0", 8)),
+                    pod_to_json(tpu_pod("big1", 8))],
+           "queue": True})
+    for _ in range(4):
+        controller.poll_once()
+    assert controller._active_reservation() is not None
+    res = _post(controller.address + f"/nodes/{nodes['g0']}/drain", {})
+    moved = {m["pod"]: m["node"] for m in res["migrated"]}
+    assert moved.get("g0") == nodes["g1"], res  # migrated beside its mate
+    assert "g0" not in res["pending"]
