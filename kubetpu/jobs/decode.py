@@ -104,8 +104,8 @@ def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos, lora_l=None,
     v = proj("wv", h, layer["wv"])
     positions = pos + jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
     positions = jnp.broadcast_to(positions, (x.shape[0], x.shape[1]))
-    q = model_lib.rope(q, positions, cfg.rope_theta)
-    k = model_lib.rope(k, positions, cfg.rope_theta)
+    q = model_lib.rope(q, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
+    k = model_lib.rope(k, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
 
     k_cache_l = jax.lax.dynamic_update_slice(k_cache_l, k, (0, pos, 0, 0))
     v_cache_l = jax.lax.dynamic_update_slice(v_cache_l, v, (0, pos, 0, 0))

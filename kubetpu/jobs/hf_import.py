@@ -42,9 +42,11 @@ def config_from_hf(hf_config, **overrides) -> ModelConfig:
 
     Checkpoint features kubetpu's block math does not reproduce are
     REFUSED, not silently dropped — a conversion that succeeds is one
-    whose logits match the torch reference: rope_scaling (Llama-3.1+
-    frequency warping), attention/MLP biases. RMSNorm eps is fixed at
-    1e-6 in kubetpu; a checkpoint trained at another eps converts with a
+    whose logits match the torch reference. Llama-3.1-style
+    ``rope_scaling`` (type 'llama3') IS reproduced (translated to
+    ``ModelConfig.rope_llama3_scaling``); other scaling types and
+    attention/MLP biases refuse. RMSNorm eps is fixed at 1e-6 in
+    kubetpu; a checkpoint trained at another eps converts with a
     warning (the delta is ~eps-level, acceptable for most uses)."""
     if getattr(hf_config, "model_type", "llama") != "llama":
         raise ValueError(
@@ -52,13 +54,24 @@ def config_from_hf(hf_config, **overrides) -> ModelConfig:
             f"maps the llama family"
         )
     scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
-        raise ValueError(
-            f"rope_scaling={scaling!r} is not supported: kubetpu's rope() "
-            f"uses plain theta^(-i/(d/2)) frequencies, so converting this "
-            f"checkpoint (Llama-3.1-style frequency warping) would produce "
-            f"silently wrong logits"
-        )
+    llama3 = None
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type"))
+        if rope_type == "llama3":
+            # translated to the hashable ModelConfig tuple; rope() applies
+            # the identical frequency warp (parity-tested against torch)
+            llama3 = (
+                float(scaling["factor"]),
+                float(scaling["low_freq_factor"]),
+                float(scaling["high_freq_factor"]),
+                int(scaling["original_max_position_embeddings"]),
+            )
+        elif rope_type != "default":
+            raise ValueError(
+                f"rope_scaling type {rope_type!r} is not supported (only "
+                f"'llama3' and 'default'): converting would produce "
+                f"silently wrong logits"
+            )
     if getattr(hf_config, "attention_bias", False) or getattr(
             hf_config, "mlp_bias", False):
         raise ValueError(
@@ -82,6 +95,8 @@ def config_from_hf(hf_config, **overrides) -> ModelConfig:
         max_seq=hf_config.max_position_embeddings,
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
     )
+    if llama3 is not None:
+        kw["rope_llama3_scaling"] = llama3
     n_kv = getattr(hf_config, "num_key_value_heads", kw["n_heads"])
     if n_kv != kw["n_heads"]:
         kw["n_kv_heads"] = n_kv

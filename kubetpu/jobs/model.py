@@ -90,6 +90,13 @@ class ModelConfig:
     # per position), and the decode cache read. Ring attention does not
     # compose with a window (validated at step build).
     window: int = 0
+    # Llama-3.1 RoPE context-extension frequency warp: (factor,
+    # low_freq_factor, high_freq_factor, original_max_position_embeddings)
+    # or None (plain rope). A hashable tuple (not the HF dict) so the
+    # frozen config stays usable as a static value; hf_import fills it
+    # from checkpoint rope_scaling. Applied at every rope site (training,
+    # decode, paged).
+    rope_llama3_scaling: Optional[tuple] = None
     # grouped-query attention: number of K/V heads (0 = n_heads, plain MHA;
     # 1 = MQA). Must divide n_heads; the decode KV cache stores only these,
     # cutting its HBM footprint by n_heads/n_kv_heads. With tensor
@@ -117,6 +124,20 @@ class ModelConfig:
             raise ValueError(f"z_loss must be >= 0, got {self.z_loss}")
         if self.window < 0:
             raise ValueError(f"window must be >= 0, got {self.window}")
+        if self.rope_llama3_scaling is not None:
+            s = self.rope_llama3_scaling
+            if (not isinstance(s, tuple) or len(s) != 4
+                    or not all(isinstance(x, (int, float)) for x in s)):
+                raise ValueError(
+                    "rope_llama3_scaling must be a (factor, low_freq_factor, "
+                    "high_freq_factor, original_max_position_embeddings) "
+                    f"tuple (not the HF dict), got {s!r}"
+                )
+            if s[1] == s[2]:
+                raise ValueError(
+                    "rope_llama3_scaling low_freq_factor == high_freq_factor "
+                    "divides by zero in the smoothing band"
+                )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
@@ -197,10 +218,26 @@ def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarr
     return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
 
 
-def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
-    """Rotary position embedding. x: (B, S, H, D), positions: (S,) or (B, S)."""
+def rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float,
+         llama3_scaling=None) -> jnp.ndarray:
+    """Rotary position embedding. x: (B, S, H, D), positions: (S,) or (B, S).
+
+    ``llama3_scaling`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings): the Llama-3.1 context-extension
+    frequency warp (``cfg.rope_llama3_scaling``) — long wavelengths divide
+    by *factor*, short ones pass through, the band between interpolates
+    smoothly. Matches the HF reference formula exactly (pinned by the
+    hf_import cross-framework tests)."""
     d_half = x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(0, d_half, dtype=jnp.float32) / d_half)
+    if llama3_scaling is not None:
+        factor, lo, hi, old_len = llama3_scaling
+        wavelen = 2.0 * jnp.pi / freqs
+        scaled = jnp.where(wavelen > old_len / lo, freqs / factor, freqs)
+        smooth = (old_len / wavelen - lo) / (hi - lo)
+        smoothed = (1.0 - smooth) * scaled / factor + smooth * scaled
+        medium = (wavelen >= old_len / hi) & (wavelen <= old_len / lo)
+        freqs = jnp.where(medium, smoothed, scaled)
     angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., S, d_half)
     if angles.ndim == 2:  # (S, d_half) -> broadcast over batch
         angles = angles[None]
@@ -320,8 +357,8 @@ def _block_with_aux(
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    q = rope(q, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
+    k = rope(k, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
     # GQA: expand grouped K/V to full heads ONLY for the attention core, so
     # every core (dense, flash, ring) sees equal head counts; the returned
     # k/v stay at kv_heads width — that is what the decode cache stores.

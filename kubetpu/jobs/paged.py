@@ -122,8 +122,8 @@ def paged_forward_one(
         k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
         v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
         positions = pos[:, None]
-        q = model_lib.rope(q, positions, cfg.rope_theta)
-        k = model_lib.rope(k, positions, cfg.rope_theta)
+        q = model_lib.rope(q, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
+        k = model_lib.rope(k, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
         k_l = _write_token_kv(k_l, k[:, 0], phys, offset)
         v_l = _write_token_kv(v_l, v[:, 0], phys, offset)
         attn = attend(q[:, 0], k_l, v_l, table, pos)
