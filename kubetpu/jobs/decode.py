@@ -78,18 +78,15 @@ def _lora_in_delta(h, a, b, scale):
     return jnp.einsum("btr,brhk->bthk", t, b) * scale
 
 
-def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos, lora_l=None,
-                  lora_scale=1.0):
-    """One transformer block over a T-token chunk at positions
-    pos..pos+T-1, writing the chunk's K/V into this layer's cache.
-    x: (B, T, D); caches: (B, S_max, H_kv, D). T == 1 is plain
-    token-at-a-time decoding; T > 1 is speculative verification.
-
-    ``lora_l``: PER-EXAMPLE adapter factors for this layer (the multi-LoRA
-    serving path, ``kubetpu.jobs.multi_lora``): a dict of (B, ...) tensors
-    keyed ``<target>_a`` / ``<target>_b`` for attention targets — each
-    example in the batch applies ITS OWN adapter while the base matmuls
-    stay batched."""
+def _decode_block_core(cfg, layer, x, cache, pos, cache_io, lora_l=None,
+                       lora_scale=1.0):
+    """THE transformer block body of every cached decode path — dense
+    cache, ring cache, seq2seq — parameterized on the cache strategy so a
+    numerics or LoRA fix can never land in one cache layout and silently
+    miss another. ``cache_io(q, k, v, cache, pos) -> (attn, cache)`` owns
+    the write + banded read; everything else (norms, projections with
+    optional per-example LoRA deltas, absolute-position rope, MLP) is
+    shared. x: (B, T, D)."""
     def proj(name, hh, base):
         out = jnp.einsum("bsd,dhk->bshk", hh, base)
         if lora_l is not None and f"{name}_a" in lora_l:
@@ -107,9 +104,7 @@ def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos, lora_l=None,
     q = model_lib.rope(q, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
     k = model_lib.rope(k, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
 
-    k_cache_l = jax.lax.dynamic_update_slice(k_cache_l, k, (0, pos, 0, 0))
-    v_cache_l = jax.lax.dynamic_update_slice(v_cache_l, v, (0, pos, 0, 0))
-    attn = _attend_cached(q, k_cache_l, v_cache_l, pos, window=cfg.window)
+    attn, cache = cache_io(q, k, v, cache, pos)
     o = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
     if lora_l is not None and "wo_a" in lora_l:
         t = jnp.einsum("bshk,bhkr->bsr", attn, lora_l["wo_a"])
@@ -119,7 +114,38 @@ def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos, lora_l=None,
 
     h = model_lib.rms_norm(x, layer["ln2"])
     delta, _aux = model_lib._mlp(cfg, h, layer)
-    return x + delta, k_cache_l, v_cache_l
+    return x + delta, cache
+
+
+def _dense_cache_io(window):
+    """The (L, B, S_max, ...) contiguous-cache strategy: write the chunk
+    at *pos*, attend through the whole (banded) cache."""
+    def io(q, k, v, cache, pos):
+        k_l, v_l = cache
+        k_l = jax.lax.dynamic_update_slice(k_l, k, (0, pos, 0, 0))
+        v_l = jax.lax.dynamic_update_slice(v_l, v, (0, pos, 0, 0))
+        return _attend_cached(q, k_l, v_l, pos, window=window), (k_l, v_l)
+
+    return io
+
+
+def _decode_block(cfg, layer, x, k_cache_l, v_cache_l, pos, lora_l=None,
+                  lora_scale=1.0):
+    """One transformer block over a T-token chunk at positions
+    pos..pos+T-1, writing the chunk's K/V into this layer's cache.
+    x: (B, T, D); caches: (B, S_max, H_kv, D). T == 1 is plain
+    token-at-a-time decoding; T > 1 is speculative verification.
+
+    ``lora_l``: PER-EXAMPLE adapter factors for this layer (the multi-LoRA
+    serving path, ``kubetpu.jobs.multi_lora``): a dict of (B, ...) tensors
+    keyed ``<target>_a`` / ``<target>_b`` for attention targets — each
+    example in the batch applies ITS OWN adapter while the base matmuls
+    stay batched."""
+    x, (k_cache_l, v_cache_l) = _decode_block_core(
+        cfg, layer, x, (k_cache_l, v_cache_l), pos,
+        _dense_cache_io(cfg.window), lora_l, lora_scale,
+    )
+    return x, k_cache_l, v_cache_l
 
 
 def forward_chunk(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache,
@@ -254,6 +280,130 @@ def make_generate(
 
     bspec = NamedSharding(mesh, P("dp", None) if "dp" in mesh.axis_names else P())
     return jax.jit(generate, static_argnums=(3,), in_shardings=(None, bspec, None))
+
+
+def _attend_ring(q, k_ring, v_ring, q_pos, window, first_pos):
+    """One-token-chunk attention over a RING-buffer cache. q: (B, 1, H, D);
+    rings: (B, W, H_kv, D). Slot j's global position is derivable from
+    arithmetic alone — the unique p ≡ j (mod W) in (q_pos - W, q_pos] —
+    so no per-slot position buffer rides the scan; a slot is visible iff
+    that p has actually been written (p >= *first_pos*, the earliest
+    position the ring ever held). Grouped-query aware like
+    ``_attend_cached``."""
+    b, t, h, d = q.shape
+    h_kv = k_ring.shape[2]
+    g = h // h_kv
+    scale = d ** -0.5
+    qg = q.reshape(b, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg,
+                        k_ring.astype(jnp.float32)) * scale
+    slots = jnp.arange(window)
+    p = q_pos - ((q_pos - slots) % window)     # slot -> global position
+    visible = p >= first_pos
+    scores = jnp.where(visible[None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_ring.astype(jnp.float32))
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def _ring_cache_io(window, first_pos):
+    """The O(window) ring strategy: write at ``pos % window`` (the
+    overwritten entry is by construction outside every later band),
+    attend over the W slots. T == 1 chunks only."""
+    def io(q, k, v, cache, pos):
+        k_l, v_l = cache
+        slot = pos % window
+        k_l = jax.lax.dynamic_update_slice(k_l, k, (0, slot, 0, 0))
+        v_l = jax.lax.dynamic_update_slice(v_l, v, (0, slot, 0, 0))
+        return _attend_ring(q, k_l, v_l, pos, window, first_pos), (k_l, v_l)
+
+    return io
+
+
+def make_rolling_generate(
+    cfg: ModelConfig,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+):
+    """``make_generate`` for sliding-window models with an O(window) cache:
+    the per-layer K/V live in a RING of ``cfg.window`` slots, so
+    generation memory is bounded by the window, not the sequence —
+    arbitrarily long windowed generation in constant cache memory.
+    Token-exact vs ``make_generate`` on the same windowed config (pinned
+    by test; keys are roped with ABSOLUTE positions before entering the
+    ring, so wraparound changes nothing). The block body is the shared
+    ``_decode_block_core`` — only the cache strategy differs from the
+    dense path.
+
+    Prefill runs the normal batched forward (compute-bound, its own
+    O(S_p) activations) and keeps only the last ``min(S_p, window)``
+    roped K/V in the ring."""
+    from kubetpu.jobs.quant import maybe_dequantize
+    from kubetpu.jobs.sampling import make_sampler
+
+    if cfg.window <= 0:
+        raise ValueError("make_rolling_generate needs cfg.window > 0")
+    W = cfg.window
+    sampler = make_sampler(temperature, top_k=top_k, top_p=top_p)
+
+    def forward_one_ring(params, token, k_rings, v_rings, pos, first_pos):
+        x = params["embed"][token][:, None]            # (B, 1, D)
+        cache_io = _ring_cache_io(W, first_pos)
+
+        def layer_body(carry, inputs):
+            x = carry
+            layer, k_l, v_l = inputs
+            layer = maybe_dequantize(layer)
+            x, (k_l, v_l) = _decode_block_core(
+                cfg, layer, x, (k_l, v_l), pos, cache_io
+            )
+            return x, (k_l, v_l)
+
+        x, (k_rings, v_rings) = jax.lax.scan(
+            layer_body, x, (params["blocks"], k_rings, v_rings)
+        )
+        x = model_lib.rms_norm(x, params["ln_f"])
+        head = maybe_dequantize(params["head"])
+        logits = jnp.einsum("bsd,dv->bsv", x, head).astype(jnp.float32)
+        return logits[:, 0], k_rings, v_rings
+
+    def generate(params, prompt, rng, num_steps: int):
+        b, s_p = prompt.shape
+        # prefill: one batched windowed forward over DEQUANTIZED params
+        # (the training forward knows nothing of QTensors — same contract
+        # as prefill()); keep the last min(S_p, W) roped K/V
+        logits, ks, vs = model_lib.forward_with_kv(
+            maybe_dequantize(params), prompt, cfg
+        )
+        L = cfg.n_layers
+        k_rings = jnp.zeros((L, b, W, cfg.kv_heads, cfg.head_dim), cfg.dtype)
+        v_rings = jnp.zeros_like(k_rings)
+        keep = min(s_p, W)
+        first_pos = s_p - keep  # earliest position the ring ever holds
+        src_pos = jnp.arange(first_pos, s_p)           # global positions kept
+        slots = src_pos % W
+        k_rings = k_rings.at[:, :, slots].set(
+            ks[:, :, first_pos:].astype(cfg.dtype))
+        v_rings = v_rings.at[:, :, slots].set(
+            vs[:, :, first_pos:].astype(cfg.dtype))
+
+        def step(carry, i):
+            k_rings, v_rings, prev_logits, rng = carry
+            rng, sub = jax.random.split(rng)
+            token = sampler(prev_logits, sub)
+            logits, k_rings, v_rings = forward_one_ring(
+                params, token, k_rings, v_rings, s_p + i, first_pos
+            )
+            return (k_rings, v_rings, logits, rng), token
+
+        (_, _, _, _), generated = jax.lax.scan(
+            step, (k_rings, v_rings, logits, rng), jnp.arange(num_steps)
+        )
+        return jnp.concatenate([prompt, generated.T.astype(prompt.dtype)],
+                               axis=1)
+
+    return jax.jit(generate, static_argnums=(3,))
 
 
 def forward_chunk_at(cfg, params, chunk, k_cache, v_cache, pos, lora=None,

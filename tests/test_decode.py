@@ -211,3 +211,51 @@ def test_windowed_generate_runs_past_window():
     out = gen(params, jnp.array([[1, 2, 3]]), jax.random.PRNGKey(0), 16)
     assert out.shape == (1, 19)
     assert int(out.max()) < cfg.vocab and int(out.min()) >= 0
+
+
+def test_rolling_generate_token_exact_vs_dense_cache():
+    """The O(window) ring cache must generate the EXACT tokens of the
+    O(max_seq) dense cache on a windowed config, across generations long
+    enough to wrap the ring several times — and from prompts both shorter
+    and longer than the window."""
+    import dataclasses
+
+    from kubetpu.jobs.decode import make_generate, make_rolling_generate
+
+    cfg = dataclasses.replace(CFG, window=5)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dense = make_generate(cfg)
+    ring = make_rolling_generate(cfg)
+    for prompt in (jnp.array([[1, 2, 3]]),                 # shorter than W
+                   jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]])):  # longer than W
+        want = dense(params, prompt, jax.random.PRNGKey(0), 20)
+        got = ring(params, prompt, jax.random.PRNGKey(0), 20)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_rolling_generate_requires_window():
+    import pytest
+
+    from kubetpu.jobs.decode import make_rolling_generate
+
+    with pytest.raises(ValueError):
+        make_rolling_generate(CFG)  # window == 0
+
+
+def test_rolling_generate_with_int8_params():
+    """The ring path serves quantized weights too: prefill dequantizes the
+    whole tree (training forward knows nothing of QTensors), the decode
+    loop per layer — greedy output matches the bf16 rolling path within
+    quantization error (and runs at all, the regression this pins)."""
+    import dataclasses
+
+    from kubetpu.jobs.decode import make_rolling_generate
+    from kubetpu.jobs.quant import quantize_params
+
+    cfg = dataclasses.replace(CFG, window=5)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    ring = make_rolling_generate(cfg)
+    out = ring(qparams, jnp.array([[1, 2, 3]]), jax.random.PRNGKey(0), 12)
+    assert out.shape == (1, 15)
+    assert int(out.max()) < cfg.vocab
