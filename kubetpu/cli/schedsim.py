@@ -21,8 +21,9 @@ from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
 from kubetpu.plugintypes import ResourceGPU, ResourceTPU
 
 
-def _tpu_pod(name, chips):
-    return PodInfo(name=name, running_containers={"main": ContainerInfo(requests={ResourceTPU: chips})})
+def _tpu_pod(name, chips, **extra_requests):
+    return PodInfo(name=name, requests=dict(extra_requests),
+                   running_containers={"main": ContainerInfo(requests={ResourceTPU: chips})})
 
 
 def _v5e8_cluster():
@@ -532,10 +533,53 @@ def config13(rounds=None):
     return out
 
 
+def config14(rounds=None):
+    """multislice: 4 fragmented v5e-256 slices; a 480-chip gang (60 hosts) that fits no single slice spans 2 slices via the opt-in knob — placement p50/p99 + per-slice contiguity"""
+    from kubetpu.scheduler.meshstate import MultisliceKey
+
+    rounds = rounds or 10
+    c = Cluster()
+    for s in range(4):
+        for h in range(32):
+            c.register_node(
+                f"s{s}h{h:02d}",
+                device=new_fake_tpu_dev_manager(
+                    make_fake_tpus_info("v5e-256", host_index=h,
+                                        slice_uid=f"slice{s}")
+                ),
+            )
+    # fragment every slice (hold one whole host each): the 60-host gang
+    # can never fit a 32-host slice regardless — the holds exist so the
+    # per-slice contiguity search runs on a NON-pristine tree (routing
+    # around a held host), keeping the latency number honest
+    for s in range(4):
+        c.schedule(_tpu_pod(f"hold{s}", 8),
+                   lambda n, pre=f"s{s}h00": n == pre)
+
+    lat, contig = [], []
+    for r in range(rounds):
+        pods = [
+            _tpu_pod(f"g{r}w{i}", 8, **{MultisliceKey: 2}) for i in range(60)
+        ]
+        t0 = time.perf_counter()
+        placed = c.schedule_gang(pods)
+        lat.append((time.perf_counter() - t0) * 1e3)
+        per = c.gang_slice_contiguity(placed)
+        contig.append(min(per.values()))
+        assert len(per) == 2, f"expected a 2-slice placement, got {len(per)}"
+        for p in placed:
+            c.release(p.name)
+    return {
+        **_percentiles(lat),
+        "slices_spanned": 2,
+        "min_per_slice_contiguity": min(contig),
+    }
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13}
-TAKES_ROUNDS = {4, 8, 9, 10, 11, 12, 13}
+           11: config11, 12: config12, 13: config13, 14: config14}
+TAKES_ROUNDS = {4, 8, 9, 10, 11, 12, 13, 14}
 
 
 def main(argv=None) -> int:

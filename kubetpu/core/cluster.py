@@ -418,16 +418,33 @@ class Cluster:
         advertise the same tpu-slice topology), hosts in index order so the
         chosen host blocks tile a contiguous torus region; roll back fully
         on any failure.
+
+        Multislice (opt-in): when every pod carries the
+        ``kubetpu/multislice`` knob with value k >= 2 and no single slice
+        fits, the gang may span up to k physical slices — data parallelism
+        rides DCN between the slices, ICI parallelism within each (the
+        third locality level the reference's two-level NVLink/PCIe tree,
+        nvidia_gpu_manager.go:74-88, never needed). Each sub-gang is placed
+        with the same per-slice geometric contiguity as a single-slice
+        gang, and members are stamped with ``kubetpu/gang-slices`` /
+        ``kubetpu/gang-slice-id`` so Allocate can emit the libtpu
+        multislice env and re-placements rejoin the right sub-gang.
         """
         t0 = time.perf_counter()
         try:
             # Stamp gang identity on copies (inputs are templates): members
             # carry it through placement, eviction, and reset, so a later
-            # individual re-place can find its surviving gang mates.
+            # individual re-place can find its surviving gang mates. Stale
+            # slice-membership stamps from a PREVIOUS placement of the same
+            # templates are dropped — only a fresh multislice placement may
+            # set them, or a single-slice re-place would leave members
+            # claiming sub-gangs that no longer exist.
             self._gang_seq += 1
             pods = [p.copy() for p in pods]
             for p in pods:
                 p.requests[GangKey] = self._gang_seq
+                p.requests.pop(meshstate.GangSlicesKey, None)
+                p.requests.pop(meshstate.GangSliceIdKey, None)
             slices = self._tpu_slices()
             # pod_wants_device covers device-native AND kube-native requests
             # over both container kinds, so a kube-only gang is still pinned
@@ -443,22 +460,21 @@ class Cluster:
                                if n not in self.cordoned]
                 if not slice_nodes:
                     continue
-                # Best case: assign pods to a *geometrically contiguous set of
-                # host blocks* (a 2-host gang on a v5e-64 should get two
-                # vertically adjacent hosts forming a 4x4 square, not a 2x8
-                # strip).
-                ordered_hosts = self._contiguous_hosts(slice_nodes, len(pods))
-                if ordered_hosts is not None:
-                    try:
-                        return self._try_gang_pinned(pods, ordered_hosts)
-                    except SchedulingError:
-                        pass
-                members = set(slice_nodes)
                 try:
-                    return self._try_gang(pods, lambda n: n in members)
+                    return self._try_gang_slice(pods, slice_nodes)
                 except SchedulingError:
                     continue
             if tpu_gang and slices:
+                # Opt-in escape hatch: span up to k slices when no single
+                # slice fits (the knob must be on EVERY member — a gang
+                # half-willing to cross DCN is a config error, treated as
+                # unwilling).
+                max_slices = min(
+                    (int(p.requests.get(meshstate.MultisliceKey, 0)) for p in pods),
+                    default=0,
+                )
+                if max_slices >= 2:
+                    return self._try_gang_multislice(pods, slices, max_slices)
                 # A TPU gang must live inside ONE physical slice: chips in
                 # different slices are connected over DCN, not ICI, and a
                 # silent straddle would wreck the job's collectives.
@@ -470,6 +486,105 @@ class Cluster:
             return self._try_gang(pods, None)
         finally:
             self.metrics.record("schedule_gang", time.perf_counter() - t0)
+
+    def _try_gang_slice(
+        self, pods: Sequence[PodInfo], slice_nodes: List[str]
+    ) -> List[PodInfo]:
+        """Place a (sub-)gang entirely within one slice's nodes. Best case:
+        assign pods to a *geometrically contiguous set of host blocks* (a
+        2-host gang on a v5e-64 should get two vertically adjacent hosts
+        forming a 4x4 square, not a 2x8 strip); fall back to any placement
+        confined to the slice."""
+        ordered_hosts = self._contiguous_hosts(slice_nodes, len(pods))
+        if ordered_hosts is not None:
+            try:
+                return self._try_gang_pinned(pods, ordered_hosts)
+            except SchedulingError:
+                pass
+        members = set(slice_nodes)
+        return self._try_gang(pods, lambda n: n in members)
+
+    def _try_gang_multislice(
+        self,
+        pods: List[PodInfo],
+        slices: Dict[str, List[str]],
+        max_slices: int,
+    ) -> List[PodInfo]:
+        """Partition the gang over k distinct physical slices, trying the
+        fewest slices first (k = 2 upward — every extra slice is another
+        DCN leg). Sub-gangs are EQUAL-SIZED contiguous chunks of the pod
+        list: the jobs-side ``dcn`` mesh axis (``make_multislice_mesh``)
+        needs the same device count in every slice, so a lopsided split
+        would schedule a gang that cannot build its mesh — k values that
+        do not divide the gang are skipped. (Equality is in PODS; gangs
+        with heterogeneous per-pod chip counts should keep worker shapes
+        uniform, as multi-host jobs do anyway.) Candidate slices are
+        tried fullest-first; each sub-gang gets the same per-slice
+        geometric contiguity treatment as a single-slice gang. All-or-
+        nothing: any shortfall rolls back every placed member and the
+        next k is tried.
+
+        On success every member is stamped with its slice membership
+        (``gang-slices`` = k, ``gang-slice-id`` = this pod's sub-gang
+        index, in pod order) — the device manager turns those into
+        MEGASCALE_NUM_SLICES / MEGASCALE_SLICE_ID at container start, and
+        ``gang_slice_filter`` uses them to pin re-placements to the pod's
+        OWN sub-gang's slice."""
+        free_chips: Dict[str, int] = {}
+        for sname, nodes in slices.items():
+            total = 0
+            for n in nodes:
+                if n in self.cordoned:
+                    continue
+                st = meshstate.parse_mesh_state(self.nodes[n].info.allocatable)
+                if st is not None:
+                    total += len(st.free)
+            free_chips[sname] = total
+        order = sorted(slices, key=lambda s: (-free_chips[s], s))
+        needs = [max(1, pod_device_count(TPU, p)) for p in pods]
+
+        for k in range(2, min(max_slices, len(order), len(pods)) + 1):
+            if len(pods) % k:
+                continue
+            sub_n = len(pods) // k
+            groups: List[List[PodInfo]] = []
+            for sname in order:
+                if len(groups) == k:
+                    break
+                nodes = [n for n in slices[sname] if n not in self.cordoned]
+                if not nodes:
+                    continue
+                lo = len(groups) * sub_n
+                if sum(needs[lo : lo + sub_n]) > free_chips[sname]:
+                    continue  # provably too full for a sub-gang
+                try:
+                    groups.append(
+                        self._try_gang_slice(pods[lo : lo + sub_n], nodes)
+                    )
+                except SchedulingError:
+                    continue
+            if len(groups) < k:
+                for sub in groups:  # all-or-nothing at this k
+                    for p in sub:
+                        self.release(p.name)
+                continue
+            placed_all: List[PodInfo] = []
+            for sid, sub in enumerate(groups):
+                for p in sub:
+                    # placed copies live in node.pods — stamps persist
+                    p.requests[meshstate.GangSlicesKey] = k
+                    p.requests[meshstate.GangSliceIdKey] = sid
+                placed_all.extend(sub)
+            self._event(
+                "schedule_multislice", gang=pods[0].requests.get(GangKey),
+                slices=k, pods=len(placed_all),
+            )
+            return placed_all
+        raise SchedulingError(
+            f"gang of {len(pods)} pods does not fit within {max_slices} TPU "
+            f"slices in equal sub-gangs ({', '.join(slices)}) — the dcn "
+            f"mesh axis needs the same device count per slice"
+        )
 
     def _contiguous_hosts(self, slice_nodes: List[str], k: int) -> Optional[List[str]]:
         """Pick k host-nodes of one slice whose blocks tile a contiguous
@@ -568,19 +683,39 @@ class Cluster:
         """Node filter honoring a re-placed pod's gang slice affinity: when
         surviving members of its gang are placed on a TPU slice, only that
         slice's nodes are eligible — the single-slice gang invariant
-        (schedule_gang's DCN guard) applies to RE-placements too. None when
-        the pod carries no gang id or has no placed gang mates."""
+        (schedule_gang's DCN guard) applies to RE-placements too. For a
+        multislice gang member the affinity is to its OWN sub-gang's slice
+        (mates sharing its ``gang-slice-id``) — rejoining a DIFFERENT
+        sub-gang's slice would silently change the job's DCN topology.
+        None when the pod carries no gang id or has no placed (sub-)gang
+        mates."""
         gid = pod.requests.get(GangKey)
         if not gid:
             return None
+        sid = pod.requests.get(meshstate.GangSliceIdKey)
+        has_sid = meshstate.GangSliceIdKey in pod.requests
+        other_slices: set = set()  # nodes of OTHER sub-gangs' slices
         for node in self.nodes.values():
             for placed in node.pods.values():
-                if placed.name != pod.name and placed.requests.get(GangKey) == gid:
-                    state = meshstate.parse_mesh_state(node.info.allocatable)
-                    if state is None:
-                        return None  # non-mesh gang: no slice constraint
-                    members = set(self._tpu_slices().get(state.slice_name, []))
-                    return lambda n, m=members: n in m
+                if placed.name == pod.name or placed.requests.get(GangKey) != gid:
+                    continue
+                state = meshstate.parse_mesh_state(node.info.allocatable)
+                if state is None:
+                    return None  # non-mesh gang: no slice constraint
+                members = set(self._tpu_slices().get(state.slice_name, []))
+                if has_sid and placed.requests.get(meshstate.GangSliceIdKey) != sid:
+                    # a mate of a DIFFERENT sub-gang pins its own slice:
+                    # not this pod's home, but ground this pod must avoid
+                    other_slices |= members
+                    continue
+                return lambda n, m=members: n in m
+        if other_slices:
+            # This pod's whole sub-gang is evicted but other sub-gangs are
+            # placed: re-place anywhere EXCEPT their slices — landing there
+            # would put two MEGASCALE "slices" on one physical slice and
+            # silently corrupt the job's DCN topology. The first member to
+            # land re-pins the rest via the same-sid branch above.
+            return lambda n, m=other_slices: n not in m
         return None
 
     def _tpu_slices(self) -> Dict[str, List[str]]:
@@ -1047,17 +1182,29 @@ class Cluster:
                         coords.append(state.chip_coord[local])
         return state.topo, sorted(coords)
 
-    def gang_contiguity(self, pods: Sequence[PodInfo]) -> float:
-        """ICI-contiguity of the union of a placed gang's chips in the global
-        slice frame — the BASELINE 'ICI-contiguity score' metric."""
-        coords = []
-        topo = None
+    def gang_slice_contiguity(self, pods: Sequence[PodInfo]) -> Dict[str, float]:
+        """Per-slice ICI-contiguity of a placed gang's chips: slice name ->
+        contiguity of the members placed on that slice. Coordinates are
+        only comparable WITHIN a slice (cross-slice hops are DCN, not ICI),
+        so a multislice gang is scored slice by slice."""
+        per: Dict[str, Tuple[TpuTopology, list]] = {}
         for pod in pods:
             pod_topo, pod_coords = self.pod_chip_coords(pod)
-            if pod_topo is None:
+            if pod_topo is None or not pod_coords:
                 continue
-            topo = pod_topo
-            coords.extend(pod_coords)
-        if topo is None or not coords:
+            state = meshstate.parse_mesh_state(
+                self.nodes[pod.node_name].info.capacity
+            )
+            key = state.slice_name if state is not None else pod_topo.name
+            per.setdefault(key, (pod_topo, []))[1].extend(pod_coords)
+        return {s: contiguity_score(c, t) for s, (t, c) in sorted(per.items())}
+
+    def gang_contiguity(self, pods: Sequence[PodInfo]) -> float:
+        """ICI-contiguity of a placed gang — the BASELINE 'ICI-contiguity
+        score' metric. For a multislice gang this is the MINIMUM per-slice
+        score (the weakest sub-gang bounds the job's collective locality);
+        for the single-slice case it is exactly the whole-gang score."""
+        per = self.gang_slice_contiguity(pods)
+        if not per:
             return 0.0
-        return contiguity_score(coords, topo)
+        return min(per.values())

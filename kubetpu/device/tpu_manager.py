@@ -34,7 +34,11 @@ from kubetpu.device.tpu_plugin import TpuPlugin
 from kubetpu.plugintypes import ResourceTPU
 from kubetpu.plugintypes.mesh import TOPOLOGIES, TpuTopology
 from kubetpu.scheduler.deviceclass import TPU
-from kubetpu.scheduler.meshstate import slice_resource_key
+from kubetpu.scheduler.meshstate import (
+    GangSliceIdKey,
+    GangSlicesKey,
+    slice_resource_key,
+)
 
 # Probe refresh period (reference nvmlLastGetTime 5-minute cache, :110-121).
 PROBE_CACHE_SECONDS = 5 * 60.0
@@ -206,6 +210,16 @@ class TpuDevManager(Device):
                 "TPU_WORKER_ID": str(self.host_index),
             }
             env.update(self._bounds_env(indices))
+            # Multislice gang members (stamped by schedule_gang's multislice
+            # path) get the libtpu/megascale identity: how many slices the
+            # job spans and which one this pod's chips live in. The
+            # coordinator address is a launch-layer concern (jobs.launch
+            # wires jax.distributed), not a per-chip allocation fact.
+            if GangSlicesKey in pod.requests:
+                env["MEGASCALE_NUM_SLICES"] = str(pod.requests[GangSlicesKey])
+                env["MEGASCALE_SLICE_ID"] = str(
+                    pod.requests.get(GangSliceIdKey, 0)
+                )
             return [], devices, env
 
     def _bounds_env(self, indices: List[int]) -> Dict[str, str]:

@@ -2,7 +2,13 @@
 run sharded training on it (dp x sp x tp, ring attention for long context).
 The demonstration workload the scheduler arranges hardware for."""
 
-from kubetpu.jobs.meshjob import factor_axes, make_mesh, mesh_from_allocation
+from kubetpu.jobs.meshjob import (
+    factor_axes,
+    make_mesh,
+    make_multislice_mesh,
+    mesh_from_allocation,
+    slice_groups,
+)
 from kubetpu.jobs.model import ModelConfig, forward, init_params, next_token_loss
 from kubetpu.jobs.ring_attention import make_ring_attention
 from kubetpu.jobs.train import TrainState, init_state, make_eval_step, make_train_step
@@ -10,7 +16,9 @@ from kubetpu.jobs.train import TrainState, init_state, make_eval_step, make_trai
 __all__ = [
     "factor_axes",
     "make_mesh",
+    "make_multislice_mesh",
     "mesh_from_allocation",
+    "slice_groups",
     "ModelConfig",
     "forward",
     "init_params",

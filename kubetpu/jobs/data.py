@@ -53,6 +53,7 @@ def pack_documents(
     eos_id: int,
     mode: str = "stream",
     pad_id: int = 0,
+    isolate_documents: bool = False,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Pack variable-length token documents into fixed (B, S) training
     batches — yields (tokens, targets, weights), all (B, S), weights f32.
@@ -73,13 +74,27 @@ def pack_documents(
       ``make_train_step(weighted=True)``). Documents longer than seq+1
       are split anyway (they cannot fit whole by definition).
 
+    Isolation caveat (both packing modes): a row holding several documents
+    gives the model CROSS-DOCUMENT attention (no block-diagonal mask — the
+    EOS delimiter is the only separation signal, the standard pretraining
+    trade), and by default the EOS -> next-document-first-token transition
+    trains at weight 1. ``isolate_documents=True`` zeros the weight on
+    those cross-document transitions in greedy mode, so no position's loss
+    asks the model to predict an unrelated document's opening token;
+    attention still crosses documents within the row.
+
     ``weights.mean()`` IS the packing efficiency — worth logging.
     """
     if mode not in ("stream", "greedy"):
         raise ValueError(f"mode must be 'stream' or 'greedy', got {mode!r}")
+    if isolate_documents and mode != "greedy":
+        # stream mode chops a continuous token stream — document boundaries
+        # deliberately vanish into it, so "isolation" cannot be honored;
+        # refusing beats silently ignoring the caller's request
+        raise ValueError("isolate_documents requires mode='greedy'")
     window = seq + 1
 
-    def flush(rows):
+    def flush(rows, bounds=None):
         tokens = np.full((batch, seq), pad_id, np.int32)
         targets = np.full((batch, seq), pad_id, np.int32)
         weights = np.zeros((batch, seq), np.float32)
@@ -91,6 +106,15 @@ def pack_documents(
             tokens[i, : m - 1] = arr[:-1]
             targets[i, : m - 1] = arr[1:]
             weights[i, : m - 1] = 1.0
+            if bounds is not None:
+                # zero the cross-document transitions: position cum-1
+                # trains "last token of piece k -> first token of piece
+                # k+1", an unlearnable target (isolate_documents)
+                cum = 0
+                for plen in bounds[i][:-1]:
+                    cum += plen
+                    if cum - 1 < seq:
+                        weights[i, cum - 1] = 0.0
         return tokens, targets, weights
 
     if mode == "stream":
@@ -112,6 +136,8 @@ def pack_documents(
         return  # tail (partial window / partial batch) is dropped
 
     rows = [[] for _ in range(batch)]
+    bounds = [[] for _ in range(batch)]  # per-row piece lengths
+    iso = bounds if isolate_documents else None
     for doc in docs:
         pieces = [list(map(int, doc)) + [eos_id]]
         if len(pieces[0]) > window:  # cannot fit whole anywhere
@@ -126,17 +152,21 @@ def pack_documents(
             ]
         for piece in pieces:
             placed = False
-            for row in rows:
+            for row, b in zip(rows, bounds):
                 if len(row) + len(piece) <= window:
                     row.extend(piece)
+                    b.append(len(piece))
                     placed = True
                     break
             if not placed:
-                yield flush(rows)
+                yield flush(rows, iso)
                 rows = [[] for _ in range(batch)]
+                bounds = [[] for _ in range(batch)]
+                iso = bounds if isolate_documents else None
                 rows[0].extend(piece)
+                bounds[0].append(len(piece))
     if any(rows):
-        yield flush(rows)
+        yield flush(rows, iso)
 
 
 def prefetch_to_mesh(
@@ -146,7 +176,7 @@ def prefetch_to_mesh(
     steps ahead (double buffering by default). Batches are tuples of any
     arity with the (B, S) layout — (tokens, targets) from the plain
     corpus, (tokens, targets, weights) from ``pack_documents``."""
-    sharding = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+    sharding = NamedSharding(mesh, _filter_spec(mesh, batch_spec(mesh)))
     queue: collections.deque = collections.deque()
 
     def put(batch):

@@ -86,6 +86,17 @@ def config_from_hf(hf_config, **overrides) -> ModelConfig:
             f"converted logits will differ at the ~{eps:g} level",
             stacklevel=2,
         )
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    if explicit_hd is not None and int(explicit_hd) != derived_hd:
+        # same refusal contract as rope_scaling/bias above: kubetpu derives
+        # head_dim as hidden/heads, so a checkpoint with a decoupled
+        # head_dim would hit a confusing reshape error deep in the mapping
+        raise ValueError(
+            f"head_dim={explicit_hd} != hidden_size/num_attention_heads="
+            f"{derived_hd}: kubetpu's blocks derive head_dim, so this "
+            f"checkpoint cannot be mapped faithfully"
+        )
     kw = dict(
         vocab=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

@@ -232,7 +232,7 @@ def make_lora_train_step(
     # the first batch element (constant wrt grad, never donated/reshaped)
     inner = make_update_step(loss_fn, optimizer, skip_nonfinite=skip_nonfinite)
 
-    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec(mesh)))
     return jax.jit(
         inner,
         in_shardings=(None, None, bspec, bspec),

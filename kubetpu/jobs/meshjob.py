@@ -54,6 +54,71 @@ def make_mesh(
     return Mesh(np.array(devs[:n]).reshape(shape), names)
 
 
+def slice_groups(devices: Sequence) -> List[List]:
+    """Group devices by the physical slice they belong to: by the runtime's
+    ``device.slice_index`` when exposed (real TPU multislice), else one
+    group (single slice / CPU). Groups are ordered by slice index, devices
+    by id within each — the deterministic frame both the scheduler's
+    slice-id stamps and ``make_multislice_mesh`` rely on."""
+    by_slice: Dict[int, List] = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    return [
+        sorted(by_slice[s], key=lambda d: getattr(d, "id", 0))
+        for s in sorted(by_slice)
+    ]
+
+
+def make_multislice_mesh(
+    axis_sizes: Dict[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    """Mesh whose OUTERMOST axis (``dcn``) spans physical slices: only that
+    axis's collectives cross the data-center network; every inner (ICI)
+    axis stays within one slice. ``axis_sizes`` must contain ``"dcn"`` —
+    its size is the number of slices — plus the usual ICI axes; the mesh
+    axis order is forced to dcn-first regardless of dict order, which is
+    what makes the placement claim true (jax lays devices out row-major,
+    so the leading axis strides across the per-slice groups).
+
+    Devices are grouped by ``slice_index`` when the runtime exposes it
+    (real multislice); a flat device list (CPU validation meshes, the
+    driver's virtual-device dryrun) is split into ``dcn`` equal contiguous
+    chunks — the same worker-id-major order the scheduler's sub-gangs
+    export."""
+    if "dcn" not in axis_sizes:
+        raise ValueError("make_multislice_mesh needs a 'dcn' axis (n_slices)")
+    n_slices = axis_sizes["dcn"]
+    inner = {a: s for a, s in axis_sizes.items() if a != "dcn"}
+    per_slice = int(np.prod(list(inner.values()))) if inner else 1
+    devs = list(devices) if devices is not None else jax.devices()
+    groups = slice_groups(devs)
+    if len(groups) == 1 and n_slices > 1:
+        # flat list: split into contiguous chunks of per_slice devices
+        flat = groups[0]
+        if len(flat) < n_slices * per_slice:
+            raise ValueError(
+                f"need {n_slices * per_slice} devices for mesh {axis_sizes}, "
+                f"have {len(flat)}"
+            )
+        groups = [
+            flat[i * per_slice : (i + 1) * per_slice] for i in range(n_slices)
+        ]
+    if len(groups) < n_slices:
+        raise ValueError(
+            f"mesh wants dcn={n_slices} slices but devices span only "
+            f"{len(groups)}"
+        )
+    for g in groups[:n_slices]:
+        if len(g) < per_slice:
+            raise ValueError(
+                f"slice group has {len(g)} devices, inner axes need {per_slice}"
+            )
+    arr = np.array([g[:per_slice] for g in groups[:n_slices]])
+    names = ("dcn",) + tuple(inner)
+    shape = (n_slices,) + tuple(inner.values())
+    return Mesh(arr.reshape(shape), names)
+
+
 def mesh_from_allocation(
     coords: Sequence[Coord],
     axis_sizes: Optional[Dict[str, int]] = None,

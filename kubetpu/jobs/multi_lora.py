@@ -78,8 +78,16 @@ class MultiLoraDecodeServer(DecodeServer):
         self.lora_stack = lora_stack
         self._rid_adapter: dict = {}
         self._submit_adapter: Optional[int] = None
+        # before super().__init__: the _admit_lora/_step_lora hooks it may
+        # exercise during construction read this array (ADVICE r4). n_slots
+        # rides kw (this signature has no positional for it).
+        from kubetpu.jobs.serving import DEFAULT_N_SLOTS
+
+        self._slot_adapter = np.zeros(
+            (kw.get("n_slots", DEFAULT_N_SLOTS),), np.int32
+        )
         super().__init__(cfg, params, **kw)
-        self._slot_adapter = np.zeros((self.n_slots,), np.int32)
+        assert self._slot_adapter.shape == (self.n_slots,)
 
     # -- request surface ------------------------------------------------------
 

@@ -195,7 +195,7 @@ def make_pipeline_train_step(
         x = fwd.hidden(params, tokens)
         return model_lib.lm_loss_tail(x, params["head"], targets, cfg)
 
-    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec(mesh)))
     from kubetpu.jobs.train import make_update_step
 
     return jax.jit(make_update_step(loss_fn, optimizer),

@@ -429,6 +429,12 @@ class SlotServerBase:
         raise RuntimeError("drain did not converge")
 
 
+# Default slot count for DecodeServer and its subclasses; subclasses that
+# size per-slot state BEFORE super().__init__ (MultiLoraDecodeServer's
+# adapter-id array) must read this, not repeat the literal.
+DEFAULT_N_SLOTS = 8
+
+
 class DecodeServer(SlotServerBase):
     """Slot-based continuous batching over one model replica (dense cache).
 
@@ -444,7 +450,7 @@ class DecodeServer(SlotServerBase):
         self,
         cfg: ModelConfig,
         params: Params,
-        n_slots: int = 8,
+        n_slots: int = DEFAULT_N_SLOTS,
         max_seq: int = 512,
         max_new_tokens: int = 64,
         eos_id: Optional[int] = None,

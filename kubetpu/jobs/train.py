@@ -65,8 +65,15 @@ def param_specs(cfg: ModelConfig, pp: bool = False) -> Params:
     }
 
 
-def batch_spec() -> P:
-    """(B, S) tokens: batch on dp, sequence on sp."""
+def batch_spec(mesh: Optional[Mesh] = None) -> P:
+    """(B, S) tokens: batch on the data axes, sequence on sp. On a
+    multislice mesh (a ``dcn`` axis — slices joined over the data-center
+    network) the batch shards over BOTH dcn and dp: params carry no dcn
+    axis (replicated per slice), so the only DCN traffic XLA emits is the
+    gradient all-reduce — data parallelism between slices, ICI parallelism
+    within, the standard multislice recipe."""
+    if mesh is not None and "dcn" in mesh.axis_names:
+        return P(("dcn", "dp"), "sp")
     return P("dp", "sp")
 
 
@@ -109,9 +116,18 @@ def make_optimizer(
 
 def _filter_spec(mesh: Mesh, spec: P) -> P:
     """Drop axis names the mesh doesn't have (a dp x sp x tp mesh simply
-    replicates the ep/pp dimensions), so one spec table serves any mesh."""
+    replicates the ep/pp dimensions), so one spec table serves any mesh.
+    Tuple entries (one array dim sharded over several mesh axes, e.g. the
+    multislice batch ``("dcn", "dp")``) filter element-wise."""
     names = set(mesh.axis_names)
-    return P(*((a if a in names else None) for a in spec))
+
+    def keep(a):
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in names)
+            return kept if kept else None
+        return a if a in names else None
+
+    return P(*(keep(a) for a in spec))
 
 
 def _shardings(mesh: Mesh, tree):
@@ -312,9 +328,11 @@ def make_train_step(
 
     chunk_constraint = None
     if accum_steps > 1:
+        batch_axes = batch_spec(mesh)[0]  # "dp" or ("dcn", "dp")
+
         def chunk_constraint(x):
-            # (accum, micro-B, S): batch on dp, seq on sp, per leaf rank
-            spec = P(*([None, "dp", "sp"][: x.ndim]))
+            # (accum, micro-B, S): batch on the data axes, seq on sp
+            spec = P(*([None, batch_axes, "sp"][: x.ndim]))
             return jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, _filter_spec(mesh, spec))
             )
@@ -324,7 +342,7 @@ def make_train_step(
                             skip_nonfinite=skip_nonfinite)
     if not jit:
         return step
-    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec(mesh)))
     n_batch = 3 if weighted else 2
     return jax.jit(
         step,
@@ -335,7 +353,7 @@ def make_train_step(
 
 def make_eval_step(cfg: ModelConfig, mesh: Mesh, use_ring: bool = True):
     attn_fn = make_ring_attention(mesh) if use_ring else None
-    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec()))
+    bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec(mesh)))
 
     def eval_step(params, tokens, targets):
         return model_lib.next_token_loss(params, tokens, targets, cfg, attn_fn)

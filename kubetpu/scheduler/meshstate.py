@@ -37,6 +37,23 @@ CHIP_CARDS_RE = re.compile(r".*/tpu/(\d+)/cards$")
 
 DEFAULT_SLICE_UID = "slice0"
 
+# Multislice gang pseudo-resources. They ride pod Requests untouched (the
+# resource-list-as-config channel, SURVEY.md §5.6) and are defined here —
+# not in core.cluster — because both sides of the exec/wire boundary need
+# them: the scheduler stamps them at gang placement, the device manager
+# reads them at Allocate to emit the libtpu multislice env
+# (MEGASCALE_NUM_SLICES / MEGASCALE_SLICE_ID).
+#
+# - MultisliceKey (input knob): max number of physical slices the gang MAY
+#   span; absent/0/1 keeps the single-slice invariant (the default — chips
+#   in different slices are DCN, not ICI).
+# - GangSlicesKey / GangSliceIdKey (placement artifacts): stamped by
+#   schedule_gang on the members of a multislice placement — how many
+#   slices the gang actually spans and which sub-gang this pod belongs to.
+MultisliceKey = "kubetpu/multislice"
+GangSlicesKey = "kubetpu/gang-slices"
+GangSliceIdKey = "kubetpu/gang-slice-id"
+
 
 def slice_resource_key(
     topology_name: str, host_index: int, slice_uid: str = DEFAULT_SLICE_UID

@@ -69,6 +69,7 @@ class ControllerServer:
         poll_interval: float = 5.0,
         token: Optional[str] = None,
         reserve_after: int = 3,
+        reserve_hold: int = 10,
     ) -> None:
         self.cluster = cluster or Cluster()
         self.poll_interval = poll_interval
@@ -83,7 +84,7 @@ class ControllerServer:
         # (the gang is likely infeasible right now — e.g. sized for a node
         # that left): its aging restarts, blocked work flows again, and it
         # re-reserves if it keeps waiting. 0 = hold forever.
-        self.reserve_hold = 10
+        self.reserve_hold = reserve_hold
         self._reserve_held: Dict[int, int] = {}  # gang id -> passes held
         self._lock = threading.Lock()
         self._node_urls: Dict[str, str] = {}

@@ -431,3 +431,167 @@ def test_defrag_ignores_cordoned_nodes():
         assert all(m.to_node != "n0" for m in plan)
     c.cordon("n0", on=False)
     assert c.defrag_plan(4) == []  # uncordoned pristine node fits plainly
+
+
+# -- multislice gangs (DCN-spanning, opt-in) --------------------------------
+
+
+def two_slice_cluster(hosts_per_slice=4):
+    """Two distinct v5e-64 slices (podA/podB), *hosts_per_slice* hosts each."""
+    cluster = Cluster()
+    for uid, prefix in (("podA", "a"), ("podB", "b")):
+        for h in range(hosts_per_slice):
+            cluster.register_node(
+                f"{prefix}{h}",
+                device=new_fake_tpu_dev_manager(
+                    make_fake_tpus_info("v5e-64", host_index=h, slice_uid=uid)
+                ),
+            )
+    return cluster
+
+
+def multislice_pod(name, chips, k=2):
+    from kubetpu.scheduler.meshstate import MultisliceKey
+
+    return tpu_pod(name, chips, **{MultisliceKey: k})
+
+
+def test_multislice_gang_spans_two_slices():
+    """A 64-chip gang over two 32-chip slice remnants: with the multislice
+    knob it places 4+4 pods, per-slice contiguity 1.0, and every member is
+    stamped with its slice membership."""
+    from kubetpu.scheduler.meshstate import GangSliceIdKey, GangSlicesKey
+
+    cluster = two_slice_cluster()
+    placed = cluster.schedule_gang(
+        [multislice_pod(f"w{i}", 8) for i in range(8)]
+    )
+    assert len(placed) == 8
+    per = cluster.gang_slice_contiguity(placed)
+    assert len(per) == 2
+    assert all(v == 1.0 for v in per.values())
+    assert cluster.gang_contiguity(placed) == 1.0
+    by_sid = {}
+    for p in placed:
+        assert p.requests[GangSlicesKey] == 2
+        by_sid.setdefault(p.requests[GangSliceIdKey], set()).add(
+            p.node_name[0]
+        )
+    # each sub-gang confined to exactly one slice
+    assert sorted(by_sid) == [0, 1]
+    assert all(len(prefixes) == 1 for prefixes in by_sid.values())
+    # allocate exports the libtpu multislice identity
+    for p in placed:
+        _, _, env = cluster.allocate(p.name)["main"]
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == str(p.requests[GangSliceIdKey])
+
+
+def test_multislice_prefers_single_slice_when_it_fits():
+    """The knob is an escape hatch, not a preference: a gang that fits one
+    slice stays there (no DCN hop, no membership stamps)."""
+    from kubetpu.scheduler.meshstate import GangSlicesKey
+
+    cluster = two_slice_cluster()
+    placed = cluster.schedule_gang(
+        [multislice_pod(f"w{i}", 8) for i in range(4)]
+    )
+    assert len({p.node_name[0] for p in placed}) == 1
+    assert all(GangSlicesKey not in p.requests for p in placed)
+
+
+def test_multislice_respects_max_slices_and_rolls_back():
+    """k=2 cannot make 3 slices' worth of chips appear: all-or-nothing
+    failure leaves zero residue."""
+    cluster = two_slice_cluster()
+    with pytest.raises(SchedulingError):
+        cluster.schedule_gang([multislice_pod(f"w{i}", 8) for i in range(9)])
+    for node in cluster.nodes.values():
+        assert node.info.allocatable[ResourceTPU] == 8
+        assert not node.pods
+
+
+def test_multislice_knob_value_one_keeps_single_slice_guard():
+    cluster = two_slice_cluster()
+    with pytest.raises(SchedulingError):
+        cluster.schedule_gang(
+            [multislice_pod(f"w{i}", 8, k=1) for i in range(8)]
+        )
+    for node in cluster.nodes.values():
+        assert not node.pods
+
+
+def test_multislice_replacement_pins_own_subgang_slice():
+    """An evicted multislice member re-places only within ITS sub-gang's
+    slice — rejoining the other sub-gang's slice would silently change the
+    job's DCN topology."""
+    cluster = two_slice_cluster()
+    placed = cluster.schedule_gang(
+        [multislice_pod(f"w{i}", 8) for i in range(8)]
+    )
+    victim = placed[-1]
+    home = victim.node_name[0]  # 'a' or 'b'
+    cluster.release(victim.name)
+    filt = cluster.gang_slice_filter(victim)
+    assert filt is not None
+    for node in cluster.nodes:
+        assert filt(node) == (node[0] == home)
+    # and the re-place through the filter lands back on the home slice
+    replaced = cluster.schedule(victim.copy(), filt)
+    assert replaced.node_name[0] == home
+
+
+def test_multislice_subgangs_are_equal_sized():
+    """The dcn mesh axis needs the same device count per slice: with
+    unequal slice headroom (5 free hosts vs 7) a 10-pod gang must still
+    split 5+5, not 7+3 — and an odd gang that cannot split equally at
+    k=2 refuses rather than placing a mesh-incompatible gang."""
+    cluster = two_slice_cluster(hosts_per_slice=7)
+    # shrink podA's headroom to 5 hosts
+    for h in (5, 6):
+        cluster.schedule(
+            tpu_pod(f"hold{h}", 8), lambda n, t=f"a{h}": n == t
+        )
+    placed = cluster.schedule_gang(
+        [multislice_pod(f"w{i}", 8) for i in range(10)]
+    )
+    from kubetpu.scheduler.meshstate import GangSliceIdKey
+
+    sizes = {}
+    for p in placed:
+        sizes[p.requests[GangSliceIdKey]] = sizes.get(
+            p.requests[GangSliceIdKey], 0) + 1
+    assert sorted(sizes.values()) == [5, 5]
+    for p in placed:
+        cluster.release(p.name)
+    # 9 pods: k=2 does not divide, max_slices=2 -> refuse, no residue
+    with pytest.raises(SchedulingError):
+        cluster.schedule_gang([multislice_pod(f"x{i}", 8) for i in range(9)])
+    assert all(
+        not node.pods or all(p.startswith("hold") for p in node.pods)
+        for node in cluster.nodes.values()
+    )
+
+
+def test_multislice_evicted_subgang_avoids_other_subgang_slices():
+    """When a WHOLE sub-gang is evicted, its members re-place anywhere
+    EXCEPT the slices of still-placed sub-gangs — landing there would put
+    two MEGASCALE "slices" on one physical slice."""
+    cluster = two_slice_cluster()
+    placed = cluster.schedule_gang(
+        [multislice_pod(f"w{i}", 8) for i in range(8)]
+    )
+    # evict one complete sub-gang
+    from kubetpu.scheduler.meshstate import GangSliceIdKey
+
+    sub1 = [p for p in placed if p.requests[GangSliceIdKey] == 1]
+    survivor_prefix = next(
+        p.node_name[0] for p in placed if p.requests[GangSliceIdKey] == 0
+    )
+    for p in sub1:
+        cluster.release(p.name)
+    filt = cluster.gang_slice_filter(sub1[0])
+    assert filt is not None
+    for node in cluster.nodes:
+        # allowed anywhere but the surviving sub-gang's slice
+        assert filt(node) == (node[0] != survivor_prefix)

@@ -836,3 +836,49 @@ def test_pipeline_honors_window_or_refuses_ring():
     want = forward(params, tokens, cfg)  # default attn honors the window
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+# -- multislice (dcn axis) ---------------------------------------------------
+
+
+def test_make_multislice_mesh_dcn_outermost():
+    from kubetpu.jobs import make_multislice_mesh
+
+    mesh = make_multislice_mesh({"dcn": 2, "dp": 1, "sp": 2, "tp": 2})
+    assert mesh.axis_names[0] == "dcn"
+    assert mesh.shape == {"dcn": 2, "dp": 1, "sp": 2, "tp": 2}
+    # dcn strides across the per-slice device groups: slice 0 devices
+    # all precede slice 1 devices in the flat (virtual) ordering
+    devs = np.asarray(mesh.devices)
+    ids0 = {d.id for d in devs[0].flat}
+    ids1 = {d.id for d in devs[1].flat}
+    assert max(ids0) < min(ids1)
+    with pytest.raises(ValueError):
+        make_multislice_mesh({"dp": 2, "tp": 2})  # no dcn axis
+    with pytest.raises(ValueError):
+        make_multislice_mesh({"dcn": 4, "tp": 4})  # 16 > 8 devices
+
+
+def test_multislice_train_step_matches_single_slice_dp():
+    """{dcn:2, dp:1, sp:2, tp:2} training must be numerically the same
+    computation as {dp:2, sp:2, tp:2}: dcn and dp are both pure data axes
+    (params replicated over dcn; the only DCN collective is the gradient
+    all-reduce)."""
+    from kubetpu.jobs import make_multislice_mesh
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, CFG.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    def run(mesh):
+        state, opt = init_state(jax.random.PRNGKey(0), CFG, mesh)
+        step = make_train_step(CFG, mesh, optimizer=opt)
+        losses = []
+        for _ in range(3):
+            state, loss = step(state, tokens, targets)
+            losses.append(float(loss))
+        return losses
+
+    ms = run(make_multislice_mesh({"dcn": 2, "dp": 1, "sp": 2, "tp": 2}))
+    ref = run(make_mesh({"dp": 2, "sp": 2, "tp": 2}))
+    np.testing.assert_allclose(ms, ref, rtol=1e-5)
+    assert ms[-1] < ms[0]

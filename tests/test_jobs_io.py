@@ -337,3 +337,32 @@ def test_weighted_train_step_ignores_pad():
         state, loss = step(state, tokens, targets, weights)
         losses.append(float(loss))
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_pack_greedy_isolate_documents_zeros_cross_doc_transitions():
+    """isolate_documents=True: every EOS -> next-document-first-token
+    transition carries weight 0 (no position trains on predicting an
+    unrelated document's opening token); all other packed positions keep
+    weight 1 and the document decomposition is unchanged."""
+    from kubetpu.jobs.data import pack_documents
+
+    EOS = 0
+    lens = [5, 9, 3, 12, 7]
+    docs = [list(d) for d in _docs(25, lens)]
+    iso = list(pack_documents(iter(docs), batch=3, seq=20, eos_id=EOS,
+                              mode="greedy", isolate_documents=True))
+    ref = list(pack_documents(iter([list(d) for d in docs]), batch=3,
+                              seq=20, eos_id=EOS, mode="greedy"))
+    assert len(iso) == len(ref)
+    for (t1, g1, w1), (t2, g2, w2) in zip(iso, ref):
+        np.testing.assert_array_equal(t1, t2)
+        np.testing.assert_array_equal(g1, g2)
+        # the zeroed positions are EXACTLY the cross-document transitions:
+        # tokens==EOS (a document just ended) with a real packed target
+        diff = (w2 == 1.0) & (w1 == 0.0)
+        expect = (t2 == EOS) & (w2 == 1.0)
+        # ...except a row's FINAL document's EOS, whose target is pad/next
+        # nothing — that position was already weight-0 in both
+        np.testing.assert_array_equal(diff, expect)
+        # everything else untouched
+        np.testing.assert_array_equal(w1[~expect], w2[~expect])
