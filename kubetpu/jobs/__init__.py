@@ -37,5 +37,6 @@ __all__ = [
 #   kubetpu.jobs.encoder (bidirectional masked-LM family),
 #   kubetpu.jobs.vision (ViT classification family),
 #   kubetpu.jobs.checkpoint (orbax), kubetpu.jobs.data,
+#   kubetpu.jobs.tokenizer (HF tokenizer.json byte-level BPE loader),
 #   kubetpu.jobs.native_data (C++ mmap corpus loader),
 #   kubetpu.jobs.launch (jax.distributed wiring)

@@ -216,7 +216,10 @@ class ByteTokenizer:
     every possible input is in-distribution — the TPU-friendly baseline
     tokenizer (fixed small vocab keeps the embedding/head matmuls modest;
     models that need subwords plug their own encode/decode in, the train
-    loop only sees int32 arrays)."""
+    loop only sees int32 arrays). For imported HF checkpoints use the
+    real subword tokenizer: ``kubetpu.jobs.tokenizer.load_hf_tokenizer``
+    (byte-level BPE from ``tokenizer.json``, same encode/decode/
+    encode_file surface)."""
 
     BOS = 256
     EOS = 257

@@ -1,0 +1,90 @@
+"""Generate tests/fixtures/tiny_tokenizer.json + tiny_tokenizer_vectors.json.
+
+Run once (committed outputs are the source of truth for CI): trains a tiny
+byte-level BPE with the llama-3 pretokenizer layout via the Rust
+``tokenizers`` package, then records encode vectors for a battery of
+tricky strings. ``tests/test_tokenizer.py`` pins kubetpu's pure-Python
+loader against these vectors WITHOUT needing ``tokenizers`` at test time
+(and additionally cross-checks live when the package is present).
+"""
+
+import json
+import os
+import random
+
+from tokenizers import Regex, Tokenizer, decoders, models, pre_tokenizers, trainers
+
+# the llama-3 tiktoken-style pattern (meta-llama/Meta-Llama-3-8B tokenizer.json)
+LLAMA3_PATTERN = (
+    r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}|"
+    r" ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"
+)
+
+STRINGS = [
+    "Hello, world!",
+    "  leading and trailing  ",
+    "The 1234 quick 56789 brown foxes' tails; they're odd.",
+    "tabs\tand\nnewlines\r\n\r\nmixed   runs",
+    "emoji \U0001f680\U0001f9e0 and accents: café naïve über",
+    "CJK: 今日は世界 你好吗",
+    "mixed1234numbers99and100words",
+    "I'll I'd I've it's we're you'll THEY'RE",
+    "punct!!! ??? ... ---- ###(nested [brackets] {braces})",
+    " nbsp and zero​width",
+    "",
+    " ",
+    "\n\n\n",
+    "a",
+    "<|begin_of_text|>framed<|end_of_text|>",
+]
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    fixdir = os.path.join(here, "..", "tests", "fixtures")
+    os.makedirs(fixdir, exist_ok=True)
+
+    tok = Tokenizer(models.BPE(ignore_merges=True))
+    tok.pre_tokenizer = pre_tokenizers.Sequence(
+        [
+            pre_tokenizers.Split(
+                pattern=Regex(LLAMA3_PATTERN), behavior="isolated", invert=False
+            ),
+            pre_tokenizers.ByteLevel(add_prefix_space=False, use_regex=False),
+        ]
+    )
+    tok.decoder = decoders.ByteLevel()
+
+    rng = random.Random(0)
+    words = [
+        "the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog",
+        "tpu", "mesh", "slice", "kernel", "attention", "token", "batch",
+        "1234", "42", "café", "über", "naïve", "hello", "world",
+    ]
+    corpus = [
+        " ".join(rng.choice(words) for _ in range(rng.randint(3, 12)))
+        + rng.choice([".", "!", "?", "...", "\n"])
+        for _ in range(4000
+        )
+    ]
+    trainer = trainers.BpeTrainer(
+        vocab_size=600,
+        special_tokens=["<|begin_of_text|>", "<|end_of_text|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(corpus, trainer)
+    path = os.path.join(fixdir, "tiny_tokenizer.json")
+    tok.save(path, pretty=True)
+
+    vectors = {}
+    for s in STRINGS:
+        vectors[s] = tok.encode(s).ids
+    with open(os.path.join(fixdir, "tiny_tokenizer_vectors.json"), "w") as f:
+        json.dump(vectors, f, ensure_ascii=True, indent=1)
+    print(f"wrote {path} (vocab {tok.get_vocab_size()}) + "
+          f"{len(vectors)} vectors")
+
+
+if __name__ == "__main__":
+    main()
