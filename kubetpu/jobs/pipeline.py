@@ -78,18 +78,14 @@ def make_pipeline_forward(
     no nested shard_map); ``interpret=True`` for CPU tests of it.
     """
     axis_name, sp_axis = "pp", "sp"
-    if cfg.window > 0 and use_ring:
-        raise ValueError(
-            "the pipeline's ring attention does not compose with "
-            "cfg.window; build with use_ring=False (the dense core then "
-            "honors the window)"
-        )
     manual_axes = {axis_name} | ({sp_axis} if use_ring else set())
     seq_spec = sp_axis if use_ring else None
     # built (and impl-validated) eagerly — even when use_ring is False, so
     # a typo'd ring_impl raises here, not when the caller later flips
-    # use_ring on; binds the sp axis only when traced
-    ring_local = make_ring_local(ring_impl, sp_axis, block_q, block_k, interpret)
+    # use_ring on; binds the sp axis only when traced. cfg.window selects
+    # the banded ring (window x sp compose via one boundary ppermute).
+    ring_local = make_ring_local(ring_impl, sp_axis, block_q, block_k,
+                                 interpret, window=cfg.window)
     attn = ring_local if use_ring else model_lib.default_attn_fn(cfg)
 
     def region(blocks, h_stack, positions):

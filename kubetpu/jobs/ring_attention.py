@@ -93,6 +93,65 @@ def _ring_attention_local(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, S_local, H, D)
 
 
+def _ring_banded_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    window: int,
+) -> jnp.ndarray:
+    """Banded (sliding-window) sequence-parallel attention — the ring x
+    window composition. With ``window <= S_local`` every query's band
+    (the previous ``window`` positions including itself, the repo-wide
+    convention) lies inside its OWN block plus the last ``window - 1``
+    keys of the LEFT neighbor's block, so the full ``sp_size``-step ring
+    rotation degenerates to ONE ``ppermute`` of that boundary tail: long-
+    document training gets sequence parallelism AND O(window) attention
+    in the same step. Device 0's incoming (wrapped) tail carries the
+    sequence END's keys — masked out by global position, not by a branch
+    (uniform SPMD steps).
+
+    Exact banded softmax in f32 (stable max-subtraction); gradients flow
+    through ``ppermute``'s transpose (the reverse hop) — no custom VJP
+    needed at one step. Memory: O(S_local * (S_local + window)) scores —
+    the band is materialized per block pair, which is fine at the
+    window sizes that make windowed attention worth it."""
+    sp_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    if window > s_local:
+        raise ValueError(
+            f"banded ring needs window <= S/sp ({window} > {s_local}): "
+            f"lower sp, shorten the window, or use the full ring "
+            f"(window=0)"
+        )
+    tail = window - 1  # how far a query reaches into the left block
+    if tail > 0:
+        perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+        left_k = jax.lax.ppermute(k[:, s_local - tail :], axis_name, perm)
+        left_v = jax.lax.ppermute(v[:, s_local - tail :], axis_name, perm)
+        kk = jnp.concatenate([left_k, k], axis=1)
+        vv = jnp.concatenate([left_v, v], axis=1)
+    else:
+        kk, vv = k, v
+    scale = d ** -0.5
+    q_pos = my_idx * s_local + jnp.arange(s_local)
+    k_pos = my_idx * s_local - tail + jnp.arange(s_local + tail)
+    diff = q_pos[:, None] - k_pos[None, :]
+    # k_pos >= 0 kills device 0's wrapped tail (negative global positions)
+    mask = (diff >= 0) & (diff < window) & (k_pos[None, :] >= 0)
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   kk.astype(jnp.float32)) * scale
+    )
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.where(mask[None, None], jnp.exp(scores - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)  # >= 1 term: self is visible
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / l, vv.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Flash-in-ring: each ring step runs the Pallas flash kernels on the visiting
 # K/V block instead of a dense S_local x S_local softmax — per-step score
@@ -224,13 +283,21 @@ def make_ring_local(
     block_k: int = 128,
     interpret: bool = False,
     causal: bool = True,
+    window: int = 0,
 ):
     """The per-device ring body (q, k, v) -> out, for callers that are
     ALREADY inside a manual region over *axis_name* (e.g. the pipeline's
     {pp, sp} region) — the single place the impl dispatch lives.
-    ``causal=False`` gives the bidirectional (encoder) ring."""
+    ``causal=False`` gives the bidirectional (encoder) ring. ``window``
+    > 0 selects the BANDED ring (one boundary ppermute instead of the
+    full rotation — both impls share it; the band is too narrow for the
+    flash kernels to pay for themselves)."""
     if impl not in ("dense", "flash"):
         raise ValueError(f"unknown ring impl {impl!r} (expected 'dense' or 'flash')")
+    if window > 0:
+        if not causal:
+            raise ValueError("window > 0 requires causal attention")
+        return partial(_ring_banded_local, axis_name=axis_name, window=window)
     if impl == "flash":
         return lambda q, k, v: _ring_flash(
             q, k, v, axis_name, block_q, block_k, interpret, causal
@@ -246,6 +313,7 @@ def make_ring_attention(
     block_k: int = 128,
     interpret: bool = False,
     causal: bool = True,
+    window: int = 0,
 ):
     """An attention core (q, k, v) -> out with the sequence axis sharded over
     *axis_name*, drop-in for ``model.forward``'s ``attn_fn``.
@@ -261,11 +329,12 @@ def make_ring_attention(
     backward). ``interpret=True`` for CPU tests of the flash impl.
     ``causal=False`` is the bidirectional ring for long-context ENCODER
     stacks (and the seq2seq encoder): same rotation, no mask — drop-in for
-    ``encoder_forward``'s ``attn_fn``.
+    ``encoder_forward``'s ``attn_fn``. ``window > 0`` is the banded ring
+    (sliding-window x sequence-parallel; one boundary ppermute).
     """
     specs = P(None, axis_name, None, None)
     local = make_ring_local(impl, axis_name, block_q, block_k, interpret,
-                            causal)
+                            causal, window=window)
     return jax.shard_map(
         lambda q, k, v: local(q, k, v),
         mesh=mesh,

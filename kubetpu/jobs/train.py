@@ -243,14 +243,14 @@ def _resolve_attention(mesh: Mesh, attention: str, window: int = 0):
     VMEM-tiled scores, fused ring backward; append '_interpret' for the CPU
     Pallas interpreter in tests), 'flash' (the Pallas kernel —
     single-sequence-shard paths), or 'dense'. ``window`` (cfg.window) makes
-    the dense and flash cores sliding-window; the ring does not compose
-    with a window (its rotation schedule assumes full causal visibility)."""
+    every core sliding-window; under the rings it selects the BANDED ring
+    (window <= S/sp: one boundary ppermute replaces the full rotation —
+    sequence parallelism and O(window) attention compose)."""
     if attention in ("ring", "ring_flash", "ring_flash_interpret"):
         if window > 0:
-            raise ValueError(
-                "ring attention does not support sliding-window (cfg.window); "
-                "use attention='flash' — O(window) work needs no sp sharding"
-            )
+            # both ring impls share the banded core — the band is too
+            # narrow for per-step flash kernels to pay for themselves
+            return make_ring_attention(mesh, window=window)
         if attention == "ring":
             return make_ring_attention(mesh)
         return make_ring_attention(
@@ -301,20 +301,9 @@ def make_train_step(
     """
     optimizer = optimizer or make_optimizer()
     if attention is None:
-        if use_ring and cfg.window > 0:
-            import warnings
-
-            # not silent: the ring request (the use_ring default) cannot
-            # honor a window; flash is the windowed long-context core
-            warnings.warn(
-                "cfg.window > 0: defaulting to dense attention instead of "
-                "the ring (ring does not compose with a sliding window); "
-                "pass attention='flash' for the O(window) kernel on TPU",
-                stacklevel=2,
-            )
-        attention = (
-            "ring" if use_ring and cfg.window == 0 else "dense"
-        )
+        # use_ring + window composes now: the banded ring (one boundary
+        # ppermute) honors both — no fallback, no warning (round 5)
+        attention = "ring" if use_ring else "dense"
     attn_fn = _resolve_attention(mesh, attention, cfg.window)
 
     if weighted:
@@ -352,7 +341,11 @@ def make_train_step(
 
 
 def make_eval_step(cfg: ModelConfig, mesh: Mesh, use_ring: bool = True):
-    attn_fn = make_ring_attention(mesh) if use_ring else None
+    # same resolution as make_train_step so eval measures the TRAINING
+    # objective — in particular a windowed config evaluates through the
+    # banded ring, not full causal attention (review r5)
+    attn_fn = _resolve_attention(mesh, "ring" if use_ring else "dense",
+                                 cfg.window)
     bspec = NamedSharding(mesh, _filter_spec(mesh, batch_spec(mesh)))
 
     def eval_step(params, tokens, targets):

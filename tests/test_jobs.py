@@ -795,29 +795,80 @@ def test_label_smoothing_and_z_loss_formulas():
         ModelConfig(z_loss=-0.1)
 
 
-def test_windowed_training_learns_and_ring_refuses():
+def test_windowed_training_learns_with_dense_and_banded_ring():
     import dataclasses
 
     cfg = dataclasses.replace(CFG, window=8)
-    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 2})
-    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
-    # attention=None: window forces the dense core (ring would be wrong)
-    step = make_train_step(cfg, mesh, optimizer=opt)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab)
     targets = jnp.roll(tokens, -1, axis=1)
-    losses = []
-    for _ in range(10):
-        state, loss = step(state, tokens, targets)
-        losses.append(float(loss))
-    assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.95
 
-    with pytest.raises(ValueError):
-        make_train_step(cfg, mesh, optimizer=opt, attention="ring")
+    def run(mesh, **kw):
+        state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+        step = make_train_step(cfg, mesh, optimizer=opt, **kw)
+        losses = []
+        for _ in range(10):
+            state, loss = step(state, tokens, targets)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.95
+        return losses
+
+    dense = run(make_mesh({"dp": 2, "sp": 1, "tp": 2}), use_ring=False)
+    # round 5: window x sp compose (banded ring) — same losses as dense
+    banded = run(make_mesh({"dp": 2, "sp": 2, "tp": 2}), attention="ring")
+    np.testing.assert_allclose(banded, dense, rtol=1e-4)
+    # eval measures the SAME banded objective (review r5: it used to build
+    # an unwindowed ring for windowed configs)
+    from kubetpu.jobs import make_eval_step
+
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eval_ring = make_eval_step(cfg, mesh)(params, tokens, targets)
+    eval_dense = make_eval_step(cfg, mesh, use_ring=False)(
+        params, tokens, targets)
+    np.testing.assert_allclose(float(eval_ring), float(eval_dense), rtol=1e-4)
     with pytest.raises(ValueError):
         ModelConfig(window=-1)
 
 
-def test_pipeline_honors_window_or_refuses_ring():
+def test_banded_ring_matches_dense_windowed_fwd_and_grad():
+    """The ring x window composition is EXACT: banded-ring attention out
+    and gradients equal the dense sliding-window reference."""
+    from functools import partial
+
+    from kubetpu.jobs.model import dense_attention
+
+    window = 6
+    mesh = make_mesh({"dp": 2, "sp": 4, "tp": 1})
+    rng = jax.random.PRNGKey(0)
+    b, s, h, d = 2, 32, 4, 8
+    q, k, v = (
+        jax.random.normal(key, (b, s, h, d), jnp.float32)
+        for key in jax.random.split(rng, 3)
+    )
+    banded = make_ring_attention(mesh, window=window)
+    out_ring = jax.jit(banded)(q, k, v)
+    out_dense = dense_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_dense), rtol=2e-4, atol=2e-5
+    )
+
+    def loss(core):
+        return lambda q, k, v: jnp.sum(core(q, k, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss(banded), argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(
+        loss(partial(dense_attention, causal=True, window=window)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_ in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-5)
+    # window wider than the local block: clear refusal at trace time
+    with pytest.raises(ValueError):
+        jax.jit(make_ring_attention(mesh, window=s // 4 + 1))(q, k, v)
+
+
+def test_pipeline_window_with_and_without_ring():
     import dataclasses
 
     from kubetpu.jobs.pipeline import make_pipeline_forward
@@ -825,15 +876,18 @@ def test_pipeline_honors_window_or_refuses_ring():
     cfg = dataclasses.replace(
         ModelConfig(vocab=64, d_model=32, n_layers=4, n_heads=4, d_ff=64),
         window=4)
-    mesh = make_mesh({"dp": 2, "pp": 2, "sp": 2, "tp": 1, "ep": 1})
-    with pytest.raises(ValueError):
-        make_pipeline_forward(cfg, mesh, n_microbatches=4, use_ring=True)
-    mesh2 = make_mesh({"dp": 2, "pp": 2, "sp": 1, "tp": 2, "ep": 1})
-    pf = make_pipeline_forward(cfg, mesh2, n_microbatches=4, use_ring=False)
     params = init_params(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
-    got = jax.jit(pf)(params, tokens)
     want = forward(params, tokens, cfg)  # default attn honors the window
+    # round 5: the pipeline's ring composes with the window (banded ring)
+    mesh = make_mesh({"dp": 2, "pp": 2, "sp": 2, "tp": 1, "ep": 1})
+    pf_ring = make_pipeline_forward(cfg, mesh, n_microbatches=4, use_ring=True)
+    got_ring = jax.jit(pf_ring)(params, tokens)
+    np.testing.assert_allclose(np.asarray(got_ring), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    mesh2 = make_mesh({"dp": 2, "pp": 2, "sp": 1, "tp": 2, "ep": 1})
+    pf = make_pipeline_forward(cfg, mesh2, n_microbatches=4, use_ring=False)
+    got = jax.jit(pf)(params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
 

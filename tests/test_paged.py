@@ -196,3 +196,76 @@ def test_paged_per_request_sampling(params):
     rs = srv.submit(prompt, sampling={"temperature": 3.0, "top_k": 1})
     srv.drain()
     assert srv.result(rs) == ref.result(rr)
+
+
+# -- windowed (banded) paged serving — round 5 ------------------------------
+
+
+def test_windowed_paged_greedy_parity_with_dense_server(params):
+    """cfg.window > 0 composes with the page pool (the paged.py refusal is
+    gone): greedy tokens EXACTLY match DecodeServer's banded read, across
+    sequences long enough to wrap the physical page ring several times."""
+    import dataclasses
+
+    wcfg = dataclasses.replace(CFG, window=8)
+    # lengths chosen to hit the dangerous geometries (review r5): 9 makes
+    # bucket padding exceed the physical ring with the first band reaching
+    # a page the pad writes would have clobbered; 40 (> ring * page_size)
+    # keeps only the LAST ring-many prompt pages live at prefill
+    prompts = [[3, 14, 15, 9, 2, 6], [26, 5],
+               [35, 8, 9, 7, 9, 3, 2, 1, 4, 11, 13, 2],
+               [5, 9, 3, 1, 7, 2, 8, 4, 6],
+               [(i * 7) % 60 + 1 for i in range(40)]]
+    dense = DecodeServer(wcfg, params, n_slots=2, max_seq=96,
+                         max_new_tokens=40)
+    paged = PagedDecodeServer(wcfg, params, n_slots=2, max_seq=96,
+                              max_new_tokens=40, page_size=4)
+    results = {}
+    for server, tag in ((dense, "dense"), (paged, "paged")):
+        ra = server.submit(prompts[0])
+        server.step()
+        rb = server.submit(prompts[1])
+        server.drain()
+        rc = server.submit(prompts[2])
+        server.drain()
+        rd = server.submit(prompts[3])
+        re_ = server.submit(prompts[4])
+        server.drain()
+        results[tag] = [server.result(r) for r in (ra, rb, rc, rd, re_)]
+    assert results["paged"] == results["dense"]
+
+
+def test_windowed_pages_bounded_by_window_not_seq(params):
+    """The compounding memory win: a windowed slot maps only
+    ceil(window/ps) + 1 physical pages however long max_seq (and the
+    sequence) grows — and they return to the pool on retirement."""
+    import dataclasses
+
+    ps = 4
+    window = 8
+    wcfg = dataclasses.replace(CFG, window=window)
+    server = PagedDecodeServer(wcfg, params, n_slots=2, max_seq=256,
+                               max_new_tokens=60, page_size=ps, n_pages=8)
+    ring = window // ps + 1  # 3 pages
+    rid = server.submit(list(range(1, 12)))  # 11-token prompt, decodes 60
+    assert server.pages_in_use() == ring
+    server.drain()
+    assert server.finished(rid)
+    out = server.pop_result(rid)
+    assert len(out) >= 11 + 1
+    assert server.pages_in_use() == 0
+    # an unwindowed server with the same shapes could not even admit:
+    # worst case needs (11 + 60 + 1)/4 = 18 pages > pool 8
+    plain = PagedDecodeServer(CFG, params, n_slots=2, max_seq=256,
+                              max_new_tokens=60, page_size=ps, n_pages=8)
+    with pytest.raises(ValueError):
+        plain.submit(list(range(1, 12)))
+
+
+def test_windowed_paged_kernel_refuses(params):
+    import dataclasses
+
+    wcfg = dataclasses.replace(CFG, window=8)
+    with pytest.raises(NotImplementedError):
+        PagedDecodeServer(wcfg, params, n_slots=2, max_seq=64,
+                          max_new_tokens=8, use_kernel=True)
