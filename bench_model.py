@@ -297,6 +297,90 @@ def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma,
     }
 
 
+def speculative_trained_pair(prompt_len, gen_steps, gamma, small=False):
+    """The number that decides whether speculation is a CAPABILITY: a
+    TRAINED draft/target pair (target trained on the skewed synthetic
+    corpus, draft distilled against it — tests/test_distill.py's recipe at
+    bench scale) measured against PLAIN greedy decode of the SAME target.
+    Reports tokens/s for both, the ratio, and the realized tokens/round.
+    Training cost is bounded (a few hundred small-model steps) and runs
+    on-device; the speedup claim is apples-to-apples because both paths
+    decode the identical trained target."""
+    import dataclasses
+
+    from kubetpu.jobs import init_state, make_mesh, make_train_step
+    from kubetpu.jobs.data import SyntheticCorpus
+    from kubetpu.jobs.decode import make_generate
+    from kubetpu.jobs.distill import (
+        agreement_rate,
+        init_draft_state,
+        make_distill_step,
+    )
+    from kubetpu.jobs.profiling import marginal_ms
+    from kubetpu.jobs.speculative import make_speculative_generate
+
+    from kubetpu.jobs import ModelConfig
+
+    if small:  # CPU smoke: same recipe, toy sizes
+        tcfg = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4,
+                           d_ff=128, max_seq=256)
+        dcfg = dataclasses.replace(tcfg, d_model=32, n_layers=1, d_ff=64)
+        t_steps, d_steps = 200, 300
+    else:
+        tcfg = ModelConfig(vocab=512, d_model=512, n_layers=8, n_heads=8,
+                           d_ff=2048, max_seq=2048, dtype=jnp.bfloat16)
+        dcfg = dataclasses.replace(tcfg, d_model=128, n_layers=2, d_ff=512)
+        t_steps, d_steps = 300, 400
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1})
+    corpus = SyntheticCorpus(tcfg.vocab, seed=3,
+                             skew=[0.85, 0.05, 0.05, 0.05])
+    data = [next(corpus.batches(8, 64, seed=5)) for _ in range(8)]
+    state, opt = init_state(jax.random.PRNGKey(0), tcfg, mesh)
+    step = make_train_step(tcfg, mesh, optimizer=opt, use_ring=False)
+    for i in range(t_steps):
+        tokens, targets = data[i % len(data)]
+        state, _tl = step(state, tokens, targets)
+    dstep, dopt = make_distill_step(tcfg, dcfg, temperature=2.0)
+    dstate = init_draft_state(jax.random.PRNGKey(1), dcfg, dopt)
+    for i in range(d_steps):
+        tokens, targets = data[i % len(data)]
+        dstate, _dl = dstep(dstate, state.params, tokens, targets)
+    t_params, d_params = state.params, dstate.params
+    agree = agreement_rate(tcfg, dcfg, t_params, d_params, data[0][0])
+
+    batch = 4
+    prompt = jnp.asarray(data[0][0][:batch, :prompt_len])
+    spec = make_speculative_generate(tcfg, dcfg, gamma)
+    plain = make_generate(tcfg)
+
+    def spec_run(n):
+        return lambda: spec(t_params, d_params, prompt, n)[0][0, -1]
+
+    def plain_run(n):
+        return lambda: plain(t_params, prompt, jax.random.PRNGKey(3), n)[0, -1]
+
+    n1 = max(8, gen_steps // 8)
+    spec_ms = marginal_ms(spec_run, n1, n1 + gen_steps, reps=2)
+    plain_ms = marginal_ms(plain_run, n1, n1 + gen_steps, reps=2)
+    _, tpr = spec(t_params, d_params, prompt, n1)
+    spec_tps = batch * gen_steps / (gen_steps * spec_ms / 1e3)
+    plain_tps = batch * gen_steps / (gen_steps * plain_ms / 1e3)
+    return {
+        "metric": "speculative_decode_tokens_per_s",
+        "value": round(spec_tps, 1),
+        "unit": "tokens/s",
+        "step_ms": round(spec_ms, 3),
+        "batch": batch,
+        "gen_steps": gen_steps,
+        "gamma": gamma,
+        "draft": "trained",
+        "mean_tokens_per_round": round(float(tpr), 2),
+        "teacher_forced_agreement": round(agree, 3),
+        "plain_decode_tokens_per_s": round(plain_tps, 1),
+        "speedup_vs_plain": round(spec_tps / plain_tps, 2),
+    }
+
+
 def _result_key(r: dict) -> tuple:
     """Identity of a measurement variant — used to merge re-runs of a
     subset of sections (--only) into an existing artifact."""
@@ -488,6 +572,10 @@ def main() -> int:
     if "spec" in only:
         emit(speculative_throughput(cfg, *dec, gamma=4))
         emit(speculative_throughput(cfg, *dec, gamma=4, self_draft=True))
+        emit(speculative_trained_pair(
+            prompt_len=16 if args.smoke else 64,
+            gen_steps=32 if args.smoke else 256, gamma=4,
+            small=args.smoke))
     if "serving" in only:
         emit(serving_throughput(cfg, n_slots=4 if args.smoke else 8,
                                 prompt_len=16 if args.smoke else 128,

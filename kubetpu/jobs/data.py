@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import collections
 import itertools
-from typing import Iterable, Iterator, Tuple
+from typing import Iterable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -28,12 +28,23 @@ class SyntheticCorpus:
     structure for a model to measurably learn (each next token depends on
     the previous one), reproducible from (vocab, seed)."""
 
-    def __init__(self, vocab: int, seed: int = 0):
+    def __init__(self, vocab: int, seed: int = 0,
+                 skew: Optional[Sequence[float]] = None):
+        """``skew``: probability over the 4 successors (default uniform).
+        A skewed chain (e.g. ``[0.85, 0.05, 0.05, 0.05]``) has a clearly
+        learnable argmax — natural text is like this, and it is what makes
+        a distilled draft's greedy agreement (speculative decoding's
+        acceptance rate) meaningfully measurable on synthetic data."""
         self.vocab = vocab
         rng = np.random.RandomState(seed)
         # sparse row-stochastic transition structure: each token prefers a
         # handful of successors
         self._next = rng.randint(0, vocab, size=(vocab, 4))
+        self._skew = None if skew is None else np.asarray(skew, np.float64)
+        if self._skew is not None and (
+            self._skew.shape != (4,) or abs(self._skew.sum() - 1.0) > 1e-9
+        ):
+            raise ValueError("skew must be 4 probabilities summing to 1")
 
     def batches(self, batch: int, seq: int, seed: int = 0) -> Iterator[Batch]:
         rng = np.random.RandomState(seed)
@@ -41,7 +52,10 @@ class SyntheticCorpus:
             tokens = np.empty((batch, seq + 1), np.int32)
             tokens[:, 0] = rng.randint(0, self.vocab, size=batch)
             for t in range(seq):
-                choice = rng.randint(0, 4, size=batch)
+                if self._skew is None:
+                    choice = rng.randint(0, 4, size=batch)
+                else:
+                    choice = rng.choice(4, size=batch, p=self._skew)
                 tokens[:, t + 1] = self._next[tokens[:, t], choice]
             yield tokens[:, :-1].copy(), tokens[:, 1:].copy()
 

@@ -1,0 +1,144 @@
+"""Draft-model construction for speculative decoding: distillation and
+truncated self-drafts.
+
+Speculation only pays when the draft AGREES with the target (VERDICT r4:
+a random quarter-size draft measures as a slowdown — tokens/round 1.0).
+Two ways to a high-agreement draft, both TPU-shaped (pure jit steps over
+the same mesh/sharding machinery as training):
+
+- ``make_distill_step``: train a small draft against the FROZEN target's
+  logits (soft cross-entropy at a temperature, optionally mixed with the
+  data CE). Greedy agreement is exactly what speculation accepts, and
+  matching the teacher's distribution maximizes it where it matters (the
+  teacher's argmax).
+- ``truncated_draft``: a zero-training draft — the first ``n_layers`` of
+  the target plus its own final norm/head. Useful as a starting point
+  for distillation (layers already speak the model's representation
+  language) and as the self-draft upper-bound harness.
+
+Reference: none (the reference has no inference stack, SURVEY.md §2);
+the distillation objective is the standard Hinton softening, reshaped to
+one fused jit step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kubetpu.jobs import model as model_lib
+from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.train import TrainState, make_optimizer
+
+
+def truncated_draft(cfg: ModelConfig, params: Params,
+                    n_layers: int) -> Tuple[ModelConfig, Params]:
+    """Draft = the target's first *n_layers* blocks + its embed/ln_f/head
+    (shared arrays, no copy). The stacked-layer layout makes this a slice
+    on axis 0 of every block leaf."""
+    if not 0 < n_layers <= cfg.n_layers:
+        raise ValueError(f"n_layers must be in (0, {cfg.n_layers}]")
+    dcfg = dataclasses.replace(cfg, n_layers=n_layers)
+    dparams = dict(params)
+    dparams["blocks"] = {
+        k: v[:n_layers] for k, v in params["blocks"].items()
+    }
+    return dcfg, dparams
+
+
+def distill_loss(
+    draft_cfg: ModelConfig,
+    draft_params: Params,
+    target_logits: jnp.ndarray,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    temperature: float = 1.0,
+    hard_weight: float = 0.5,
+) -> jnp.ndarray:
+    """Soft CE against the teacher's logits + ``hard_weight`` x data CE.
+    The T^2 factor keeps the soft-gradient scale independent of the
+    softening temperature (Hinton et al.)."""
+    d_logits = model_lib.forward(draft_params, tokens, draft_cfg)
+    d_logits = d_logits.astype(jnp.float32)
+    t_soft = jax.nn.softmax(target_logits.astype(jnp.float32) / temperature,
+                            axis=-1)
+    d_logsoft = jax.nn.log_softmax(d_logits / temperature, axis=-1)
+    soft = -jnp.mean(jnp.sum(t_soft * d_logsoft, axis=-1)) * temperature**2
+    # hard CE from the SAME logits (one draft forward per step, not two)
+    logp = jax.nn.log_softmax(d_logits, axis=-1)
+    hard = -jnp.mean(
+        jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    )
+    return soft + hard_weight * hard
+
+
+def make_distill_step(
+    target_cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    optimizer: Optional[Any] = None,
+    temperature: float = 1.0,
+    hard_weight: float = 0.5,
+):
+    """Jitted ``step(draft_state, target_params, tokens, targets) ->
+    (draft_state, loss)``: one distillation update of the draft against
+    the frozen target. The target forward runs inside the same jit (no
+    teacher-logit materialization on host; XLA fuses and frees). Build
+    ``draft_state`` with ``init_draft_state``."""
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError("target and draft must share a vocabulary")
+    optimizer = optimizer or make_optimizer()
+
+    @jax.jit
+    def step(state: TrainState, target_params: Params, tokens, targets):
+        t_logits = jax.lax.stop_gradient(
+            model_lib.forward(target_params, tokens, target_cfg)
+        )
+
+        def loss_fn(p):
+            return distill_loss(draft_cfg, p, t_logits, tokens, targets,
+                                temperature, hard_weight)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(new_params, new_opt, state.step + 1), loss
+
+    return step, optimizer
+
+
+def init_draft_state(
+    rng: jax.Array, draft_cfg: ModelConfig, optimizer,
+    init_params: Optional[Params] = None,
+) -> TrainState:
+    """Fresh (or warm-started, e.g. ``truncated_draft``) distillation
+    state. Warm starts COPY the arrays — the target's own weights must
+    not be donated away by the draft's updates."""
+    params = (
+        jax.tree.map(jnp.array, init_params)
+        if init_params is not None
+        else model_lib.init_params(rng, draft_cfg)
+    )
+    return TrainState(params=params, opt_state=jax.jit(optimizer.init)(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def agreement_rate(
+    target_cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    target_params: Params,
+    draft_params: Params,
+    tokens: jnp.ndarray,
+) -> float:
+    """Teacher-forced greedy agreement: fraction of positions where the
+    draft's argmax equals the target's argmax given the same prefix. The
+    per-position acceptance probability speculation sees; mean
+    tokens/round is ~ (1 - a^(gamma+1)) / (1 - a) for agreement a."""
+    t = jnp.argmax(model_lib.forward(target_params, tokens, target_cfg), -1)
+    d = jnp.argmax(model_lib.forward(draft_params, tokens, draft_cfg), -1)
+    return float(jnp.mean((t == d).astype(jnp.float32)))

@@ -1,0 +1,101 @@
+"""Draft distillation (kubetpu/jobs/distill.py): a TRAINED draft pair
+must make speculation actually win — mean tokens/round >= 2 (VERDICT r4:
+the random-draft measurement records speculation losing at 1.0)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_state, make_mesh, make_train_step
+from kubetpu.jobs.data import SyntheticCorpus
+from kubetpu.jobs.distill import (
+    agreement_rate,
+    init_draft_state,
+    make_distill_step,
+    truncated_draft,
+)
+from kubetpu.jobs.speculative import make_speculative_generate
+
+TCFG = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                   max_seq=128)
+DCFG = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=4, d_ff=64,
+                   max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def trained_pair():
+    """Target trained on the skewed synthetic corpus (a learnable argmax,
+    like natural text); draft distilled against it. Module-scoped: the
+    tests share the (CPU-cheap) pair."""
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1})
+    corpus = SyntheticCorpus(TCFG.vocab, seed=3,
+                             skew=[0.85, 0.05, 0.05, 0.05])
+    batches = corpus.batches(8, 32, seed=5)
+
+    state, opt = init_state(jax.random.PRNGKey(0), TCFG, mesh)
+    step = make_train_step(TCFG, mesh, optimizer=opt, use_ring=False)
+    data = [next(batches) for _ in range(8)]
+    for i in range(250):
+        tokens, targets = data[i % len(data)]
+        state, t_loss = step(state, tokens, targets)
+    t_params = state.params
+
+    dstep, dopt = make_distill_step(TCFG, DCFG, temperature=2.0)
+    dstate = init_draft_state(jax.random.PRNGKey(1), DCFG, dopt)
+    for i in range(300):
+        tokens, targets = data[i % len(data)]
+        dstate, d_loss = dstep(dstate, t_params, tokens, targets)
+    return t_params, dstate.params, data, float(t_loss), float(d_loss)
+
+
+def test_distilled_draft_agrees(trained_pair):
+    t_params, d_params, data, t_loss, d_loss = trained_pair
+    assert np.isfinite(t_loss) and np.isfinite(d_loss)
+    tokens, _ = data[0]
+    a = agreement_rate(TCFG, DCFG, t_params, d_params, tokens)
+    assert a >= 0.7, f"agreement {a} too low for speculation to win"
+
+
+def test_trained_pair_speculation_wins(trained_pair):
+    """The VERDICT r4 bar: mean tokens/round >= 2 with a trained pair —
+    and the output is still EXACTLY target-only greedy."""
+    from kubetpu.jobs.decode import make_generate
+
+    t_params, d_params, data, _t, _d = trained_pair
+    prompt = data[0][0][:4, :8]
+    gen = make_speculative_generate(TCFG, DCFG, gamma=4)
+    spec_tokens, tokens_per_round = gen(t_params, d_params, prompt, 24)
+    plain = make_generate(TCFG)(t_params, prompt, jax.random.PRNGKey(0), 24)
+    np.testing.assert_array_equal(np.asarray(spec_tokens), np.asarray(plain))
+    assert float(tokens_per_round) >= 2.0, (
+        f"trained pair yields only {float(tokens_per_round)} tokens/round"
+    )
+
+
+def test_truncated_self_draft(trained_pair):
+    """The zero-training draft: first-layer slice of the trained target
+    shares its arrays, forwards at the right shapes, and beats a random
+    draft's agreement."""
+    t_params, _d, data, _t, _dl = trained_pair
+    dcfg, dparams = truncated_draft(TCFG, t_params, 1)
+    assert dcfg.n_layers == 1
+    assert dparams["blocks"]["wq"].shape[0] == 1
+    assert dparams["embed"] is t_params["embed"]  # shared, not copied
+    tokens, _ = data[0]
+    a_trunc = agreement_rate(TCFG, dcfg, t_params, dparams, tokens)
+    from kubetpu.jobs.model import init_params
+
+    rand = init_params(jax.random.PRNGKey(9), DCFG)
+    a_rand = agreement_rate(TCFG, DCFG, t_params, rand, tokens)
+    assert a_trunc > a_rand
+    with pytest.raises(ValueError):
+        truncated_draft(TCFG, t_params, 3)
+
+
+def test_distill_refuses_vocab_mismatch():
+    bad = dataclasses.replace(DCFG, vocab=32)
+    with pytest.raises(ValueError):
+        make_distill_step(TCFG, bad)
