@@ -204,7 +204,7 @@ def flash_vs_dense(cfg, seqs):
 
 
 def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads,
-                      int8: bool = False):
+                      int8: bool = False, kv_int8: bool = False):
     import dataclasses
 
     from kubetpu.jobs import init_params
@@ -220,7 +220,7 @@ def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads,
                                 dcfg.vocab, jnp.int32)
     from kubetpu.jobs.profiling import marginal_ms
 
-    gen = make_generate(dcfg)
+    gen = make_generate(dcfg, kv_int8=kv_int8)
 
     # Marginal per decode step across two generation lengths — the scan is
     # already inside one jitted call; the fetch of a generated token forces
@@ -242,6 +242,7 @@ def decode_throughput(cfg, batch, prompt_len, gen_steps, n_kv_heads,
         "gen_steps": gen_steps,
         "n_kv_heads": n_kv_heads or cfg.n_heads,
         "weights": "int8" if int8 else "bf16",
+        "kv_cache": "int8" if kv_int8 else "bf16",
     }
 
 
@@ -394,7 +395,8 @@ def _result_key(r: dict) -> tuple:
     if draft is None and r.get("metric") == "speculative_decode_tokens_per_s":
         draft = "quarter"  # backfill: rows written before the self-draft leg
     return (r.get("metric"), r.get("seq"), r.get("n_kv_heads"), r.get("gamma"),
-            weights, remat, draft, r.get("batch"), r.get("loss_chunk", 0))
+            weights, remat, draft, r.get("batch"), r.get("loss_chunk", 0),
+            r.get("kv_cache", "bf16"))
 
 
 def _merge_out(path: str, new: list) -> None:
@@ -569,6 +571,8 @@ def main() -> int:
         emit(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2))
         emit(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2,
                                int8=True))
+        emit(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2,
+                               int8=True, kv_int8=True))
     if "spec" in only:
         emit(speculative_throughput(cfg, *dec, gamma=4))
         emit(speculative_throughput(cfg, *dec, gamma=4, self_draft=True))

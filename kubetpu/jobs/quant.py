@@ -116,6 +116,19 @@ def maybe_dequantize(params) -> Any:
     )
 
 
+def quantize_kv_chunk(x: jnp.ndarray) -> tuple:
+    """Dynamic per-token per-head int8 quantization for KV-cache entries:
+    x (..., H_kv, D) -> (int8 values, f32 scale (..., H_kv, 1)). Unlike
+    weights (static, per-output-channel), cache entries arrive one
+    token/chunk at a time with unknown range — the max|.|/127 scale is
+    computed per head per position at WRITE time, so a loud head cannot
+    crush a quiet one's resolution. Zero vectors stay exactly zero."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
 def param_bytes(params) -> int:
     """Resident bytes of a (possibly quantized) param tree."""
     return sum(
