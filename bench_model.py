@@ -572,6 +572,8 @@ def main() -> int:
         emit(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2,
                                int8=True))
         emit(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2,
+                               kv_int8=True))
+        emit(decode_throughput(cfg, *dec, n_kv_heads=4 if not args.smoke else 2,
                                int8=True, kv_int8=True))
     if "spec" in only:
         emit(speculative_throughput(cfg, *dec, gamma=4))

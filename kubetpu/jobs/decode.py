@@ -269,6 +269,24 @@ def _forward_one_with_io(cfg: ModelConfig, params: Params, token, cache, pos,
     return logits[:, 0], cache
 
 
+def _prefill_with(cfg: ModelConfig, params: Params, tokens, cache, write,
+                  attn_fn=None):
+    """THE prefill body: one batched forward over the whole prompt, K/V
+    landing in the cache through the *write* hook — the dense and int8
+    layouts share everything else (the attn_fn ring hook, the padding
+    invariant, the dequant policy), mirroring ``cache_io`` on the decode
+    side. Quantized params are dequantized WHOLE here: prefill is one
+    compute-bound batched pass through the training forward (which knows
+    nothing of QTensors); the bandwidth-critical steady-state decode loop
+    keeps its own policy."""
+    from kubetpu.jobs.quant import maybe_dequantize
+
+    params = maybe_dequantize(params)
+    logits, ks, vs = model_lib.forward_with_kv(params, tokens, cfg,
+                                               attn_fn=attn_fn)
+    return logits, write(cache, ks, vs)
+
+
 def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache,
             attn_fn=None):
     """Fill the cache from one batched forward over the whole prompt (a
@@ -281,43 +299,40 @@ def prefill(cfg: ModelConfig, params: Params, tokens, k_cache, v_cache,
     (shard_map partitions the sequence axis) — pad the prompt to a multiple
     of sp (pad K/V positions are overwritten before any real query can
     attend them, the serving-bucketing invariant)."""
-    # quantized params are dequantized WHOLE here: prefill is one
-    # compute-bound batched pass through the training forward (which knows
-    # nothing of QTensors); the bandwidth-critical steady-state decode
-    # loop stays int8 (see forward_chunk)
-    from kubetpu.jobs.quant import maybe_dequantize
+    def write(cache, ks, vs):
+        k_cache, v_cache = cache
+        z = (0, 0, 0, 0, 0)
+        return (
+            jax.lax.dynamic_update_slice(k_cache, ks.astype(k_cache.dtype), z),
+            jax.lax.dynamic_update_slice(v_cache, vs.astype(v_cache.dtype), z),
+        )
 
-    params = maybe_dequantize(params)
-    logits, ks, vs = model_lib.forward_with_kv(params, tokens, cfg, attn_fn=attn_fn)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, ks.astype(k_cache.dtype),
-                                           (0, 0, 0, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, vs.astype(v_cache.dtype),
-                                           (0, 0, 0, 0, 0))
+    logits, (k_cache, v_cache) = _prefill_with(
+        cfg, params, tokens, (k_cache, v_cache), write, attn_fn
+    )
     return logits, k_cache, v_cache
 
 
 def prefill_int8(cfg: ModelConfig, params: Params, tokens, cache,
                  attn_fn=None):
-    """``prefill`` for the int8 cache: the same one-batched-forward
-    contract (including the *attn_fn* ring hook for sp-sharded long
-    prompts and its padding invariant — see ``prefill``), with the
+    """``prefill`` for the int8 cache: the shared ``_prefill_with`` body
+    (attn_fn ring hook, padding invariant, dequant policy) with the
     prompt's K/V quantizing into the cache in one shot."""
-    from kubetpu.jobs.quant import maybe_dequantize, quantize_kv_chunk
+    from kubetpu.jobs.quant import quantize_kv_chunk
 
-    logits, ks, vs = model_lib.forward_with_kv(
-        maybe_dequantize(params), tokens, cfg, attn_fn=attn_fn
-    )
-    (kq, ksc), (vq, vsc) = cache
-    k8, kscale = quantize_kv_chunk(ks)
-    v8, vscale = quantize_kv_chunk(vs)
-    z = (0, 0, 0, 0, 0)
-    cache = (
-        (jax.lax.dynamic_update_slice(kq, k8, z),
-         jax.lax.dynamic_update_slice(ksc, kscale, z)),
-        (jax.lax.dynamic_update_slice(vq, v8, z),
-         jax.lax.dynamic_update_slice(vsc, vscale, z)),
-    )
-    return logits, cache
+    def write(cache, ks, vs):
+        (kq, ksc), (vq, vsc) = cache
+        k8, kscale = quantize_kv_chunk(ks)
+        v8, vscale = quantize_kv_chunk(vs)
+        z = (0, 0, 0, 0, 0)
+        return (
+            (jax.lax.dynamic_update_slice(kq, k8, z),
+             jax.lax.dynamic_update_slice(ksc, kscale, z)),
+            (jax.lax.dynamic_update_slice(vq, v8, z),
+             jax.lax.dynamic_update_slice(vsc, vscale, z)),
+        )
+
+    return _prefill_with(cfg, params, tokens, cache, write, attn_fn)
 
 
 def make_generate(
@@ -354,43 +369,32 @@ def make_generate(
         )
 
     def generate(params, prompt, rng, num_steps: int):
+        # ONE loop body for both cache layouts: only the (init, prefill,
+        # cache_io) triple differs — a sampling/carry fix cannot land in
+        # one layout and miss the other (review r5)
         b, s_prompt = prompt.shape
         max_seq = s_prompt + num_steps
         if kv_int8:
             cache = _constrain_cache(init_kv_cache_int8(cfg, b, max_seq))
             logits, cache = prefill_int8(cfg, params, prompt, cache)
             cache_io = _int8_cache_io(cfg.window)
-
-            def step(carry, i):
-                cache, prev_logits, rng = carry
-                rng, sub = jax.random.split(rng)
-                token = sampler(prev_logits, sub)
-                logits, cache = _forward_one_with_io(
-                    cfg, params, token, cache, s_prompt + i, cache_io
-                )
-                return (cache, logits, rng), token
-
-            (_, _, _), generated = jax.lax.scan(
-                step, (cache, logits, rng), jnp.arange(num_steps)
-            )
-            return jnp.concatenate(
-                [prompt, generated.T.astype(prompt.dtype)], axis=1
-            )
-
-        k_cache, v_cache = _constrain_cache(init_kv_cache(cfg, b, max_seq))
-        logits, k_cache, v_cache = prefill(cfg, params, prompt, k_cache, v_cache)
+        else:
+            cache = _constrain_cache(init_kv_cache(cfg, b, max_seq))
+            logits, k_cache, v_cache = prefill(cfg, params, prompt, *cache)
+            cache = (k_cache, v_cache)
+            cache_io = _dense_cache_io(cfg.window)
 
         def step(carry, i):
-            k_cache, v_cache, prev_logits, rng = carry
+            cache, prev_logits, rng = carry
             rng, sub = jax.random.split(rng)
             token = sampler(prev_logits, sub)
-            logits, k_cache, v_cache = _forward_one(
-                cfg, params, token, k_cache, v_cache, s_prompt + i
+            logits, cache = _forward_one_with_io(
+                cfg, params, token, cache, s_prompt + i, cache_io
             )
-            return (k_cache, v_cache, logits, rng), token
+            return (cache, logits, rng), token
 
-        (_, _, _, _), generated = jax.lax.scan(
-            step, (k_cache, v_cache, logits, rng), jnp.arange(num_steps)
+        (_, _, _), generated = jax.lax.scan(
+            step, (cache, logits, rng), jnp.arange(num_steps)
         )
         return jnp.concatenate([prompt, generated.T.astype(prompt.dtype)], axis=1)
 

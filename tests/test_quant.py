@@ -132,9 +132,8 @@ def test_kv_int8_quality_contract_on_trained_model(trained_small):
         init_kv_cache,
         init_kv_cache_int8,
         prefill,
+        prefill_int8,
     )
-    from kubetpu.jobs.model import forward_with_kv
-    from kubetpu.jobs.quant import quantize_kv_chunk
 
     b, s_p = prompt.shape
     kc, vc = init_kv_cache(cfg, b, s_p + 4)
@@ -142,15 +141,9 @@ def test_kv_int8_quality_contract_on_trained_model(trained_small):
     tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     ref_logits, _, _ = _forward_one(cfg, params, tok, kc, vc, s_p)
 
-    (kq, ks), (vq, vs) = init_kv_cache_int8(cfg, b, s_p + 4)
-    _, ks_full, vs_full = forward_with_kv(params, prompt, cfg)
-    k8, ksc = quantize_kv_chunk(ks_full)
-    v8, vsc = quantize_kv_chunk(vs_full)
-    z = (0, 0, 0, 0, 0)
-    cache = ((jax.lax.dynamic_update_slice(kq, k8, z),
-              jax.lax.dynamic_update_slice(ks, ksc, z)),
-             (jax.lax.dynamic_update_slice(vq, v8, z),
-              jax.lax.dynamic_update_slice(vs, vsc, z)))
+    # through the PRODUCTION int8 prefill, not a hand-rolled copy
+    cache = init_kv_cache_int8(cfg, b, s_p + 4)
+    _, cache = prefill_int8(cfg, params, prompt, cache)
     q8_logits, _ = _forward_one_with_io(cfg, params, tok, cache, s_p,
                                         _int8_cache_io(cfg.window))
     ref_n = np.asarray(ref_logits)
