@@ -70,7 +70,7 @@ def chip_peak_flops():
 
 
 def train_throughput(cfg, batch, seq, steps, attention, remat_policy="full",
-                     loss_chunk=0):
+                     loss_chunk=0, block_q=128, block_k=128):
     import dataclasses
 
     from kubetpu.jobs import init_state, make_mesh, make_train_step
@@ -82,7 +82,7 @@ def train_throughput(cfg, batch, seq, steps, attention, remat_policy="full",
     state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
     n_params = param_count(state.params)
     raw_step = make_train_step(cfg, mesh, optimizer=opt, attention=attention,
-                               jit=False)
+                               jit=False, block_q=block_q, block_k=block_k)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab,
                                 jnp.int32)
     targets = jnp.roll(tokens, -1, axis=1)
@@ -126,6 +126,8 @@ def train_throughput(cfg, batch, seq, steps, attention, remat_policy="full",
         "attention": attention,
         "remat": remat_policy,
         "loss_chunk": loss_chunk,
+        "block_q": block_q,
+        "block_k": block_k,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "device": getattr(jax.devices()[0], "device_kind", str(jax.devices()[0])),
     }
@@ -396,7 +398,8 @@ def _result_key(r: dict) -> tuple:
         draft = "quarter"  # backfill: rows written before the self-draft leg
     return (r.get("metric"), r.get("seq"), r.get("n_kv_heads"), r.get("gamma"),
             weights, remat, draft, r.get("batch"), r.get("loss_chunk", 0),
-            r.get("kv_cache", "bf16"))
+            r.get("kv_cache", "bf16"), r.get("block_q", 128),
+            r.get("block_k", 128))
 
 
 def _merge_out(path: str, new: list) -> None:
@@ -501,7 +504,7 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--out", default=None, help="also merge JSON lines into FILE")
     ap.add_argument("--only", default=None,
-                    help="comma list of sections: train,flash,decode,spec,"
+                    help="comma list of sections: train,flash,decode,spec,flashtune,"
                          "serving (big compiles over the tunneled backend "
                          "make a full run slow; sections merge into --out)")
     args = ap.parse_args()
@@ -515,7 +518,7 @@ def main() -> int:
             pass
 
     cfg = flagship_cfg(args.smoke)
-    sections = {"train", "flash", "decode", "spec", "serving"}
+    sections = {"train", "flash", "decode", "spec", "serving", "flashtune"}
     only = (
         {s.strip() for s in args.only.split(",")} if args.only else set(sections)
     )
@@ -541,6 +544,40 @@ def main() -> int:
         batch, seq = 4, 2048
         seqs = [2048, 4096, 8192]
         dec = (8, 128, 128)
+
+    if "flashtune" in only:
+        # MFU push (VERDICT r5 #9): sweep the flash kernels' VMEM tiles on
+        # the flagship train shape. TPU-only — the Pallas kernels don't
+        # run on the CPU backend, and tile choice is a hardware question.
+        if jax.default_backend() == "cpu":
+            print(json.dumps({"metric": "flashtune", "skipped": "cpu backend"}))
+        else:
+            best = None
+            # (128,128) is the default the train section already measures;
+            # sweep it here only when that section isn't in this run
+            points = ((256, 128), (128, 256), (256, 256),
+                      (64, 128), (128, 64), (512, 128))
+            if "train" not in only:
+                points = ((128, 128),) + points
+            for bq, bk in points:
+                try:
+                    r = train_throughput(cfg, batch, seq, args.steps, "flash",
+                                         remat_policy="dots",
+                                         loss_chunk=64 if args.smoke else 256,
+                                         block_q=bq, block_k=bk)
+                except Exception as e:  # noqa: BLE001 — a tile may not fit VMEM
+                    print(json.dumps({"metric": "flashtune_point",
+                                      "block_q": bq, "block_k": bk,
+                                      "error": str(e)[:120]}), flush=True)
+                    continue
+                emit(r)
+                if best is None or r["value"] > best["value"]:
+                    best = r
+            if best is not None:
+                print(json.dumps({"metric": "flashtune_best",
+                                  "block_q": best["block_q"],
+                                  "block_k": best["block_k"],
+                                  "mfu": best["mfu"]}), flush=True)
 
     if "train" in only:
         attn = "flash" if jax.default_backend() != "cpu" else "dense"

@@ -237,7 +237,8 @@ def make_update_step(loss_fn, optimizer, accum_steps: int = 1,
     return train_step
 
 
-def _resolve_attention(mesh: Mesh, attention: str, window: int = 0):
+def _resolve_attention(mesh: Mesh, attention: str, window: int = 0,
+                       block_q: int = 128, block_k: int = 128):
     """Pick the attention core: 'ring' (sequence-parallel over sp),
     'ring_flash' (ring with the Pallas flash kernels inside every step —
     VMEM-tiled scores, fused ring backward; append '_interpret' for the CPU
@@ -254,12 +255,13 @@ def _resolve_attention(mesh: Mesh, attention: str, window: int = 0):
         if attention == "ring":
             return make_ring_attention(mesh)
         return make_ring_attention(
-            mesh, impl="flash", interpret=attention.endswith("_interpret")
+            mesh, impl="flash", block_q=block_q, block_k=block_k,
+            interpret=attention.endswith("_interpret")
         )
     if attention in ("flash", "flash_interpret"):
         from kubetpu.ops import flash_attention
 
-        return partial(flash_attention, block_q=128, block_k=128,
+        return partial(flash_attention, block_q=block_q, block_k=block_k,
                        interpret=attention.endswith("_interpret"),
                        window=window)
     if attention == "dense":
@@ -283,6 +285,8 @@ def make_train_step(
     accum_steps: int = 1,
     skip_nonfinite: bool = False,
     weighted: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
 ):
     """Build the jitted full training step: loss -> grads -> adamw update.
 
@@ -297,14 +301,17 @@ def make_train_step(
     ``weighted=True`` makes the step ``(state, tokens, targets, weights)``
     with per-position loss weights — the packed-batch path (pad masking;
     note the gradient-accumulation caveat on weighted means in
-    ``make_update_step``).
+    ``make_update_step``). ``block_q``/``block_k`` tune the flash kernels'
+    VMEM tiles (the 'flash'/'ring_flash' cores; bench_model's flashtune
+    section sweeps them on-chip).
     """
     optimizer = optimizer or make_optimizer()
     if attention is None:
         # use_ring + window composes now: the banded ring (one boundary
         # ppermute) honors both — no fallback, no warning (round 5)
         attention = "ring" if use_ring else "dense"
-    attn_fn = _resolve_attention(mesh, attention, cfg.window)
+    attn_fn = _resolve_attention(mesh, attention, cfg.window,
+                                 block_q=block_q, block_k=block_k)
 
     if weighted:
         def loss_fn(params, tokens, targets, weights):
