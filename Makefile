@@ -42,7 +42,7 @@ schedsim:
 
 .PHONY: bench-adversarial
 bench-adversarial:
-	python -m kubetpu.cli.schedsim --config 8 9 10 11 12 13
+	python -m kubetpu.cli.schedsim --config 8 9 10 11 12 13 14
 
 .PHONY: demo
 demo:
