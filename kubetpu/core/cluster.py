@@ -43,7 +43,11 @@ from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import GPU, TPU
 from kubetpu.scheduler.gpu_scheduler import GpuScheduler
 from kubetpu.scheduler.tpu_scheduler import TpuScheduler
-from kubetpu.scheduler.translate import pod_device_count, pod_wants_device
+from kubetpu.scheduler.translate import (
+    pod_device_count,
+    pod_device_need,
+    pod_wants_device,
+)
 
 
 class SchedulingError(Exception):
@@ -452,6 +456,16 @@ class Cluster:
             tpu_gang = bool(pods) and all(
                 pod_wants_device(TPU, pod) for pod in pods
             )
+            # provable-capacity pre-filter: a slice whose free chips cannot
+            # cover the gang's total need would fail only after placing
+            # (and rolling back) pods one by one — at 60-pod gangs that
+            # wasted pass per slice dominates placement latency.
+            # pod_device_need (not _count): these are UN-translated
+            # templates, so the kube/device max-merge must apply inline.
+            total_need = (
+                sum(max(1, pod_device_need(TPU, p)) for p in pods)
+                if tpu_gang else 0
+            )
             for slice_nodes in slices.values():
                 # cordoned hosts never host gang members; NOTE a slice with
                 # fewer (uncordoned) hosts than pods can still fit the gang
@@ -459,6 +473,8 @@ class Cluster:
                 slice_nodes = [n for n in slice_nodes
                                if n not in self.cordoned]
                 if not slice_nodes:
+                    continue
+                if tpu_gang and self._slice_free_chips(slice_nodes) < total_need:
                     continue
                 try:
                     return self._try_gang_slice(pods, slice_nodes)
@@ -486,6 +502,17 @@ class Cluster:
             return self._try_gang(pods, None)
         finally:
             self.metrics.record("schedule_gang", time.perf_counter() - t0)
+
+    def _slice_free_chips(self, nodes: Sequence[str]) -> int:
+        """Free chips across a slice's (already cordon-filtered) nodes —
+        the ONE free-capacity tally both the single-slice pre-filter and
+        the multislice candidate ordering use."""
+        return sum(
+            len(st.free)
+            for n in nodes
+            if (st := meshstate.parse_mesh_state(
+                self.nodes[n].info.allocatable)) is not None
+        )
 
     def _try_gang_slice(
         self, pods: Sequence[PodInfo], slice_nodes: List[str]
@@ -530,18 +557,14 @@ class Cluster:
         MEGASCALE_NUM_SLICES / MEGASCALE_SLICE_ID at container start, and
         ``gang_slice_filter`` uses them to pin re-placements to the pod's
         OWN sub-gang's slice."""
-        free_chips: Dict[str, int] = {}
-        for sname, nodes in slices.items():
-            total = 0
-            for n in nodes:
-                if n in self.cordoned:
-                    continue
-                st = meshstate.parse_mesh_state(self.nodes[n].info.allocatable)
-                if st is not None:
-                    total += len(st.free)
-            free_chips[sname] = total
+        free_chips: Dict[str, int] = {
+            sname: self._slice_free_chips(
+                [n for n in nodes if n not in self.cordoned]
+            )
+            for sname, nodes in slices.items()
+        }
         order = sorted(slices, key=lambda s: (-free_chips[s], s))
-        needs = [max(1, pod_device_count(TPU, p)) for p in pods]
+        needs = [max(1, pod_device_need(TPU, p)) for p in pods]
 
         for k in range(2, min(max_slices, len(order), len(pods)) + 1):
             if len(pods) % k:

@@ -82,6 +82,28 @@ def pod_device_count(dc: DeviceClass, pod_info: PodInfo) -> int:
     return int(num)
 
 
+def pod_device_need(dc: DeviceClass, pod_info: PodInfo) -> int:
+    """``pod_device_count`` that is safe BEFORE ``set_device_reqs``: the
+    kube/device max-merge is applied inline per container (the same
+    semantics the merge writes back later). For capacity pre-filters on
+    un-translated pods — gang templates, queue heads."""
+    num = 0
+    for cont in pod_info.running_containers.values():
+        num += max(
+            cont.requests.get(dc.resource_name, 0),
+            cont.kube_requests.get(dc.resource_name, 0),
+        )
+    for cont in pod_info.init_containers.values():
+        num = max(
+            num,
+            max(
+                cont.requests.get(dc.resource_name, 0),
+                cont.kube_requests.get(dc.resource_name, 0),
+            ),
+        )
+    return int(num)
+
+
 def pod_wants_device(dc: DeviceClass, pod_info: PodInfo) -> bool:
     """Does the pod request any devices of this class, counting BOTH
     device-native and kube-native requests over BOTH container kinds (the

@@ -595,3 +595,39 @@ def test_multislice_evicted_subgang_avoids_other_subgang_slices():
     for node in cluster.nodes:
         # allowed anywhere but the surviving sub-gang's slice
         assert filt(node) == (node[0] != survivor_prefix)
+
+
+def test_pod_device_need_counts_kube_native_pre_merge():
+    """The gang capacity pre-filter runs on UN-translated templates:
+    pod_device_need must apply the kube/device max-merge inline, so a
+    kube-native-only pod counts its real chips, not 0 (review r5)."""
+    from kubetpu.scheduler.deviceclass import TPU
+    from kubetpu.scheduler.translate import pod_device_count, pod_device_need
+
+    kube_pod = PodInfo(
+        name="k",
+        running_containers={
+            "main": ContainerInfo(kube_requests={ResourceTPU: 4})
+        },
+        init_containers={
+            "init": ContainerInfo(kube_requests={ResourceTPU: 6})
+        },
+    )
+    assert pod_device_need(TPU, kube_pod) == 6  # max(sum=4, init max=6)
+    assert pod_device_count(TPU, kube_pod) == 0  # pre-merge: blind
+    # and a kube-native multislice gang still places end to end
+    from kubetpu.scheduler.meshstate import MultisliceKey
+
+    cluster = two_slice_cluster()
+
+    def kpod(name):
+        return PodInfo(
+            name=name, requests={MultisliceKey: 2},
+            running_containers={
+                "main": ContainerInfo(kube_requests={ResourceTPU: 8})
+            },
+        )
+
+    placed = cluster.schedule_gang([kpod(f"w{i}") for i in range(8)])
+    per = cluster.gang_slice_contiguity(placed)
+    assert len(per) == 2 and all(v == 1.0 for v in per.values())
