@@ -1,0 +1,107 @@
+"""Multislice end to end: a DCN-spanning gang through scheduler -> env ->
+dcn-axis mesh -> training step.
+
+The round-5 capability walkthrough: two fragmented v5e-64 slices cannot
+host an 8-host gang alone, so the `kubetpu/multislice: 2` knob splits it
+into two 4-host sub-gangs (per-slice contiguity 1.0); Allocate injects
+the MEGASCALE identity; the job side builds the matching
+{dcn: 2, sp, tp} mesh (slice axis outermost — only the gradient
+all-reduce crosses DCN) and runs a training step whose loss exactly
+matches the single-mesh data-parallel equivalent.
+
+    python examples/multislice_demo.py      # CPU, 8 virtual devices
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from kubetpu.api.types import ContainerInfo, PodInfo  # noqa: E402
+from kubetpu.core import Cluster  # noqa: E402
+from kubetpu.device import (  # noqa: E402
+    make_fake_tpus_info,
+    new_fake_tpu_dev_manager,
+)
+from kubetpu.jobs import (  # noqa: E402
+    ModelConfig,
+    init_state,
+    make_mesh,
+    make_multislice_mesh,
+    make_train_step,
+)
+from kubetpu.plugintypes import ResourceTPU  # noqa: E402
+from kubetpu.scheduler.meshstate import MultisliceKey  # noqa: E402
+
+
+def main():
+    # -- control plane: place a DCN-spanning gang -------------------------
+    cluster = Cluster()
+    for uid, prefix in (("podA", "a"), ("podB", "b")):
+        for h in range(4):
+            cluster.register_node(
+                f"{prefix}{h}",
+                device=new_fake_tpu_dev_manager(
+                    make_fake_tpus_info("v5e-64", host_index=h,
+                                        slice_uid=uid)
+                ),
+            )
+    pods = [
+        PodInfo(
+            name=f"w{i}",
+            requests={MultisliceKey: 2},
+            running_containers={
+                "main": ContainerInfo(requests={ResourceTPU: 8})
+            },
+        )
+        for i in range(8)
+    ]
+    placed = cluster.schedule_gang(pods)
+    per = cluster.gang_slice_contiguity(placed)
+    print(f"gang of 8 placed across {len(per)} slices, "
+          f"per-slice contiguity {per}")
+    env0 = cluster.allocate(placed[0].name)["main"][2]
+    env4 = cluster.allocate(placed[4].name)["main"][2]
+    print(f"  worker 0 env: MEGASCALE_NUM_SLICES={env0['MEGASCALE_NUM_SLICES']} "
+          f"SLICE_ID={env0['MEGASCALE_SLICE_ID']}")
+    print(f"  worker 4 env: MEGASCALE_NUM_SLICES={env4['MEGASCALE_NUM_SLICES']} "
+          f"SLICE_ID={env4['MEGASCALE_SLICE_ID']}")
+
+    # -- job side: the matching dcn-axis mesh -----------------------------
+    n_slices = int(env0["MEGASCALE_NUM_SLICES"])
+    cfg = ModelConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                      d_ff=128, max_seq=128)
+    mesh = make_multislice_mesh({"dcn": n_slices, "dp": 1, "sp": 2, "tp": 2})
+    print(f"mesh axes: {dict(mesh.shape)} (dcn outermost = DCN boundary)")
+    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab, jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    state, loss = step(state, tokens, targets)
+
+    # identity check: dcn and dp are both pure data axes
+    ref_mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    ref_state, ref_opt = init_state(jax.random.PRNGKey(0), cfg, ref_mesh)
+    ref_step = make_train_step(cfg, ref_mesh, optimizer=ref_opt)
+    _, ref_loss = ref_step(ref_state, tokens, targets)
+    print(f"multislice loss {float(loss):.6f} == "
+          f"single-slice dp loss {float(ref_loss):.6f}")
+    assert abs(float(loss) - float(ref_loss)) < 1e-4
+    print("multislice demo OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -530,31 +530,44 @@ def make_rolling_generate(
     return jax.jit(generate, static_argnums=(3,))
 
 
-def forward_chunk_at(cfg, params, chunk, k_cache, v_cache, pos, lora=None,
-                     adapter_ids=None, lora_scale=1.0):
-    """``forward_chunk`` with PER-BATCH positions (vmapped over the
-    batch: speculative rounds advance each sequence unevenly, so the cache
-    write offset differs per example). ``lora``/``adapter_ids`` as in
-    ``forward_chunk`` — each example applies its own adapter."""
+def forward_chunk_at_io(cfg, params, chunk, cache, pos, cache_io, lora=None,
+                        adapter_ids=None, lora_scale=1.0):
+    """``forward_chunk_io`` with PER-BATCH positions (vmapped over the
+    batch: speculative rounds / serving slots advance each sequence
+    unevenly, so the cache offset differs per example). The integer
+    ``in_axes`` applies to every leaf of the cache pytree, so any cache
+    layout (dense, int8) rides the same vmap."""
     sel = None if lora is None else jax.tree.map(
         lambda t: t[adapter_ids], lora["blocks"]
     )  # (B, L, ...)
 
-    def one(params, chunk, k_c, v_c, p, lsel):
+    def one(params, chunk, cache_b, p, lsel):
         lora1 = (
             None if lsel is None
             else {"blocks": jax.tree.map(lambda t: t[None], lsel)}
         )
-        logits, k_c, v_c = forward_chunk(
-            cfg, params, chunk[None], k_c[:, None], v_c[:, None], p,
+        logits, cache_b = forward_chunk_io(
+            cfg, params, chunk[None],
+            jax.tree.map(lambda x: x[:, None], cache_b), p, cache_io,
             lora=lora1,
             adapter_ids=None if lora1 is None else jnp.zeros((1,), jnp.int32),
             lora_scale=lora_scale,
         )
-        return logits[0], k_c[:, 0], v_c[:, 0]
+        return logits[0], jax.tree.map(lambda x: x[:, 0], cache_b)
 
     return jax.vmap(
         one,
-        in_axes=(None, 0, 1, 1, 0, None if sel is None else 0),
-        out_axes=(0, 1, 1),
-    )(params, chunk, k_cache, v_cache, pos, sel)
+        in_axes=(None, 0, 1, 0, None if sel is None else 0),
+        out_axes=(0, 1),
+    )(params, chunk, cache, pos, sel)
+
+
+def forward_chunk_at(cfg, params, chunk, k_cache, v_cache, pos, lora=None,
+                     adapter_ids=None, lora_scale=1.0):
+    """``forward_chunk`` with PER-BATCH positions — the dense-cache
+    spelling of ``forward_chunk_at_io``."""
+    logits, (k_cache, v_cache) = forward_chunk_at_io(
+        cfg, params, chunk, (k_cache, v_cache), pos,
+        _dense_cache_io(cfg.window), lora, adapter_ids, lora_scale,
+    )
+    return logits, k_cache, v_cache

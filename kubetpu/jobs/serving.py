@@ -60,7 +60,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubetpu.core.metrics import LatencyRecorder
-from kubetpu.jobs.decode import forward_chunk, forward_chunk_at, init_kv_cache
+from kubetpu.jobs.decode import (
+    _dense_cache_io,
+    _int8_cache_io,
+    forward_chunk_at_io,
+    forward_chunk_io,
+    init_kv_cache,
+    init_kv_cache_int8,
+)
 from kubetpu.jobs.sampling import chosen_logprob
 from kubetpu.jobs.model import ModelConfig, Params
 
@@ -459,11 +466,23 @@ class DecodeServer(SlotServerBase):
         top_p: Optional[float] = None,
         seed: int = 0,
         mesh=None,
+        kv_int8: bool = False,
     ) -> None:
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
                          top_p=top_p, seed=seed)
-        self.k_cache, self.v_cache = init_kv_cache(cfg, n_slots, max_seq)
+        # The cache is a PYTREE + a cache_io strategy (decode.py's slot):
+        # dense (k, v) or int8 ((kq, ks), (vq, vs)) — the server legs are
+        # layout-blind. ``kv_int8=True`` stores the cache in int8 with
+        # per-token per-head scales (~2x effective slot capacity at the
+        # same HBM; greedy token-exact on trained models, test_quant.py).
+        self.kv_int8 = kv_int8
+        if kv_int8:
+            self.cache = init_kv_cache_int8(cfg, n_slots, max_seq)
+            cache_io = _int8_cache_io(cfg.window)
+        else:
+            self.cache = init_kv_cache(cfg, n_slots, max_seq)
+            cache_io = _dense_cache_io(cfg.window)
         if mesh is not None:
             # Multi-chip serving: params tensor-parallel over tp (same
             # specs training uses — a trained checkpoint serves without a
@@ -471,7 +490,8 @@ class DecodeServer(SlotServerBase):
             # (slots only when dp divides n_slots; otherwise replicated —
             # correctness never depends on the slot split). Committed input
             # shardings propagate through the donated jit legs, so every
-            # step keeps the layout without per-call constraints.
+            # step keeps the layout without per-call constraints. The int8
+            # scale leaves share the spec (their head axis is axis 3 too).
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from kubetpu.jobs.decode import kv_cache_specs
@@ -484,58 +504,80 @@ class DecodeServer(SlotServerBase):
             if n_slots % max(dp, 1):
                 cache_spec = P(None, None, *cache_spec[2:])
             csh = NamedSharding(mesh, _filter_spec(mesh, cache_spec))
-            self.k_cache = jax.device_put(self.k_cache, csh)
-            self.v_cache = jax.device_put(self.v_cache, csh)
+            self.cache = jax.tree.map(
+                lambda x: jax.device_put(x, csh), self.cache
+            )
 
         cfg_ = cfg
         sampler = self._sampler
         lora_scale = getattr(self, "_lora_scale", 1.0)
 
-        # donate_argnums=(1, 2): the caller overwrites self.k_cache/v_cache
-        # with the results, so XLA updates the (large) cache buffers in
-        # place instead of holding input+output copies live per step.
+        # donate_argnums=(1,): the caller overwrites self.cache with the
+        # result, so XLA updates the (large) cache buffers in place
+        # instead of holding input+output copies live per step.
         # The trailing (lora, aid/aids) pair is the multi-LoRA hook
         # (kubetpu.jobs.multi_lora): None/zeros for the plain server — an
         # empty pytree arg, zero trace cost.
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_slot(params, k_cache, v_cache, prompt, slot, prompt_len,
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_slot(params, cache, prompt, slot, prompt_len,
                          rng, temp, tk, tp, lora, aid):
             # single-sequence chunk forward at pos 0, written into `slot`;
             # `prompt` is bucket-padded (see module docstring) — only
             # prompt_len is real, and the last REAL position's logits pick
             # the first token
-            k_s = jnp.take(k_cache, slot[None], axis=1)      # (L,1,S,Hkv,D)
-            v_s = jnp.take(v_cache, slot[None], axis=1)
-            logits, k_s, v_s = forward_chunk(
-                cfg_, params, prompt[None], k_s, v_s, 0,
+            cache_s = jax.tree.map(
+                lambda x: jnp.take(x, slot[None], axis=1), cache
+            )  # every leaf: (L, 1, S, Hkv, D-or-1)
+            logits, cache_s = forward_chunk_io(
+                cfg_, params, prompt[None], cache_s, 0, cache_io,
                 lora=lora, adapter_ids=None if lora is None else aid[None],
                 lora_scale=lora_scale,
             )
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k_s, (0, slot, 0, 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v_s, (0, slot, 0, 0, 0)
+            cache = jax.tree.map(
+                lambda c, s: jax.lax.dynamic_update_slice(
+                    c, s, (0, slot, 0, 0, 0)
+                ),
+                cache, cache_s,
             )
             row = jnp.take(logits[0], prompt_len - 1, axis=0)
             first = sampler(row, rng, temp, tk, tp)
-            return k_cache, v_cache, first, chosen_logprob(row, first)
+            return cache, first, chosen_logprob(row, first)
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def step_all(params, k_cache, v_cache, last, pos, active, rng,
+        @partial(jax.jit, donate_argnums=(1,))
+        def step_all(params, cache, last, pos, active, rng,
                      temp, tk, tp, lora, aids):
-            logits, k_cache, v_cache = forward_chunk_at(
-                cfg_, params, last[:, None], k_cache, v_cache, pos,
+            logits, cache = forward_chunk_at_io(
+                cfg_, params, last[:, None], cache, pos, cache_io,
                 lora=lora, adapter_ids=aids, lora_scale=lora_scale,
             )
             nxt = sampler(logits[:, 0], rng, temp, tk, tp)
             nxt = jnp.where(active, nxt, last)     # inactive slots hold
             lp = chosen_logprob(logits[:, 0], nxt)
             pos = pos + active.astype(jnp.int32)
-            return k_cache, v_cache, nxt, pos, lp
+            return cache, nxt, pos, lp
 
         self._prefill_slot = prefill_slot
         self._step_all = step_all
+
+    @property
+    def k_cache(self):
+        """Dense-layout K cache array — kept for introspection/tests. The
+        int8 layout has no single K array; read ``self.cache`` (the
+        ((kq, ks), (vq, vs)) pytree) there instead of getting cache[0]'s
+        tuple masquerading as an array."""
+        if self.kv_int8:
+            raise AttributeError(
+                "kv_int8 server: no dense k_cache array — use self.cache"
+            )
+        return self.cache[0]
+
+    @property
+    def v_cache(self):
+        if self.kv_int8:
+            raise AttributeError(
+                "kv_int8 server: no dense v_cache array — use self.cache"
+            )
+        return self.cache[1]
 
     # -- multi-LoRA hooks (overridden by MultiLoraDecodeServer) ---------------
 
@@ -555,8 +597,8 @@ class DecodeServer(SlotServerBase):
         bucket = self._bucket(len(prompt))
         padded = prompt + [0] * (bucket - len(prompt))
         lora, aid = self._admit_lora(slot)
-        self.k_cache, self.v_cache, first, first_lp = self._prefill_slot(
-            self.params, self.k_cache, self.v_cache,
+        self.cache, first, first_lp = self._prefill_slot(
+            self.params, self.cache,
             jnp.asarray(padded, jnp.int32), jnp.int32(slot),
             jnp.int32(len(prompt)), self._next_rng(),
             jnp.float32(self._slot_temp[slot]),
@@ -568,8 +610,8 @@ class DecodeServer(SlotServerBase):
 
     def _device_step(self) -> "tuple[np.ndarray, np.ndarray]":
         lora, aids = self._step_lora()
-        self.k_cache, self.v_cache, nxt, self.pos, lp = self._step_all(
-            self.params, self.k_cache, self.v_cache, self.last, self.pos,
+        self.cache, nxt, self.pos, lp = self._step_all(
+            self.params, self.cache, self.last, self.pos,
             jnp.asarray(self.active), self._next_rng(),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp),
@@ -588,8 +630,8 @@ class DecodeServer(SlotServerBase):
 
         def prefill_dummy(padded):
             lora, aid = self._admit_lora(0)
-            self.k_cache, self.v_cache, _f, _lp = self._prefill_slot(
-                self.params, self.k_cache, self.v_cache,
+            self.cache, _f, _lp = self._prefill_slot(
+                self.params, self.cache,
                 jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
                 self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
                 jnp.float32(d_tp), lora, aid,
@@ -597,8 +639,8 @@ class DecodeServer(SlotServerBase):
 
         self._warmup_buckets(prefill_dummy)
         lora, aids = self._step_lora()
-        self.k_cache, self.v_cache, _nxt, _pos, _lps = self._step_all(
-            self.params, self.k_cache, self.v_cache, self.last, self.pos,
+        self.cache, _nxt, _pos, _lps = self._step_all(
+            self.params, self.cache, self.last, self.pos,
             jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp), lora, aids,
@@ -607,4 +649,4 @@ class DecodeServer(SlotServerBase):
         # pays the wall time of every queued warmup execution and records
         # it as admission stall (seen as a ~1.3 s p99 outlier on the
         # tunneled backend, BENCH_MODEL.json serving row)
-        jax.block_until_ready((self.k_cache, self.v_cache))
+        jax.block_until_ready(self.cache)
