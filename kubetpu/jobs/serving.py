@@ -443,7 +443,12 @@ DEFAULT_N_SLOTS = 8
 
 
 class DecodeServer(SlotServerBase):
-    """Slot-based continuous batching over one model replica (dense cache).
+    """Slot-based continuous batching over one model replica, with a
+    contiguous per-slot KV cache in either layout: dense (``cfg.dtype``)
+    or int8 with per-token per-head scales (``kv_int8=True`` — ~2x
+    effective slot capacity, greedy token-exact on trained models). The
+    device legs are cache-layout-blind (a pytree + ``cache_io``
+    strategy); ``PagedDecodeServer`` is the pool-backed sibling.
 
     ``submit(prompt)`` -> request id (or None when all slots are busy);
     ``enqueue(prompt)`` -> request id, admitted at a step boundary;

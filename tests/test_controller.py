@@ -975,3 +975,56 @@ def test_drain_exempts_gang_survivors_from_reservation(stack):
     moved = {m["pod"]: m["node"] for m in res["migrated"]}
     assert moved.get("g0") == nodes["g1"], res  # migrated beside its mate
     assert "g0" not in res["pending"]
+
+
+def test_multislice_gang_over_the_wire():
+    """A multislice gang submitted through the controller HTTP API: the
+    knob rides the pod JSON, placement spans both slices, and the
+    returned launcher env carries the MEGASCALE identity (round 5)."""
+    agents = []
+    try:
+        for uid, pre in (("podA", "a"), ("podB", "b")):
+            for h in range(2):
+                agents.append(NodeAgentServer(
+                    new_fake_tpu_dev_manager(
+                        make_fake_tpus_info("v5e-64", host_index=h,
+                                            slice_uid=uid)
+                    ),
+                    f"{pre}{h}",
+                ))
+        for a in agents:
+            a.start()
+        ctl = ControllerServer(poll_interval=3600)
+        try:
+            ctl.start()
+            for a in agents:
+                _post(ctl.address + "/nodes", {"url": a.address})
+
+            from kubetpu.scheduler.meshstate import MultisliceKey
+
+            def mpod(name):
+                p = tpu_pod(name, 8)
+                p.requests[MultisliceKey] = 2
+                return p
+
+            # 4 pods x 8 chips = 32 > 16 per slice: must span both
+            out = _post(
+                ctl.address + "/pods",
+                {"gang": [pod_to_json(mpod(f"w{i}")) for i in range(4)]},
+            )
+            placements = out["placements"]
+            assert len(placements) == 4
+            slice_ids = set()
+            for pl in placements:
+                envs = [c["env"] for c in pl["containers"].values()
+                        if c["env"].get("TPU_VISIBLE_DEVICES")]
+                assert envs, pl
+                env = envs[0]
+                assert env["MEGASCALE_NUM_SLICES"] == "2"
+                slice_ids.add(env["MEGASCALE_SLICE_ID"])
+            assert slice_ids == {"0", "1"}
+        finally:
+            ctl.shutdown()
+    finally:
+        for a in agents:
+            a.shutdown()
