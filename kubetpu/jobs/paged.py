@@ -43,17 +43,38 @@ import numpy as np
 
 from kubetpu.jobs import model as model_lib
 from kubetpu.jobs.model import ModelConfig, Params
-from kubetpu.jobs.quant import maybe_dequantize
+from kubetpu.jobs.quant import maybe_dequantize, quantize_kv_chunk
 from kubetpu.jobs.sampling import chosen_logprob
 from kubetpu.jobs.serving import SlotServerBase
 
 
 def init_page_pool(
-    cfg: ModelConfig, n_pages: int, page_size: int
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(k_pages, v_pages), each (L, n_pages, page_size, H_kv, D)."""
+    cfg: ModelConfig, n_pages: int, page_size: int, kv_int8: bool = False
+):
+    """(k_pages, v_pages), each (L, n_pages, page_size, H_kv, D) — or,
+    with ``kv_int8``, each a (values int8, scales f32 (..., H_kv, 1))
+    pair: the page pool stores quantized entries (per-token per-head
+    scales, ``quant.quantize_kv_chunk``), compounding the pool's
+    live-token provisioning with another ~2x per page."""
     shape = (cfg.n_layers, n_pages, page_size, cfg.kv_heads, cfg.head_dim)
+    if kv_int8:
+        sshape = shape[:-1] + (1,)
+        return (
+            (jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32)),
+            (jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32)),
+        )
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _gather_pages(pages_l, safe):
+    """Gather a slot's pages from a dense array or an int8 (values,
+    scales) pair — dequant happens on the GATHERED slice only (the
+    convert+mul fuses into the attention einsum's read; the full pool is
+    never materialized in f32)."""
+    if isinstance(pages_l, tuple):
+        q8, sc = pages_l
+        return q8[safe].astype(jnp.float32) * sc[safe]
+    return pages_l[safe]
 
 
 def _attend_paged(q, k_pages_l, v_pages_l, table, pos, window: int = 0):
@@ -72,15 +93,16 @@ def _attend_paged(q, k_pages_l, v_pages_l, table, pos, window: int = 0):
     copy is ever inside the band; everything else is masked here.
     """
     b, h, d = q.shape
-    ps = k_pages_l.shape[1]
-    h_kv = k_pages_l.shape[2]
+    vals_k = k_pages_l[0] if isinstance(k_pages_l, tuple) else k_pages_l
+    ps = vals_k.shape[1]
+    h_kv = vals_k.shape[2]
     g = h // h_kv
     max_pages = table.shape[1]
     scale = d ** -0.5
 
     safe = jnp.maximum(table, 0)
-    k = k_pages_l[safe].reshape(b, max_pages * ps, h_kv, d)   # (B, S_v, Hkv, D)
-    v = v_pages_l[safe].reshape(b, max_pages * ps, h_kv, d)
+    k = _gather_pages(k_pages_l, safe).reshape(b, max_pages * ps, h_kv, d)
+    v = _gather_pages(v_pages_l, safe).reshape(b, max_pages * ps, h_kv, d)
 
     qg = q.reshape(b, h_kv, g, d).astype(jnp.float32)
     scores = jnp.einsum("bkgd,bskd->bkgs", qg, k.astype(jnp.float32)) * scale
@@ -97,11 +119,19 @@ def _attend_paged(q, k_pages_l, v_pages_l, table, pos, window: int = 0):
 
 def _write_token_kv(pages_l, new, phys_page, offset):
     """Scatter one token's K or V per slot into its page.
-    pages_l: (P, ps, H_kv, D); new: (B, H_kv, D); phys_page/offset: (B,).
-    mode="drop": an INACTIVE slot's table row is -1 (mapped to the
-    out-of-bounds sentinel by the caller) — without drop, the negative
-    index would wrap and scribble on the last pool page, which may belong
-    to a live request."""
+    pages_l: (P, ps, H_kv, D) — or the int8 (values, scales) pair, where
+    the token quantizes at write time; new: (B, H_kv, D); phys_page/
+    offset: (B,). mode="drop": an INACTIVE slot's table row is -1 (mapped
+    to the out-of-bounds sentinel by the caller) — without drop, the
+    negative index would wrap and scribble on the last pool page, which
+    may belong to a live request."""
+    if isinstance(pages_l, tuple):
+        q8, sc = pages_l
+        n8, ns = quantize_kv_chunk(new)
+        return (
+            q8.at[phys_page, offset].set(n8, mode="drop"),
+            sc.at[phys_page, offset].set(ns, mode="drop"),
+        )
     return pages_l.at[phys_page, offset].set(new, mode="drop")
 
 
@@ -113,9 +143,11 @@ def paged_forward_one(
     token: (B,) int32; pos: (B,) per-slot position of this token;
     table: (B, max_pages). Returns (logits (B, V), k_pages, v_pages).
     *attend* swaps the page-attention core (the Pallas kernel plugs in
-    here)."""
-    ps = k_pages.shape[2]
-    n_pool = k_pages.shape[1]
+    here). The pools may be dense arrays or int8 (values, scales) pairs —
+    the write/gather helpers branch, the layer scan carries either."""
+    vals = k_pages[0] if isinstance(k_pages, tuple) else k_pages
+    ps = vals.shape[2]
+    n_pool = vals.shape[1]
     phys = jnp.take_along_axis(table, (pos // ps)[:, None], axis=1)[:, 0]
     phys = jnp.where(phys >= 0, phys, n_pool)  # unmapped -> dropped write
     offset = pos % ps
@@ -161,28 +193,58 @@ def paged_prefill(
     worst-case reservation), and their writes are DROPPED — clamping
     instead would scribble on pool page 0, which may belong to another
     slot. Returns (first_token_logits (V,), k_pages, v_pages)."""
-    from kubetpu.jobs.decode import forward_chunk, init_kv_cache
+    from kubetpu.jobs.decode import (
+        _int8_cache_io,
+        forward_chunk,
+        forward_chunk_io,
+        init_kv_cache,
+        init_kv_cache_int8,
+    )
 
-    ps = k_pages.shape[2]
-    n_pool = k_pages.shape[1]
+    int8 = isinstance(k_pages, tuple)
+    vals = k_pages[0] if int8 else k_pages
+    ps = vals.shape[2]
+    n_pool = vals.shape[1]
     s_bucket = prompt.shape[0]
     n_write = (s_bucket + ps - 1) // ps
-    # chunk forward through a TRANSIENT contiguous scratch cache — the very
-    # code path the dense server prefills with, so paged greedy decode is
-    # token-exact against it; the scratch (one bucket) is then re-shaped
-    # into page writes and freed by XLA
-    k_scratch, v_scratch = init_kv_cache(cfg, 1, n_write * ps)
-    logits, k_scratch, v_scratch = forward_chunk(
-        cfg, params, prompt[None], k_scratch, v_scratch, 0
-    )
-    ks = k_scratch[:, 0].reshape(cfg.n_layers, n_write, ps, cfg.kv_heads,
-                                 cfg.head_dim)
-    vs = v_scratch[:, 0].reshape(cfg.n_layers, n_write, ps, cfg.kv_heads,
-                                 cfg.head_dim)
     row = slot_row[:n_write]
     phys = jnp.where(row >= 0, row, n_pool)   # out-of-bounds -> dropped
-    k_pages = k_pages.at[:, phys].set(ks.astype(k_pages.dtype), mode="drop")
-    v_pages = v_pages.at[:, phys].set(vs.astype(v_pages.dtype), mode="drop")
+
+    def reshape_pages(x):
+        # (L, 1, S, H, last) scratch -> (L, n_write, ps, H, last)
+        return x[:, 0].reshape(cfg.n_layers, n_write, ps, *x.shape[3:])
+
+    if int8:
+        # chunk forward through a TRANSIENT int8 scratch — the SAME
+        # quantize-then-attend strategy the int8 DENSE server prefills
+        # with (_int8_cache_io), so the pool receives bit-identical
+        # quantized entries and paged int8 decode is STRUCTURALLY
+        # token-exact against DecodeServer(kv_int8=True) (review r5: an
+        # exact-bf16-scratch prefill only agreed by argmax margin)
+        scratch = init_kv_cache_int8(cfg, 1, n_write * ps)
+        logits, ((kq, ksc), (vq, vsc)) = forward_chunk_io(
+            cfg, params, prompt[None], scratch, 0, _int8_cache_io(cfg.window)
+        )
+        k_pages = (
+            k_pages[0].at[:, phys].set(reshape_pages(kq), mode="drop"),
+            k_pages[1].at[:, phys].set(reshape_pages(ksc), mode="drop"),
+        )
+        v_pages = (
+            v_pages[0].at[:, phys].set(reshape_pages(vq), mode="drop"),
+            v_pages[1].at[:, phys].set(reshape_pages(vsc), mode="drop"),
+        )
+    else:
+        # the very code path the dense server prefills with, so paged
+        # greedy decode is token-exact against it; the scratch (one
+        # bucket) is re-shaped into page writes and freed by XLA
+        k_scratch, v_scratch = init_kv_cache(cfg, 1, n_write * ps)
+        logits, k_scratch, v_scratch = forward_chunk(
+            cfg, params, prompt[None], k_scratch, v_scratch, 0
+        )
+        k_pages = k_pages.at[:, phys].set(
+            reshape_pages(k_scratch).astype(k_pages.dtype), mode="drop")
+        v_pages = v_pages.at[:, phys].set(
+            reshape_pages(v_scratch).astype(v_pages.dtype), mode="drop")
     first = jnp.take(logits[0], prompt_len - 1, axis=0)       # (V,)
     return first, k_pages, v_pages
 
@@ -218,12 +280,18 @@ class PagedDecodeServer(SlotServerBase):
         top_p: Optional[float] = None,
         seed: int = 0,
         mesh=None,
+        kv_int8: bool = False,
     ) -> None:
         if cfg.window > 0 and use_kernel:
             raise NotImplementedError(
                 "the Pallas paged-attention kernel does not implement the "
                 "banded mask yet; windowed paged serving uses the gather "
                 "core (use_kernel=False)"
+            )
+        if kv_int8 and use_kernel:
+            raise NotImplementedError(
+                "the Pallas paged-attention kernel reads dense-dtype pages; "
+                "int8 pools use the gather core (use_kernel=False)"
             )
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
@@ -246,7 +314,10 @@ class PagedDecodeServer(SlotServerBase):
         # default pool: HALF the dense equivalent — the win is configurable,
         # callers size it to expected live tokens
         self.pool_pages = n_pages or (n_slots * self.max_pages_per_slot + 1) // 2
-        self.k_pages, self.v_pages = init_page_pool(cfg, self.pool_pages, page_size)
+        self.kv_int8 = kv_int8
+        self.k_pages, self.v_pages = init_page_pool(
+            cfg, self.pool_pages, page_size, kv_int8=kv_int8
+        )
         if mesh is not None:
             # Multi-chip paged serving: params tensor-parallel (training's
             # specs), pool pages sharded on kv heads over tp. The PAGE axis
@@ -262,8 +333,12 @@ class PagedDecodeServer(SlotServerBase):
                 params, _shardings(mesh, param_specs(cfg)))
             psh = NamedSharding(
                 mesh, _filter_spec(mesh, P(None, None, None, "tp", None)))
-            self.k_pages = jax.device_put(self.k_pages, psh)
-            self.v_pages = jax.device_put(self.v_pages, psh)
+            # int8 pools are (values, scales) pairs; the scale leaves'
+            # head axis is axis 3 too, so one spec serves every leaf
+            self.k_pages = jax.tree.map(
+                lambda x: jax.device_put(x, psh), self.k_pages)
+            self.v_pages = jax.tree.map(
+                lambda x: jax.device_put(x, psh), self.v_pages)
         self._free: List[int] = list(range(self.pool_pages))
         self._table = np.full((n_slots, self.max_pages_per_slot), -1, np.int32)
         self._host_len = [0] * n_slots          # tokens stored per slot

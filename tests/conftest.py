@@ -26,3 +26,31 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: spawns real OS processes / long end-to-end flows"
     )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def trained_small():
+    """ONE briefly-trained small model shared by every quality-contract
+    test (int8 caches, paged pools): (cfg, params, data). The int8
+    exactness contracts need trained weights — an untrained model's
+    near-argmax ties flip under rounding — and training once per SESSION
+    instead of per module saves ~50 s per extra copy."""
+    import jax as _jax
+
+    from kubetpu.jobs import ModelConfig, init_state, make_mesh, make_train_step
+    from kubetpu.jobs.data import SyntheticCorpus
+
+    cfg = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                      max_seq=128)
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1})
+    data = [next(SyntheticCorpus(cfg.vocab, seed=3,
+                                 skew=[0.85, 0.05, 0.05, 0.05])
+                 .batches(8, 32, seed=5)) for _ in range(8)]
+    state, opt = init_state(_jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer=opt, use_ring=False)
+    for i in range(150):
+        state, _ = step(state, *data[i % 8])
+    return cfg, state.params, data

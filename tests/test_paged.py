@@ -269,3 +269,68 @@ def test_windowed_paged_kernel_refuses(params):
     with pytest.raises(NotImplementedError):
         PagedDecodeServer(wcfg, params, n_slots=2, max_seq=64,
                           max_new_tokens=8, use_kernel=True)
+
+
+def test_int8_page_pool_parity_and_bytes(trained_small):
+    """kv_int8 page pool: greedy tokens EXACTLY match the int8 dense-cache
+    server (the apples-to-apples reference: same quantize-on-write scales,
+    only the storage layout differs) across a staggered lifecycle with
+    page-boundary crossings — and the pool is ~half the resident bytes,
+    so the live-token provisioning and the int8 entries COMPOUND. (Versus
+    the bf16 pool the contract is agreement, not exactness: int8 rounding
+    legitimately flips near-argmax ties on weak continuations.)"""
+    import jax as _jax
+
+    tcfg, params, data = trained_small
+    row = [int(t) for t in data[0][0][0]]
+    prompts = [row[:6], row[:2], row[:9]]
+
+    def run(server):
+        ra = server.submit(prompts[0])
+        server.step()
+        rb = server.submit(prompts[1])
+        server.drain()
+        rc = server.submit(prompts[2])
+        server.drain()
+        return [server.result(r) for r in (ra, rb, rc)]
+
+    dense = PagedDecodeServer(tcfg, params, n_slots=2, max_seq=64,
+                              max_new_tokens=12, page_size=8)
+    q8 = PagedDecodeServer(tcfg, params, n_slots=2, max_seq=64,
+                           max_new_tokens=12, page_size=8, kv_int8=True)
+    q8_dense_ref = DecodeServer(tcfg, params, n_slots=2, max_seq=64,
+                                max_new_tokens=12, kv_int8=True)
+    got = run(q8)
+    assert got == run(q8_dense_ref)  # exact: same layout semantics
+    bf16 = run(dense)
+    agree = sum(a == b for g, r in zip(got, bf16) for a, b in zip(g, r))
+    total = sum(len(g) for g in got)
+    assert agree / total > 0.9, f"int8 vs bf16 agreement {agree/total}"
+    dense_b = sum(x.nbytes for x in _jax.tree.leaves(
+        (dense.k_pages, dense.v_pages)))
+    q8_b = sum(x.nbytes for x in _jax.tree.leaves((q8.k_pages, q8.v_pages)))
+    assert q8_b < 0.6 * dense_b  # f32 pool -> int8 + thin scales
+    with pytest.raises(NotImplementedError):
+        PagedDecodeServer(tcfg, params, use_kernel=True, kv_int8=True)
+
+
+def test_int8_windowed_paged_triple_composition(trained_small):
+    """window x paged ring x int8 pool all at once: token-exact vs the
+    dense banded DecodeServer — every memory feature stacked."""
+    import dataclasses
+
+    tcfg, params, data = trained_small
+    wcfg = dataclasses.replace(tcfg, window=8)
+    prompt = [int(t) for t in data[1][0][0][:9]]
+    # exact reference: the int8 DENSE banded server — same write-time
+    # quantization, only the storage layout (pool ring vs contiguous)
+    # differs, so the tokens must be identical
+    ref = DecodeServer(wcfg, params, n_slots=2, max_seq=96,
+                       max_new_tokens=30, kv_int8=True)
+    q8 = PagedDecodeServer(wcfg, params, n_slots=2, max_seq=96,
+                           max_new_tokens=30, page_size=4, kv_int8=True)
+    rr, rq = ref.submit(prompt), q8.submit(prompt)
+    ref.drain(); q8.drain()
+    assert ref.result(rr) == q8.result(rq)
+    # the ring bound still holds with the int8 pool
+    assert q8.pages_in_use() == 0  # retired

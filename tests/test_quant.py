@@ -90,26 +90,7 @@ def test_serving_servers_accept_quantized_params():
 # -- int8 KV cache (round 5) -------------------------------------------------
 
 
-import pytest
-
-
-@pytest.fixture(scope="module")
-def trained_small():
-    """One shared 150-step trained model for the kv-int8 quality tests."""
-    from kubetpu.jobs import init_state, make_mesh, make_train_step
-    from kubetpu.jobs.data import SyntheticCorpus
-
-    cfg = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4, d_ff=128,
-                      max_seq=128)
-    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1})
-    data = [next(SyntheticCorpus(64, seed=3,
-                                 skew=[0.85, 0.05, 0.05, 0.05])
-                 .batches(8, 32, seed=5)) for _ in range(8)]
-    state, opt = init_state(jax.random.PRNGKey(0), cfg, mesh)
-    step = make_train_step(cfg, mesh, optimizer=opt, use_ring=False)
-    for i in range(150):
-        state, _ = step(state, *data[i % 8])
-    return cfg, state.params, data
+# trained_small: the SESSION-scoped shared fixture in conftest.py
 
 
 def test_kv_int8_quality_contract_on_trained_model(trained_small):
