@@ -38,5 +38,7 @@ __all__ = [
 #   kubetpu.jobs.vision (ViT classification family),
 #   kubetpu.jobs.checkpoint (orbax), kubetpu.jobs.data,
 #   kubetpu.jobs.tokenizer (HF tokenizer.json byte-level BPE loader),
+#   kubetpu.jobs.distill (draft distillation for speculative decoding),
+#   kubetpu.jobs.quant (int8 weights + int8 KV cache),
 #   kubetpu.jobs.native_data (C++ mmap corpus loader),
 #   kubetpu.jobs.launch (jax.distributed wiring)
