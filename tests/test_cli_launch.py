@@ -155,3 +155,47 @@ def test_event_log_records_lifecycle():
     kinds = [e["kind"] for e in cluster.events]
     assert kinds == ["schedule", "release", "node_failed"]
     assert cluster.status()["recent_events"][-1]["kind"] == "node_failed"
+
+
+def test_gang_launch_configs_multislice():
+    """The launch layer closes the multislice loop: a DCN-spanning gang
+    yields ONE jax.distributed process group (ranks = gang order across
+    both sub-gangs, one coordinator), and each worker's env still carries
+    its MEGASCALE identity for the dcn-axis mesh build."""
+    from kubetpu.core import Cluster
+    from kubetpu.jobs.launch import gang_launch_configs, select_device_env
+    from kubetpu.scheduler.meshstate import MultisliceKey
+
+    cluster = Cluster()
+    for uid, pre in (("podA", "a"), ("podB", "b")):
+        for h in range(2):
+            cluster.register_node(
+                f"{pre}{h}",
+                device=new_fake_tpu_dev_manager(
+                    make_fake_tpus_info("v5e-64", host_index=h,
+                                        slice_uid=uid)
+                ),
+            )
+    pods = [
+        PodInfo(name=f"w{i}", requests={MultisliceKey: 2},
+                running_containers={
+                    "m": ContainerInfo(requests={ResourceTPU: 8})})
+        for i in range(4)  # 32 chips > 16 per (2-host) slice: spans both
+    ]
+    placed = cluster.schedule_gang(pods)
+    configs = gang_launch_configs(cluster, placed)
+    assert len(configs) == 4
+    assert all(c.num_processes == 4 for c in configs)
+    assert [c.process_id for c in configs] == [0, 1, 2, 3]
+    assert {c.coordinator_address for c in configs} == {
+        placed[0].node_name + ":8476"
+    }
+    # MEGASCALE env per worker, both slice ids represented
+    sids = set()
+    for pod in placed:
+        env = select_device_env(
+            [e for _, _, e in cluster.allocate(pod.name).values()]
+        )
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        sids.add(env["MEGASCALE_SLICE_ID"])
+    assert sids == {"0", "1"}
