@@ -48,6 +48,14 @@ bench-adversarial:
 demo:
 	python examples/train_demo.py
 
+.PHONY: multislice-demo
+multislice-demo:
+	python examples/multislice_demo.py
+
+.PHONY: text-serve-demo
+text-serve-demo:
+	python examples/text_serve_demo.py
+
 .PHONY: train-demo-wire
 train-demo-wire:
 	python examples/train_demo.py --wire
