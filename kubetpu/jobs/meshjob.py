@@ -84,13 +84,21 @@ def make_multislice_mesh(
     (real multislice); a flat device list (CPU validation meshes, the
     driver's virtual-device dryrun) is split into ``dcn`` equal contiguous
     chunks — the same worker-id-major order the scheduler's sub-gangs
-    export."""
+    export.
+
+    An EXPLICIT device list must fit the mesh exactly: oversupply (more
+    slice groups than ``dcn``, or a group larger than the inner axes)
+    raises like undersupply does — a silently truncated allocation would
+    leave scheduled chips idle and hide a placement bug. The default
+    (process-wide ``jax.devices()``) keeps the permissive take-what-fits
+    behavior."""
     if "dcn" not in axis_sizes:
         raise ValueError("make_multislice_mesh needs a 'dcn' axis (n_slices)")
     n_slices = axis_sizes["dcn"]
     inner = {a: s for a, s in axis_sizes.items() if a != "dcn"}
     per_slice = int(np.prod(list(inner.values()))) if inner else 1
-    devs = list(devices) if devices is not None else jax.devices()
+    explicit = devices is not None
+    devs = list(devices) if explicit else jax.devices()
     groups = slice_groups(devs)
     if len(groups) == 1 and n_slices > 1:
         # flat list: split into contiguous chunks of per_slice devices
@@ -100,6 +108,12 @@ def make_multislice_mesh(
                 f"need {n_slices * per_slice} devices for mesh {axis_sizes}, "
                 f"have {len(flat)}"
             )
+        if explicit and len(flat) > n_slices * per_slice:
+            raise ValueError(
+                f"mesh {axis_sizes} uses {n_slices * per_slice} devices but "
+                f"{len(flat)} were supplied — truncating would leave "
+                f"allocated chips idle"
+            )
         groups = [
             flat[i * per_slice : (i + 1) * per_slice] for i in range(n_slices)
         ]
@@ -108,10 +122,20 @@ def make_multislice_mesh(
             f"mesh wants dcn={n_slices} slices but devices span only "
             f"{len(groups)}"
         )
+    if explicit and len(groups) > n_slices:
+        raise ValueError(
+            f"mesh wants dcn={n_slices} slices but the supplied devices "
+            f"span {len(groups)} — truncating would drop whole slices"
+        )
     for g in groups[:n_slices]:
         if len(g) < per_slice:
             raise ValueError(
                 f"slice group has {len(g)} devices, inner axes need {per_slice}"
+            )
+        if explicit and len(g) > per_slice:
+            raise ValueError(
+                f"slice group has {len(g)} devices but the inner axes use "
+                f"{per_slice} — truncating would leave allocated chips idle"
             )
     arr = np.array([g[:per_slice] for g in groups[:n_slices]])
     names = ("dcn",) + tuple(inner)

@@ -113,14 +113,16 @@ class MultiLoraDecodeServer(DecodeServer):
         self._rid_adapter[rid] = aid
         return rid
 
-    def _try_admit(self, rid: int, prompt: List[int], slot: int,
-                   defer: bool = False) -> bool:
+    def _bind_slot(self, rid: int, slot: int) -> None:
+        # the shared binding hook runs on BOTH admission paths (monolithic
+        # _try_admit and the chunked-prefill _begin_prefill), so a chunked
+        # multi-LoRA prefill applies the right adapter from chunk one
         if rid not in self._rid_adapter:  # submit path: rid is brand new
             self._rid_adapter[rid] = (
                 0 if self._submit_adapter is None else self._submit_adapter
             )
         self._slot_adapter[slot] = self._rid_adapter[rid]
-        return super()._try_admit(rid, prompt, slot, defer)
+        super()._bind_slot(rid, slot)
 
     def cancel(self, rid: int) -> bool:
         out = super().cancel(rid)

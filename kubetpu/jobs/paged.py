@@ -42,10 +42,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubetpu.jobs import model as model_lib
+from kubetpu.jobs.decode import forward_chunk_io
 from kubetpu.jobs.model import ModelConfig, Params
 from kubetpu.jobs.quant import maybe_dequantize, quantize_kv_chunk
 from kubetpu.jobs.sampling import chosen_logprob
-from kubetpu.jobs.serving import SlotServerBase
+from kubetpu.jobs.serving import SlotServerBase, _cached_legs
 
 
 def init_page_pool(
@@ -137,19 +138,24 @@ def _write_token_kv(pages_l, new, phys_page, offset):
 
 def paged_forward_one(
     cfg: ModelConfig, params: Params, token, k_pages, v_pages, table, pos,
-    attend=_attend_paged,
+    attend=_attend_paged, write_enable=None,
 ):
     """One decode step for all slots through the page pool.
     token: (B,) int32; pos: (B,) per-slot position of this token;
     table: (B, max_pages). Returns (logits (B, V), k_pages, v_pages).
     *attend* swaps the page-attention core (the Pallas kernel plugs in
     here). The pools may be dense arrays or int8 (values, scales) pairs —
-    the write/gather helpers branch, the layer scan carries either."""
+    the write/gather helpers branch, the layer scan carries either.
+    *write_enable* (B,) bool drops the K/V write for masked slots — the
+    serving step passes ``active`` so an inactive slot never scribbles
+    on pages a mid-prefill neighbor has already filled."""
     vals = k_pages[0] if isinstance(k_pages, tuple) else k_pages
     ps = vals.shape[2]
     n_pool = vals.shape[1]
     phys = jnp.take_along_axis(table, (pos // ps)[:, None], axis=1)[:, 0]
     phys = jnp.where(phys >= 0, phys, n_pool)  # unmapped -> dropped write
+    if write_enable is not None:
+        phys = jnp.where(write_enable, phys, n_pool)
     offset = pos % ps
     x = params["embed"][token][:, None]                       # (B, 1, D)
 
@@ -181,72 +187,124 @@ def paged_forward_one(
     return logits[:, 0], k_pages, v_pages
 
 
-def paged_prefill(
-    cfg: ModelConfig, params: Params, prompt, k_pages, v_pages,
-    slot_row, prompt_len,
-):
-    """Prefill one slot's prompt into its pages with a single batched
-    forward. prompt: (S_bucket,) int32 (bucket-padded); slot_row: the
-    slot's page-table row (max_pages,); writes ceil(S_bucket/ps) pages.
-    A bucket can exceed the slot's RESERVED pages (power-of-two padding);
-    the excess holds pad positions only (real tokens always fit in the
-    worst-case reservation), and their writes are DROPPED — clamping
-    instead would scribble on pool page 0, which may belong to another
-    slot. Returns (first_token_logits (V,), k_pages, v_pages)."""
-    from kubetpu.jobs.decode import (
-        _int8_cache_io,
-        forward_chunk,
-        forward_chunk_io,
-        init_kv_cache,
-        init_kv_cache_int8,
-    )
+def _paged_prefill_io(write_phys, gather_row, ps: int, window: int):
+    """The PAGE-POOL cache strategy for a prefill chunk: scatter the
+    chunk's K/V into its (page-aligned) physical pages, then attend the
+    chunk's queries through the slot's gathered logical pages — so
+    ``decode.forward_chunk_io`` (THE chunk forward) runs unchanged over
+    the pool, and chunked paged prefill shares one block implementation
+    with every other cache layout. The attention math is the dense
+    ``_attend_cached`` over the logical-order gather, which is exactly
+    the masked score math ``_attend_paged`` computes — paged prefill
+    stays token-exact against the dense server.
 
-    int8 = isinstance(k_pages, tuple)
-    vals = k_pages[0] if int8 else k_pages
-    ps = vals.shape[2]
-    n_pool = vals.shape[1]
-    s_bucket = prompt.shape[0]
-    n_write = (s_bucket + ps - 1) // ps
-    row = slot_row[:n_write]
-    phys = jnp.where(row >= 0, row, n_pool)   # out-of-bounds -> dropped
+    Attention order matters on a ring: the pool is gathered BEFORE the
+    chunk's writes (so the pre-chunk window tail is still resident — the
+    chunk's pages would evict it), the chunk's own K/V is PATCHED into
+    the gathered contiguous view at its positions, and only then does the
+    scatter commit the chunk to the pool for later chunks and decode.
+    The attended view is therefore a contiguous position-ordered cache —
+    the dense ``_attend_cached`` math, so paged prefill stays token-exact
+    against the dense server.
 
-    def reshape_pages(x):
-        # (L, 1, S, H, last) scratch -> (L, n_write, ps, H, last)
-        return x[:, 0].reshape(cfg.n_layers, n_write, ps, *x.shape[3:])
+    *write_phys* (n_write,): physical page per chunk page, with dropped
+    pages (pad-only, or ring-aliased duplicates — the host keeps only the
+    last logical occurrence) pointed out of bounds so the scatter drops
+    them. *gather_row*: a PREFIX of the slot's logical table just
+    covering the chunk's visible positions (the host rounds it to a
+    power-of-two page count so compile entries stay bounded) — attending
+    the full max_seq view would charge every admission for the slot's
+    worst case. Unmapped (-1) rows gather page 0 and are killed by the
+    positional mask (their logical positions exceed every chunk query),
+    aliased stale ring rows by the window band — the same soundness
+    argument the decode-side ring table relies on. int8 pools quantize at write with
+    the same per-token per-head scales as ``_int8_cache_io`` — and the
+    patched in-chunk view is the DEQUANTIZED quantized chunk, exactly
+    what the int8 dense server's attention reads — so the pool receives
+    bit-identical entries and emits bit-identical attention."""
+    from kubetpu.jobs.decode import _attend_cached
 
-    if int8:
-        # chunk forward through a TRANSIENT int8 scratch — the SAME
-        # quantize-then-attend strategy the int8 DENSE server prefills
-        # with (_int8_cache_io), so the pool receives bit-identical
-        # quantized entries and paged int8 decode is STRUCTURALLY
-        # token-exact against DecodeServer(kv_int8=True) (review r5: an
-        # exact-bf16-scratch prefill only agreed by argmax margin)
-        scratch = init_kv_cache_int8(cfg, 1, n_write * ps)
-        logits, ((kq, ksc), (vq, vsc)) = forward_chunk_io(
-            cfg, params, prompt[None], scratch, 0, _int8_cache_io(cfg.window)
+    n_write = write_phys.shape[0]
+
+    def split(pages_l, new):
+        """(pool write payload, contiguous attend payload) for one chunk."""
+        if isinstance(pages_l, tuple):
+            n8, ns = quantize_kv_chunk(new)
+            return (n8, ns), (n8.astype(jnp.float32) * ns)
+        return new.astype(pages_l.dtype), new
+
+    def scatter(pages_l, payload):
+        if isinstance(pages_l, tuple):
+            q8, sc = pages_l
+            n8, ns = payload
+            return (
+                q8.at[write_phys].set(
+                    n8[0].reshape(n_write, ps, *n8.shape[2:]), mode="drop"),
+                sc.at[write_phys].set(
+                    ns[0].reshape(n_write, ps, *ns.shape[2:]), mode="drop"),
+            )
+        return pages_l.at[write_phys].set(
+            payload[0].reshape(n_write, ps, *payload.shape[2:]), mode="drop")
+
+    def io(q, k, v, cache, pos):
+        k_l, v_l = cache
+        k_pool, k_att = split(k_l, k)
+        v_pool, v_att = split(v_l, v)
+        safe = jnp.maximum(gather_row, 0)
+        kk = _gather_pages(k_l, safe)       # (max_pages, ps, H_kv, D)
+        vv = _gather_pages(v_l, safe)
+        kk = kk.reshape(1, -1, *kk.shape[2:])
+        vv = vv.reshape(1, -1, *vv.shape[2:])
+        kk = jax.lax.dynamic_update_slice(
+            kk, k_att.astype(kk.dtype), (0, pos, 0, 0))
+        vv = jax.lax.dynamic_update_slice(
+            vv, v_att.astype(vv.dtype), (0, pos, 0, 0))
+        attn = _attend_cached(q, kk, vv, pos, window=window)
+        return attn, (scatter(k_l, k_pool), scatter(v_l, v_pool))
+
+    return io
+
+
+def _build_paged_legs(cfg_, page_size, attend):
+    """(prefill_chunk, step_all) jits for the page-pool server — shared
+    across same-key servers via ``serving._cached_legs`` (the legs are
+    pure functions of their arguments)."""
+    from kubetpu.jobs.sampling import make_slot_sampler
+
+    sampler = make_slot_sampler()
+    ps_ = page_size
+    window_ = cfg_.window
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def step_all(params, k_pages, v_pages, table, last, pos, active,
+                 reqkeys, temp, tk, tp):
+        logits, k_pages, v_pages = paged_forward_one(
+            cfg_, params, last, k_pages, v_pages, table, pos,
+            attend=attend, write_enable=active,
         )
-        k_pages = (
-            k_pages[0].at[:, phys].set(reshape_pages(kq), mode="drop"),
-            k_pages[1].at[:, phys].set(reshape_pages(ksc), mode="drop"),
+        keys = jax.vmap(jax.random.fold_in)(reqkeys, pos)
+        nxt = sampler(logits, keys, temp, tk, tp)
+        nxt = jnp.where(active, nxt, last)
+        lp = chosen_logprob(logits, nxt)
+        pos = pos + active.astype(jnp.int32)
+        return k_pages, v_pages, nxt, pos, lp
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def prefill_chunk(params, k_pages, v_pages, chunk, write_phys, row,
+                      pos, last_idx, reqkey, temp, tk, tp):
+        # the chunk forward THROUGH the pool: forward_chunk_io over
+        # the paged cache strategy (module docstring) — one compile
+        # per chunk length serves every offset and every slot
+        io = _paged_prefill_io(write_phys, row, ps_, window_)
+        logits, (k_pages, v_pages) = forward_chunk_io(
+            cfg_, params, chunk[None], (k_pages, v_pages), pos, io
         )
-        v_pages = (
-            v_pages[0].at[:, phys].set(reshape_pages(vq), mode="drop"),
-            v_pages[1].at[:, phys].set(reshape_pages(vsc), mode="drop"),
-        )
-    else:
-        # the very code path the dense server prefills with, so paged
-        # greedy decode is token-exact against it; the scratch (one
-        # bucket) is re-shaped into page writes and freed by XLA
-        k_scratch, v_scratch = init_kv_cache(cfg, 1, n_write * ps)
-        logits, k_scratch, v_scratch = forward_chunk(
-            cfg, params, prompt[None], k_scratch, v_scratch, 0
-        )
-        k_pages = k_pages.at[:, phys].set(
-            reshape_pages(k_scratch).astype(k_pages.dtype), mode="drop")
-        v_pages = v_pages.at[:, phys].set(
-            reshape_pages(v_scratch).astype(v_pages.dtype), mode="drop")
-    first = jnp.take(logits[0], prompt_len - 1, axis=0)       # (V,)
-    return first, k_pages, v_pages
+        r = jnp.take(logits[0], last_idx, axis=0)
+        tok = sampler(r, jax.random.fold_in(reqkey, pos + last_idx),
+                      temp, tk, tp)
+        return k_pages, v_pages, tok, chosen_logprob(r, tok)
+
+    return prefill_chunk, step_all
 
 
 class PagedDecodeServer(SlotServerBase):
@@ -255,12 +313,22 @@ class PagedDecodeServer(SlotServerBase):
     ``SlotServerBase``; only the device legs differ), cache memory
     proportional to live tokens.
 
-    ``n_pages`` provisions the shared pool; a request is admitted only
-    when the pool can cover its worst case (prompt + max_new_tokens), so a
-    decoding sequence never starves mid-flight — and a request whose worst
-    case exceeds the WHOLE pool is rejected up front by ``_check_prompt``
-    (otherwise it would park the queue head forever). ``pages_in_use()``
-    and ``pool_pages`` expose the accounting the memory test pins.
+    ``n_pages`` provisions the shared pool; a DECODING request always
+    holds its worst case (prompt + max_new_tokens), so it never starves
+    mid-flight — and a request whose worst case exceeds the WHOLE pool is
+    rejected up front by ``_check_prompt`` (otherwise it would park the
+    queue head forever). ``pages_in_use()`` and ``pool_pages`` expose the
+    accounting the memory test pins.
+
+    With ``prefill_budget > 0`` the prompt streams in as page-aligned
+    chunks and the reservation is CHUNK-GRANULAR during the prefill
+    phase: a mid-prefill slot holds pages only for the tokens written so
+    far (the final chunk upgrades to the decode worst case), so a long
+    admission no longer locks worst-case pages away from its decoding
+    neighbors. A chunk that cannot get its pages parks until retirements
+    free some; if every holder is itself a parked prefill (nothing will
+    ever free), the scheduler sends all but the oldest back to the queue
+    with their pages released — no deadlock, no leak.
     """
 
     def __init__(
@@ -281,6 +349,8 @@ class PagedDecodeServer(SlotServerBase):
         seed: int = 0,
         mesh=None,
         kv_int8: bool = False,
+        prefill_budget: int = 0,
+        overlap: bool = False,
     ) -> None:
         if cfg.window > 0 and use_kernel:
             raise NotImplementedError(
@@ -295,7 +365,8 @@ class PagedDecodeServer(SlotServerBase):
             )
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
-                         top_p=top_p, seed=seed)
+                         top_p=top_p, seed=seed,
+                         prefill_budget=prefill_budget, overlap=overlap)
         self.page_size = page_size
         self._min_bucket = page_size  # bucket >= one page keeps shapes few
         self.max_pages_per_slot = (max_seq + page_size - 1) // page_size
@@ -349,32 +420,10 @@ class PagedDecodeServer(SlotServerBase):
 
             attend = partial(paged_attention, interpret=interpret)
 
-        cfg_ = cfg
-        sampler = self._sampler
-
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def step_all(params, k_pages, v_pages, table, last, pos, active, rng,
-                     temp, tk, tp):
-            logits, k_pages, v_pages = paged_forward_one(
-                cfg_, params, last, k_pages, v_pages, table, pos, attend=attend
-            )
-            nxt = sampler(logits, rng, temp, tk, tp)
-            nxt = jnp.where(active, nxt, last)
-            lp = chosen_logprob(logits, nxt)
-            pos = pos + active.astype(jnp.int32)
-            return k_pages, v_pages, nxt, pos, lp
-
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_slot(params, k_pages, v_pages, prompt, slot_row,
-                         prompt_len, rng, temp, tk, tp):
-            first, k_pages, v_pages = paged_prefill(
-                cfg_, params, prompt, k_pages, v_pages, slot_row, prompt_len
-            )
-            tok = sampler(first, rng, temp, tk, tp)
-            return k_pages, v_pages, tok, chosen_logprob(first, tok)
-
-        self._step_all = step_all
-        self._prefill_slot = prefill_slot
+        self._prefill_chunk, self._step_all = _cached_legs(
+            ("paged", cfg, page_size, kv_int8, use_kernel, interpret),
+            lambda: _build_paged_legs(cfg, page_size, attend),
+        )
 
     # -- page accounting -----------------------------------------------------
 
@@ -395,6 +444,14 @@ class PagedDecodeServer(SlotServerBase):
         sequence length."""
         need = self._pages_needed(upto_tokens)
         if self._ring_pages:
+            if (self._table[slot] >= 0).any():
+                # the slot already holds its mapped ring (a resumed
+                # chunked prefill, or a buggy re-admission) — popping a
+                # fresh ring here would LEAK the mapped physical pages;
+                # the existing aliased mapping already covers every
+                # logical page, so this is a no-op, mirroring the
+                # non-ring branch's `have` handling
+                return True
             phys_need = min(need, self._ring_pages)
             if phys_need > len(self._free):
                 return False
@@ -446,57 +503,116 @@ class PagedDecodeServer(SlotServerBase):
 
     # -- device legs ---------------------------------------------------------
 
+    def _chunk_quantum(self) -> int:
+        return self.page_size       # chunk starts stay page-aligned
+
+    def _gather_prefix(self, upto_tokens: int) -> int:
+        """Power-of-two page count covering *upto_tokens* positions
+        (capped at the slot's table) — the attend-prefix shape rule,
+        shared by the live path and warmup so a warmed shape is exactly
+        a served shape."""
+        n = 1
+        while n * self.page_size < upto_tokens:
+            n *= 2
+        return min(n, self.max_pages_per_slot)
+
     def _admit_device(self, prompt: List[int], slot: int):
-        """Reserve worst-case pages, dispatch the prefill. None when the
-        pool cannot cover the request (nothing mutated); otherwise the
-        first token as a DEVICE scalar (no host sync — the defer path
-        depends on it)."""
-        if not self._alloc_pages(slot, self._worst_case_tokens(len(prompt))):
-            return None
-        bucket = self._bucket(len(prompt))
-        padded = prompt + [0] * (bucket - len(prompt))
-        prefill_row = self._table[slot]
+        """Whole-prompt prefill as one pos-0 final chunk — the chunk leg
+        owns the worst-case page reservation (its ``final`` branch) and
+        returns None on pool exhaustion with nothing mutated."""
+        return self._prefill_chunk_device(prompt, slot, 0, len(prompt), True)
+
+    def _prefill_chunk_device(self, prompt: List[int], slot: int, pos: int,
+                              take: int, final: bool):
+        """One (page-aligned) prefill chunk through the pool, with
+        CHUNK-GRANULAR page reservation: a mid-prefill slot holds pages
+        for the tokens written so far, not the worst case — the pool
+        serves decoding neighbors while a long prompt streams in. The
+        FINAL chunk upgrades the reservation to the decode worst case
+        (prompt + max_new_tokens + 1), so the invariant decode relies on
+        — boundary crossings never fail — holds from the first emitted
+        token. Ring (windowed) slots map their whole O(window) ring up
+        front instead: it is already the worst case, and chunk-granular
+        aliasing bookkeeping would buy nothing."""
         if self._ring_pages:
-            # Prefill scatters every bucket page in ONE .at[].set; logical
-            # pages aliased onto the same ring page would be duplicate
-            # scatter indices (undefined winner). Keep exactly the last
-            # ring-many REAL prompt pages: earlier prompt pages are
-            # superseded (outside every future band), and pad-only bucket
-            # pages must NOT win an aliased write over live prompt data
-            # (review r5: bucket padding displaced real pages) — their
-            # positions are masked until decode overwrites them token by
-            # token, so dropping their writes is free.
-            prompt_pages = self._pages_needed(len(prompt))
-            phys_live = len({int(p) for p in self._table[slot] if p >= 0})
-            keep_lo = max(0, prompt_pages - phys_live)
-            if keep_lo > 0 or self._pages_needed(bucket) > prompt_pages:
-                prefill_row = self._table[slot].copy()
-                prefill_row[:keep_lo] = -1
-                prefill_row[prompt_pages:] = -1
-        self.k_pages, self.v_pages, first, first_lp = self._prefill_slot(
+            if not self._alloc_pages(
+                    slot, self._worst_case_tokens(len(prompt))):
+                return None
+        else:
+            upto = (self._worst_case_tokens(len(prompt)) if final
+                    else pos + take)
+            if not self._alloc_pages(slot, upto):
+                return None
+        ps = self.page_size
+        if final:
+            # final chunks bucket-pad (finish-the-tail, _chunk_take) —
+            # pad K/V land at positions decode overwrites before any
+            # read, pad-only pages are dropped below
+            bucket = self._bucket(take)
+            if pos + bucket > self.max_pages_per_slot * ps:
+                bucket = ((take + ps - 1) // ps) * ps   # defensive clamp
+        else:
+            # grid-sized chunk, page-rounded so starts stay page-aligned
+            bucket = ((take + ps - 1) // ps) * ps
+        chunk = prompt[pos:pos + take] + [0] * (bucket - take)
+        n_write = (bucket + ps - 1) // ps
+        p0 = pos // ps
+        row = self._table[slot]
+        write_row = row[p0:p0 + n_write].astype(np.int64)
+        # Pad-only pages (no real token) are dropped: a pad write must
+        # never win an aliased ring slot over live prompt data, nor land
+        # on an unreserved page (review r5's bucket-padding hazard).
+        last_real = (pos + take - 1) // ps - p0
+        write_row[last_real + 1:] = -1
+        if self._ring_pages:
+            # one scatter must not carry duplicate physical indices
+            # (undefined winner): keep only the LAST logical occurrence
+            # of each ring page — earlier aliased pages are superseded
+            # (outside every future band), the monolithic dance's rule
+            # applied per chunk
+            seen = set()
+            for i in range(len(write_row) - 1, -1, -1):
+                p = int(write_row[i])
+                if p < 0:
+                    continue
+                if p in seen:
+                    write_row[i] = -1
+                else:
+                    seen.add(p)
+        write_phys = np.where(write_row >= 0, write_row,
+                              self.pool_pages).astype(np.int32)
+        # attend only the pages the chunk can SEE (positions <= pos +
+        # bucket), prefix rounded to a power of two so a handful of
+        # compilations serves every offset — not the slot's whole
+        # max_seq view (a ~max_seq/bucket x cost on every admission)
+        n_gather = self._gather_prefix(pos + bucket)
+        self.k_pages, self.v_pages, first, first_lp = self._prefill_chunk(
             self.params, self.k_pages, self.v_pages,
-            jnp.asarray(padded, jnp.int32),
-            jnp.asarray(prefill_row),
-            jnp.int32(len(prompt)), self._next_rng(),
+            jnp.asarray(chunk, jnp.int32),
+            jnp.asarray(write_phys), jnp.asarray(row[:n_gather]),
+            jnp.int32(pos), jnp.int32(take - 1),
+            jnp.asarray(self._slot_reqkey[slot]),
             jnp.float32(self._slot_temp[slot]),
             jnp.int32(self._slot_topk[slot]),
             jnp.float32(self._slot_topp[slot]),
         )
-        return first, first_lp
+        return (first, first_lp) if final else True
 
-    def _device_step(self) -> "tuple[np.ndarray, np.ndarray]":
-        # worst-case pages were reserved at admission, so boundary
-        # crossings never fail; the REAL table (with -1 sentinels) flows
-        # to the device — the attention core masks unmapped pages
+    def _device_step(self):
+        # worst-case pages were reserved by admission / the final prefill
+        # chunk, so boundary crossings never fail; the REAL table (with
+        # -1 sentinels) flows to the device — the attention core masks
+        # unmapped pages
         self.k_pages, self.v_pages, nxt, self.pos, lp = self._step_all(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table),
-            self.last, self.pos, jnp.asarray(self.active), self._next_rng(),
+            self.last, self.pos, jnp.asarray(self.active),
+            jnp.asarray(self._slot_reqkey),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp),
         )
         self.last = nxt
-        return np.asarray(nxt), np.asarray(lp)
+        return nxt, lp
 
     def warmup(self) -> None:
         """Pre-compile every prompt bucket + the step (serving.warmup's
@@ -508,19 +624,41 @@ class PagedDecodeServer(SlotServerBase):
             self._pages_needed(self.max_seq)
         ) % self.pool_pages
 
-        def prefill_dummy(padded):
-            self.k_pages, self.v_pages, _f, _lp = self._prefill_slot(
+        def prefill_dummy(padded, n_gather=None):
+            n_write = (len(padded) + self.page_size - 1) // self.page_size
+            if n_gather is None:
+                n_gather = self._gather_prefix(len(padded))
+            self.k_pages, self.v_pages, _f, _lp = self._prefill_chunk(
                 self.params, self.k_pages, self.v_pages,
-                jnp.asarray(padded, jnp.int32), jnp.asarray(row), jnp.int32(1),
-                self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
-                jnp.float32(d_tp),
+                jnp.asarray(padded, jnp.int32),
+                jnp.asarray(row[:n_write]), jnp.asarray(row[:n_gather]),
+                jnp.int32(0), jnp.int32(0),
+                jnp.asarray(self._slot_reqkey[0]),
+                jnp.float32(d_temp), jnp.int32(d_tk), jnp.float32(d_tp),
             )
 
         self._warmup_buckets(prefill_dummy)
+        if self.prefill_budget > 0:
+            # A RESUMED chunk pairs a small chunk length with a LARGER
+            # gather prefix (the already-written prefix grows with pos;
+            # pos itself is traced, so only the shape pair matters). Warm
+            # every (chunk, prefix) signature the budget can produce —
+            # a compile at chunk 2, 3, ... of the first long admission is
+            # exactly the mid-serving stall prefill_budget exists to
+            # bound.
+            b = self.page_size
+            max_b = self._bucket(max(self.prefill_budget, self.page_size))
+            while b <= max_b:
+                g = self._gather_prefix(b)
+                while g < self.max_pages_per_slot:
+                    g = min(g * 2, self.max_pages_per_slot)
+                    prefill_dummy([0] * b, n_gather=g)
+                b *= 2
         self.k_pages, self.v_pages, _n, _p, _lps = self._step_all(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table), self.last, self.pos,
-            jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
+            jnp.asarray(np.zeros((self.n_slots,), bool)),
+            jnp.asarray(self._slot_reqkey),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp),
         )

@@ -126,7 +126,13 @@ def make_slot_sampler():
     leading dims — every slot of a serving batch draws with its own
     settings, no per-config recompile. Rows with temperature <= 0 are
     greedy (exact argmax, filters bypassed), matching ``make_sampler``'s
-    static greedy path token-for-token."""
+    static greedy path token-for-token.
+
+    ``rng`` may be one key for the whole batch (the classic spelling) or
+    PER-ROW keys shaped ``logits.shape[:-1] + (2,)`` — the serving
+    request-determinism contract: each slot draws from its own request
+    key stream, so a request's sampled tokens depend only on (seed, rid,
+    position), never on batch composition or step alignment."""
 
     def sample(logits, rng, temperature, top_k, top_p):
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -138,7 +144,13 @@ def make_slot_sampler():
                 temperature, 1e-6)[..., None]
             x = apply_top_k_rows(x, top_k)
             x = apply_top_p_rows(x, top_p)
-            drawn = jax.random.categorical(rng, x).astype(jnp.int32)
+            if rng.ndim > 1:   # per-row keys (..., 2): one draw per key
+                flat_k = rng.reshape(-1, rng.shape[-1])
+                flat_x = x.reshape(-1, x.shape[-1])
+                drawn = jax.vmap(jax.random.categorical)(flat_k, flat_x)
+                drawn = drawn.reshape(x.shape[:-1]).astype(jnp.int32)
+            else:
+                drawn = jax.random.categorical(rng, x).astype(jnp.int32)
             return jnp.where(temperature <= 0.0, greedy, drawn)
 
         # all-greedy batches (the server default) execute ONLY the argmax:

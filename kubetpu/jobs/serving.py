@@ -35,6 +35,31 @@ serving pattern behind modern LLM inference engines, TPU-shaped:
   time a step pays to admit) is measured per admission and reported by
   ``metrics_summary``.
 
+- chunked prefill under a TOKEN BUDGET (``prefill_budget``) kills
+  head-of-line blocking: instead of one monolithic whole-prompt prefill
+  at admission, each ``step()`` packs up to ``prefill_budget`` tokens of
+  in-flight prompt CHUNKS (the Sarathi-Serve / vLLM discipline) through
+  the same ``forward_chunk_io`` body decode uses, so a multi-thousand-
+  token prompt never freezes the decode batch for more than one bounded
+  chunk — the operator trades time-to-first-token against decode-stream
+  p99 with one knob. Chunks are exact bucket-grid sizes (no padding
+  except the single-chunk pos-0 case, which keeps the monolithic
+  semantics), so a bounded set of compilations serves every prompt;
+- ``overlap=True`` double-buffers the host loop: ``step()`` DISPATCHES
+  step N+1 before MATERIALIZING step N's tokens, so the per-step
+  blocking ``np.asarray`` host sync leaves the hot path — the device
+  runs one step ahead of token routing. Emission (and therefore
+  EOS/length retirement) lags one step; a retired slot's single
+  in-flight token is discarded by the routing snapshot, and the one
+  stray cache write it made lands at a position the next occupant
+  overwrites before any read (the standard reuse invariant);
+- sampling is REQUEST-DETERMINISTIC: the key for a request's token at
+  position q is ``fold_in(fold_in(PRNGKey(seed), rid), q - 1)`` —
+  sampled streams depend only on (seed, rid, position), never on batch
+  composition, chunking, or step alignment, which is what makes the
+  chunked server token-exact against the monolithic one under seeded
+  sampling (pinned by test).
+
 A drained slot is immediately reusable: its cache region is overwritten by
 the next occupant's prefill, and every attention mask is position-bounded,
 so stale entries are never read (same invariant as speculative decoding).
@@ -77,14 +102,22 @@ class SlotServerBase:
 
     Subclass contract:
     - ``_admit_device(prompt, slot) -> Optional[(token, logprob)]``:
-      reserve resources and prefill; the first generated token and its
-      raw-distribution logprob as device scalars, or None when resources
-      are unavailable (the request stays queued — nothing may be mutated);
-    - ``_device_step() -> (np tokens, np logprobs)``: one decode step for
-      all slots, updating device state;
+      reserve resources and prefill the WHOLE prompt; the first generated
+      token and its raw-distribution logprob as device scalars, or None
+      when resources are unavailable (the request stays queued — nothing
+      may be mutated). The base spelling routes through the chunk leg;
+    - ``_prefill_chunk_device(prompt, slot, pos, take, final) ->
+      None | True | (token, logprob)``: prefill ``prompt[pos:pos+take]``
+      into the slot's cache at position ``pos`` (``final`` marks the last
+      chunk, which samples the first token). None = resources
+      unavailable (nothing mutated; retried next step); True = chunk
+      dispatched, more to come — the token-budget scheduler's leg;
+    - ``_device_step() -> (tokens, logprobs)`` as DEVICE arrays: one
+      decode step for all slots, updating device state; the base routes
+      (and with ``overlap`` defers) the host materialization;
     - ``warmup()``: pre-compile; only valid while no request is active;
     - optional hooks ``_note_admitted(slot, prompt)``, ``_note_emitted
-      (slot)``, ``_on_retire(slot)``.
+      (slot)``, ``_on_retire(slot)``, ``_bind_slot(rid, slot)``.
     """
 
     _min_bucket = 1
@@ -101,16 +134,17 @@ class SlotServerBase:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         seed: int = 0,
+        prefill_budget: int = 0,
+        overlap: bool = False,
     ) -> None:
-        from kubetpu.jobs.sampling import make_slot_sampler
-
         self.cfg = cfg
         self.params = params
         # Per-request sampling: one compiled step serves every (temperature,
         # top_k, top_p) combination — the settings are traced per-slot
-        # arrays, not baked constants. Server-level arguments are the
-        # defaults a request inherits unless submit/enqueue overrides them.
-        self._sampler = make_slot_sampler()
+        # arrays, not baked constants (the samplers themselves live in the
+        # shared compiled legs, _build_dense_legs/_build_paged_legs).
+        # Server-level arguments are the defaults a request inherits
+        # unless submit/enqueue overrides them.
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
         if top_k is not None and top_k <= 0:
@@ -123,7 +157,19 @@ class SlotServerBase:
         self._slot_topk = np.full((n_slots,), top_k or 0, np.int32)
         self._slot_topp = np.full((n_slots,), top_p or 1.0, np.float32)
         self._rid_sampling: Dict[int, Tuple[float, int, float]] = {}
-        self._rng = jax.random.PRNGKey(seed)
+        # request-deterministic sampling: per-slot REQUEST keys
+        # (fold_in(base, rid)); the device legs fold the position in, so a
+        # request's draws depend only on (seed, rid, position)
+        self._base_key = jax.random.PRNGKey(seed)
+        self._slot_reqkey = np.zeros((n_slots, 2), np.uint32)
+        if prefill_budget < 0:
+            raise ValueError("prefill_budget must be >= 0 (0 = monolithic)")
+        self.prefill_budget = int(prefill_budget)
+        self.overlap = bool(overlap)
+        # token-budget scheduler state: slot -> in-flight prefill progress
+        self._prefills: Dict[int, dict] = {}
+        self._prefill_fifo: List[int] = []
+        self._inflight = None          # overlap: the un-materialized step
         self.n_slots = n_slots
         self.max_seq = max_seq
         self.max_new_tokens = max_new_tokens
@@ -143,9 +189,27 @@ class SlotServerBase:
         self._pending_first: Dict[int, object] = {}    # slot -> device scalar
         self._metrics = LatencyRecorder()
 
-    def _next_rng(self):
-        self._rng, sub = jax.random.split(self._rng)
-        return sub
+    def _request_key(self, rid: int) -> np.ndarray:
+        """The request's sampling key: fold_in(PRNGKey(seed), rid)."""
+        return np.asarray(jax.random.fold_in(self._base_key, rid))
+
+    def _bind_slot(self, rid: int, slot: int) -> None:
+        """Point the slot's traced per-slot arrays (sampling settings,
+        request key) at *rid* — runs before ANY device leg touches the
+        slot, on both the monolithic and the chunked admission path.
+        Subclasses with more per-slot request state (multi-LoRA adapter
+        ids) extend this."""
+        temp, tk, tp = self._rid_sampling.get(rid, self._default_sampling)
+        self._slot_temp[slot] = temp
+        self._slot_topk[slot] = tk
+        self._slot_topp[slot] = tp
+        self._slot_reqkey[slot] = self._request_key(rid)
+
+    def _free_slots(self) -> List[int]:
+        """Slots holding neither an active decode nor an in-flight
+        prefill."""
+        return [i for i in range(self.n_slots)
+                if not self.active[i] and i not in self._prefills]
 
     # -- request lifecycle ---------------------------------------------------
 
@@ -173,12 +237,9 @@ class SlotServerBase:
         path, which must not serialize prefill-complete before the decode
         dispatch."""
         t0 = time.perf_counter()
-        # slot sampling settings BEFORE the prefill — it samples the first
-        # token under them
-        temp, tk, tp = self._rid_sampling.get(rid, self._default_sampling)
-        self._slot_temp[slot] = temp
-        self._slot_topk[slot] = tk
-        self._slot_topp[slot] = tp
+        # slot sampling settings + request key BEFORE the prefill — it
+        # samples the first token under them
+        self._bind_slot(rid, slot)
         admitted = self._admit_device(prompt, slot)
         if admitted is None:
             return False
@@ -230,12 +291,14 @@ class SlotServerBase:
     def submit(self, prompt: List[int],
                sampling: Optional[dict] = None) -> Optional[int]:
         """Admit into a free slot; None when slots (or, for the paged
-        server, pool pages) are unavailable. Synchronous admission; see
-        ``enqueue`` for the non-blocking path. *sampling* overrides the
+        server, pool pages) are unavailable. Synchronous admission — the
+        whole prompt prefills on the caller's clock even when
+        ``prefill_budget`` is set; see ``enqueue`` for the non-blocking
+        (and, with a budget, chunked) path. *sampling* overrides the
         server defaults for THIS request: a dict with any of temperature /
         top_k / top_p."""
         self._check_prompt(prompt)
-        free = [i for i in range(self.n_slots) if not self.active[i]]
+        free = self._free_slots()
         if not free:
             return None
         rid = self._next_rid
@@ -274,38 +337,70 @@ class SlotServerBase:
         return self._metrics.summary()
 
     def step(self) -> Dict[int, List[int]]:
-        """Admit queued requests into free slots (resources permitting,
-        first-token fetch deferred), then one decode step for every active
-        slot -> {rid: [tokens emitted this step]}. A request admitted from
-        the queue THIS step emits two tokens (its prefill's first + this
-        step's decode) — the list shape keeps both visible to streaming
-        consumers."""
-        self._drain_queue_into_slots()
-        if not self.active.any():
-            return self._materialize_pending()
+        """Admit/advance prefills under the token budget (monolithic when
+        ``prefill_budget == 0``; first-token fetch deferred either way),
+        then one decode step for every active slot -> {rid: [tokens
+        emitted this step]}. A request admitted from the queue THIS step
+        emits two tokens (its prefill's first + this step's decode) — the
+        list shape keeps both visible to streaming consumers. With
+        ``overlap`` the decode materialization is DOUBLE-BUFFERED: this
+        call dispatches step N and routes step N-1's tokens (decode
+        emission lags one step; ``drain`` flushes the tail)."""
+        self._schedule_prefills()
+        handle = None
         t0 = time.perf_counter()
-        tokens, lps = self._device_step()   # dispatched; synced below
+        if self.active.any():
+            handle = self._dispatch_step()
+        if self.overlap:
+            handle, self._inflight = self._inflight, handle
         out = self._materialize_pending()
-        self._metrics.record("step", time.perf_counter() - t0)
+        if handle is not None:
+            self._route_step(handle, out)
+        if handle is not None or self._inflight is not None:
+            self._metrics.record("step", time.perf_counter() - t0)
+        return out
+
+    def _dispatch_step(self):
+        """Dispatch one decode step; capture the (active, rid) snapshot
+        the routing pass needs — under ``overlap`` the live tables may
+        have moved on (retirement, re-admission) by the time the tokens
+        are materialized, and a stale token must never reach a new
+        occupant."""
+        tokens, lps = self._device_step()
+        return (tokens, lps, self.active.copy(), list(self._slot_rid))
+
+    def _route_step(self, handle, out: Dict[int, List[int]]) -> None:
+        """Materialize a dispatched step (the ONE host sync) and route its
+        tokens by the dispatch-time snapshot. A token whose request has
+        since retired or lost the slot is discarded — its stray cache
+        write sits at a position the next occupant overwrites before any
+        read (module docstring)."""
+        tokens_d, lps_d, snap_active, snap_rids = handle
+        tokens = np.asarray(tokens_d)
+        lps = np.asarray(lps_d)
         for slot in range(self.n_slots):
-            if not self.active[slot]:
+            if not snap_active[slot]:
                 continue
-            rid = self._slot_rid[slot]
+            rid = snap_rids[slot]
+            if (rid is None or self._done.get(rid, True)
+                    or self._slot_rid[slot] != rid):
+                continue
             tok = int(tokens[slot])
             self._emitted[rid].append(tok)
             self._logprobs[rid].append(float(lps[slot]))
             self._note_emitted(slot)
             out.setdefault(rid, []).append(tok)
             self._retire_if_done(slot)
-        return out
 
     def _warmup_buckets(self, prefill_dummy) -> None:
         """Shared warmup skeleton: call *prefill_dummy(padded_prompt)* for
         every power-of-two prompt bucket from ``_min_bucket`` to
         ``max_seq`` — a bucketing change lands in every server at once."""
-        assert not self.active.any() and not self._queue, (
+        assert (not self.active.any() and not self._queue
+                and not self._prefills and self._inflight is None), (
             "warmup() must run before serving: it scribbles on slot 0's "
-            "device state"
+            "device state (and, for the paged server, on pool pages a "
+            "mid-prefill slot may have mapped)"
         )
         bucket = self._min_bucket
         while True:
@@ -317,13 +412,143 @@ class SlotServerBase:
 
     def _drain_queue_into_slots(self) -> None:
         """Admit queued requests into free slots (resources permitting),
-        first-token fetch deferred — shared by every subclass's step."""
-        while self._queue and not self.active.all():
-            free = [i for i in range(self.n_slots) if not self.active[i]]
+        first-token fetch deferred — the MONOLITHIC admission leg (whole
+        prompt in one prefill), shared by every subclass's step."""
+        while self._queue:
+            free = self._free_slots()
+            if not free:
+                break
             rid, prompt = self._queue[0]
             if not self._try_admit(rid, prompt, free[0], defer=True):
                 break              # resources exhausted: retry next step
             self._queue.pop(0)
+
+    # -- token-budget chunked prefill ----------------------------------------
+
+    def _chunk_quantum(self) -> int:
+        """Smallest chunk granularity (1 for contiguous caches; the page
+        size for paged ones, so chunk starts stay page-aligned)."""
+        return 1
+
+    def _chunk_take(self, budget: int, pos: int, remaining: int) -> int:
+        """Largest bucket-grid chunk (q * 2^k tokens) within
+        min(max(budget, quantum), remaining) — grid-sized chunks keep the
+        compilation set bounded, and at least one quantum always moves
+        (the budget is a soft per-step bound). A TAIL that fits this
+        step's allowance finishes NOW as one bucket-padded final chunk
+        (pad K/V positions are dead by overwrite-before-read) instead of
+        dribbling out as log2(tail) single-chunk steps — unless the pad
+        would run past the cache end, where grid-exact fragmentation is
+        the safe spelling."""
+        q = self._chunk_quantum()
+        cap = min(max(budget, q), remaining)
+        take = q
+        while take * 2 <= cap:
+            take *= 2
+        if (take < remaining and remaining <= max(budget, q)
+                and pos + self._bucket(remaining) <= self.max_seq):
+            return remaining       # final chunk, padded by the device leg
+        return min(take, remaining)
+
+    def _schedule_prefills(self) -> None:
+        """The token-budget prefill scheduler: each step spends up to
+        ``prefill_budget`` prompt tokens — first resuming in-flight
+        chunked prefills (FIFO), then starting queued requests in free
+        slots — so decode never waits more than one bounded chunk behind
+        any prompt. ``prefill_budget == 0`` is the monolithic path."""
+        if self.prefill_budget <= 0:
+            self._drain_queue_into_slots()
+            return
+        budget = self.prefill_budget
+        progressed = False
+        for slot in list(self._prefill_fifo):
+            if budget <= 0:
+                return
+            used = self._advance_prefill(slot, budget)
+            budget -= used
+            progressed = progressed or used > 0
+        while budget > 0 and self._queue:
+            free = self._free_slots()
+            if not free:
+                break
+            rid, prompt = self._queue.pop(0)
+            self._begin_prefill(rid, prompt, free[0])
+            used = self._advance_prefill(free[0], budget)
+            budget -= used
+            progressed = progressed or used > 0
+        # Deadlock safeguard (paged pool pressure): several half-prefilled
+        # slots can hold pages while none can take its next chunk and no
+        # decoder is left to free any. Park every prefill but the oldest
+        # back at the queue head (pages released, progress discarded) —
+        # the oldest then owns the freed pool and completes.
+        if (not progressed and len(self._prefills) > 1
+                and not self.active.any()):
+            for slot in list(self._prefill_fifo[1:])[::-1]:
+                st = self._prefills[slot]
+                self._queue.insert(0, (st["rid"], st["prompt"]))
+                self._abort_prefill(slot)
+
+    def _begin_prefill(self, rid: int, prompt: List[int], slot: int) -> None:
+        """Occupy *slot* with a chunked prefill at progress 0. Device
+        resources are claimed chunk by chunk in ``_advance_prefill``."""
+        self._bind_slot(rid, slot)
+        self._slot_rid[slot] = rid        # cancel() finds mid-prefills
+        self._done[rid] = False
+        self._prefills[slot] = {
+            "rid": rid, "prompt": list(prompt), "done": 0, "t": 0.0,
+        }
+        self._prefill_fifo.append(slot)
+
+    def _abort_prefill(self, slot: int) -> None:
+        """Release a mid-prefill slot (deadlock parking): resources back
+        via ``_on_retire``, slot free, NO result bookkeeping touched."""
+        self._prefills.pop(slot, None)
+        if slot in self._prefill_fifo:
+            self._prefill_fifo.remove(slot)
+        self._slot_rid[slot] = None
+        self._on_retire(slot)
+
+    def _advance_prefill(self, slot: int, budget: int) -> int:
+        """Run one chunk of *slot*'s in-flight prefill (at most ~budget
+        tokens; at least one quantum) -> tokens consumed. The FINAL chunk
+        samples the request's first token and flips the slot to decoding
+        with the first-token fetch deferred — from the decode batch's
+        view a finishing prefill is indistinguishable from a monolithic
+        admission."""
+        st = self._prefills[slot]
+        remaining = len(st["prompt"]) - st["done"]
+        take = self._chunk_take(budget, st["done"], remaining)
+        final = take >= remaining
+        t0 = time.perf_counter()
+        res = self._prefill_chunk_device(
+            st["prompt"], slot, st["done"], take, final)
+        if res is None:
+            return 0               # resources unavailable: retry next step
+        dt = time.perf_counter() - t0
+        st["t"] += dt
+        st["done"] += take
+        self._metrics.record("prefill_chunk", dt)
+        if final:
+            rid = st["rid"]
+            first, first_lp = res
+            self.pos = self.pos.at[slot].set(len(st["prompt"]))
+            self.last = self.last.at[slot].set(first)
+            self.active[slot] = True
+            self._note_admitted(slot, st["prompt"])
+            self._pending_first[slot] = (first, first_lp)
+            self._metrics.record("admission_stall", st["t"])
+            self._prefills.pop(slot)
+            self._prefill_fifo.remove(slot)
+        return take
+
+    def _prefill_chunk_device(self, prompt: List[int], slot: int, pos: int,
+                              take: int, final: bool):
+        """Subclass leg: prefill ``prompt[pos:pos+take]`` at position
+        *pos* into *slot*'s cache. Returns None when resources are
+        unavailable (nothing mutated), True for a dispatched non-final
+        chunk, and the deferred (first token, logprob) device scalars for
+        the final chunk."""
+        raise NotImplementedError
 
     def _materialize_pending(self) -> Dict[int, List[int]]:
         """Fetch deferred first tokens (one sync AFTER the step's decode
@@ -356,6 +581,9 @@ class SlotServerBase:
         self._done[rid] = True
         self.active[slot] = False           # slot immediately reusable
         self._slot_rid[slot] = None
+        self._prefills.pop(slot, None)      # cancel() mid-prefill
+        if slot in self._prefill_fifo:
+            self._prefill_fifo.remove(slot)
         self._on_retire(slot)
 
     def cancel(self, rid: int) -> bool:
@@ -427,10 +655,17 @@ class SlotServerBase:
         self._logprobs.pop(rid, None)
         return out
 
+    def _idle(self) -> bool:
+        """Nothing to do: no active decode, no queue, no in-flight
+        prefill chunks, no un-materialized overlap step."""
+        return (not self.active.any() and not self._queue
+                and not self._prefills and self._inflight is None)
+
     def drain(self, max_steps: int = 10_000) -> None:
-        """Run until every admitted AND queued request finishes."""
+        """Run until every admitted AND queued request finishes (flushing
+        in-flight prefill chunks and the overlap pipeline)."""
         for _ in range(max_steps):
-            if not self.active.any() and not self._queue:
+            if self._idle():
                 return
             self.step()
         raise RuntimeError("drain did not converge")
@@ -440,6 +675,89 @@ class SlotServerBase:
 # size per-slot state BEFORE super().__init__ (MultiLoraDecodeServer's
 # adapter-id array) must read this, not repeat the literal.
 DEFAULT_N_SLOTS = 8
+
+
+# Compiled device legs shared across same-configuration servers: the legs
+# are pure functions of their arguments (cfg/cache layout baked at build
+# time), so two servers over the same key reuse ONE jit cache — spinning
+# up another replica (or the parity-heavy test suite's Nth server) never
+# recompiles. Keys are value-hashable (ModelConfig is a frozen
+# dataclass); the cache lives for the process, like jit caches do.
+_LEG_CACHE: Dict[tuple, tuple] = {}
+
+
+def _cached_legs(key: tuple, builder):
+    if key not in _LEG_CACHE:
+        _LEG_CACHE[key] = builder()
+    return _LEG_CACHE[key]
+
+
+def _build_dense_legs(cfg_, cache_io, lora_scale):
+    """(prefill_chunk, step_all) jits for the contiguous-cache server —
+    see DecodeServer for the calling contract."""
+    from kubetpu.jobs.sampling import make_slot_sampler
+
+    sampler = make_slot_sampler()
+
+    # donate_argnums=(1,): the caller overwrites self.cache with the
+    # result, so XLA updates the (large) cache buffers in place
+    # instead of holding input+output copies live per step.
+    # The trailing (lora, aid/aids) pair is the multi-LoRA hook
+    # (kubetpu.jobs.multi_lora): None/zeros for the plain server — an
+    # empty pytree arg, zero trace cost.
+    @partial(jax.jit, donate_argnums=(1,))
+    def prefill_chunk(params, cache, chunk, slot, pos, last_idx,
+                      reqkey, temp, tk, tp, lora, aid):
+        # single-sequence chunk forward at *pos*, written into `slot`
+        # — the monolithic prefill is the pos == 0 whole-prompt case
+        # (chunk then bucket-padded; only last_idx + 1 is real and the
+        # last REAL position's logits pick the first token). *pos* is
+        # traced, so ONE compilation per chunk length serves every
+        # offset a resumed prefill lands on.
+        cache_s = jax.tree.map(
+            lambda x: jnp.take(x, slot[None], axis=1), cache
+        )  # every leaf: (L, 1, S, Hkv, D-or-1)
+        logits, cache_s = forward_chunk_io(
+            cfg_, params, chunk[None], cache_s, pos, cache_io,
+            lora=lora, adapter_ids=None if lora is None else aid[None],
+            lora_scale=lora_scale,
+        )
+        cache = jax.tree.map(
+            lambda c, s: jax.lax.dynamic_update_slice(
+                c, s, (0, slot, 0, 0, 0)
+            ),
+            cache, cache_s,
+        )
+        row = jnp.take(logits[0], last_idx, axis=0)
+        # request-deterministic draw: the token at position q samples
+        # under fold_in(request_key, q - 1), whatever the chunking
+        first = sampler(row, jax.random.fold_in(reqkey, pos + last_idx),
+                        temp, tk, tp)
+        return cache, first, chosen_logprob(row, first)
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def step_all(params, cache, last, pos, active, reqkeys,
+                 temp, tk, tp, lora, aids):
+        # INACTIVE slots must not scribble K/V at their stale pos: a
+        # mid-prefill neighbor's already-written chunks live there
+        # (the monolithic whole-prompt overwrite no longer protects
+        # them). Redirect their write to S_max - 1 — never attended
+        # before the decode step that rewrites it (the overwrite-
+        # before-read invariant), so the row is provably dead.
+        smax = jax.tree.leaves(cache)[0].shape[2]
+        pos_w = jnp.where(active, pos, smax - 1)
+        logits, cache = forward_chunk_at_io(
+            cfg_, params, last[:, None], cache, pos_w, cache_io,
+            lora=lora, adapter_ids=aids, lora_scale=lora_scale,
+        )
+        keys = jax.vmap(jax.random.fold_in)(reqkeys, pos)
+        nxt = sampler(logits[:, 0], keys, temp, tk, tp)
+        nxt = jnp.where(active, nxt, last)     # inactive slots hold
+        lp = chosen_logprob(logits[:, 0], nxt)
+        pos = pos + active.astype(jnp.int32)
+        return cache, nxt, pos, lp
+
+    return prefill_chunk, step_all
 
 
 class DecodeServer(SlotServerBase):
@@ -456,6 +774,15 @@ class DecodeServer(SlotServerBase):
     ``{request_id: [tokens emitted this step]}``;
     ``finished(rid)``/``result(rid)`` collect completed sequences.
     ``max_new_tokens`` and optional ``eos_id`` bound each request.
+
+    ``prefill_budget=N`` turns on CHUNKED prefill for the queued
+    (``enqueue``) path: each step spends at most ~N prompt tokens on
+    prefill chunks interleaved with the decode batch, so a long prompt
+    never blocks decoding for more than one chunk — token-exact vs the
+    monolithic path (greedy AND seeded sampling; the sampling keys are
+    request-deterministic). ``overlap=True`` double-buffers the host
+    loop: step N+1 is dispatched before step N's tokens are materialized
+    (emission lags one step; ``drain()`` flushes).
     """
 
     def __init__(
@@ -472,10 +799,13 @@ class DecodeServer(SlotServerBase):
         seed: int = 0,
         mesh=None,
         kv_int8: bool = False,
+        prefill_budget: int = 0,
+        overlap: bool = False,
     ) -> None:
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
-                         top_p=top_p, seed=seed)
+                         top_p=top_p, seed=seed,
+                         prefill_budget=prefill_budget, overlap=overlap)
         # The cache is a PYTREE + a cache_io strategy (decode.py's slot):
         # dense (k, v) or int8 ((kq, ks), (vq, vs)) — the server legs are
         # layout-blind. ``kv_int8=True`` stores the cache in int8 with
@@ -513,56 +843,11 @@ class DecodeServer(SlotServerBase):
                 lambda x: jax.device_put(x, csh), self.cache
             )
 
-        cfg_ = cfg
-        sampler = self._sampler
         lora_scale = getattr(self, "_lora_scale", 1.0)
-
-        # donate_argnums=(1,): the caller overwrites self.cache with the
-        # result, so XLA updates the (large) cache buffers in place
-        # instead of holding input+output copies live per step.
-        # The trailing (lora, aid/aids) pair is the multi-LoRA hook
-        # (kubetpu.jobs.multi_lora): None/zeros for the plain server — an
-        # empty pytree arg, zero trace cost.
-        @partial(jax.jit, donate_argnums=(1,))
-        def prefill_slot(params, cache, prompt, slot, prompt_len,
-                         rng, temp, tk, tp, lora, aid):
-            # single-sequence chunk forward at pos 0, written into `slot`;
-            # `prompt` is bucket-padded (see module docstring) — only
-            # prompt_len is real, and the last REAL position's logits pick
-            # the first token
-            cache_s = jax.tree.map(
-                lambda x: jnp.take(x, slot[None], axis=1), cache
-            )  # every leaf: (L, 1, S, Hkv, D-or-1)
-            logits, cache_s = forward_chunk_io(
-                cfg_, params, prompt[None], cache_s, 0, cache_io,
-                lora=lora, adapter_ids=None if lora is None else aid[None],
-                lora_scale=lora_scale,
-            )
-            cache = jax.tree.map(
-                lambda c, s: jax.lax.dynamic_update_slice(
-                    c, s, (0, slot, 0, 0, 0)
-                ),
-                cache, cache_s,
-            )
-            row = jnp.take(logits[0], prompt_len - 1, axis=0)
-            first = sampler(row, rng, temp, tk, tp)
-            return cache, first, chosen_logprob(row, first)
-
-        @partial(jax.jit, donate_argnums=(1,))
-        def step_all(params, cache, last, pos, active, rng,
-                     temp, tk, tp, lora, aids):
-            logits, cache = forward_chunk_at_io(
-                cfg_, params, last[:, None], cache, pos, cache_io,
-                lora=lora, adapter_ids=aids, lora_scale=lora_scale,
-            )
-            nxt = sampler(logits[:, 0], rng, temp, tk, tp)
-            nxt = jnp.where(active, nxt, last)     # inactive slots hold
-            lp = chosen_logprob(logits[:, 0], nxt)
-            pos = pos + active.astype(jnp.int32)
-            return cache, nxt, pos, lp
-
-        self._prefill_slot = prefill_slot
-        self._step_all = step_all
+        self._prefill_chunk, self._step_all = _cached_legs(
+            ("dense", cfg, kv_int8, float(lora_scale)),
+            lambda: _build_dense_legs(cfg, cache_io, lora_scale),
+        )
 
     @property
     def k_cache(self):
@@ -597,33 +882,48 @@ class DecodeServer(SlotServerBase):
     # -- device legs ---------------------------------------------------------
 
     def _admit_device(self, prompt: List[int], slot: int):
-        """Dispatch the prefill; returns the first token as a DEVICE
-        scalar (no host sync — the defer path depends on it)."""
-        bucket = self._bucket(len(prompt))
-        padded = prompt + [0] * (bucket - len(prompt))
+        """Dispatch the whole-prompt prefill (one pos-0 chunk); returns
+        the first token as a DEVICE scalar (no host sync — the defer path
+        depends on it)."""
+        return self._prefill_chunk_device(prompt, slot, 0, len(prompt), True)
+
+    def _prefill_chunk_device(self, prompt: List[int], slot: int, pos: int,
+                              take: int, final: bool):
+        """One prefill chunk through the slot's cache rows. Non-final
+        chunks are exact bucket-grid sizes (no padding); FINAL chunks
+        bucket-pad (the monolithic pos-0 path, and the finish-the-tail
+        rule of ``_chunk_take``) — pad K/V positions are dead by
+        overwrite-before-read, and the pad never runs past the cache end
+        (``_chunk_take`` only returns a paddable final; the clamp is a
+        defensive spelling of the same bound)."""
+        bucket = self._bucket(take) if final else take
+        if pos + bucket > self.max_seq:
+            bucket = take          # grid-exact tail: never overflows
+        chunk = prompt[pos:pos + take] + [0] * (bucket - take)
         lora, aid = self._admit_lora(slot)
-        self.cache, first, first_lp = self._prefill_slot(
+        self.cache, first, first_lp = self._prefill_chunk(
             self.params, self.cache,
-            jnp.asarray(padded, jnp.int32), jnp.int32(slot),
-            jnp.int32(len(prompt)), self._next_rng(),
+            jnp.asarray(chunk, jnp.int32), jnp.int32(slot),
+            jnp.int32(pos), jnp.int32(take - 1),
+            jnp.asarray(self._slot_reqkey[slot]),
             jnp.float32(self._slot_temp[slot]),
             jnp.int32(self._slot_topk[slot]),
             jnp.float32(self._slot_topp[slot]),
             lora, aid,
         )
-        return first, first_lp
+        return (first, first_lp) if final else True
 
-    def _device_step(self) -> "tuple[np.ndarray, np.ndarray]":
+    def _device_step(self):
         lora, aids = self._step_lora()
         self.cache, nxt, self.pos, lp = self._step_all(
             self.params, self.cache, self.last, self.pos,
-            jnp.asarray(self.active), self._next_rng(),
+            jnp.asarray(self.active), jnp.asarray(self._slot_reqkey),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp),
             lora, aids,
         )
         self.last = nxt
-        return np.asarray(nxt), np.asarray(lp)
+        return nxt, lp
 
     def warmup(self) -> None:
         """Pre-compile every prompt bucket's prefill and the decode step so
@@ -635,10 +935,11 @@ class DecodeServer(SlotServerBase):
 
         def prefill_dummy(padded):
             lora, aid = self._admit_lora(0)
-            self.cache, _f, _lp = self._prefill_slot(
+            self.cache, _f, _lp = self._prefill_chunk(
                 self.params, self.cache,
-                jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(1),
-                self._next_rng(), jnp.float32(d_temp), jnp.int32(d_tk),
+                jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(0),
+                jnp.int32(0), jnp.asarray(self._slot_reqkey[0]),
+                jnp.float32(d_temp), jnp.int32(d_tk),
                 jnp.float32(d_tp), lora, aid,
             )
 
@@ -646,7 +947,8 @@ class DecodeServer(SlotServerBase):
         lora, aids = self._step_lora()
         self.cache, _nxt, _pos, _lps = self._step_all(
             self.params, self.cache, self.last, self.pos,
-            jnp.asarray(np.zeros((self.n_slots,), bool)), self._next_rng(),
+            jnp.asarray(np.zeros((self.n_slots,), bool)),
+            jnp.asarray(self._slot_reqkey),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp), lora, aids,
         )

@@ -46,9 +46,13 @@ def trained_small():
     cfg = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4, d_ff=128,
                       max_seq=128)
     mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1})
-    data = [next(SyntheticCorpus(cfg.vocab, seed=3,
-                                 skew=[0.85, 0.05, 0.05, 0.05])
-                 .batches(8, 32, seed=5)) for _ in range(8)]
+    # ONE generator, 8 distinct batches (test_distill.py's idiom) — a
+    # fresh .batches(...) per element restarts the stream and every
+    # "batch" is the identical first batch
+    batches = SyntheticCorpus(cfg.vocab, seed=3,
+                              skew=[0.85, 0.05, 0.05, 0.05]).batches(
+                                  8, 32, seed=5)
+    data = [next(batches) for _ in range(8)]
     state, opt = init_state(_jax.random.PRNGKey(0), cfg, mesh)
     step = make_train_step(cfg, mesh, optimizer=opt, use_ring=False)
     for i in range(150):

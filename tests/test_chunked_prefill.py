@@ -1,0 +1,321 @@
+"""Chunked prefill under a token budget + the double-buffered host loop.
+
+The contract the tentpole rests on: a server admitting prompts in bounded
+chunks interleaved with decode steps (``prefill_budget > 0``) must be
+TOKEN-EXACT against the monolithic-prefill server — greedy and seeded
+sampling, dense and paged, windowed and unwindowed — because the chunks
+write bit-identical cache contents and the sampling keys are
+request-deterministic (position-keyed, never stream-keyed). The overlap
+loop (dispatch step N+1 before materializing step N) must change WHEN
+tokens surface, never WHICH tokens."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.jobs.serving import DecodeServer
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+
+PROMPTS = [[3, 14, 15, 9, 2, 6, 5], [26, 5],
+           [(i * 7) % 60 + 1 for i in range(19)]]
+
+
+KW = dict(n_slots=2, max_seq=64, max_new_tokens=6)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def mono_dense(params):
+    """The monolithic dense reference run, shared by every parity test
+    (one server, one set of compiles)."""
+    return run_schedule(DecodeServer(CFG, params, **KW))
+
+
+def run_schedule(server, prompts=PROMPTS, sampling=None, interleave=2):
+    """Enqueue prompts staggered across live steps, then drain — the
+    mixed-load shape (prompts arriving mid-decode) chunking exists for."""
+    rids = []
+    for p in prompts:
+        rids.append(server.enqueue(p, sampling=sampling))
+        for _ in range(interleave):
+            server.step()
+    server.drain()
+    return [server.result(r) for r in rids]
+
+
+@pytest.mark.parametrize("budget", [1, 3])
+def test_chunked_greedy_token_exact_vs_monolithic(params, mono_dense, budget):
+    """Greedy parity across chunk budgets: a budget of one token
+    (maximal chunking) and a non-power-of-two budget (grid flooring +
+    the padded final tail)."""
+    chunked = DecodeServer(CFG, params, prefill_budget=budget, **KW)
+    assert run_schedule(chunked) == mono_dense
+
+
+def test_chunked_seeded_sampling_token_exact_vs_monolithic(params,
+                                                           mono_dense):
+    """Seeded stochastic sampling is chunking-invariant: the key for a
+    request's token at position q is (seed, rid, q)-derived, so the
+    chunked and monolithic servers draw IDENTICAL streams even though
+    their step alignment differs."""
+    kw = dict(KW, seed=7)
+    sampling = {"temperature": 1.0, "top_k": 12}
+    mono = run_schedule(DecodeServer(CFG, params, **kw), sampling=sampling)
+    chunked = run_schedule(DecodeServer(CFG, params, prefill_budget=3, **kw),
+                           sampling=sampling)
+    assert chunked == mono
+    # the draws are actually stochastic (not greedy in disguise)
+    assert mono != mono_dense
+
+
+def test_chunked_windowed_chunk_boundary_mid_window(params):
+    """Banded config: budget 4 against window 8 puts chunk boundaries
+    mid-window, so later chunks must attend earlier chunks' cache entries
+    through the band — token-exact vs the monolithic banded server."""
+    import dataclasses
+
+    wcfg = dataclasses.replace(CFG, window=8)
+    kw = dict(n_slots=2, max_seq=64, max_new_tokens=8)
+    mono = DecodeServer(wcfg, params, **kw)
+    chunked = DecodeServer(wcfg, params, prefill_budget=4, **kw)
+    assert run_schedule(chunked) == run_schedule(mono)
+
+
+def test_chunked_paged_token_exact_vs_monolithic_and_dense(params,
+                                                           mono_dense):
+    """Paged chunked prefill (page-aligned chunks through the pool via
+    forward_chunk_io) matches both the monolithic paged server and the
+    dense server exactly."""
+    mono = run_schedule(PagedDecodeServer(CFG, params, page_size=4, **KW))
+    chunked = run_schedule(PagedDecodeServer(CFG, params, page_size=4,
+                                             prefill_budget=8, **KW))
+    assert chunked == mono == mono_dense
+
+
+def test_chunked_paged_windowed_ring(params):
+    """window x page ring x chunked prefill composes: the ring maps up
+    front, chunks stream through aliased pages, tokens exactly match the
+    monolithic windowed paged server."""
+    import dataclasses
+
+    wcfg = dataclasses.replace(CFG, window=8)
+    kw = dict(n_slots=2, max_seq=96, max_new_tokens=8, page_size=4)
+    mono = run_schedule(PagedDecodeServer(wcfg, params, **kw))
+    chunked = run_schedule(PagedDecodeServer(wcfg, params, prefill_budget=8,
+                                             **kw))
+    assert chunked == mono
+
+
+def test_chunk_granular_page_reservation_under_pressure(params):
+    """During a chunked prefill the slot holds pages for the tokens
+    written so far, NOT the worst case — so a long admission streams in
+    next to a decoding neighbor that a monolithic worst-case reservation
+    would have blocked behind, and the final chunk still upgrades to the
+    decode worst case before the first token."""
+    ps = 4
+    long_prompt = [(i * 5) % 60 + 1 for i in range(16)]
+    short = [7, 8]
+    # worst cases: long = ceil((16+4+1)/4) = 6 pages, short = 2 pages
+    srv = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=4, page_size=ps, n_pages=7,
+                            prefill_budget=ps)
+    rs = srv.submit(short)               # decoding: holds its 2 pages
+    rl = srv.enqueue(long_prompt)
+    srv.step()
+    # one chunk (4 tokens = 1 page) in flight: 2 (short) + 1, not 2 + 6
+    assert srv.pages_in_use() == 3
+    assert not srv.finished(rl)
+    srv.step()
+    assert srv.pages_in_use() == 4       # second chunk, still not worst case
+    srv.drain()
+    assert srv.finished(rs) and srv.finished(rl)
+    assert srv.pages_in_use() == 0
+    # parity: the streamed-in request decodes exactly the monolithic tokens
+    ref = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=4, page_size=ps)
+    rr = ref.submit(long_prompt)
+    ref.drain()
+    assert srv.result(rl) == ref.result(rr)
+
+
+def test_prefill_deadlock_parks_younger_back_to_queue(params):
+    """Two chunked prefills contending for a pool with no decoder left to
+    free pages must NOT deadlock: the scheduler parks the younger back to
+    the queue (pages released), the older completes, then the parked one
+    runs — both finish with exact monolithic tokens."""
+    ps = 4
+    p1 = [(i * 3) % 60 + 1 for i in range(12)]   # worst case 5 pages
+    p2 = [(i * 11) % 60 + 1 for i in range(12)]
+    srv = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=3, page_size=ps, n_pages=5,
+                            prefill_budget=64)
+    r1, r2 = srv.enqueue(p1), srv.enqueue(p2)
+    srv.drain()
+    assert srv.finished(r1) and srv.finished(r2)
+    assert srv.pages_in_use() == 0
+    ref = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=3, page_size=ps)
+    for rid, p in ((r1, p1), (r2, p2)):
+        rr = ref.submit(p)
+        ref.drain()
+        assert srv.result(rid) == ref.result(rr)
+
+
+def test_cancel_mid_prefill_releases_slot_and_pages(params):
+    srv = PagedDecodeServer(CFG, params, n_slots=1, max_seq=64,
+                            max_new_tokens=4, page_size=4, prefill_budget=4)
+    rid = srv.enqueue([(i * 7) % 60 + 1 for i in range(16)])
+    srv.step()                           # first chunk only
+    assert not srv.finished(rid) and srv.pages_in_use() > 0
+    assert srv.cancel(rid) is True
+    assert srv.finished(rid)
+    assert srv.pages_in_use() == 0       # chunk-granular pages reclaimed
+    # the freed slot serves the next request exactly
+    r2 = srv.submit([3, 14, 15, 9])
+    srv.drain()
+    ref = PagedDecodeServer(CFG, params, n_slots=1, max_seq=64,
+                            max_new_tokens=4, page_size=4)
+    rr = ref.submit([3, 14, 15, 9])
+    ref.drain()
+    assert srv.result(r2) == ref.result(rr)
+
+
+def test_overlap_tokens_identical_and_lagged(params, mono_dense):
+    """overlap=True changes WHEN tokens surface (one step later), never
+    WHICH tokens — drained results are identical, and the first step
+    after admission routes only the deferred first token (the decode
+    token is still in flight)."""
+    sync = mono_dense
+    # chunked + overlap together (the bench configuration)
+    both = run_schedule(DecodeServer(CFG, params, overlap=True,
+                                     prefill_budget=4, **KW))
+    assert both == sync
+
+    srv = DecodeServer(CFG, params, overlap=True, **KW)
+    p = PROMPTS[0]
+    rid = srv.enqueue(p)
+    out1 = srv.step()
+    # first token only: this step's decode token is still in flight
+    assert out1[rid] == sync[0][len(p):len(p) + 1]
+    out2 = srv.step()
+    # step 1's decode token surfaces one step late
+    assert out2[rid] == sync[0][len(p) + 1:len(p) + 2]
+    srv.drain()
+    assert srv.result(rid) == sync[0]    # pure-overlap parity end to end
+
+
+def test_overlap_dispatches_ahead_of_materialization(params, monkeypatch):
+    """The no-per-token-host-sync pin: with overlap on, step N+1 is
+    DISPATCHED before step N's tokens are materialized (event order
+    dispatch, dispatch, route, dispatch, route, ...), the un-materialized
+    step is held in flight across the step() boundary, and
+    jax.block_until_ready never runs on the hot path."""
+    events = []
+
+    class Probe(DecodeServer):
+        def _device_step(self):
+            events.append("dispatch")
+            return super()._device_step()
+
+        def _route_step(self, handle, out):
+            events.append("route")
+            return super()._route_step(handle, out)
+
+    srv = Probe(CFG, params, n_slots=2, max_seq=64, max_new_tokens=16,
+                overlap=True)
+    srv.warmup()
+
+    blocks = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda *a, **k: blocks.append(1) or real(*a, **k))
+    srv.submit([3, 14, 15, 9])
+    for _ in range(4):
+        srv.step()
+        assert srv._inflight is not None   # a step is ALWAYS in flight
+    assert events == ["dispatch", "dispatch", "route", "dispatch", "route",
+                      "dispatch", "route"]
+    assert blocks == []                    # no block_until_ready per token
+    srv.drain()
+
+    # the sync server, by contrast, routes every dispatch immediately
+    events.clear()
+    ref = Probe(CFG, params, n_slots=2, max_seq=64, max_new_tokens=4)
+    ref.submit([3, 14, 15, 9])
+    ref.step()
+    ref.step()
+    assert events == ["dispatch", "route", "dispatch", "route"]
+
+
+@pytest.mark.slow
+def test_chunked_multi_lora_applies_adapter_per_chunk(params):
+    """Multi-LoRA rides chunked prefill: the adapter binds at prefill
+    begin and every chunk applies it, so the chunked multi-tenant server
+    matches the monolithic one exactly, per adapter."""
+    from kubetpu.jobs.lora import LoraConfig, init_lora_params
+    from kubetpu.jobs.multi_lora import MultiLoraDecodeServer, stack_adapters
+
+    lcfg = LoraConfig(rank=4, alpha=8.0)
+
+    def adapter(seed):
+        lora = init_lora_params(jax.random.PRNGKey(seed), CFG, lcfg)
+        keys = jax.random.split(jax.random.PRNGKey(seed + 100), 4)
+        for i, t in enumerate(lcfg.targets):
+            b = lora["blocks"][f"{t}_b"]
+            lora["blocks"][f"{t}_b"] = (
+                jax.random.normal(keys[i], b.shape, b.dtype) * 0.05)
+        return lora
+
+    stack = stack_adapters(lcfg, [adapter(1), adapter(2)])
+    kw = dict(n_slots=2, max_seq=64, max_new_tokens=5)
+
+    def run(server):
+        ra = server.enqueue(PROMPTS[0], adapter=1)
+        server.step()
+        rb = server.enqueue(PROMPTS[1], adapter=0)
+        server.drain()
+        return [server.result(r) for r in (ra, rb)]
+
+    mono = run(MultiLoraDecodeServer(CFG, params, lcfg, stack, **kw))
+    chunked = run(MultiLoraDecodeServer(CFG, params, lcfg, stack,
+                                        prefill_budget=2, **kw))
+    assert chunked == mono
+
+
+def test_paged_budgeted_warmup_and_long_admission(params):
+    """A budgeted paged server's warmup pre-compiles the resumed-chunk
+    (chunk, gather-prefix) shapes too; a long admission after warmup
+    streams through them and still matches the monolithic tokens."""
+    p = [(i * 3) % 60 + 1 for i in range(24)]
+    srv = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=4, page_size=4, prefill_budget=8)
+    srv.warmup()
+    rid = srv.enqueue(p)
+    srv.drain()
+    assert srv.finished(rid)
+    ref = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=4, page_size=4)
+    rr = ref.submit(p)
+    ref.drain()
+    assert srv.result(rid) == ref.result(rr)
+
+
+def test_prefill_chunk_metrics_recorded(params):
+    """The token-budget scheduler reports its work: per-chunk timings
+    land under "prefill_chunk", admission_stall still counts one entry
+    per admission (the summed chunk cost)."""
+    srv = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=4,
+                       prefill_budget=4)
+    rid = srv.enqueue([(i * 7) % 60 + 1 for i in range(13)])  # 4 chunks
+    srv.drain()
+    assert srv.finished(rid)
+    stats = srv.metrics_summary()
+    assert stats["prefill_chunk"]["count"] == 4   # 4 + 4 + 4 + 1 tokens
+    assert stats["admission_stall"]["count"] == 1

@@ -913,6 +913,38 @@ def test_make_multislice_mesh_dcn_outermost():
         make_multislice_mesh({"dcn": 4, "tp": 4})  # 16 > 8 devices
 
 
+def test_make_multislice_mesh_rejects_oversupply():
+    """An EXPLICIT device list larger than the mesh raises (mirroring the
+    undersupply errors) instead of silently truncating — dropped chips
+    would sit idle behind a placement bug. The implicit jax.devices()
+    path stays permissive."""
+    from kubetpu.jobs import make_multislice_mesh
+
+    devs = jax.devices()
+    # flat oversupply: 8 devices explicitly supplied for a 4-device mesh
+    with pytest.raises(ValueError, match="truncat"):
+        make_multislice_mesh({"dcn": 2, "tp": 2}, devices=devs)
+    # exact explicit supply still builds
+    mesh = make_multislice_mesh({"dcn": 2, "tp": 2}, devices=devs[:4])
+    assert mesh.shape == {"dcn": 2, "tp": 2}
+    # implicit (process-wide) devices keep take-what-fits behavior
+    mesh = make_multislice_mesh({"dcn": 2, "tp": 2})
+    assert mesh.shape == {"dcn": 2, "tp": 2}
+
+    class FakeDev:
+        def __init__(self, i, s):
+            self.id, self.slice_index = i, s
+
+    # grouped oversupply: 3 slice groups for dcn=2, and a fat group
+    fake6 = [FakeDev(i, i // 2) for i in range(6)]
+    with pytest.raises(ValueError, match="3"):
+        make_multislice_mesh({"dcn": 2, "tp": 2}, devices=fake6)
+    fat = [FakeDev(0, 0), FakeDev(1, 0), FakeDev(2, 0), FakeDev(3, 1),
+           FakeDev(4, 1)]
+    with pytest.raises(ValueError, match="idle"):
+        make_multislice_mesh({"dcn": 2, "tp": 2}, devices=fat)
+
+
 def test_multislice_train_step_matches_single_slice_dp():
     """{dcn:2, dp:1, sp:2, tp:2} training must be numerically the same
     computation as {dp:2, sp:2, tp:2}: dcn and dp are both pure data axes
