@@ -32,6 +32,12 @@ $(BUILD_DIR)/libkubetpu_dataio.so: kubetpu/dataio/loader.cc
 test: tpuinfo gpuinfo dataio
 	python -m pytest tests/ -x -q
 
+# seeded fault-injection soaks + the resilience suite (the short soak
+# also runs in tier-1; this target adds the slow 30% one)
+.PHONY: chaos
+chaos:
+	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+
 .PHONY: bench
 bench: tpuinfo
 	python bench.py

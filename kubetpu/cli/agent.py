@@ -26,6 +26,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
 import time
 
 from kubetpu.api.types import new_node_info
@@ -124,6 +125,7 @@ def main(argv=None) -> int:
 
     if args.serve:
         import os
+        import signal
 
         from kubetpu.wire import NodeAgentServer
 
@@ -132,11 +134,19 @@ def main(argv=None) -> int:
             dev, name, host=args.bind, port=args.port,
             token=os.environ.get("KUBETPU_WIRE_TOKEN"),
         )
+        # SIGTERM = graceful stop: drain (new work 503s), finish in-flight
+        # requests, then exit — the operator's rolling-restart contract
+        signal.signal(
+            signal.SIGTERM,
+            lambda *_: threading.Thread(
+                target=server.shutdown, daemon=True
+            ).start(),
+        )
         print(json.dumps({"listening": server.address, "node": name}), flush=True)
         try:
             server.serve_forever()
         except KeyboardInterrupt:
-            pass
+            server.shutdown()
         return 0
 
     last = None

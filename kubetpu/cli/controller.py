@@ -29,12 +29,23 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0,
                     help="API port (0 = ephemeral, printed on startup)")
     ap.add_argument("--poll-interval", type=float, default=5.0)
+    ap.add_argument("--suspect-after", type=int, default=1,
+                    help="consecutive missed probes before a node is "
+                         "health-cordoned (pods kept, no new placements)")
+    ap.add_argument("--dead-after", type=int, default=3,
+                    help="consecutive missed probes before a node is "
+                         "evicted and its pods rescheduled (1 = legacy "
+                         "one-strike)")
+    ap.add_argument("--probation-passes", type=int, default=1,
+                    help="clean probes a recovering node must answer "
+                         "while on probation before taking new work")
     args = ap.parse_args(argv)
 
     token = os.environ.get("KUBETPU_WIRE_TOKEN")
     server = ControllerServer(
         host=args.bind, port=args.port, poll_interval=args.poll_interval,
-        token=token,
+        token=token, suspect_after=args.suspect_after,
+        dead_after=args.dead_after, probation_passes=args.probation_passes,
     )
     registered, skipped = [], []
     for url in args.agents:

@@ -32,8 +32,14 @@ import os
 import socket
 import subprocess
 import sys
-import urllib.request
 from typing import Dict, List, Optional
+
+from kubetpu.wire.httpcommon import RetryPolicy, request_json
+
+# env fetches ride the shared retrying client: a transient controller blip
+# (reconcile hiccup, restart) costs a backoff, not an aborted launch
+FETCH_RETRY = RetryPolicy(attempts=4, base_delay=0.1, max_delay=2.0,
+                          deadline=60.0)
 
 
 def _free_port() -> int:
@@ -51,11 +57,10 @@ def _fetch_pod_env(controller: str, pod: str, token: Optional[str]) -> Dict[str,
     env-contract breakage this launcher exists to certify."""
     from kubetpu.jobs.launch import select_device_env
 
-    req = urllib.request.Request(controller.rstrip("/") + f"/pods/{pod}")
-    if token:
-        req.add_header("Authorization", f"Bearer {token}")
-    with urllib.request.urlopen(req, timeout=30) as r:
-        body = json.loads(r.read())
+    body = request_json(
+        controller.rstrip("/") + f"/pods/{pod}",
+        token=token, timeout=30, retry=FETCH_RETRY,
+    )
     envs = [
         result.get("env", {}) if isinstance(result, dict) else {}
         for result in body.get("containers", {}).values()

@@ -312,11 +312,12 @@ def config10(rounds=None):
 
 def config11(rounds=None):
     """adversarial: controller API end-to-end (HTTP submit -> schedule -> wire allocate -> HTTP release) p50/p99 over live agent servers"""
-    import json as json_lib
-    import urllib.request
+    import urllib.error
+    import uuid
 
     from kubetpu.wire import NodeAgentServer
     from kubetpu.wire.controller import ControllerServer, pod_to_json
+    from kubetpu.wire.httpcommon import request_json
 
     rounds = rounds or 60
     agents = [
@@ -334,17 +335,20 @@ def config11(rounds=None):
     controller.start()
 
     def post(path, obj):
-        req = urllib.request.Request(
-            controller.address + path, data=json_lib.dumps(obj).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=30) as r:
-            return json_lib.loads(r.read())
+        # the shared retrying client; the idempotency key makes the POST
+        # safely retriable (a replayed /pods submit cannot double-place)
+        return request_json(controller.address + path, obj, timeout=30,
+                            idempotency_key=uuid.uuid4().hex)
 
     def delete(path):
-        req = urllib.request.Request(controller.address + path, method="DELETE")
-        with urllib.request.urlopen(req, timeout=30) as r:
-            r.read()
+        try:
+            request_json(controller.address + path, method="DELETE",
+                         timeout=30)
+        except urllib.error.HTTPError as e:
+            # a 404 on a DELETE retry means the FIRST attempt succeeded
+            # and its response was lost — deleted either way
+            if e.code != 404:
+                raise
 
     try:
         for a in agents:

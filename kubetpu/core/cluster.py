@@ -1154,6 +1154,73 @@ class Cluster:
 
     # -- introspection ------------------------------------------------------
 
+    def check_invariants(self) -> List[str]:
+        """Audit the accounting invariants every scheduling path must
+        preserve; returns human-readable violations (empty = consistent).
+        The chaos soak's oracle: after a run of injected drops/retries/
+        evictions there must be NO double allocation —
+
+        - a pod name is placed on at most one node;
+        - a per-chip cards key is held by at most one POD (a pod's init
+          containers deliberately REUSE its running containers' pool, so
+          holds are the pod's distinct-key set — mirroring
+          group_scheduler._account), and held + free == capacity for
+          every advertised cards key;
+        - scalar device counts (tpu/gpu) balance: allocatable ==
+          capacity - held cards of that class, within [0, capacity].
+        """
+        problems: List[str] = []
+        owner: Dict[str, str] = {}
+        for name in utils.sorted_string_keys(self.nodes):
+            node = self.nodes[name]
+            held_keys: Dict[str, int] = {}
+            scalar_held = {ResourceTPU: 0, ResourceGPU: 0}
+            for pname, pod in node.pods.items():
+                if pname in owner:
+                    problems.append(
+                        f"pod {pname!r} placed on both {owner[pname]!r} "
+                        f"and {name!r}"
+                    )
+                owner[pname] = name
+                for key in group_scheduler._pod_held_keys(pod):
+                    m = group_scheduler._CARDS_KEY_RE.match(key)
+                    if not m:
+                        continue
+                    held_keys[key] = held_keys.get(key, 0) + 1
+                    scalar = group_scheduler._SCALAR_BY_BASE.get(m.group(5))
+                    if scalar in scalar_held:
+                        scalar_held[scalar] += 1
+            for key, n in sorted(held_keys.items()):
+                if n > 1:
+                    problems.append(
+                        f"{name}: resource {key!r} held by {n} pods"
+                    )
+            # sweep EVERY per-device key the node advertises, not just the
+            # currently-held ones — a key leaked while free (held 0 but
+            # allocatable corrupted downward) must not hide from the audit
+            for key in sorted(node.info.capacity):
+                if not key.endswith("/cards"):
+                    continue
+                n = held_keys.get(key, 0)
+                cap = int(node.info.capacity.get(key, 0))
+                free = int(node.info.allocatable.get(key, 0))
+                if n + free != cap:
+                    problems.append(
+                        f"{name}: {key!r} held({n}) + free({free}) != "
+                        f"capacity({cap})"
+                    )
+            for scalar, n in scalar_held.items():
+                if scalar not in node.info.capacity:
+                    continue
+                cap = int(node.info.capacity.get(scalar, 0))
+                free = int(node.info.allocatable.get(scalar, 0))
+                if not 0 <= free <= cap or n + free != cap:
+                    problems.append(
+                        f"{name}: {scalar} held({n}) + free({free}) != "
+                        f"capacity({cap})"
+                    )
+        return problems
+
     def status(self) -> Dict[str, object]:
         """Operator-facing snapshot: per-node free/total devices and pods,
         per-slice free chips, and scheduling latency percentiles."""

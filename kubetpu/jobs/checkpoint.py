@@ -6,11 +6,22 @@ durable state lives. Checkpoints restore directly into the target mesh's
 shardings — each host writes/reads only its shards (OCDBT), which is what
 makes resume-on-a-new-slice (after the gang scheduler re-places a job)
 practical.
+
+Crash safety (Round-7): every save writes to a TEMP sibling directory and
+atomically renames into place only after the writer flushed — a job killed
+mid-save (the exact window elastic recovery creates: the gang scheduler
+re-places a job whenever a node dies) leaves a ``.tmp-*`` orphan, never a
+half-written directory at the real path. ``latest_step_dir`` ignores
+orphans (non-digit names), and ``restore_checkpoint`` raises the typed
+``CorruptCheckpointError`` for a missing/truncated/mangled checkpoint so
+resume logic can fall back to an older step instead of crashing on an
+anonymous orbax stack trace.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 import warnings
 from typing import Any, Optional
 
@@ -20,14 +31,72 @@ import numpy as np
 from kubetpu.jobs.train import TrainState
 
 
+class CheckpointError(RuntimeError):
+    """Base for checkpoint load/save failures."""
+
+
+class CorruptCheckpointError(CheckpointError):
+    """The checkpoint at this path is missing, truncated, or mangled —
+    resume from an older step (``latest_step_dir`` of the parent) or
+    restart from scratch."""
+
+
+def _tmp_path(path: str) -> str:
+    # sibling, same filesystem (os.replace must not cross devices); pid
+    # disambiguates concurrent writers from different processes
+    return f"{path}.tmp-{os.getpid()}"
+
+
+def _single_host() -> bool:
+    """Atomic temp-write + rename is a SINGLE-HOST protocol: on a
+    multi-host job every process writes shards of the same directory, and
+    per-pid temp dirs would scatter them (then race the rename). There the
+    save degrades to writing the final path directly — orbax's own
+    multi-host commit protocol applies instead."""
+    return jax.process_count() == 1
+
+
+def _commit(tmp: str, path: str) -> None:
+    """Atomically move a finished write into place. An overwritten
+    previous checkpoint is first set ASIDE (rename, not rmtree) so no
+    crash window loses both generations: a kill between the two renames
+    leaves the old checkpoint at ``<path>.old``, which
+    ``restore_checkpoint`` falls back to."""
+    old = path + ".old"
+    had_old = False
+    if os.path.isdir(path):
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.replace(path, old)
+        had_old = True
+    os.replace(tmp, path)
+    if had_old:
+        shutil.rmtree(old, ignore_errors=True)
+
+
 def save_checkpoint(path: str, state: TrainState) -> None:
-    """Write a TrainState to *path* (created if needed)."""
+    """Write a TrainState to *path* (created if needed): temp-write +
+    atomic rename, so a crash mid-save never leaves a torn checkpoint at
+    the real path."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state)
-        ckptr.wait_until_finished()
+    if not _single_host():
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, state)
+            ckptr.wait_until_finished()
+        return
+    tmp = _tmp_path(path)
+    if os.path.isdir(tmp):  # stale orphan from a crashed writer: replace
+        shutil.rmtree(tmp)
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(tmp, state)
+            ckptr.wait_until_finished()
+        _commit(tmp, path)
+    finally:
+        if os.path.isdir(tmp):  # failed before commit: don't leak orphans
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 class AsyncCheckpointer:
@@ -40,15 +109,44 @@ class AsyncCheckpointer:
     previous one (bounding dirty state at one checkpoint), matching the
     single-writer layout ``latest_step_dir`` resumes from. Use as a
     context manager or call ``close()`` — pending writes flush on exit.
+
+    Crash safety: the background write lands in a ``.tmp-*`` sibling and
+    is renamed into place only once finished (at the next ``save``/
+    ``wait``/``close``) — a crash mid-write leaves an ignored orphan,
+    never a torn checkpoint at the real path.
     """
 
     def __init__(self) -> None:
         import orbax.checkpoint as ocp
 
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        self._pending: Optional[tuple] = None  # (tmp, final) awaiting commit
+
+    def _finalize(self) -> None:
+        """Commit the finished background write (caller has waited)."""
+        if self._pending is not None:
+            tmp, final = self._pending
+            self._pending = None
+            _commit(tmp, final)
+
+    def _abort_pending(self) -> None:
+        """The awaited write FAILED: never commit its torn tmp over the
+        last good checkpoint — drop the marker and the debris."""
+        if self._pending is not None:
+            tmp, _final = self._pending
+            self._pending = None
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _await_writer(self) -> None:
+        try:
+            self._ckptr.wait_until_finished()
+        except BaseException:
+            self._abort_pending()
+            raise
 
     def save(self, path: str, state: TrainState) -> None:
-        self._ckptr.wait_until_finished()  # at most one in flight
+        self._await_writer()  # at most one in flight
+        self._finalize()
         # Snapshot BEFORE returning: the train step donates its state, so
         # the caller's very next step deletes these buffers while orbax's
         # background thread still reads them. All device->host copies are
@@ -67,6 +165,11 @@ class AsyncCheckpointer:
                 return np.asarray(x)
             return x
 
+        path = os.path.abspath(path)
+        atomic = _single_host()
+        tmp = _tmp_path(path) if atomic else path
+        if atomic and os.path.isdir(tmp):  # stale orphan, crashed writer
+            shutil.rmtree(tmp)
         has_remote = any(
             isinstance(x, jax.Array) and not x.is_fully_addressable
             for x in jax.tree.leaves(state)
@@ -84,17 +187,27 @@ class AsyncCheckpointer:
                 "on multi-host, or accept the blocking save).",
                 stacklevel=2,
             )
-            self._ckptr.save(os.path.abspath(path), args=_standard_save_args(state))
+            self._ckptr.save(tmp, args=_standard_save_args(state))
             self._ckptr.wait_until_finished()
+            if atomic:
+                _commit(tmp, path)
             return
         state = jax.tree.map(collect, jax.tree.map(start, state))
-        self._ckptr.save(os.path.abspath(path), args=_standard_save_args(state))
+        self._ckptr.save(tmp, args=_standard_save_args(state))
+        if atomic:
+            self._pending = (tmp, path)
 
     def wait(self) -> None:
-        self._ckptr.wait_until_finished()
+        self._await_writer()
+        self._finalize()
 
     def close(self) -> None:
-        self._ckptr.close()
+        try:
+            self._ckptr.close()  # flushes the in-flight write
+        except BaseException:
+            self._abort_pending()
+            raise
+        self._finalize()
 
     def __enter__(self) -> "AsyncCheckpointer":
         return self
@@ -112,18 +225,42 @@ def _standard_save_args(state):
 def restore_checkpoint(path: str, target: TrainState) -> TrainState:
     """Restore into the structure/shardings of *target* (a freshly-built
     state on the destination mesh — possibly a different slice than the one
-    that saved)."""
+    that saved). Raises ``CorruptCheckpointError`` when the checkpoint is
+    missing, truncated, or otherwise unreadable — the typed signal resume
+    logic needs to fall back to an older step."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-            if hasattr(x, "sharding")
-            else x,
-            target,
-        )
-        restored = ckptr.restore(path, abstract)
+    if not os.path.isdir(path):
+        if os.path.isdir(path + ".old"):
+            # a writer died between _commit's two renames: the previous
+            # generation survives set-aside — restore it rather than fail
+            warnings.warn(
+                f"checkpoint at {path} is missing but a set-aside "
+                f"previous generation exists; restoring {path}.old",
+                stacklevel=2,
+            )
+            path = path + ".old"
+        else:
+            raise CorruptCheckpointError(
+                f"no checkpoint directory at {path} (crashed mid-save "
+                f"leaves only a .tmp-* orphan; resume from an older step)"
+            )
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+                if hasattr(x, "sharding")
+                else x,
+                target,
+            )
+            restored = ckptr.restore(path, abstract)
+    except Exception as e:  # noqa: BLE001 — orbax raises library-specific
+        # types for truncation/mangling; surface ONE typed error
+        raise CorruptCheckpointError(
+            f"checkpoint at {path} is unreadable (truncated, mangled, or "
+            f"not matching the target structure): {e}"
+        ) from e
     # Pin every leaf to a committed mesh sharding. Freshly-initialized
     # scalars (optimizer counts, step) are uncommitted single-device arrays
     # that jit may re-place freely, but restored arrays come back committed —

@@ -351,6 +351,7 @@ class PagedDecodeServer(SlotServerBase):
         kv_int8: bool = False,
         prefill_budget: int = 0,
         overlap: bool = False,
+        queue_ttl: Optional[float] = None,
     ) -> None:
         if cfg.window > 0 and use_kernel:
             raise NotImplementedError(
@@ -366,7 +367,8 @@ class PagedDecodeServer(SlotServerBase):
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
                          top_p=top_p, seed=seed,
-                         prefill_budget=prefill_budget, overlap=overlap)
+                         prefill_budget=prefill_budget, overlap=overlap,
+                         queue_ttl=queue_ttl)
         self.page_size = page_size
         self._min_bucket = page_size  # bucket >= one page keeps shapes few
         self.max_pages_per_slot = (max_seq + page_size - 1) // page_size

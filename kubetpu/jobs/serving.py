@@ -60,6 +60,12 @@ serving pattern behind modern LLM inference engines, TPU-shaped:
   chunked server token-exact against the monolithic one under seeded
   sampling (pinned by test).
 
+- graceful degradation under overload: ``queue_ttl`` (server default) /
+  ``enqueue(ttl=)`` (per request) bound the ADMISSION-QUEUE wait — a
+  queued prompt past its deadline is expired (finished empty, reason
+  readable via ``expire_reason`` and counted in ``metrics_summary`` as
+  ``queue_expired``) instead of waiting forever behind a backlog.
+
 A drained slot is immediately reusable: its cache region is overwritten by
 the next occupant's prefill, and every attention mask is position-bounded,
 so stale entries are never read (same invariant as speculative decoding).
@@ -136,6 +142,7 @@ class SlotServerBase:
         seed: int = 0,
         prefill_budget: int = 0,
         overlap: bool = False,
+        queue_ttl: Optional[float] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -185,7 +192,15 @@ class SlotServerBase:
         self._emitted: Dict[int, List[int]] = {}
         self._logprobs: Dict[int, List[float]] = {}
         self._done: Dict[int, bool] = {}
-        self._queue: List[Tuple[int, List[int]]] = []  # awaiting a slot
+        # admission queue entries: (rid, prompt, deadline-or-None) — the
+        # deadline is the graceful-degradation knob: under overload a
+        # queued prompt past its TTL is EXPIRED (finished empty, reason
+        # counted) instead of waiting forever
+        if queue_ttl is not None and queue_ttl < 0:
+            raise ValueError("queue_ttl must be >= 0 (None = no deadline)")
+        self.queue_ttl = queue_ttl
+        self._queue: List[Tuple[int, List[int], Optional[float]]] = []
+        self._expired: Dict[int, str] = {}     # rid -> reason
         self._pending_first: Dict[int, object] = {}    # slot -> device scalar
         self._metrics = LatencyRecorder()
 
@@ -311,12 +326,17 @@ class SlotServerBase:
         return rid
 
     def enqueue(self, prompt: List[int],
-                sampling: Optional[dict] = None) -> int:
+                sampling: Optional[dict] = None,
+                ttl: Optional[float] = None) -> int:
         """Non-blocking admission: host-side bookkeeping ONLY — the caller
         never waits on a compile or a prefill. The request enters a slot at
         the next ``step`` boundary with one free (decode keeps emitting for
         active streams in the meantime). Always returns a request id.
-        *sampling* as in ``submit``."""
+        *sampling* as in ``submit``. *ttl* (seconds) bounds the QUEUE wait
+        for this request (default: the server's ``queue_ttl``): past the
+        deadline it is expired — finished with no tokens, reason counted
+        (``expire_reason``/``metrics_summary``) — instead of waiting
+        forever behind an overload."""
         self._check_prompt(prompt)
         rid = self._next_rid
         self._next_rid += 1
@@ -325,15 +345,44 @@ class SlotServerBase:
         self._emitted[rid] = []
         self._logprobs[rid] = []
         self._done[rid] = False
-        self._queue.append((rid, list(prompt)))
+        if ttl is None:
+            ttl = self.queue_ttl
+        deadline = None if ttl is None else time.monotonic() + ttl
+        self._queue.append((rid, list(prompt), deadline))
         return rid
 
     def queued(self) -> int:
         """Requests enqueued but not yet admitted to a slot."""
         return len(self._queue)
 
+    def expire_reason(self, rid: int) -> Optional[str]:
+        """Why a request was expired ("queue_ttl"), or None for requests
+        that were admitted (or are still waiting)."""
+        return self._expired.get(rid)
+
+    def _expire_queue(self) -> None:
+        """Drop queued requests past their deadline — they finish EMPTY
+        with a counted reason; a caller polling ``finished`` sees them
+        complete and reads the reason instead of waiting forever."""
+        if not self._queue:
+            return
+        now = time.monotonic()
+        keep = []
+        for rid, prompt, deadline in self._queue:
+            if deadline is not None and now >= deadline:
+                self._done[rid] = True
+                self._expired[rid] = "queue_ttl"
+                self._rid_sampling.pop(rid, None)
+                self._metrics.record("queue_expired", now - deadline)
+            else:
+                keep.append((rid, prompt, deadline))
+        if len(keep) != len(self._queue):
+            self._queue = keep
+
     def metrics_summary(self) -> dict:
-        """{"admission_stall": {p50_ms, p99_ms, count}, "step": {...}}."""
+        """{"admission_stall": {p50_ms, p99_ms, count}, "step": {...},
+        "queue_expired": {count, ...}} (the latter only once a TTL has
+        expired a queued request)."""
         return self._metrics.summary()
 
     def step(self) -> Dict[int, List[int]]:
@@ -413,12 +462,15 @@ class SlotServerBase:
     def _drain_queue_into_slots(self) -> None:
         """Admit queued requests into free slots (resources permitting),
         first-token fetch deferred — the MONOLITHIC admission leg (whole
-        prompt in one prefill), shared by every subclass's step."""
+        prompt in one prefill), shared by every subclass's step. Expiry
+        runs HERE too (not only in _schedule_prefills) so subclasses that
+        call this leg directly (the speculative server) inherit the TTL."""
+        self._expire_queue()
         while self._queue:
             free = self._free_slots()
             if not free:
                 break
-            rid, prompt = self._queue[0]
+            rid, prompt, _deadline = self._queue[0]
             if not self._try_admit(rid, prompt, free[0], defer=True):
                 break              # resources exhausted: retry next step
             self._queue.pop(0)
@@ -456,6 +508,7 @@ class SlotServerBase:
         chunked prefills (FIFO), then starting queued requests in free
         slots — so decode never waits more than one bounded chunk behind
         any prompt. ``prefill_budget == 0`` is the monolithic path."""
+        self._expire_queue()   # graceful degradation: TTL'd waiters leave
         if self.prefill_budget <= 0:
             self._drain_queue_into_slots()
             return
@@ -471,8 +524,8 @@ class SlotServerBase:
             free = self._free_slots()
             if not free:
                 break
-            rid, prompt = self._queue.pop(0)
-            self._begin_prefill(rid, prompt, free[0])
+            rid, prompt, deadline = self._queue.pop(0)
+            self._begin_prefill(rid, prompt, free[0], deadline)
             used = self._advance_prefill(free[0], budget)
             budget -= used
             progressed = progressed or used > 0
@@ -485,17 +538,26 @@ class SlotServerBase:
                 and not self.active.any()):
             for slot in list(self._prefill_fifo[1:])[::-1]:
                 st = self._prefills[slot]
-                self._queue.insert(0, (st["rid"], st["prompt"]))
+                # parked back with its ORIGINAL deadline: parking must not
+                # grant a TTL'd request immortality
+                self._queue.insert(
+                    0, (st["rid"], st["prompt"], st["deadline"])
+                )
                 self._abort_prefill(slot)
 
-    def _begin_prefill(self, rid: int, prompt: List[int], slot: int) -> None:
+    def _begin_prefill(self, rid: int, prompt: List[int], slot: int,
+                       deadline: Optional[float] = None) -> None:
         """Occupy *slot* with a chunked prefill at progress 0. Device
-        resources are claimed chunk by chunk in ``_advance_prefill``."""
+        resources are claimed chunk by chunk in ``_advance_prefill``. Once
+        chunks start the TTL no longer applies (device work is under way);
+        *deadline* is kept only so deadlock PARKING can re-queue the
+        request without resetting its clock."""
         self._bind_slot(rid, slot)
         self._slot_rid[slot] = rid        # cancel() finds mid-prefills
         self._done[rid] = False
         self._prefills[slot] = {
             "rid": rid, "prompt": list(prompt), "done": 0, "t": 0.0,
+            "deadline": deadline,
         }
         self._prefill_fifo.append(slot)
 
@@ -600,7 +662,7 @@ class SlotServerBase:
         are evicted here (never consulted again once canceled)."""
         if self._done.get(rid, False) or rid not in self._prompts:
             return False
-        for i, (qrid, _p) in enumerate(self._queue):
+        for i, (qrid, _p, _d) in enumerate(self._queue):
             if qrid == rid:
                 self._queue.pop(i)
                 self._done[rid] = True
@@ -653,6 +715,7 @@ class SlotServerBase:
         del self._done[rid]
         self._rid_sampling.pop(rid, None)
         self._logprobs.pop(rid, None)
+        self._expired.pop(rid, None)  # expiry reason is bookkeeping too
         return out
 
     def _idle(self) -> bool:
@@ -801,11 +864,13 @@ class DecodeServer(SlotServerBase):
         kv_int8: bool = False,
         prefill_budget: int = 0,
         overlap: bool = False,
+        queue_ttl: Optional[float] = None,
     ) -> None:
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
                          top_p=top_p, seed=seed,
-                         prefill_budget=prefill_budget, overlap=overlap)
+                         prefill_budget=prefill_budget, overlap=overlap,
+                         queue_ttl=queue_ttl)
         # The cache is a PYTREE + a cache_io strategy (decode.py's slot):
         # dense (k, v) or int8 ((kq, ks), (vq, vs)) — the server legs are
         # layout-blind. ``kv_int8=True`` stores the cache in int8 with

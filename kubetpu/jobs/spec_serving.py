@@ -63,11 +63,13 @@ class SpeculativeDecodeServer(SlotServerBase):
         eos_id: Optional[int] = None,
         gamma: int = 4,
         seed: int = 0,
+        queue_ttl: Optional[float] = None,
     ) -> None:
         if target_cfg.vocab != draft_cfg.vocab:
             raise ValueError("target and draft must share a vocabulary")
         super().__init__(target_cfg, target_params, n_slots, max_seq,
-                         max_new_tokens, eos_id, seed=seed)
+                         max_new_tokens, eos_id, seed=seed,
+                         queue_ttl=queue_ttl)
         self.draft_cfg = draft_cfg
         self.draft_params = draft_params
         self.gamma = gamma

@@ -15,6 +15,13 @@ KubeDevice core; kubetpu owns the core, so it owns this boundary too:
 - ``client`` — ``RemoteDevice``: a ``device.Device`` whose probe and
   allocate legs cross the wire, so a ``Cluster`` schedules across live agent
   processes with zero changes to the scheduling path.
+- ``httpcommon`` — the shared retrying client (``request_json`` +
+  ``RetryPolicy``: jittered exponential backoff, per-call deadlines,
+  POST-only-with-key retry safety) and the server-side idempotency
+  replay window.
+- ``faults`` — deterministic (seeded) per-route fault injection for chaos
+  testing: drop/delay/5xx/partial-response, installable into both the
+  stdlib servers and the urllib client path.
 """
 
 from kubetpu.wire.client import AgentUnreachable, RemoteDevice, probe_remote_agent
@@ -27,14 +34,27 @@ from kubetpu.wire.codec import (
     pod_info_to_json,
 )
 from kubetpu.wire.controller import ControllerServer
+from kubetpu.wire.faults import FaultInjector, RoutePolicy
+from kubetpu.wire.httpcommon import (
+    NO_RETRY,
+    IdempotencyCache,
+    RetryPolicy,
+    request_json,
+)
 from kubetpu.wire.server import NodeAgentServer
 
 __all__ = [
     "AgentUnreachable",
     "ControllerServer",
+    "FaultInjector",
+    "IdempotencyCache",
+    "NO_RETRY",
     "NodeAgentServer",
     "probe_remote_agent",
     "RemoteDevice",
+    "request_json",
+    "RetryPolicy",
+    "RoutePolicy",
     "allocate_result_from_json",
     "allocate_result_to_json",
     "node_info_from_json",

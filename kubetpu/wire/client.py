@@ -11,13 +11,24 @@ that into the ``fail_node`` -> reschedule path (SURVEY.md §5.3).
 Follows the reference's HTTP-backend pattern (``NvidiaDockerPlugin``'s REST
 client against localhost:3476, ``nvidia_docker_plugin.go:21-27``) with
 stdlib urllib — no third-party HTTP dependency.
+
+Chaos-hardening contract (shared ``request_json`` discipline):
+
+- every wire call runs under jittered exponential retry with a per-call
+  deadline (``retry=`` — a transient blip costs a backoff, not a node
+  eviction); ``AgentUnreachable`` now means "unreachable after the whole
+  retry budget";
+- ``POST /allocate`` carries a client-generated idempotency key, fresh
+  per LOGICAL call and shared across its retries, so a retried allocate
+  whose first response was lost mid-flight is replayed from the agent's
+  dedup window instead of double-allocating.
 """
 
 from __future__ import annotations
 
 import json
 import urllib.error
-import urllib.request
+import uuid
 from typing import Optional
 
 from kubetpu.api.device import AllocateResult, Device
@@ -27,14 +38,29 @@ from kubetpu.wire.codec import (
     node_info_from_json,
     pod_info_to_json,
 )
+from kubetpu.wire.httpcommon import (
+    TRANSIENT_ERRORS,
+    RetryPolicy,
+    request_json,
+)
 
 
 class AgentUnreachable(ConnectionError):
     """The node agent did not answer — treat the node as failed."""
 
 
+# agent calls: tight per-attempt timeout, small budget — the controller's
+# probe pool must converge within one reconcile pass, not block it
+AGENT_RETRY = RetryPolicy(
+    attempts=3, base_delay=0.05, max_delay=0.5, deadline=12.0
+)
+
+
 def probe_remote_agent(
-    url: str, name: Optional[str] = None, token: Optional[str] = None
+    url: str,
+    name: Optional[str] = None,
+    token: Optional[str] = None,
+    retry: Optional[RetryPolicy] = None,
 ):
     """Health-check + probe an agent and return ``(RemoteDevice, NodeInfo)``
     — the wire half of remote-node registration, factored out so callers
@@ -42,7 +68,7 @@ def probe_remote_agent(
     this slow leg OUTSIDE it. Raises ``AgentUnreachable``/``ValueError``."""
     from kubetpu.api.types import new_node_info
 
-    dev = RemoteDevice(url, token=token)
+    dev = RemoteDevice(url, token=token, retry=retry)
     dev.start()  # fail fast on a dead address
     info = new_node_info(name or "")
     dev.update_node_info(info)
@@ -55,11 +81,19 @@ class RemoteDevice(Device):
     """Device manager proxy over a node agent's HTTP surface."""
 
     def __init__(
-        self, url: str, timeout: float = 5.0, token: Optional[str] = None
+        self,
+        url: str,
+        timeout: float = 5.0,
+        token: Optional[str] = None,
+        retry: Optional[RetryPolicy] = None,
+        faults=None,
     ) -> None:
         """*token*: shared-secret bearer token matching the agent's
         (``NodeAgentServer(token=)`` / agent ``KUBETPU_WIRE_TOKEN``);
-        defaults to the client-side ``KUBETPU_WIRE_TOKEN`` env."""
+        defaults to the client-side ``KUBETPU_WIRE_TOKEN`` env.
+        *retry*: per-call retry/backoff budget (default ``AGENT_RETRY``).
+        *faults*: a ``FaultInjector`` for this client's outbound calls
+        (chaos tests); None also consults the process-wide injector."""
         import os
 
         self.url = url.rstrip("/")
@@ -67,32 +101,45 @@ class RemoteDevice(Device):
         if token is None:
             token = os.environ.get("KUBETPU_WIRE_TOKEN")
         self.token = token or None  # "" (blank env var) = no auth, both sides
+        self.retry = retry or AGENT_RETRY
+        self.faults = faults
         self._plugin_name: Optional[str] = None
 
     # -- transport ----------------------------------------------------------
 
-    def _request(self, path: str, payload: Optional[dict] = None) -> dict:
-        headers = {"Content-Type": "application/json"}
-        if self.token:
-            headers["Authorization"] = f"Bearer {self.token}"
-        req = urllib.request.Request(
-            self.url + path,
-            data=None if payload is None else json.dumps(payload).encode(),
-            headers=headers,
-            method="GET" if payload is None else "POST",
-        )
+    def _request(
+        self,
+        path: str,
+        payload: Optional[dict] = None,
+        idempotency_key: Optional[str] = None,
+    ) -> dict:
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
+            return request_json(
+                self.url + path,
+                payload,
+                token=self.token,
+                timeout=self.timeout,
+                retry=self.retry,
+                idempotency_key=idempotency_key,
+                faults=self.faults,
+            )
         except urllib.error.HTTPError as e:
-            # The agent answered with an application error — surface it as a
-            # normal failure, NOT as node death.
             try:
                 detail = json.loads(e.read()).get("error", str(e))
             except Exception:  # noqa: BLE001
                 detail = str(e)
+            if e.code in (502, 503, 504):
+                # infra-transient through the whole retry budget (agent
+                # draining, injected faults, idempotent dup in flight):
+                # the node is effectively unreachable right now — let the
+                # caller's breaker/reconcile logic absorb it
+                raise AgentUnreachable(
+                    f"agent {self.url}{path}: {detail}"
+                ) from e
+            # The agent answered with an application error — surface it as a
+            # normal failure, NOT as node death.
             raise RuntimeError(f"agent {self.url}{path}: {detail}") from e
-        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+        except TRANSIENT_ERRORS as e:
             raise AgentUnreachable(f"agent {self.url} unreachable: {e}") from e
 
     # -- Device surface ------------------------------------------------------
@@ -126,8 +173,12 @@ class RemoteDevice(Device):
         )
         if cname is None:
             raise ValueError("container is not part of pod")
+        # one key per LOGICAL allocate, shared by its retries: the agent's
+        # dedup window replays a lost response instead of re-allocating
         result = self._request(
-            "/allocate", {"pod": pod_info_to_json(pod), "container": cname}
+            "/allocate",
+            {"pod": pod_info_to_json(pod), "container": cname},
+            idempotency_key=uuid.uuid4().hex,
         )
         return allocate_result_from_json(result)
 

@@ -33,6 +33,25 @@ nodes (pods that fit nowhere stay in a pending queue, retried each poll —
 elastic recovery as a service, SURVEY.md §5.3). All Cluster mutations are
 serialized under one lock; the HTTP layer is threaded.
 
+Round-7 fault tolerance:
+
+- node health is a CIRCUIT-BREAKER state machine, not one-strike: a
+  missed probe moves a node healthy -> suspect (health-cordoned: no new
+  placements, existing pods KEPT); only ``dead_after`` consecutive misses
+  evict (fail_node -> reschedule), so a transient partition or agent GC
+  pause no longer tears down and re-places whole gangs. A recovering
+  node passes through probation (``probation_passes`` clean probes)
+  before taking new work;
+- ``POST /pods`` honors the ``Idempotency-Key`` header: a client retry
+  whose first response was lost replays the committed placement instead
+  of double-placing (only success is cached; a failed attempt's key is
+  released so the retry re-executes);
+- graceful lifecycle: ``drain_server()`` refuses new mutating work (503)
+  while in-flight requests finish; ``shutdown(graceful=True)`` waits for
+  them (bounded) before closing the listener;
+- ``faults=`` installs a seeded ``FaultInjector`` into this server for
+  chaos testing (``wire.faults``).
+
 Shared-secret auth: like the agent server, a ``token`` protects every
 route except ``/healthz`` (``KUBETPU_WIRE_TOKEN`` in the CLI).
 """
@@ -55,7 +74,31 @@ from kubetpu.wire.codec import (
     pod_info_from_json,
     pod_info_to_json,
 )
-from kubetpu.wire.httpcommon import check_bearer, write_json
+from kubetpu.wire.httpcommon import (
+    IdempotencyCache,
+    InflightTracker,
+    check_bearer,
+    handle_guarded,
+    run_idempotent,
+    write_json,
+)
+
+# circuit-breaker health states (healthy -> suspect -> probation -> dead)
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+PROBATION = "probation"
+
+
+class NodeHealth:
+    """Per-node breaker state: consecutive probe misses and, while
+    recovering, consecutive clean probes."""
+
+    __slots__ = ("state", "misses", "oks")
+
+    def __init__(self) -> None:
+        self.state = HEALTHY
+        self.misses = 0
+        self.oks = 0
 
 
 class ControllerServer:
@@ -70,10 +113,33 @@ class ControllerServer:
         token: Optional[str] = None,
         reserve_after: int = 3,
         reserve_hold: int = 10,
+        suspect_after: int = 1,
+        dead_after: int = 3,
+        probation_passes: int = 1,
+        faults=None,
+        agent_retry=None,
+        idem_window: float = 300.0,
     ) -> None:
         self.cluster = cluster or Cluster()
         self.poll_interval = poll_interval
         self.token = token or None
+        # circuit-breaker thresholds: ``suspect_after`` consecutive missed
+        # probes health-cordon a node (pods kept, no new placements);
+        # ``dead_after`` consecutive misses evict it. ``dead_after=1`` is
+        # the legacy one-strike behavior. A recovering node must answer
+        # ``probation_passes`` consecutive probes before taking work again.
+        if dead_after < 1 or suspect_after < 1:
+            raise ValueError("health thresholds must be >= 1")
+        self.suspect_after = suspect_after
+        self.dead_after = max(dead_after, suspect_after)
+        self.probation_passes = max(probation_passes, 1)
+        self._health: Dict[str, NodeHealth] = {}
+        self._health_cordoned: set = set()  # cordons WE placed (not operator)
+        self.faults = faults
+        self.agent_retry = agent_retry  # RetryPolicy toward agents (None=default)
+        self._idem = IdempotencyCache(ttl=idem_window)
+        self.draining = False
+        self._inflight = InflightTracker()
         # head-of-line gang reservation: a pending gang that has survived
         # this many reconcile passes claims the device classes it requests —
         # later pending work and new submissions of those classes queue
@@ -112,11 +178,15 @@ class ControllerServer:
                 return json.loads(self.rfile.read(length) or b"{}")
 
             def do_GET(self):  # noqa: N802
+                handle_guarded(controller, self, self._do_get)
+
+            def _do_get(self):
                 # NOTE: payloads are built under the lock but written to the
                 # socket OUTSIDE it — one stalled reader must never block
                 # scheduling or reconciliation.
                 if self.path == "/healthz":
-                    self._reply(200, {"ok": True})
+                    self._reply(200, {"ok": True,
+                                      "draining": controller.draining})
                     return
                 if not self._authorized():
                     return
@@ -128,7 +198,11 @@ class ControllerServer:
                     with controller._lock:
                         status = controller.cluster.status()["nodes"]
                         out = {
-                            name: {**entry, "url": controller._node_urls.get(name)}
+                            name: {
+                                **entry,
+                                "url": controller._node_urls.get(name),
+                                "health": controller._health_state(name),
+                            }
                             for name, entry in status.items()
                         }
                     self._reply(200, out)
@@ -148,7 +222,22 @@ class ControllerServer:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):  # noqa: N802
+                handle_guarded(controller, self, self._do_post)
+
+            def _submit_leg(self):
+                """/pods execution leg for run_idempotent: the draining
+                refusal lives here, AFTER the replay lookup — a keyed
+                retry of an already-committed submit gets its replay even
+                mid-drain (replaying mutates nothing)."""
+                if controller.draining:
+                    return 503, {"error": "controller is draining"}
+                return 200, controller._submit(self._body())
+
+            def _do_post(self):
                 if not self._authorized():
+                    return
+                if controller.draining and self.path != "/pods":
+                    self._reply(503, {"error": "controller is draining"})
                     return
                 try:
                     if self.path == "/nodes":
@@ -162,9 +251,16 @@ class ControllerServer:
                         # _submit manages the lock itself: placement commits
                         # under it, the per-container agent wire calls run
                         # OUTSIDE it (a slow-but-alive agent must not freeze
-                        # /status, /nodes, DELETE and the reconcile pass)
-                        out = controller._submit(self._body())
-                        self._reply(200, out)
+                        # /status, /nodes, DELETE and the reconcile pass).
+                        # Idempotency-keyed retries replay the committed
+                        # placement instead of double-placing (the shared
+                        # run_idempotent contract; exceptions abort the key
+                        # and fall through to the error mapping below).
+                        run_idempotent(
+                            self, controller._idem,
+                            self.headers.get("Idempotency-Key"),
+                            self._submit_leg,
+                        )
                     elif self.path == "/defrag":
                         req = self._body()
                         with controller._lock:
@@ -193,11 +289,25 @@ class ControllerServer:
                         self._reply(404, {"error": f"no route {self.path}"})
                 except SchedulingError as e:
                     self._reply(409, {"error": str(e)})
+                except ConnectionError as e:
+                    # an agent wire leg died mid-request (state rolled
+                    # back): transient infra, answered 503 so a keyed
+                    # client retry re-executes instead of surfacing a
+                    # dead-end 500
+                    self._reply(503, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — report, stay up
                     self._reply(500, {"error": str(e)})
 
             def do_DELETE(self):  # noqa: N802
+                handle_guarded(controller, self, self._do_delete)
+
+            def _do_delete(self):
                 if not self._authorized():
+                    return
+                if controller.draining:
+                    # DELETE mutates cluster state too: a draining control
+                    # plane must be FROZEN, not merely not-placing
+                    self._reply(503, {"error": "controller is draining"})
                     return
                 if not self.path.startswith("/pods/"):
                     self._reply(404, {"error": f"no route {self.path}"})
@@ -238,12 +348,25 @@ class ControllerServer:
         """Register a live agent (the one registration path — the POST
         /nodes handler and the CLI both call this). The wire probe runs
         OUTSIDE the cluster lock: a black-holed URL must cost the caller a
-        timeout, not stall the whole operator API."""
+        timeout, not stall the whole operator API. Re-registering the SAME
+        name at the SAME url is a no-op returning the name — a retried
+        registration whose first response was lost must not 500."""
         from kubetpu.wire.client import probe_remote_agent
 
-        dev, info = probe_remote_agent(url, name=name, token=token)
+        dev, info = probe_remote_agent(
+            url, name=name, token=token, retry=self.agent_retry
+        )
         with self._lock:
             if info.name in self.cluster.nodes:
+                if self._node_urls.get(info.name) == url:
+                    # idempotent re-register — and the probe above just
+                    # SUCCEEDED, so any accumulated miss streak is over:
+                    # reset the breaker and lift our health cordon (a
+                    # freshly verified-alive node must not be one blip
+                    # from eviction)
+                    self._health[info.name] = NodeHealth()
+                    self._health_uncordon(info.name)
+                    return info.name
                 raise ValueError(
                     f"node {info.name!r} is already registered; remove it "
                     f"first, or start the agent with a distinct --name"
@@ -253,7 +376,73 @@ class ControllerServer:
                 info.name, device=dev, node_info=info, probe=False
             )
             self._node_urls[info.name] = url
+            self._health[info.name] = NodeHealth()
             return info.name
+
+    # -- circuit-breaker node health -----------------------------------------
+
+    def _health_state(self, name: str) -> str:
+        """Call under the lock. Nodes without breaker state (in-process
+        devices, never probed) read healthy."""
+        h = self._health.get(name)
+        return h.state if h is not None else HEALTHY
+
+    def _health_cordon(self, name: str) -> None:
+        """Health-cordon (under the lock): no NEW placements while the
+        node is suspect/probation; existing pods stay. Operator cordons
+        are left alone — we only lift cordons WE placed."""
+        if name not in self.cluster.cordoned:
+            self.cluster.cordon(name)
+            self._health_cordoned.add(name)
+
+    def _health_uncordon(self, name: str) -> None:
+        if name in self._health_cordoned:
+            self._health_cordoned.discard(name)
+            if name in self.cluster.nodes:
+                self.cluster.cordon(name, on=False)
+
+    def _record_miss(self, name: str) -> bool:
+        """One missed probe (under the lock). Returns True when the node
+        crossed ``dead_after`` consecutive misses and must be evicted."""
+        h = self._health.setdefault(name, NodeHealth())
+        h.misses += 1
+        h.oks = 0
+        if h.misses >= self.dead_after:
+            self._health.pop(name, None)
+            self._health_cordoned.discard(name)  # remove_node drops the cordon
+            return True
+        if h.state != SUSPECT and h.misses >= self.suspect_after:
+            h.state = SUSPECT
+            self._health_cordon(name)
+            self.cluster._event("node_suspect", node=name, misses=h.misses)
+        return False
+
+    def _record_ok(self, name: str) -> None:
+        """One clean probe (under the lock): suspect -> probation on the
+        first clean probe, then healthy after ``probation_passes`` MORE
+        consecutive clean probes (the node stays health-cordoned through
+        probation — a flapping agent must prove itself before taking new
+        work; its existing pods ran undisturbed the whole time)."""
+        h = self._health.get(name)
+        if h is None:
+            return
+        # a clean probe ALWAYS zeroes the miss streak — dead_after counts
+        # CONSECUTIVE misses, so a healthy-but-flapping node (miss, ok,
+        # miss, ok, ...) must never accumulate toward suspect/dead
+        h.misses = 0
+        if h.state == HEALTHY:
+            return
+        if h.state == SUSPECT:
+            h.state = PROBATION
+            h.oks = 0
+            self.cluster._event("node_probation", node=name)
+            return
+        h.oks += 1
+        if h.oks >= self.probation_passes:
+            h.state = HEALTHY
+            h.oks = 0
+            self._health_uncordon(name)
+            self.cluster._event("node_recovered", node=name)
 
     def _snapshot_placed(self, name: str, node_name: Optional[str] = None):
         """(device, pod copy) of a placed pod — caller holds the lock.
@@ -613,9 +802,11 @@ class ControllerServer:
     def poll_once(self) -> dict:
         """One reconcile pass: probe remote agents (OUTSIDE the lock — a
         partition must not stall the operator API for timeout x agents),
-        fail dead ones, apply fresh advertisements, and re-place evicted +
-        pending pods where capacity allows. Re-placed pods are allocated
-        too, so their launcher env is ready (also at GET /pods/<name>)."""
+        run missed probes through the circuit breaker (suspect/probation
+        keep their pods; only ``dead_after`` consecutive misses evict),
+        apply fresh advertisements, and re-place evicted + pending pods
+        where capacity allows. Re-placed pods are allocated too, so their
+        launcher env is ready (also at GET /pods/<name>)."""
         from kubetpu.api.types import new_node_info
         from kubetpu.wire import AgentUnreachable, RemoteDevice
 
@@ -652,13 +843,26 @@ class ControllerServer:
 
         with self._lock:
             failed: List[str] = []
+            suspect: List[str] = []
             for name in dead:
-                if name in self.cluster.nodes:
+                if name not in self.cluster.nodes:
+                    continue
+                if self._record_miss(name):
+                    # breaker tripped: dead_after consecutive misses
                     self._node_urls.pop(name, None)
                     self._pending.extend(self.cluster.fail_node(name))
                     failed.append(name)
+                elif self._health_state(name) != HEALTHY:
+                    # transient so far: pods stay placed, node is health-
+                    # cordoned — a blip shorter than the threshold costs
+                    # ZERO reschedules. (With suspect_after > 1 a node's
+                    # first misses leave it HEALTHY and schedulable — it
+                    # must not be reported suspect before the breaker
+                    # actually opened.)
+                    suspect.append(name)
             for name, fresh in probed.items():
                 if name in self.cluster.nodes:
+                    self._record_ok(name)
                     self.cluster.refresh_node(name, probed=fresh)
             # Phase 1 (under the lock): commit placements and snapshot; pods
             # that fit nowhere stay pending. Placed pods leave _pending NOW
@@ -776,6 +980,7 @@ class ControllerServer:
             pending_names = [p.name for p in self._pending]
         return {
             "failed_nodes": failed,
+            "suspect_nodes": sorted(suspect),
             "rescheduled": rescheduled,
             "pending": pending_names,
             "reserved_gang": reservation["gang"] if reservation else None,
@@ -783,6 +988,11 @@ class ControllerServer:
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
+            if self.draining:
+                # a draining control plane is FROZEN end to end: client
+                # mutations 503 AND the reconcile loop stops evicting/
+                # re-placing — the operator's handoff snapshot stays put
+                continue
             try:
                 result = self.poll_once()
                 if result["failed_nodes"] or result["rescheduled"]:
@@ -796,6 +1006,15 @@ class ControllerServer:
             return [p.name for p in self._pending]
 
     # -- lifecycle -----------------------------------------------------------
+
+    def drain_server(self) -> None:
+        """Freeze the control plane for a handoff: mutating work is
+        refused 503 (reads keep answering, ``/healthz`` reports
+        ``draining``), in-flight requests finish, and the background
+        reconcile loop pauses — no eviction or re-placement moves pods
+        out from under the operator. Named apart from the node-drain
+        route (``_drain``)."""
+        self.draining = True
 
     @property
     def address(self) -> str:
@@ -818,8 +1037,14 @@ class ControllerServer:
         if self._poll_thread is not None:
             self._poll_thread.join()
 
-    def shutdown(self) -> None:
+    def shutdown(self, graceful: bool = True, timeout: float = 5.0) -> None:
+        """Stop the daemon. ``graceful`` first refuses new mutating work
+        and waits (bounded) for in-flight requests to finish — no response
+        is cut mid-write; set False to simulate abrupt death."""
         self._stop.set()
+        if graceful:
+            self.draining = True
+            self._inflight.wait_idle(timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._poll_thread is not None:

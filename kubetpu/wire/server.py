@@ -5,7 +5,8 @@ This is the transport leg the reference leaves to the external KubeDevice
 core (its CRI shim and scheduler are separate processes; VERDICT r1 #1): a
 small threaded HTTP server wrapping a ``device.Device``:
 
-    GET  /healthz   -> {"ok": true, "node": <name>, "plugin": <device name>}
+    GET  /healthz   -> {"ok": true, "node": <name>, "plugin": <device name>,
+                        "draining": <bool>}
     GET  /nodeinfo  -> NodeInfo JSON (fresh advertisement; the manager's
                        probe cache bounds actual hardware queries)
     GET  /metrics   -> Prometheus-style text: request/error counters,
@@ -14,6 +15,19 @@ small threaded HTTP server wrapping a ``device.Device``:
     POST /allocate  -> {"pod": PodInfo, "container": <name>} ->
                        AllocateResult JSON (the container-start injection
                        step, run node-local where the devices live)
+
+Robustness (Round-7):
+
+- idempotent allocate: a request carrying an ``Idempotency-Key`` header is
+  deduped through a bounded replay window — a client retry whose first
+  response was lost gets the committed result replayed (counted as
+  ``allocate_replays``), never a second device allocation;
+- graceful drain/shutdown: ``drain()`` stops accepting mutating work
+  (POST -> 503, liveness keeps answering with ``"draining": true``);
+  ``shutdown(graceful=True)`` drains, waits for in-flight requests to
+  finish (bounded), then stops the listener — no request is cut mid-write;
+- fault injection: pass ``faults=FaultInjector(...)`` to chaos-test the
+  surface (seeded drop/delay/5xx/partial per route, ``wire.faults``).
 
 Stdlib-only (http.server), threaded so a slow probe doesn't block health
 checks. Binds 127.0.0.1 by default; port 0 picks an ephemeral port — the
@@ -36,7 +50,15 @@ from kubetpu.wire.codec import (
     node_info_to_json,
     pod_info_from_json,
 )
-from kubetpu.wire.httpcommon import check_bearer, write_json, write_text
+from kubetpu.wire.httpcommon import (
+    IdempotencyCache,
+    InflightTracker,
+    check_bearer,
+    handle_guarded,
+    run_idempotent,
+    write_json,
+    write_text,
+)
 
 
 class NodeAgentServer:
@@ -49,14 +71,21 @@ class NodeAgentServer:
         host: str = "127.0.0.1",
         port: int = 0,
         token: "str | None" = None,
+        faults=None,
+        idem_window: float = 300.0,
     ) -> None:
         """*token*: shared-secret auth — when set, every request must carry
         ``Authorization: Bearer <token>`` or is rejected 401 (``/healthz``
         stays open for liveness probes). Matches ``RemoteDevice(token=)``;
-        the agent CLI reads it from ``KUBETPU_WIRE_TOKEN``."""
+        the agent CLI reads it from ``KUBETPU_WIRE_TOKEN``.
+        *faults*: optional ``FaultInjector`` for chaos testing.
+        *idem_window*: seconds an allocate's committed response stays
+        replayable for idempotency-keyed retries."""
         self.device = device
         self.node_name = node_name
         self.token = token or None  # "" (e.g. a blank env var) = no auth
+        self.faults = faults
+        self.idem = IdempotencyCache(ttl=idem_window)
         self.started_at = time.time()
         # counters are written under the per-request threads; int += is a
         # single bytecode read-modify-write, so guard with a lock
@@ -64,8 +93,14 @@ class NodeAgentServer:
         self.counters = {
             "nodeinfo_requests": 0,
             "allocate_requests": 0,
+            "allocate_replays": 0,
             "errors": 0,
         }
+        # graceful lifecycle: while draining, mutating work is refused 503
+        # but in-flight requests run to completion (tracked so a graceful
+        # shutdown can wait for them)
+        self.draining = False
+        self._inflight = InflightTracker()
         # last advertised kube capacity — /metrics serves this snapshot
         # instead of re-probing hardware per scrape (a 15s Prometheus
         # interval must not defeat the manager's probe-cache bound). None =
@@ -95,6 +130,9 @@ class NodeAgentServer:
                 return False
 
             def do_GET(self):  # noqa: N802
+                handle_guarded(agent, self, self._do_get)
+
+            def _do_get(self):
                 if self.path == "/healthz":
                     self._reply(
                         200,
@@ -102,6 +140,7 @@ class NodeAgentServer:
                             "ok": True,
                             "node": agent.node_name,
                             "plugin": agent.device.get_name(),
+                            "draining": agent.draining,
                         },
                     )
                 elif not self._authorized():
@@ -147,11 +186,18 @@ class NodeAgentServer:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):  # noqa: N802
-                if not self._authorized():  # auth before routing, like GET
-                    return
-                if self.path != "/allocate":
-                    self._reply(404, {"error": f"no route {self.path}"})
-                    return
+                handle_guarded(agent, self, self._do_post)
+
+            def _allocate(self):
+                """One allocate execution -> (code, obj); run_idempotent
+                commits 200s and aborts the rest (a retried failure
+                re-executes). The draining refusal lives HERE, after the
+                replay lookup: a keyed retry of an already-committed
+                allocate must get its replay even mid-drain (replaying
+                mutates nothing; refusing it would leak the committed
+                chips when the controller rolls back)."""
+                if agent.draining:
+                    return 503, {"error": "agent is draining"}
                 bump("allocate_requests")
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -162,18 +208,32 @@ class NodeAgentServer:
                         cname
                     ) or pod.init_containers.get(cname)
                     if cont is None:
-                        self._reply(
-                            400, {"error": f"pod has no container {cname!r}"}
-                        )
-                        return
+                        return 400, {"error": f"pod has no container {cname!r}"}
                     result = agent.device.allocate(pod, cont)
-                    self._reply(200, allocate_result_to_json(result))
+                    return 200, allocate_result_to_json(result)
                 except Exception as e:  # noqa: BLE001 — report, stay up
                     bump("errors")
-                    self._reply(500, {"error": str(e)})
+                    return 500, {"error": str(e)}
+
+            def _do_post(self):
+                if not self._authorized():  # auth before routing, like GET
+                    return
+                if self.path != "/allocate":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                # idempotency: a keyed retry of an allocate whose response
+                # was lost replays the committed result (the shared
+                # run_idempotent contract, httpcommon)
+                run_idempotent(
+                    self, agent.idem, self.headers.get("Idempotency-Key"),
+                    self._allocate,
+                    on_replay=lambda: bump("allocate_replays"),
+                )
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
 
     @property
     def address(self) -> str:
@@ -198,7 +258,18 @@ class NodeAgentServer:
         """Serve on the calling thread (the agent CLI's main loop)."""
         self._httpd.serve_forever()
 
-    def shutdown(self) -> None:
+    def drain(self) -> None:
+        """Stop accepting mutating work (POST -> 503); reads and liveness
+        keep answering, in-flight requests finish."""
+        self.draining = True
+
+    def shutdown(self, graceful: bool = True, timeout: float = 5.0) -> None:
+        """Stop the server. ``graceful`` first drains and waits (bounded)
+        for in-flight requests to complete, so no response is cut mid-write
+        — set False to simulate abrupt death (chaos tests)."""
+        if graceful:
+            self.draining = True
+            self._inflight.wait_idle(timeout)
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:

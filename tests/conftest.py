@@ -26,6 +26,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: spawns real OS processes / long end-to-end flows"
     )
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection soaks over the wire stack"
+    )
 
 
 import pytest  # noqa: E402

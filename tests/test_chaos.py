@@ -1,0 +1,265 @@
+"""Chaos soak: the whole wire stack (controller + N in-process agents +
+gang placements) under seeded fault injection — drops, injected 5xx,
+partial (truncated) responses — must CONVERGE: no lost pods, no double
+allocations, an empty pending queue once the network heals, and zero gang
+reschedules for a transient (< dead_after) agent blackout.
+
+The layering under test (ISSUE 2 tentpole):
+
+- retries absorb single-call faults (jittered backoff + deadline,
+  ``httpcommon.request_json`` / ``RemoteDevice``);
+- idempotency keys make the retries SAFE (a replayed ``POST /pods`` /
+  ``POST /allocate`` whose first response was lost cannot double-place /
+  double-allocate);
+- the circuit breaker absorbs multi-pass outages (suspect/probation keep
+  pods placed; only ``dead_after`` consecutive missed probes evict);
+- ``Cluster.check_invariants`` is the oracle: after any soak, held + free
+  == capacity on every node and every pod has exactly one placement.
+
+Deterministic: every fault draw comes from ``random.Random(seed)`` in
+request order. The short soak stays in tier-1; the long one is ``slow``.
+"""
+
+import json
+import urllib.error
+
+import pytest
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceTPU
+from kubetpu.wire import (
+    ControllerServer,
+    FaultInjector,
+    NodeAgentServer,
+    RetryPolicy,
+    RoutePolicy,
+)
+from kubetpu.wire.controller import pod_to_json
+from kubetpu.wire.httpcommon import request_json
+
+pytestmark = pytest.mark.chaos
+
+# aggressive client retry for the chaos runs: enough attempts that a
+# sub-50% per-call fault rate practically never exhausts the budget
+CHAOS_RETRY = RetryPolicy(attempts=8, base_delay=0.01, max_delay=0.1,
+                          deadline=30.0)
+
+
+def tpu_pod(name, chips):
+    return PodInfo(
+        name=name,
+        running_containers={"main": ContainerInfo(requests={ResourceTPU: chips})},
+    )
+
+
+def _mk_stack(seed, fault_rate):
+    """4 v5e-64 hosts (8 chips each, one slice) + controller, every server
+    running its own seeded injector at *fault_rate* split across
+    drop/error/partial, plus injected latency on top."""
+    per = fault_rate / 3.0
+    delay = 0.1 if fault_rate else 0.0
+    policy = lambda: RoutePolicy(  # noqa: E731
+        drop=per, error=per, partial=per, delay=delay, delay_s=0.005)
+    agents = []
+    for h in range(4):
+        inj = FaultInjector(seed=seed + 1 + h, default=policy())
+        agents.append(NodeAgentServer(
+            new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-64", host_index=h)),
+            f"h{h}", faults=inj,
+        ))
+    for a in agents:
+        a.start()
+    controller = ControllerServer(
+        poll_interval=3600,
+        faults=FaultInjector(seed=seed, default=policy()),
+        suspect_after=1, dead_after=3,
+    )
+    controller.start()
+    return controller, agents
+
+
+def _heal(controller, agents):
+    controller.faults.clear()
+    for a in agents:
+        a.faults.clear()
+
+
+def _shutdown(controller, agents):
+    controller.shutdown()
+    for a in agents:
+        try:
+            a.shutdown()
+        except Exception:  # noqa: BLE001 — may already be down
+            pass
+
+
+def _post(url, obj, key=None):
+    return request_json(url, obj, retry=CHAOS_RETRY, idempotency_key=key)
+
+
+def _delete(url):
+    """DELETE with retry; a 404 on a retry means the FIRST attempt
+    succeeded and its response was lost — deleted either way."""
+    try:
+        request_json(url, method="DELETE", retry=CHAOS_RETRY)
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise
+
+
+def _run_soak(seed, rounds, fault_rate):
+    controller, agents = _mk_stack(seed, fault_rate)
+    try:
+        for i, a in enumerate(agents):
+            # registration POST: retriable because keyed; a replayed
+            # register at the same URL is a server-side no-op
+            _post(controller.address + "/nodes", {"url": a.address},
+                  key=f"reg-{seed}-{i}")
+        live_singles, live_gang, submitted, deleted = [], None, set(), set()
+        for r in range(rounds):
+            name = f"p{r}"
+            _post(controller.address + "/pods",
+                  {"pod": pod_to_json(tpu_pod(name, 4)), "queue": True},
+                  key=f"sub-{seed}-{name}")
+            submitted.add(name)
+            live_singles.append(name)
+            # sliding windows keep outstanding chips under total capacity
+            # (32): <= 3 singles (12) + 1 gang (16)
+            if len(live_singles) > 3:
+                victim = live_singles.pop(0)
+                _delete(controller.address + f"/pods/{victim}")
+                deleted.add(victim)
+            if r % 4 == 0:
+                if live_gang is not None:
+                    for m in live_gang:
+                        _delete(controller.address + f"/pods/{m}")
+                        deleted.add(m)
+                live_gang = [f"g{r}w{i}" for i in range(2)]
+                _post(controller.address + "/pods",
+                      {"gang": [pod_to_json(tpu_pod(m, 8)) for m in live_gang],
+                       "queue": True},
+                      key=f"gang-{seed}-{r}")
+                submitted.update(live_gang)
+            controller.poll_once()
+        # the network heals; the control plane must CONVERGE
+        _heal(controller, agents)
+        expected = submitted - deleted
+        for _ in range(30):
+            result = controller.poll_once()
+            placed = {
+                p for n in controller.cluster.nodes.values() for p in n.pods
+            }
+            if not result["pending"] and placed == expected:
+                break
+        placed = {p for n in controller.cluster.nodes.values() for p in n.pods}
+        assert placed == expected, (
+            f"lost or duplicated pods: placed={sorted(placed)} "
+            f"expected={sorted(expected)} pending={controller.pending_pods}"
+        )
+        assert controller.pending_pods == []
+        # the oracle: no double allocation anywhere in the accounting
+        assert controller.cluster.check_invariants() == []
+        # faults actually fired (the soak tested something)
+        total_injected = sum(
+            sum(s.faults.counts.values()) for s in [controller, *agents]
+        )
+        assert total_injected > 0, "no faults injected — dead knob?"
+    finally:
+        _shutdown(controller, agents)
+
+
+def test_chaos_soak_short():
+    """Tier-1 soak: >= 10% aggregate injected fault rate on every route,
+    fixed seed, full convergence."""
+    _run_soak(seed=1234, rounds=10, fault_rate=0.12)
+
+
+@pytest.mark.slow
+def test_chaos_soak_long():
+    """The full soak (make chaos): more rounds, ~30% injected faults."""
+    _run_soak(seed=987, rounds=40, fault_rate=0.3)
+
+
+def test_transient_blackout_causes_zero_reschedules():
+    """An agent that goes fully dark for FEWER than dead_after reconcile
+    passes: its gang must never be evicted or re-placed — the breaker
+    holds it suspect (no new placements) until the blackout ends, then
+    returns it to service through probation."""
+    controller, agents = _mk_stack(seed=77, fault_rate=0.0)
+    try:
+        for a in agents:
+            _post(controller.address + "/nodes", {"url": a.address})
+        out = _post(controller.address + "/pods",
+                    {"gang": [pod_to_json(tpu_pod(f"w{i}", 8))
+                              for i in range(2)]})
+        placed_before = {p["pod"]: p["node"] for p in out["placements"]}
+        victim_node = placed_before["w0"]
+        victim = next(a for a in agents if a.node_name == victim_node)
+
+        # total blackout, 2 polls < dead_after=3
+        victim.faults.set_default(RoutePolicy(drop=1.0))
+        for expected_state in ("suspect", "suspect"):
+            result = controller.poll_once()
+            assert result["failed_nodes"] == []
+            assert result["rescheduled"] == []
+            assert result["suspect_nodes"] == [victim_node]
+            with controller._lock:
+                assert controller._health_state(victim_node) == expected_state
+        # pods never moved; the suspect node takes no NEW work
+        with controller._lock:
+            assert set(controller.cluster.nodes[victim_node].pods) >= {"w0"}
+            assert victim_node in controller.cluster.cordoned
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(controller.address + "/pods",
+                  {"pod": pod_to_json(tpu_pod("px", 32))})
+        assert e.value.code == 409  # capacity exists only on the suspect
+
+        # blackout ends: probation, then healthy + schedulable again
+        victim.faults.clear()
+        assert controller.poll_once()["suspect_nodes"] == []
+        with controller._lock:
+            assert controller._health_state(victim_node) == "probation"
+        controller.poll_once()
+        with controller._lock:
+            assert controller._health_state(victim_node) == "healthy"
+            assert victim_node not in controller.cluster.cordoned
+        # the gang sat still through the whole episode
+        placed_after = {
+            p: node_name
+            for node_name, node in controller.cluster.nodes.items()
+            for p in node.pods
+        }
+        assert placed_after == placed_before
+        assert controller.cluster.check_invariants() == []
+    finally:
+        _shutdown(controller, agents)
+
+
+def test_retried_submit_with_idempotency_key_places_once():
+    """A ``POST /pods`` whose response is truncated mid-write (processed,
+    reply lost) is retried by the client and REPLAYED by the dedup window
+    — one placement, identical response bytes."""
+    controller, agents = _mk_stack(seed=5, fault_rate=0.0)
+    try:
+        for a in agents:
+            _post(controller.address + "/nodes", {"url": a.address})
+        # fault exactly one response on /pods: the commit lands, the reply
+        # is cut, the client's retry must replay
+        controller.faults.set_route("/pods", RoutePolicy(partial=1.0, times=1))
+        out = _post(controller.address + "/pods",
+                    {"pod": pod_to_json(tpu_pod("once", 4))}, key="k-once")
+        assert out["placements"][0]["pod"] == "once"
+        placed = [p for n in controller.cluster.nodes.values() for p in n.pods]
+        assert placed.count("once") == 1
+        # an explicit replay (same key) returns the SAME response and does
+        # not double-place
+        again = _post(controller.address + "/pods",
+                      {"pod": pod_to_json(tpu_pod("once", 4))}, key="k-once")
+        assert json.dumps(again, sort_keys=True) == json.dumps(
+            out, sort_keys=True)
+        placed = [p for n in controller.cluster.nodes.values() for p in n.pods]
+        assert placed.count("once") == 1
+        assert controller.cluster.check_invariants() == []
+    finally:
+        _shutdown(controller, agents)

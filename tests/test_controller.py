@@ -55,8 +55,11 @@ def stack():
     ]
     for a in agents:
         a.start()
-    # long poll interval: tests drive reconciliation via poll_once()
-    controller = ControllerServer(poll_interval=3600)
+    # long poll interval: tests drive reconciliation via poll_once().
+    # dead_after=1 pins the legacy one-strike eviction these tests drive
+    # deliberately (the default circuit breaker takes 3 misses; breaker
+    # behavior itself is covered in test_resilience.py)
+    controller = ControllerServer(poll_interval=3600, dead_after=1)
     controller.start()
     for a in agents:
         _post(controller.address + "/nodes", {"url": a.address})
@@ -199,7 +202,9 @@ def test_reconcile_rescheduled_pod_carries_launcher_env(stack):
 
 def test_submit_rolls_back_when_allocate_fails(stack, monkeypatch):
     """If the agent dies between placement and allocation, the submission
-    must not leave capacity held by an unlaunchable pod."""
+    must not leave capacity held by an unlaunchable pod — and the error
+    is a RETRYABLE 503 (the state rolled back; a keyed retry may
+    succeed), not a dead-end 500."""
     controller, agents = stack
 
     def dying_allocations(device, pod_copy):
@@ -208,7 +213,7 @@ def test_submit_rolls_back_when_allocate_fails(stack, monkeypatch):
     monkeypatch.setattr(controller, "_run_allocations", dying_allocations)
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("z", 4))})
-    assert e.value.code == 500
+    assert e.value.code == 503
     monkeypatch.undo()
     status = _get(controller.address + "/status")
     for entry in status["nodes"].values():
@@ -237,7 +242,7 @@ def test_reconcile_never_straddles_gang_across_slices():
     ]
     for a in agents:
         a.start()
-    controller = ControllerServer(poll_interval=3600)
+    controller = ControllerServer(poll_interval=3600, dead_after=1)
     controller.start()
     try:
         for a in agents:
@@ -290,7 +295,7 @@ def test_whole_gang_reassembles_on_one_slice():
     ]
     for a in s0:
         a.start()
-    controller = ControllerServer(poll_interval=3600)
+    controller = ControllerServer(poll_interval=3600, dead_after=1)
     controller.start()
     extra = []
     try:
@@ -488,7 +493,7 @@ def test_preemption_submit_restores_victims_on_allocate_failure(stack, monkeypat
     high.requests["kubetpu/priority"] = 10
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(controller.address + "/pods", {"pod": pod_to_json(high)})
-    assert e.value.code == 500
+    assert e.value.code == 503  # rolled back + retryable (wire leg died)
 
     # both low pods back in place, nothing pending, no capacity lost
     placed = {

@@ -7,15 +7,31 @@
   single-threaded-only in the reference (unsynchronized package globals,
   SURVEY.md §5.2); kubetpu made them locked instances — prove it under
   concurrent add/remove/query.
+- Round-7 fault tolerance: the controller's circuit-breaker health state
+  machine (suspect nodes recover with ZERO reschedules; dead nodes still
+  evict), idempotent re-allocate under injected connection resets, retry
+  absorption of transient 5xx, and graceful drain/shutdown.
 """
 
 import threading
+import urllib.error
+
+import pytest
 
 from kubetpu.api.types import ContainerInfo, PodInfo
 from kubetpu.core import Cluster, SchedulingError
 from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
 from kubetpu.plugintypes import ResourceTPU
 from kubetpu.scheduler.treecache import NodeTreeCache
+from kubetpu.wire import (
+    ControllerServer,
+    FaultInjector,
+    NodeAgentServer,
+    RemoteDevice,
+    RoutePolicy,
+)
+from kubetpu.wire.controller import pod_to_json
+from kubetpu.wire.httpcommon import RetryPolicy, request_json
 
 
 def tpu_pod(name, chips):
@@ -316,3 +332,265 @@ def test_preemption_rollback_when_other_dimension_rejects():
         pass
     assert "low" in cluster.nodes["n0"].pods  # victim restored
     assert cluster.nodes["n0"].info.allocatable[ResourceTPU] == 0  # chips held
+
+
+# -- Round-7: circuit breaker, idempotency, retry, graceful drain ------------
+
+
+def _breaker_stack(dead_after=3, **kw):
+    """One live agent + controller with the default (multi-miss) breaker."""
+    agent = NodeAgentServer(
+        new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")),
+        "n0", faults=FaultInjector(seed=0),
+    )
+    agent.start()
+    controller = ControllerServer(poll_interval=3600, dead_after=dead_after,
+                                  **kw)
+    controller.start()
+    controller.register_agent(agent.address)
+    return controller, agent
+
+
+def test_breaker_suspect_recovers_without_reschedule():
+    """A transient blackout shorter than dead_after: pods stay placed, the
+    node is health-cordoned while suspect, and recovery (probation ->
+    healthy) lifts the cordon — zero evictions, zero reschedules."""
+    controller, agent = _breaker_stack()
+    try:
+        out = controller._submit({"pod": pod_to_json(tpu_pod("job", 4))})
+        assert out["placements"][0]["node"] == "n0"
+        agent.faults.set_default(RoutePolicy(drop=1.0))  # total blackout
+        for _ in range(2):  # < dead_after=3
+            result = controller.poll_once()
+            assert result["failed_nodes"] == []
+            assert result["rescheduled"] == []
+        with controller._lock:
+            assert controller._health_state("n0") == "suspect"
+            assert "n0" in controller.cluster.cordoned   # no NEW work
+            assert "job" in controller.cluster.nodes["n0"].pods  # pods kept
+        agent.faults.clear()
+        controller.poll_once()
+        with controller._lock:
+            assert controller._health_state("n0") == "probation"
+            assert "n0" in controller.cluster.cordoned   # still proving itself
+        controller.poll_once()
+        with controller._lock:
+            assert controller._health_state("n0") == "healthy"
+            assert "n0" not in controller.cluster.cordoned
+            assert "job" in controller.cluster.nodes["n0"].pods
+        assert controller.cluster.check_invariants() == []
+    finally:
+        controller.shutdown()
+        agent.shutdown()
+
+
+def test_breaker_dead_node_still_evicts():
+    """dead_after consecutive misses must still trip the breaker: the node
+    is failed and its pods reschedule (here: pend — no other node)."""
+    controller, agent = _breaker_stack()
+    try:
+        controller._submit({"pod": pod_to_json(tpu_pod("job", 4))})
+        agent.shutdown()  # real death, not a blip
+        results = [controller.poll_once() for _ in range(3)]
+        assert results[0]["failed_nodes"] == results[1]["failed_nodes"] == []
+        assert results[2]["failed_nodes"] == ["n0"]
+        assert "n0" not in controller.cluster.nodes
+        assert controller.pending_pods == ["job"]  # evicted, awaiting capacity
+    finally:
+        controller.shutdown()
+
+
+def test_breaker_operator_cordon_survives_recovery():
+    """Recovery must lift only the cordon the BREAKER placed: a node the
+    operator cordoned before/while suspect stays cordoned after it heals."""
+    controller, agent = _breaker_stack()
+    try:
+        with controller._lock:
+            controller.cluster.cordon("n0")  # operator's own cordon
+        agent.faults.set_default(RoutePolicy(drop=1.0))
+        controller.poll_once()
+        with controller._lock:
+            assert controller._health_state("n0") == "suspect"
+        agent.faults.clear()
+        controller.poll_once()
+        controller.poll_once()
+        with controller._lock:
+            assert controller._health_state("n0") == "healthy"
+            assert "n0" in controller.cluster.cordoned  # operator's, untouched
+    finally:
+        controller.shutdown()
+        agent.shutdown()
+
+
+def test_idempotent_reallocate_under_connection_reset():
+    """The ISSUE's double-allocation window: the agent processes /allocate
+    but the response dies mid-write (injected partial). The client retry
+    must be REPLAYED from the dedup window — the device allocates once."""
+    agent = NodeAgentServer(
+        new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")), "n0",
+        faults=FaultInjector(
+            seed=3, routes={"/allocate": RoutePolicy(partial=1.0, times=1)}),
+    )
+    agent.start()
+    try:
+        cluster = Cluster()
+        cluster.register_remote_node(agent.address)
+        cluster.schedule(tpu_pod("p", 4))
+        result = cluster.allocate("p")
+        env = next(iter(result.values()))[2]
+        assert env["TPU_VISIBLE_DEVICES"].count(",") == 3
+        assert agent.counters["allocate_requests"] == 1  # executed ONCE
+        assert agent.counters["allocate_replays"] == 1   # retry replayed
+    finally:
+        agent.shutdown()
+
+
+def test_retry_absorbs_transient_5xx_and_drops():
+    """A couple of injected 503s/drops on the probe route must cost a
+    backoff, not an AgentUnreachable: the call succeeds within its retry
+    budget."""
+    agent = NodeAgentServer(
+        new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")), "n0",
+        faults=FaultInjector(
+            seed=1, routes={"/nodeinfo": RoutePolicy(error=1.0, times=2)}),
+    )
+    agent.start()
+    try:
+        dev = RemoteDevice(
+            agent.address,
+            retry=RetryPolicy(attempts=4, base_delay=0.01, deadline=10.0),
+        )
+        dev.start()
+        from kubetpu.api.types import new_node_info
+
+        info = new_node_info("n0")
+        dev.update_node_info(info)  # 2 injected 503s, then success
+        assert info.capacity.get(ResourceTPU) == 8
+        assert agent.faults.counts.get("error") == 2
+    finally:
+        agent.shutdown()
+
+
+def test_agent_graceful_drain_and_shutdown():
+    """drain(): liveness keeps answering (flagged), reads work, mutating
+    work is refused 503; graceful shutdown finishes cleanly."""
+    agent = NodeAgentServer(
+        new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")), "n0")
+    agent.start()
+    try:
+        dev = RemoteDevice(agent.address)
+        dev.start()
+        agent.drain()
+        health = request_json(agent.address + "/healthz")
+        assert health["ok"] and health["draining"]
+        # reads still served
+        assert request_json(agent.address + "/nodeinfo")["capacity"]
+        # mutating work refused with a retryable status
+        pod = tpu_pod("p", 1)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            request_json(
+                agent.address + "/allocate",
+                {"pod": pod_to_json(pod), "container": "main"},
+            )
+        assert e.value.code == 503
+    finally:
+        agent.shutdown()  # graceful default: waits for in-flight work
+
+
+def test_controller_drain_server_refuses_new_work():
+    controller, agent = _breaker_stack()
+    try:
+        controller.drain_server()
+        health = request_json(controller.address + "/healthz")
+        assert health["ok"] and health["draining"]
+        assert request_json(controller.address + "/status")["nodes"]  # reads ok
+        with pytest.raises(urllib.error.HTTPError) as e:
+            request_json(controller.address + "/pods",
+                         {"pod": pod_to_json(tpu_pod("p", 1))})
+        assert e.value.code == 503
+    finally:
+        controller.shutdown()
+        agent.shutdown()
+
+
+def test_breaker_counts_consecutive_misses_only():
+    """dead_after counts CONSECUTIVE misses: a flapping node (miss, ok,
+    miss, ok, ...) must never accumulate toward suspect or dead — each
+    clean probe zeroes the streak, whatever the thresholds."""
+    controller, agent = _breaker_stack(dead_after=3, suspect_after=2)
+    try:
+        controller._submit({"pod": pod_to_json(tpu_pod("job", 4))})
+        for _ in range(4):  # 4x (miss, ok) = 4 non-consecutive misses
+            agent.faults.set_default(RoutePolicy(drop=1.0))
+            result = controller.poll_once()
+            assert result["failed_nodes"] == []
+            agent.faults.clear()
+            controller.poll_once()
+        with controller._lock:
+            # never even reached suspect_after=2 consecutively
+            assert controller._health_state("n0") == "healthy"
+            assert "n0" not in controller.cluster.cordoned
+            assert "job" in controller.cluster.nodes["n0"].pods
+    finally:
+        controller.shutdown()
+        agent.shutdown()
+
+
+def test_keyed_replay_served_while_draining():
+    """A keyed retry of an ALREADY-COMMITTED allocate must get its replay
+    even mid-drain (replay mutates nothing; refusing it would leak the
+    committed chips when the caller rolls back). New work still 503s."""
+    agent = NodeAgentServer(
+        new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")), "n0")
+    agent.start()
+    try:
+        cluster = Cluster()
+        cluster.register_remote_node(agent.address)
+        placed = cluster.schedule(tpu_pod("p", 2))
+        from kubetpu.wire.codec import pod_info_to_json
+
+        body = {"pod": pod_info_to_json(
+            cluster.nodes["n0"].pods["p"]), "container": "main"}
+        out = request_json(agent.address + "/allocate", body,
+                           idempotency_key="k-drain")
+        agent.drain()
+        # committed key: replayed verbatim despite draining
+        again = request_json(agent.address + "/allocate", body,
+                             idempotency_key="k-drain")
+        assert again == out
+        assert agent.counters["allocate_requests"] == 1
+        assert agent.counters["allocate_replays"] == 1
+        # new work: refused with the retryable draining status
+        with pytest.raises(urllib.error.HTTPError) as e:
+            request_json(agent.address + "/allocate", body,
+                         idempotency_key="k-fresh")
+        assert e.value.code == 503
+        assert agent.counters["allocate_requests"] == 1  # never executed
+    finally:
+        agent.shutdown()
+
+
+def test_reregister_resets_breaker_state():
+    """Re-registering an agent at the same URL (idempotent path) proves it
+    alive: the miss streak resets and the health cordon lifts — a freshly
+    verified node must not sit one blip from eviction."""
+    controller, agent = _breaker_stack()
+    try:
+        agent.faults.set_default(RoutePolicy(drop=1.0))
+        controller.poll_once()
+        controller.poll_once()  # 2 misses: one short of dead_after=3
+        with controller._lock:
+            assert controller._health_state("n0") == "suspect"
+        agent.faults.clear()
+        assert controller.register_agent(agent.address) == "n0"
+        with controller._lock:
+            assert controller._health_state("n0") == "healthy"
+            assert "n0" not in controller.cluster.cordoned
+        # one fresh blip must NOT evict (streak restarted)
+        agent.faults.set_default(RoutePolicy(drop=1.0))
+        result = controller.poll_once()
+        assert result["failed_nodes"] == []
+        assert result["suspect_nodes"] == ["n0"]
+    finally:
+        controller.shutdown()
+        agent.shutdown()
