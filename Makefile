@@ -33,10 +33,18 @@ test: tpuinfo gpuinfo dataio
 	python -m pytest tests/ -x -q
 
 # seeded fault-injection soaks + the resilience suite (the short soak
-# also runs in tier-1; this target adds the slow 30% one)
+# also runs in tier-1; this target adds the slow 30% one). obs-check runs
+# first: a chaos run whose faults are invisible proves nothing.
 .PHONY: chaos
-chaos:
+chaos: obs-check
 	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+
+# observability smoke oracle: controller + 2 fake agents, scrape the
+# federated /metrics, fail on malformed Prometheus text / missing
+# required series / an unstitched submit trace
+.PHONY: obs-check
+obs-check:
+	python scripts/obs_check.py
 
 .PHONY: bench
 bench: tpuinfo

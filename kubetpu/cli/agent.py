@@ -105,7 +105,15 @@ def main(argv=None) -> int:
                     help="stream mode: seconds between advertisement refreshes")
     ap.add_argument("--iterations", type=int, default=0,
                     help="stream mode: stop after N refreshes (0 = run forever)")
+    ap.add_argument("--trace-sink", default=None, metavar="PATH",
+                    help="append every finished trace span to PATH as JSON "
+                         "lines (also via KUBETPU_TRACE_SINK)")
     args = ap.parse_args(argv)
+
+    if args.trace_sink:
+        from kubetpu.obs import trace as obs_trace
+
+        obs_trace.tracer().set_sink(args.trace_sink)
 
     if args.device_class == "gpu":
         # TPU-topology flags silently dropped on the floor would make a

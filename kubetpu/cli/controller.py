@@ -39,7 +39,15 @@ def main(argv=None) -> int:
     ap.add_argument("--probation-passes", type=int, default=1,
                     help="clean probes a recovering node must answer "
                          "while on probation before taking new work")
+    ap.add_argument("--trace-sink", default=None, metavar="PATH",
+                    help="append every finished trace span to PATH as JSON "
+                         "lines (also via KUBETPU_TRACE_SINK)")
     args = ap.parse_args(argv)
+
+    if args.trace_sink:
+        from kubetpu.obs import trace as obs_trace
+
+        obs_trace.tracer().set_sink(args.trace_sink)
 
     token = os.environ.get("KUBETPU_WIRE_TOKEN")
     server = ControllerServer(

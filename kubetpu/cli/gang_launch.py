@@ -79,9 +79,23 @@ def launch_gang(
     """Spawn one worker process per gang member and collect their reports.
 
     Returns {"workers": [per-worker report...], "loss": common loss,
-    "commands": the argv each rank ran} — raises RuntimeError when a
-    worker fails or the losses disagree (a broken cross-process psum).
+    "commands": the argv each rank ran, "trace_id": the launch's trace id
+    (fetch the stitched controller+agent timeline at the controller's
+    ``GET /trace/<id>``)} — raises RuntimeError when a worker fails or
+    the losses disagree (a broken cross-process psum).
     """
+    from kubetpu.obs import trace as obs_trace
+
+    with obs_trace.span("gang_launch", component="gang-launch",
+                        pods=len(pod_names)) as _root:
+        out = _launch_gang_inner(controller, pod_names, token, platform,
+                                 coordinator_port, timeout)
+        out["trace_id"] = _root.trace_id
+        return out
+
+
+def _launch_gang_inner(controller, pod_names, token, platform,
+                       coordinator_port, timeout) -> dict:
     port = coordinator_port or _free_port()
     # fetch EVERY env before spawning anything: a 404 on a later member
     # must not leave earlier workers orphaned at the coordinator barrier

@@ -1,0 +1,207 @@
+"""``kubetpu-obs`` — the operator's one-screen fleet summary.
+
+Scrapes the controller's FEDERATED ``/metrics`` (and any extra ``/metrics``
+endpoints — agents directly, or serving replicas behind an
+``obs.exporter.MetricsServer``) and renders the numbers an operator
+actually pages on: nodes by breaker state, free/held chips, pending pods,
+scheduler latency percentiles, per-node agent counters, and serving
+TTFT/ITL/queue when a serving endpoint is scraped. ``--trace ID`` renders
+one stitched trace as an indented timeline instead.
+
+    python -m kubetpu.cli.obs --controller URL [--token T]
+                              [--scrape URL ...] [--watch SECONDS]
+    python -m kubetpu.cli.obs --controller URL --trace TRACE_ID
+
+One-shot by default; ``--watch N`` redraws every N seconds until ^C.
+Auth: ``KUBETPU_WIRE_TOKEN`` (or ``--token``) rides as the bearer token.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from kubetpu.obs.registry import parse_prometheus_text
+
+
+def _fetch(url: str, token: Optional[str], timeout: float = 10.0) -> bytes:
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _index(samples) -> Dict[str, List[Tuple[dict, float]]]:
+    out: Dict[str, List[Tuple[dict, float]]] = {}
+    for name, labels, value in samples:
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def _pick(idx, name: str, **want) -> Optional[float]:
+    for labels, value in idx.get(name, []):
+        if all(labels.get(k) == v for k, v in want.items()):
+            return value
+    return None
+
+
+def _fmt_ms(seconds: Optional[float]) -> str:
+    return "-" if seconds is None else f"{seconds * 1e3:.1f}ms"
+
+
+def render_summary(metrics_text: str, source: str) -> str:
+    """One fleet summary block from one exposition text."""
+    idx = _index(parse_prometheus_text(metrics_text))
+    lines = [f"== {source} =="]
+
+    states = {labels.get("state"): int(v)
+              for labels, v in idx.get("kubetpu_nodes", [])}
+    if states:
+        lines.append("nodes     " + "  ".join(
+            f"{s}={states.get(s, 0)}"
+            for s in ("healthy", "suspect", "probation")))
+    chips = []
+    for labels, v in idx.get("kubetpu_chips_free", []):
+        dev = labels.get("device", "?")
+        held = _pick(idx, "kubetpu_chips_held", device=dev) or 0
+        if v or held:
+            chips.append(f"{dev}: free={int(v)} held={int(held)}")
+    if chips:
+        lines.append("chips     " + "  ".join(chips))
+    pending = _pick(idx, "kubetpu_pending_pods")
+    if pending is not None:
+        lines.append(f"pending   {int(pending)} pod(s)")
+
+    # scheduler latency summaries: one row per op
+    lat = {}
+    for labels, v in idx.get("kubetpu_schedule_latency_seconds", []):
+        op, q = labels.get("op"), labels.get("quantile")
+        if op and q:
+            lat.setdefault(op, {})[q] = v
+    for op in sorted(lat):
+        n = _pick(idx, "kubetpu_schedule_latency_seconds_count", op=op)
+        lines.append(
+            f"sched     {op}: p50={_fmt_ms(lat[op].get('0.5'))} "
+            f"p90={_fmt_ms(lat[op].get('0.9'))} "
+            f"p99={_fmt_ms(lat[op].get('0.99'))} "
+            f"n={int(n or 0)}")
+
+    # per-node agent counters (federated series carry node=...)
+    per_node: Dict[str, Dict[str, int]] = {}
+    for short in ("nodeinfo_requests", "allocate_requests",
+                  "allocate_replays", "errors"):
+        for labels, v in idx.get(f"kubetpu_agent_{short}_total", []):
+            node = labels.get("node")
+            if node:
+                per_node.setdefault(node, {})[short] = int(v)
+    for node in sorted(per_node):
+        c = per_node[node]
+        lines.append(
+            f"agent     {node}: nodeinfo={c.get('nodeinfo_requests', 0)} "
+            f"allocate={c.get('allocate_requests', 0)} "
+            f"replays={c.get('allocate_replays', 0)} "
+            f"errors={c.get('errors', 0)}")
+
+    # serving histograms (present when scraping a serving exporter)
+    srv = {}
+    for labels, v in idx.get("kubetpu_serving_latency_seconds", []):
+        op, q = labels.get("op"), labels.get("quantile")
+        if op in ("ttft", "itl", "queue_wait") and q in ("0.5", "0.99"):
+            srv.setdefault(op, {})[q] = v
+    if srv:
+        lines.append("serving   " + "  ".join(
+            f"{op} p50={_fmt_ms(srv[op].get('0.5'))}/"
+            f"p99={_fmt_ms(srv[op].get('0.99'))}"
+            for op in ("ttft", "itl", "queue_wait") if op in srv))
+        act = _pick(idx, "kubetpu_serving_active_slots")
+        depth = _pick(idx, "kubetpu_serving_queue_depth")
+        if act is not None or depth is not None:
+            lines.append(
+                f"serving   active_slots={int(act or 0)} "
+                f"queue_depth={int(depth or 0)}")
+    return "\n".join(lines)
+
+
+def render_trace(body: dict) -> str:
+    """Indented span timeline of one stitched trace (children under
+    parents, siblings by start time; orphaned parents render at root —
+    a dark agent loses its leg, not the whole view)."""
+    spans = body.get("spans", [])
+    by_id = {s["span_id"]: s for s in spans}
+    children: Dict[Optional[str], List[dict]] = {}
+    for s in spans:
+        parent = s.get("parent_id")
+        children.setdefault(
+            parent if parent in by_id else None, []).append(s)
+    lines = [f"trace {body.get('trace', '?')} ({len(spans)} spans)"]
+
+    def walk(parent_key, depth):
+        for s in sorted(children.get(parent_key, []),
+                        key=lambda x: x["start"]):
+            comp = s.get("component", "")
+            tag = f" [{comp}]" if comp else ""
+            status = "" if s.get("status") == "ok" else f" !{s.get('status')}"
+            lines.append(
+                f"{'  ' * depth}- {s['op']}{tag} "
+                f"{s.get('dur', 0) * 1e3:.2f}ms{status}")
+            walk(s["span_id"], depth + 1)
+
+    walk(None, 1)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubetpu-obs", description=__doc__)
+    ap.add_argument("--controller", default=None,
+                    help="controller base URL (its /metrics is already "
+                         "fleet-federated)")
+    ap.add_argument("--scrape", nargs="*", default=[], metavar="URL",
+                    help="extra /metrics base URLs (agents, serving "
+                         "exporters)")
+    ap.add_argument("--token", default=os.environ.get("KUBETPU_WIRE_TOKEN"))
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="redraw every N seconds (0 = one-shot)")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="render one stitched trace from the controller "
+                         "and exit")
+    args = ap.parse_args(argv)
+    if not args.controller and not args.scrape:
+        ap.error("need --controller and/or --scrape URLs")
+
+    if args.trace:
+        if not args.controller:
+            ap.error("--trace needs --controller")
+        body = json.loads(_fetch(
+            args.controller.rstrip("/") + f"/trace/{args.trace}",
+            args.token))
+        print(render_trace(body))
+        return 0
+
+    targets = []
+    if args.controller:
+        targets.append(("controller", args.controller.rstrip("/")))
+    targets.extend(("scrape", u.rstrip("/")) for u in args.scrape)
+
+    while True:
+        blocks = []
+        for kind, base in targets:
+            try:
+                text = _fetch(base + "/metrics", args.token).decode()
+                blocks.append(render_summary(text, f"{kind} {base}"))
+            except Exception as e:  # noqa: BLE001 — show the gap, keep going
+                blocks.append(f"== {kind} {base} ==\nUNREACHABLE: {e}")
+        if args.watch:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        print("\n\n".join(blocks), flush=True)
+        if not args.watch:
+            return 0
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -278,9 +278,12 @@ class Cluster:
     ) -> PodInfo:
         """Place one pod; returns the placed copy (with node_name and
         AllocateFrom filled). Raises SchedulingError when nothing fits."""
+        from kubetpu.obs import trace as obs_trace
+
         t0 = time.perf_counter()
         try:
-            return self._schedule_inner(pod, node_filter)
+            with obs_trace.span("cluster.schedule", pod=pod.name):
+                return self._schedule_inner(pod, node_filter)
         finally:
             self.metrics.record("schedule_pod", time.perf_counter() - t0)
 
@@ -434,74 +437,80 @@ class Cluster:
         ``kubetpu/gang-slice-id`` so Allocate can emit the libtpu
         multislice env and re-placements rejoin the right sub-gang.
         """
+        from kubetpu.obs import trace as obs_trace
+
         t0 = time.perf_counter()
         try:
-            # Stamp gang identity on copies (inputs are templates): members
-            # carry it through placement, eviction, and reset, so a later
-            # individual re-place can find its surviving gang mates. Stale
-            # slice-membership stamps from a PREVIOUS placement of the same
-            # templates are dropped — only a fresh multislice placement may
-            # set them, or a single-slice re-place would leave members
-            # claiming sub-gangs that no longer exist.
-            self._gang_seq += 1
-            pods = [p.copy() for p in pods]
-            for p in pods:
-                p.requests[GangKey] = self._gang_seq
-                p.requests.pop(meshstate.GangSlicesKey, None)
-                p.requests.pop(meshstate.GangSliceIdKey, None)
-            slices = self._tpu_slices()
-            # pod_wants_device covers device-native AND kube-native requests
-            # over both container kinds, so a kube-only gang is still pinned
-            # to a single slice below.
-            tpu_gang = bool(pods) and all(
-                pod_wants_device(TPU, pod) for pod in pods
-            )
-            # provable-capacity pre-filter: a slice whose free chips cannot
-            # cover the gang's total need would fail only after placing
-            # (and rolling back) pods one by one — at 60-pod gangs that
-            # wasted pass per slice dominates placement latency.
-            # pod_device_need (not _count): these are UN-translated
-            # templates, so the kube/device max-merge must apply inline.
-            total_need = (
-                sum(max(1, pod_device_need(TPU, p)) for p in pods)
-                if tpu_gang else 0
-            )
-            for slice_nodes in slices.values():
-                # cordoned hosts never host gang members; NOTE a slice with
-                # fewer (uncordoned) hosts than pods can still fit the gang
-                # by co-locating sub-host pods — no count-based skip here
-                slice_nodes = [n for n in slice_nodes
-                               if n not in self.cordoned]
-                if not slice_nodes:
-                    continue
-                if tpu_gang and self._slice_free_chips(slice_nodes) < total_need:
-                    continue
-                try:
-                    return self._try_gang_slice(pods, slice_nodes)
-                except SchedulingError:
-                    continue
-            if tpu_gang and slices:
-                # Opt-in escape hatch: span up to k slices when no single
-                # slice fits (the knob must be on EVERY member — a gang
-                # half-willing to cross DCN is a config error, treated as
-                # unwilling).
-                max_slices = min(
-                    (int(p.requests.get(meshstate.MultisliceKey, 0)) for p in pods),
-                    default=0,
-                )
-                if max_slices >= 2:
-                    return self._try_gang_multislice(pods, slices, max_slices)
-                # A TPU gang must live inside ONE physical slice: chips in
-                # different slices are connected over DCN, not ICI, and a
-                # silent straddle would wreck the job's collectives.
-                raise SchedulingError(
-                    f"gang of {len(pods)} pods does not fit within any single "
-                    f"TPU slice ({', '.join(slices)})"
-                )
-            # non-TPU gangs (or clusters without slice geometry): anywhere
-            return self._try_gang(pods, None)
+            with obs_trace.span("cluster.schedule_gang", pods=len(pods)):
+                return self._schedule_gang_inner(pods)
         finally:
             self.metrics.record("schedule_gang", time.perf_counter() - t0)
+
+    def _schedule_gang_inner(self, pods: Sequence[PodInfo]) -> List[PodInfo]:
+        # Stamp gang identity on copies (inputs are templates): members
+        # carry it through placement, eviction, and reset, so a later
+        # individual re-place can find its surviving gang mates. Stale
+        # slice-membership stamps from a PREVIOUS placement of the same
+        # templates are dropped — only a fresh multislice placement may
+        # set them, or a single-slice re-place would leave members
+        # claiming sub-gangs that no longer exist.
+        self._gang_seq += 1
+        pods = [p.copy() for p in pods]
+        for p in pods:
+            p.requests[GangKey] = self._gang_seq
+            p.requests.pop(meshstate.GangSlicesKey, None)
+            p.requests.pop(meshstate.GangSliceIdKey, None)
+        slices = self._tpu_slices()
+        # pod_wants_device covers device-native AND kube-native requests
+        # over both container kinds, so a kube-only gang is still pinned
+        # to a single slice below.
+        tpu_gang = bool(pods) and all(
+            pod_wants_device(TPU, pod) for pod in pods
+        )
+        # provable-capacity pre-filter: a slice whose free chips cannot
+        # cover the gang's total need would fail only after placing
+        # (and rolling back) pods one by one — at 60-pod gangs that
+        # wasted pass per slice dominates placement latency.
+        # pod_device_need (not _count): these are UN-translated
+        # templates, so the kube/device max-merge must apply inline.
+        total_need = (
+            sum(max(1, pod_device_need(TPU, p)) for p in pods)
+            if tpu_gang else 0
+        )
+        for slice_nodes in slices.values():
+            # cordoned hosts never host gang members; NOTE a slice with
+            # fewer (uncordoned) hosts than pods can still fit the gang
+            # by co-locating sub-host pods — no count-based skip here
+            slice_nodes = [n for n in slice_nodes
+                           if n not in self.cordoned]
+            if not slice_nodes:
+                continue
+            if tpu_gang and self._slice_free_chips(slice_nodes) < total_need:
+                continue
+            try:
+                return self._try_gang_slice(pods, slice_nodes)
+            except SchedulingError:
+                continue
+        if tpu_gang and slices:
+            # Opt-in escape hatch: span up to k slices when no single
+            # slice fits (the knob must be on EVERY member — a gang
+            # half-willing to cross DCN is a config error, treated as
+            # unwilling).
+            max_slices = min(
+                (int(p.requests.get(meshstate.MultisliceKey, 0)) for p in pods),
+                default=0,
+            )
+            if max_slices >= 2:
+                return self._try_gang_multislice(pods, slices, max_slices)
+            # A TPU gang must live inside ONE physical slice: chips in
+            # different slices are connected over DCN, not ICI, and a
+            # silent straddle would wreck the job's collectives.
+            raise SchedulingError(
+                f"gang of {len(pods)} pods does not fit within any single "
+                f"TPU slice ({', '.join(slices)})"
+            )
+        # non-TPU gangs (or clusters without slice geometry): anywhere
+        return self._try_gang(pods, None)
 
     def _slice_free_chips(self, nodes: Sequence[str]) -> int:
         """Free chips across a slice's (already cordon-filtered) nodes —

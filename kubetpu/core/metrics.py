@@ -3,45 +3,93 @@
 The reference has no tracing/profiling hooks (SURVEY.md §5.1); kubetpu adds
 latency histograms around the per-pod scheduling hot path because the
 BASELINE north-star metric is pod-schedule p50 < 100 ms for 256-chip gangs.
+
+Round-8: ``LatencyRecorder`` is now a thin facade over
+``kubetpu.obs.Histogram`` — one bounded reservoir per op instead of the
+old unbounded per-op sample lists, so a controller that schedules for
+months holds at most ``cap`` samples per op. Percentiles are EXACT below
+the cap; above it, uniform reservoir sampling keeps every observation
+with equal probability (cap/count), making the reported quantiles
+unbiased estimates (error shrinks as cap grows) while ``count`` stays
+exact. ``bind(registry, metric)`` re-homes the per-op histograms into an
+``obs.Registry`` (label ``op=<op>``), which is how the controller's
+``/metrics`` exports ``kubetpu_schedule_latency_seconds`` without a
+second recording path.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List
+from typing import Dict, Optional
+
+from kubetpu.obs.registry import Histogram, Registry
+
+# per-op reservoir size: exact percentiles for the first 4096 samples of
+# each op, unbiased estimates beyond
+DEFAULT_CAP = 4096
 
 
 class LatencyRecorder:
-    """Collects per-operation latencies (seconds) and reports percentiles."""
+    """Collects per-operation latencies (seconds) and reports percentiles.
 
-    def __init__(self) -> None:
+    Memory is bounded: each op holds one fixed-size reservoir (``cap``
+    samples), never a growing list."""
+
+    def __init__(self, cap: int = DEFAULT_CAP,
+                 registry: Optional[Registry] = None,
+                 metric: str = "kubetpu_latency_seconds") -> None:
         self._lock = threading.Lock()
-        self._samples: Dict[str, List[float]] = {}
+        self._cap = cap
+        self._hists: Dict[str, Histogram] = {}
+        self._registry = registry
+        self._metric = metric
+
+    def bind(self, registry: Registry, metric: str) -> "LatencyRecorder":
+        """Export this recorder's histograms through *registry* as
+        ``<metric>{op="<op>"}`` summaries — existing ops are attached
+        in place (samples kept), future ops register on first record.
+        Returns self for chaining."""
+        with self._lock:
+            self._registry = registry
+            self._metric = metric
+            for op, hist in self._hists.items():
+                registry.attach_histogram(metric, hist, op=op)
+        return self
+
+    def _hist(self, op: str) -> Histogram:
+        with self._lock:
+            hist = self._hists.get(op)
+            if hist is None:
+                if self._registry is not None:
+                    hist = self._registry.histogram(
+                        self._metric, cap=self._cap, op=op)
+                else:
+                    hist = Histogram(cap=self._cap)
+                self._hists[op] = hist
+            return hist
 
     def record(self, op: str, seconds: float) -> None:
-        with self._lock:
-            self._samples.setdefault(op, []).append(seconds)
+        self._hist(op).observe(seconds)
 
     def count(self, op: str) -> int:
         with self._lock:
-            return len(self._samples.get(op, []))
+            hist = self._hists.get(op)
+        return hist.count if hist is not None else 0
 
     def percentile(self, op: str, p: float) -> float:
         """p in [0, 100]; returns seconds (0.0 if no samples)."""
         with self._lock:
-            samples = sorted(self._samples.get(op, []))
-        if not samples:
-            return 0.0
-        idx = min(len(samples) - 1, max(0, int(round(p / 100.0 * (len(samples) - 1)))))
-        return samples[idx]
+            hist = self._hists.get(op)
+        return hist.percentile(p) if hist is not None else 0.0
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
-            ops = list(self._samples)
+            ops = list(self._hists)
         return {
             op: {
                 "count": self.count(op),
                 "p50_ms": self.percentile(op, 50) * 1e3,
+                "p90_ms": self.percentile(op, 90) * 1e3,
                 "p99_ms": self.percentile(op, 99) * 1e3,
             }
             for op in ops

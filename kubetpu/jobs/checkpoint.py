@@ -77,26 +77,31 @@ def _commit(tmp: str, path: str) -> None:
 def save_checkpoint(path: str, state: TrainState) -> None:
     """Write a TrainState to *path* (created if needed): temp-write +
     atomic rename, so a crash mid-save never leaves a torn checkpoint at
-    the real path."""
+    the real path. Spanned (``checkpoint.save``): save stalls are visible
+    on the same trace timeline as the scheduling/serving work around
+    them."""
     import orbax.checkpoint as ocp
 
+    from kubetpu.obs import trace as obs_trace
+
     path = os.path.abspath(path)
-    if not _single_host():
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path, state)
-            ckptr.wait_until_finished()
-        return
-    tmp = _tmp_path(path)
-    if os.path.isdir(tmp):  # stale orphan from a crashed writer: replace
-        shutil.rmtree(tmp)
-    try:
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(tmp, state)
-            ckptr.wait_until_finished()
-        _commit(tmp, path)
-    finally:
-        if os.path.isdir(tmp):  # failed before commit: don't leak orphans
-            shutil.rmtree(tmp, ignore_errors=True)
+    with obs_trace.span("checkpoint.save", path=path):
+        if not _single_host():
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(path, state)
+                ckptr.wait_until_finished()
+            return
+        tmp = _tmp_path(path)
+        if os.path.isdir(tmp):  # stale orphan from a crashed writer: replace
+            shutil.rmtree(tmp)
+        try:
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(tmp, state)
+                ckptr.wait_until_finished()
+            _commit(tmp, path)
+        finally:
+            if os.path.isdir(tmp):  # failed before commit: no orphan leak
+                shutil.rmtree(tmp, ignore_errors=True)
 
 
 class AsyncCheckpointer:
@@ -230,7 +235,14 @@ def restore_checkpoint(path: str, target: TrainState) -> TrainState:
     logic needs to fall back to an older step."""
     import orbax.checkpoint as ocp
 
+    from kubetpu.obs import trace as obs_trace
+
     path = os.path.abspath(path)
+    with obs_trace.span("checkpoint.restore", path=path):
+        return _restore_inner(path, target, ocp)
+
+
+def _restore_inner(path: str, target: TrainState, ocp) -> TrainState:
     if not os.path.isdir(path):
         if os.path.isdir(path + ".old"):
             # a writer died between _commit's two renames: the previous

@@ -415,6 +415,14 @@ class PagedDecodeServer(SlotServerBase):
         self._free: List[int] = list(range(self.pool_pages))
         self._table = np.full((n_slots, self.max_pages_per_slot), -1, np.int32)
         self._host_len = [0] * n_slots          # tokens stored per slot
+        # pool-pressure gauges (Round-8): scraped alongside the base
+        # class's slot/queue gauges via metrics_text / the obs exporter
+        self.obs.gauge_fn("kubetpu_serving_pool_pages",
+                          lambda: self.pool_pages)
+        self.obs.gauge_fn("kubetpu_serving_pages_in_use",
+                          lambda: self.pages_in_use())
+        self.obs.gauge_fn("kubetpu_serving_pages_free",
+                          lambda: len(self._free))
 
         attend = partial(_attend_paged, window=cfg.window)
         if use_kernel:

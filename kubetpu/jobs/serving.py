@@ -91,6 +91,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubetpu.core.metrics import LatencyRecorder
+from kubetpu.obs.registry import Registry
 from kubetpu.jobs.decode import (
     _dense_cache_io,
     _int8_cache_io,
@@ -202,7 +203,26 @@ class SlotServerBase:
         self._queue: List[Tuple[int, List[int], Optional[float]]] = []
         self._expired: Dict[int, str] = {}     # rid -> reason
         self._pending_first: Dict[int, object] = {}    # slot -> device scalar
-        self._metrics = LatencyRecorder()
+        # -- observability (Round-8): every histogram this server records
+        # (admission stall, step, prefill chunks, and the per-request
+        # TTFT / inter-token latency / queue wait) lives in ONE registry,
+        # exposed as Prometheus text via ``metrics_text()`` (or over HTTP
+        # through ``obs.exporter.MetricsServer``) and as the structured
+        # ``metrics_summary()`` dict. Occupancy is collect-time gauges —
+        # the hot loop pays nothing for them.
+        self.obs = Registry()
+        self._metrics = LatencyRecorder(
+            registry=self.obs, metric="kubetpu_serving_latency_seconds")
+        self.obs.gauge_fn("kubetpu_serving_active_slots",
+                          lambda: int(self.active.sum()))
+        self.obs.gauge_fn("kubetpu_serving_slots", lambda: self.n_slots)
+        self.obs.gauge_fn("kubetpu_serving_queue_depth",
+                          lambda: len(self._queue))
+        self.obs.gauge_fn("kubetpu_serving_inflight_prefills",
+                          lambda: len(self._prefills))
+        self._arrive: Dict[int, float] = {}    # rid -> arrival perf stamp
+        self._last_emit: Dict[int, float] = {}  # rid -> last emission stamp
+        self._qw_recorded: set = set()         # rids with a queue_wait sample
 
     def _request_key(self, rid: int) -> np.ndarray:
         """The request's sampling key: fold_in(PRNGKey(seed), rid)."""
@@ -258,6 +278,7 @@ class SlotServerBase:
         admitted = self._admit_device(prompt, slot)
         if admitted is None:
             return False
+        self._record_queue_wait(rid, t0)
         first, first_lp = admitted
         self.pos = self.pos.at[slot].set(len(prompt))
         self.last = self.last.at[slot].set(first)
@@ -273,6 +294,7 @@ class SlotServerBase:
         else:
             self._emitted[rid] = [int(first)]
             self._logprobs[rid] = [float(first_lp)]
+            self._obs_tokens(rid, 1)
             self._retire_if_done(slot)
         self._metrics.record("admission_stall", time.perf_counter() - t0)
         return True
@@ -319,9 +341,11 @@ class SlotServerBase:
         rid = self._next_rid
         self._next_rid += 1
         self._rid_sampling[rid] = self._normalize_sampling(sampling)
+        self._arrive[rid] = time.perf_counter()
         if not self._try_admit(rid, prompt, free[0]):
             self._next_rid -= 1
             del self._rid_sampling[rid]
+            del self._arrive[rid]
             return None
         return rid
 
@@ -341,6 +365,7 @@ class SlotServerBase:
         rid = self._next_rid
         self._next_rid += 1
         self._rid_sampling[rid] = self._normalize_sampling(sampling)
+        self._arrive[rid] = time.perf_counter()
         self._prompts[rid] = list(prompt)
         self._emitted[rid] = []
         self._logprobs[rid] = []
@@ -373,17 +398,53 @@ class SlotServerBase:
                 self._done[rid] = True
                 self._expired[rid] = "queue_ttl"
                 self._rid_sampling.pop(rid, None)
+                self._arrive.pop(rid, None)  # no tokens ever: no TTFT
                 self._metrics.record("queue_expired", now - deadline)
             else:
                 keep.append((rid, prompt, deadline))
         if len(keep) != len(self._queue):
             self._queue = keep
 
+    def _record_queue_wait(self, rid: int, now: float) -> None:
+        """One queue_wait sample per request, at its FIRST admission
+        start — a deadlock-PARKED prefill re-entering the queue must not
+        record a second, overlapping interval (the first already covers
+        arrival -> first start)."""
+        arrived = self._arrive.get(rid)
+        if arrived is None or rid in self._qw_recorded:
+            return
+        self._qw_recorded.add(rid)
+        self._metrics.record("queue_wait", now - arrived)
+
+    def _obs_tokens(self, rid: int, n: int) -> None:
+        """One emission event for *rid* (*n* tokens): the FIRST event
+        records TTFT (arrival -> first token, host-observable wall time);
+        later events record the inter-token latency, normalized by the
+        event's token count so a speculative burst of k tokens reads as k
+        tokens at gap/k, not one slow token."""
+        now = time.perf_counter()
+        last = self._last_emit.get(rid)
+        if last is None:
+            arrived = self._arrive.get(rid)
+            if arrived is not None:
+                self._metrics.record("ttft", now - arrived)
+        elif n > 0:
+            self._metrics.record("itl", (now - last) / n)
+        self._last_emit[rid] = now
+
     def metrics_summary(self) -> dict:
-        """{"admission_stall": {p50_ms, p99_ms, count}, "step": {...},
+        """{"admission_stall": {p50_ms, p90_ms, p99_ms, count},
+        "step": {...}, "ttft": {...}, "itl": {...}, "queue_wait": {...},
         "queue_expired": {count, ...}} (the latter only once a TTL has
-        expired a queued request)."""
+        expired a queued request). The same histograms render as
+        Prometheus text via ``metrics_text``."""
         return self._metrics.summary()
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition of this server's registry (latency
+        summaries + occupancy gauges) — the text an
+        ``obs.exporter.MetricsServer`` serves at ``/metrics``."""
+        return self.obs.render()
 
     def step(self) -> Dict[int, List[int]]:
         """Admit/advance prefills under the token budget (monolithic when
@@ -439,6 +500,7 @@ class SlotServerBase:
             self._logprobs[rid].append(float(lps[slot]))
             self._note_emitted(slot)
             out.setdefault(rid, []).append(tok)
+            self._obs_tokens(rid, 1)
             self._retire_if_done(slot)
 
     def _warmup_buckets(self, prefill_dummy) -> None:
@@ -553,6 +615,7 @@ class SlotServerBase:
         *deadline* is kept only so deadlock PARKING can re-queue the
         request without resetting its clock."""
         self._bind_slot(rid, slot)
+        self._record_queue_wait(rid, time.perf_counter())
         self._slot_rid[slot] = rid        # cancel() finds mid-prefills
         self._done[rid] = False
         self._prefills[slot] = {
@@ -626,6 +689,7 @@ class SlotServerBase:
             self._emitted[rid] = [tok] + self._emitted[rid]
             self._logprobs[rid] = [float(np.asarray(lp))] + self._logprobs[rid]
             out.setdefault(rid, []).append(tok)
+            self._obs_tokens(rid, 1)
             self._retire_if_done(slot)
         self._pending_first.clear()
         return out
@@ -716,6 +780,9 @@ class SlotServerBase:
         self._rid_sampling.pop(rid, None)
         self._logprobs.pop(rid, None)
         self._expired.pop(rid, None)  # expiry reason is bookkeeping too
+        self._arrive.pop(rid, None)   # observability stamps are too
+        self._last_emit.pop(rid, None)
+        self._qw_recorded.discard(rid)
         return out
 
     def _idle(self) -> bool:

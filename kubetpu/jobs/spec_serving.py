@@ -191,6 +191,7 @@ class SpeculativeDecodeServer(SlotServerBase):
                 float(x) for x in lps[slot][: len(accepted)])
             self._note_emitted(slot)
             out.setdefault(rid, []).extend(accepted)
+            self._obs_tokens(rid, len(accepted))
             self._retire_if_done(slot)
         return out
 

@@ -1,0 +1,69 @@
+"""``kubetpu.obs`` — the one observability spine (Round-8).
+
+SURVEY.md §5.1 records that the reference has no tracing or profiling
+hooks at all, and the BASELINE north star (pod-schedule p50 < 100 ms at
+256 chips; serving TTFT/ITL targets) is unmeasurable in production
+without them. Before this subsystem kubetpu's observability was four
+disconnected fragments — the scheduler's ``LatencyRecorder``, the agent's
+ad-hoc ``/metrics`` counter dict, serving's in-process
+``metrics_summary()``, and the jobs-side ``profiling`` helpers. ``obs``
+is the spine they all hang off:
+
+- ``registry`` — typed instruments (Counter, Gauge, bounded-reservoir
+  Histogram with p50/p90/p99) in a thread-safe ``Registry`` with
+  Prometheus text exposition, plus the parse/validate/federate helpers
+  the controller uses to merge agent scrapes into one fleet ``/metrics``;
+- ``trace`` — lightweight distributed tracing: ``span()`` produces
+  structured events (trace_id/span_id/parent, op, start, dur, tags) into
+  a bounded process-wide ``Tracer`` (optional JSONL sink), and the wire
+  layer propagates the context via ``X-Kubetpu-Trace-Id`` /
+  ``X-Kubetpu-Parent-Span`` headers so one ``gang_launch`` or pod submit
+  yields a single stitched trace across controller -> agent -> allocate
+  (retries visible as child spans);
+- ``exporter`` — a tiny stdlib HTTP server exposing any ``Registry`` (and
+  the process tracer) as ``/metrics`` + ``/trace/<id>``, the wire path a
+  serving replica (DecodeServer and friends) publishes its histograms
+  through.
+
+Deliberately dependency-free (stdlib only) and import-light: every other
+layer (wire, core, scheduler, jobs) may import ``obs``; ``obs`` imports
+none of them.
+"""
+
+from kubetpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+    federate,
+    parse_prometheus_text,
+    validate_prometheus_text,
+)
+from kubetpu.obs.trace import (
+    Tracer,
+    attach_wire_context,
+    current_span_id,
+    current_trace_id,
+    span,
+    tracer,
+    wire_headers,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Tracer",
+    "attach_wire_context",
+    "current_span_id",
+    "current_trace_id",
+    "default_registry",
+    "federate",
+    "parse_prometheus_text",
+    "span",
+    "tracer",
+    "validate_prometheus_text",
+    "wire_headers",
+]

@@ -1,0 +1,87 @@
+"""``MetricsServer`` — publish any ``Registry`` (and the process tracer)
+over HTTP.
+
+The serving replicas (DecodeServer / PagedDecodeServer and friends) are
+in-process objects with registries but no wire surface of their own; this
+tiny stdlib server is the slot-server wire path: point it at one or more
+registries and scrape
+
+    GET /metrics      merged Prometheus text of every attached registry
+    GET /healthz      liveness
+    GET /trace/<id>   finished spans of one trace from the process tracer
+
+``kubetpu.cli.obs`` consumes both endpoints; so does the fleet federation
+test rig. Threaded, ephemeral-port friendly (port 0), same lifecycle
+shape as the wire servers (start/shutdown).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from kubetpu.obs import trace as obs_trace
+from kubetpu.obs.registry import Registry
+from kubetpu.wire.httpcommon import write_json, write_text
+
+
+class MetricsServer:
+    """Expose named registries at ``/metrics`` + traces at ``/trace/<id>``."""
+
+    def __init__(self, registries: Dict[str, Registry],
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        """*registries*: {component name -> Registry}; with more than one,
+        every series gains a ``component="<name>"`` label via federation
+        so two replicas' histograms never collide."""
+        self.registries = dict(registries)
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 — quiet
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    write_json(self, 200, {"ok": True})
+                elif self.path == "/metrics":
+                    write_text(self, 200, exporter.render())
+                elif self.path.startswith("/trace/"):
+                    tid = self.path[len("/trace/"):]
+                    spans = obs_trace.tracer().spans(tid)
+                    write_json(self, 200, {"trace": tid, "spans": spans})
+                else:
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    def render(self) -> str:
+        from kubetpu.obs.registry import federate
+
+        if len(self.registries) == 1:
+            return next(iter(self.registries.values())).render()
+        return federate(
+            "", {name: reg.render() for name, reg in self.registries.items()},
+            label="component",
+        )
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> str:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="kubetpu-obs-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.address
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

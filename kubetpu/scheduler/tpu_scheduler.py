@@ -16,6 +16,7 @@ from typing import Dict, Tuple
 from kubetpu.api import utils
 from kubetpu.api.devicescheduler import DeviceScheduler, FitResult, PredicateFailureReason
 from kubetpu.api.types import DeviceGroupPrefix, NodeInfo, PodInfo
+from kubetpu.obs import trace as obs_trace
 from kubetpu.plugintypes.mesh import find_contiguous_block
 from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import TPU
@@ -68,7 +69,12 @@ class TpuScheduler(DeviceScheduler):
         """Normalize the node's allocatable to the 2-level grouped form by
         translating against a synthetic fully-grouped 1-device list, then
         cache its topology shape (reference AddNode trick,
-        gpu_scheduler.go:21-28)."""
+        gpu_scheduler.go:21-28). Spanned (``tpu.add_node``): registration
+        storms show up in the trace timeline, node by node."""
+        with obs_trace.span("tpu.add_node", node=node_name):
+            self._add_node_inner(node_name, node_info)
+
+    def _add_node_inner(self, node_name: str, node_info: NodeInfo) -> None:
         synthetic = {
             DeviceGroupPrefix + "/tpugrp1/A/tpugrp0/B/tpu/TPU0/cards": 1,
         }
@@ -163,11 +169,18 @@ class TpuScheduler(DeviceScheduler):
         return True, [], score
 
     def pod_allocate(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
-        err, found = translate_pod_device_resources(TPU, self._cache, node_info, pod_info)
-        if err is not None:
-            raise RuntimeError(err)
-        if not found:
-            raise RuntimeError("translate_pod_device_resources found no translation")
+        # spanned at ALLOCATE granularity only — the pod_fits_device
+        # predicate runs per (pod x node) and must stay span-free (the
+        # obs discipline: spans per operation, histograms per loop)
+        with obs_trace.span("tpu.pod_allocate", node=node_info.name,
+                            pod=pod_info.name):
+            err, found = translate_pod_device_resources(
+                TPU, self._cache, node_info, pod_info)
+            if err is not None:
+                raise RuntimeError(err)
+            if not found:
+                raise RuntimeError(
+                    "translate_pod_device_resources found no translation")
 
     def take_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo) -> None:
         """No-op: the core harness owns usage accounting (reference

@@ -22,6 +22,11 @@ KubeDevice core; kubetpu owns the core, so it owns this boundary too:
 - ``faults`` — deterministic (seeded) per-route fault injection for chaos
   testing: drop/delay/5xx/partial-response, installable into both the
   stdlib servers and the urllib client path.
+
+Observability (Round-8): both servers expose Prometheus ``/metrics``
+(the controller's is fleet-federated) and ``/trace/<id>``; the shared
+client propagates trace context and records retry spans + wire counters
+(``kubetpu.obs``).
 """
 
 from kubetpu.wire.client import AgentUnreachable, RemoteDevice, probe_remote_agent

@@ -9,6 +9,13 @@ agents, and serves a small operator HTTP API:
     GET    /healthz          liveness
     GET    /status           Cluster.status() snapshot (nodes, slices,
                              latency percentiles, recent events)
+    GET    /metrics          FLEET-FEDERATED Prometheus text: controller
+                             registry (scheduler latency summaries,
+                             breaker-state / chips / pending gauges)
+                             merged with every agent's /metrics scrape,
+                             agent series relabeled node="<name>"
+    GET    /trace/<id>       one stitched trace: controller spans merged
+                             with each agent's /trace/<id> leg
     POST   /nodes            {"url": ..., "token"?: ...} -> register agent
     GET    /nodes            node name -> {url, free chips, pods}
     POST   /pods             {"pod": PodInfo} or {"gang": [PodInfo, ...]}
@@ -60,6 +67,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -67,6 +75,8 @@ from typing import Dict, List, Optional
 from kubetpu.api import utils
 from kubetpu.core import Cluster, SchedulingError
 from kubetpu.core.cluster import GangKey, _reset_for_reschedule, pod_priority
+from kubetpu.obs import trace as obs_trace
+from kubetpu.obs.registry import Registry, federate
 from kubetpu.scheduler.deviceclass import GPU, TPU
 from kubetpu.scheduler.translate import pod_device_count, pod_wants_device
 from kubetpu.wire.codec import (
@@ -81,6 +91,7 @@ from kubetpu.wire.httpcommon import (
     handle_guarded,
     run_idempotent,
     write_json,
+    write_text,
 )
 
 # circuit-breaker health states (healthy -> suspect -> probation -> dead)
@@ -123,6 +134,33 @@ class ControllerServer:
         self.cluster = cluster or Cluster()
         self.poll_interval = poll_interval
         self.token = token or None
+        # -- observability (Round-8): one registry for the whole control
+        # plane. The cluster's scheduler latencies are re-homed into it
+        # (same histograms, no second recording path); breaker-state /
+        # capacity / queue gauges are collect-time callbacks so scrapes
+        # read fresh state under the lock and mutations pay nothing.
+        self.obs_component = "controller"
+        self.registry = Registry()
+        self.cluster.metrics.bind(
+            self.registry, "kubetpu_schedule_latency_seconds")
+        for key in ("submits", "reconcile_passes",
+                    "federation_scrape_errors"):
+            self.registry.counter(f"kubetpu_controller_{key}_total")
+        for state in (HEALTHY, SUSPECT, PROBATION):
+            self.registry.gauge_fn(
+                "kubetpu_nodes",
+                lambda s=state: self._count_health(s), state=state)
+        self.registry.gauge_fn(
+            "kubetpu_pending_pods", lambda: len(self._pending))
+        for dc in (TPU, GPU):
+            self.registry.gauge_fn(
+                "kubetpu_chips_free",
+                lambda r=dc.resource_name: self._chip_totals(r)[0],
+                device=dc.resource_name)
+            self.registry.gauge_fn(
+                "kubetpu_chips_held",
+                lambda r=dc.resource_name: self._chip_totals(r)[1],
+                device=dc.resource_name)
         # circuit-breaker thresholds: ``suspect_after`` consecutive missed
         # probes health-cordon a node (pods kept, no new placements);
         # ``dead_after`` consecutive misses evict it. ``dead_after=1`` is
@@ -194,6 +232,16 @@ class ControllerServer:
                     with controller._lock:
                         out = controller.cluster.status()
                     self._reply(200, out)
+                elif self.path == "/metrics":
+                    # fleet federation: own registry + every agent's scrape
+                    # (relabeled node="...") + the Cluster gauges — built
+                    # OUTSIDE the lock (the gauge callbacks take it briefly
+                    # per read; a slow agent scrape must not freeze the
+                    # operator API)
+                    write_text(self, 200, controller._metrics_text())
+                elif self.path.startswith("/trace/"):
+                    tid = self.path[len("/trace/"):]
+                    self._reply(200, controller._trace(tid))
                 elif self.path == "/nodes":
                     with controller._lock:
                         status = controller.cluster.status()["nodes"]
@@ -650,6 +698,17 @@ class ControllerServer:
         return {"queued": [p.name for p in pods]}
 
     def _submit(self, req: dict) -> dict:
+        """Span + counter shell around ``_submit_inner`` — a submit is the
+        control plane's marquee operation, so it gets its own span (child
+        of the HTTP server span, parent of the per-container agent
+        allocate calls)."""
+        self.registry.counter("kubetpu_controller_submits_total").inc()
+        with obs_trace.span("controller.submit", component="controller") as sp:
+            sp.tag(pods=len(req.get("gang", [])) or 1,
+                   gang="gang" in req)
+            return self._submit_inner(req)
+
+    def _submit_inner(self, req: dict) -> dict:
         """Place a pod or a gang and run container-start allocation — the
         caller gets everything a launcher needs. Manages the lock itself,
         in three phases (the _allocate_existing pattern, ADVICE r2):
@@ -797,9 +856,102 @@ class ControllerServer:
                 }
         return out
 
+    # -- observability (Round-8) ---------------------------------------------
+
+    def _count_health(self, state: str) -> int:
+        with self._lock:
+            return sum(
+                1 for name in self.cluster.nodes
+                if self._health_state(name) == state
+            )
+
+    def _chip_totals(self, resource: str):
+        """(free, held) chips of *resource* across the fleet."""
+        with self._lock:
+            free = sum(
+                int(n.info.allocatable.get(resource, 0))
+                for n in self.cluster.nodes.values()
+            )
+            total = sum(
+                int(n.info.capacity.get(resource, 0))
+                for n in self.cluster.nodes.values()
+            )
+        return free, total - free
+
+    def _agent_token(self, name: str) -> Optional[str]:
+        """The token that works toward THIS agent: the one its
+        RemoteDevice authenticated registration with (register_agent
+        accepts a per-agent token), falling back to the controller's."""
+        node = self.cluster.nodes.get(name)
+        token = getattr(getattr(node, "device", None), "token", None)
+        return token or self.token
+
+    def _scrape_agent_text(self, url: str, token: Optional[str]) -> str:
+        """One raw-text scrape of an agent endpoint (no retry — a missed
+        scrape is a gap in a graph, not an outage worth backoff)."""
+        headers = {}
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            return r.read().decode()
+
+    def _metrics_text(self) -> str:
+        """The federated fleet exposition: this registry (scheduler
+        latency summaries, breaker/capacity/queue gauges, controller
+        counters) merged with every registered agent's ``/metrics``,
+        agent series relabeled ``node="<name>"``. Scrape failures skip
+        that agent and count — federation degrades, never 500s."""
+        with self._lock:
+            targets = {
+                name: (url, self._agent_token(name))
+                for name, url in self._node_urls.items()
+            }
+        scraped: Dict[str, str] = {}
+        for name, (url, token) in sorted(targets.items()):
+            try:
+                scraped[name] = self._scrape_agent_text(
+                    url + "/metrics", token)
+            except Exception:  # noqa: BLE001 — degrade per agent
+                self.registry.counter(
+                    "kubetpu_controller_federation_scrape_errors_total").inc()
+        return federate(self.registry.render(), scraped)
+
+    def _trace(self, trace_id: str) -> dict:
+        """Stitch one trace: this process's spans plus every agent's
+        ``/trace/<id>`` leg, deduplicated by span_id (in-process test
+        stacks share the tracer; cross-process fleets don't), ordered by
+        start time."""
+        spans = {s["span_id"]: s
+                 for s in obs_trace.tracer().spans(trace_id)}
+        with self._lock:
+            targets = {
+                name: (url, self._agent_token(name))
+                for name, url in self._node_urls.items()
+            }
+        for name, (url, token) in sorted(targets.items()):
+            try:
+                body = json.loads(self._scrape_agent_text(
+                    f"{url}/trace/{trace_id}", token))
+                for s in body.get("spans", []):
+                    spans.setdefault(s["span_id"], s)
+            except Exception:  # noqa: BLE001 — a dark agent loses its leg,
+                pass           # not the whole trace
+        ordered = sorted(spans.values(), key=lambda s: s["start"])
+        return {"trace": trace_id, "spans": ordered}
+
     # -- reconcile loop ------------------------------------------------------
 
     def poll_once(self) -> dict:
+        """One reconcile pass (see ``_poll_once``) wrapped in a root trace
+        span — the reconcile loop runs with no inbound request to parent
+        under, so each pass is its own trace."""
+        self.registry.counter(
+            "kubetpu_controller_reconcile_passes_total").inc()
+        with obs_trace.span("controller.reconcile", component="controller"):
+            return self._poll_once()
+
+    def _poll_once(self) -> dict:
         """One reconcile pass: probe remote agents (OUTSIDE the lock — a
         partition must not stall the operator API for timeout x agents),
         run missed probes through the circuit breaker (suspect/probation

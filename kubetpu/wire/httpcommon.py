@@ -25,10 +25,19 @@ the chaos-hardening contract in one place:
 replay window mapping key -> committed 200 response. Only SUCCESS is
 cached — a failed attempt clears the in-flight marker so the retry may
 re-execute (at-most-once success, at-least-once attempt).
+
+Observability (Round-8, ``kubetpu.obs``): every ``request_json`` call runs
+inside a client trace span with retries as child spans, propagating the
+trace context via ``X-Kubetpu-Trace-Id`` / ``X-Kubetpu-Parent-Span``;
+``handle_guarded`` adopts it server-side, so controller -> agent chains
+stitch into one trace. Client-side wire counters
+(``kubetpu_wire_requests_total`` / ``_retried_total``) land on the
+process-default ``obs.Registry``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import hmac
 import http.client
 import io
@@ -42,6 +51,9 @@ import urllib.request
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Optional, Tuple
+
+from kubetpu.obs import registry as obs_registry
+from kubetpu.obs import trace as obs_trace
 
 # -- server reply helpers ----------------------------------------------------
 
@@ -150,9 +162,19 @@ def request_json(
     """One JSON request/response over urllib with the shared retry
     discipline. *method* defaults to GET without a payload, POST with one.
     Raises ``urllib.error.HTTPError`` for a final HTTP error status and
-    the last transport exception when every attempt failed."""
+    the last transport exception when every attempt failed.
+
+    Observability (Round-8): the logical call runs inside one trace span
+    (child of whatever span the caller holds — a fresh trace root
+    otherwise), each retry is a CHILD span tagged with its attempt number,
+    and the trace context travels to the server as the
+    ``X-Kubetpu-Trace-Id`` / ``X-Kubetpu-Parent-Span`` headers — rebuilt
+    per attempt, so a server span parents under the exact attempt that
+    reached it. ``kubetpu_wire_requests_total`` / ``_retried_total``
+    count on the process-default registry."""
     from kubetpu.wire import faults as faults_mod
 
+    reg = obs_registry.default_registry()
     retry = retry or DEFAULT_RETRY
     method = method or ("GET" if payload is None else "POST")
     data = None if payload is None else json.dumps(payload).encode()
@@ -173,50 +195,68 @@ def request_json(
     # the server side — hand the injector the path, not the full URL
     fault_path = urllib.parse.urlsplit(url).path or "/"
     last_exc: Optional[BaseException] = None
-    for attempt in range(attempts):
-        injector = faults if faults is not None else faults_mod.client_injector()
-        try:
-            if injector is not None:
-                injector.client_fault(fault_path)
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
-            req = urllib.request.Request(
-                url, data=data, headers=hdrs, method=method
-            )
-            with urllib.request.urlopen(
-                req, timeout=min(timeout, remaining)
-            ) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            if not (retry.retry_5xx and e.code in (502, 503, 504)
-                    and retriable) or attempt + 1 >= attempts:
-                raise
-            # drain the socket but keep the body READABLE: the deadline
-            # may end the loop and re-raise this error, and callers read
-            # the server's error detail from it. Reassigning e.fp is NOT
-            # enough (addinfourl delegates read() to the original file),
-            # so rebuild the error around a buffered body.
+    reg.counter("kubetpu_wire_requests_total").inc()
+    with obs_trace.span(f"http.{method}", component="wire-client",
+                        path=fault_path):
+        for attempt in range(attempts):
+            injector = (faults if faults is not None
+                        else faults_mod.client_injector())
+            if attempt:
+                reg.counter("kubetpu_wire_requests_retried_total").inc()
+                attempt_cm = obs_trace.span(
+                    "http.retry", component="wire-client",
+                    path=fault_path, attempt=attempt)
+            else:
+                attempt_cm = contextlib.nullcontext()
             try:
-                last_exc = urllib.error.HTTPError(
-                    e.url, e.code, e.reason, e.headers, io.BytesIO(e.read())
-                )
-            except Exception:  # noqa: BLE001 — body already gone
+                with attempt_cm:
+                    if injector is not None:
+                        injector.client_fault(fault_path)
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    # context rebuilt per attempt: inside a retry span the
+                    # propagated parent IS that retry span
+                    attempt_hdrs = dict(hdrs)
+                    attempt_hdrs.update(obs_trace.wire_headers())
+                    req = urllib.request.Request(
+                        url, data=data, headers=attempt_hdrs, method=method
+                    )
+                    with urllib.request.urlopen(
+                        req, timeout=min(timeout, remaining)
+                    ) as resp:
+                        return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if not (retry.retry_5xx and e.code in (502, 503, 504)
+                        and retriable) or attempt + 1 >= attempts:
+                    raise
+                # drain the socket but keep the body READABLE: the deadline
+                # may end the loop and re-raise this error, and callers read
+                # the server's error detail from it. Reassigning e.fp is NOT
+                # enough (addinfourl delegates read() to the original file),
+                # so rebuild the error around a buffered body.
+                try:
+                    last_exc = urllib.error.HTTPError(
+                        e.url, e.code, e.reason, e.headers,
+                        io.BytesIO(e.read())
+                    )
+                except Exception:  # noqa: BLE001 — body already gone
+                    last_exc = e
+                    e.close()
+            except TRANSIENT_ERRORS as e:
                 last_exc = e
-                e.close()
-        except TRANSIENT_ERRORS as e:
-            last_exc = e
-        if attempt + 1 >= attempts:
-            break
-        sleep = min(delay, retry.max_delay, max(0.0, deadline - time.monotonic()))
-        if sleep > 0:
-            time.sleep(sleep * (1.0 - retry.jitter * _random.random()))
-        delay *= retry.multiplier
-    if last_exc is None:
-        last_exc = TimeoutError(
-            f"{method} {url}: retry deadline ({retry.deadline}s) exhausted"
-        )
-    raise last_exc
+            if attempt + 1 >= attempts:
+                break
+            sleep = min(delay, retry.max_delay,
+                        max(0.0, deadline - time.monotonic()))
+            if sleep > 0:
+                time.sleep(sleep * (1.0 - retry.jitter * _random.random()))
+            delay *= retry.multiplier
+        if last_exc is None:
+            last_exc = TimeoutError(
+                f"{method} {url}: retry deadline ({retry.deadline}s) exhausted"
+            )
+        raise last_exc
 
 
 # -- idempotency (server side) -----------------------------------------------
@@ -347,12 +387,24 @@ class InflightTracker:
 
 def handle_guarded(server, handler, dispatch) -> None:
     """THE per-request bracket both wire servers wrap every HTTP verb in:
-    count the request in flight (so graceful shutdown can wait), consult
-    the server's fault injector (chaos drop/delay/error/partial), then
-    run *dispatch*. Lives here so the order (track -> faults -> route)
-    can never drift between the agent and the controller. *server* needs
-    ``._inflight`` (InflightTracker) and ``.faults`` attributes."""
+    count the request in flight (so graceful shutdown can wait), adopt the
+    caller's trace context (``X-Kubetpu-Trace-Id`` headers) and open a
+    server span, consult the server's fault injector (chaos
+    drop/delay/error/partial), then run *dispatch*. Lives here so the
+    order (track -> trace -> faults -> route) can never drift between the
+    agent and the controller. *server* needs ``._inflight``
+    (InflightTracker) and ``.faults`` attributes; an ``.obs_component``
+    string names the server in span records."""
+    comp = getattr(server, "obs_component", type(server).__name__)
     with server._inflight.track():
-        if server.faults is not None and server.faults.server_fault(handler):
-            return
-        dispatch()
+        with obs_trace.attach_wire_context(handler.headers):
+            with obs_trace.span(
+                f"{handler.command} {handler.path}", component=comp
+            ) as sp:
+                if server.faults is not None and server.faults.server_fault(
+                        handler):
+                    # drop/error consumed the request before routing —
+                    # visible in the trace as a server span that did no work
+                    sp.tag(fault="injected")
+                    return
+                dispatch()

@@ -9,9 +9,13 @@ small threaded HTTP server wrapping a ``device.Device``:
                         "draining": <bool>}
     GET  /nodeinfo  -> NodeInfo JSON (fresh advertisement; the manager's
                        probe cache bounds actual hardware queries)
-    GET  /metrics   -> Prometheus-style text: request/error counters,
-                       advertised device count, uptime (the metrics
-                       endpoint the reference never had, SURVEY.md §5.5)
+    GET  /metrics   -> Prometheus text rendered from the agent's
+                       ``obs.Registry``: request/error counters, advertised
+                       capacity gauges, uptime (the metrics endpoint the
+                       reference never had, SURVEY.md §5.5); the controller
+                       scrapes and federates this into its fleet /metrics
+    GET  /trace/<id>-> finished spans of one trace from the process tracer
+                       (agent legs of a stitched controller trace)
     POST /allocate  -> {"pod": PodInfo, "container": <name>} ->
                        AllocateResult JSON (the container-start injection
                        step, run node-local where the devices live)
@@ -45,6 +49,8 @@ from typing import Optional
 from kubetpu.api import utils
 from kubetpu.api.device import Device
 from kubetpu.api.types import new_node_info
+from kubetpu.obs import trace as obs_trace
+from kubetpu.obs.registry import Registry
 from kubetpu.wire.codec import (
     allocate_result_to_json,
     node_info_to_json,
@@ -87,15 +93,18 @@ class NodeAgentServer:
         self.faults = faults
         self.idem = IdempotencyCache(ttl=idem_window)
         self.started_at = time.time()
-        # counters are written under the per-request threads; int += is a
-        # single bytecode read-modify-write, so guard with a lock
-        self._counter_lock = threading.Lock()
-        self.counters = {
-            "nodeinfo_requests": 0,
-            "allocate_requests": 0,
-            "allocate_replays": 0,
-            "errors": 0,
-        }
+        self.obs_component = f"agent:{node_name}"  # names spans from here
+        # every counter/gauge lives in ONE thread-safe registry (Round-8);
+        # the old hand-rolled counter dict + lock are gone — /metrics
+        # renders the registry, writers inc() instruments
+        self.registry = Registry()
+        for key in ("nodeinfo_requests", "allocate_requests",
+                    "allocate_replays", "errors"):
+            self.registry.counter(f"kubetpu_agent_{key}_total")
+        self.registry.gauge_fn(
+            "kubetpu_agent_uptime_seconds",
+            lambda: time.time() - self.started_at,
+        )
         # graceful lifecycle: while draining, mutating work is refused 503
         # but in-flight requests run to completion (tracked so a graceful
         # shutdown can wait for them)
@@ -109,8 +118,7 @@ class NodeAgentServer:
         agent = self
 
         def bump(key: str) -> None:
-            with agent._counter_lock:
-                agent.counters[key] += 1
+            agent.registry.counter(f"kubetpu_agent_{key}_total").inc()
 
         class Handler(BaseHTTPRequestHandler):
             # quiet the default per-request stderr lines; route to leveled log
@@ -150,38 +158,27 @@ class NodeAgentServer:
                     try:
                         info = new_node_info(agent.node_name)
                         agent.device.update_node_info(info)
-                        agent.last_capacity = dict(info.kube_cap)
+                        agent._capacity_snapshot(info.kube_cap)
                         self._reply(200, node_info_to_json(info))
                     except Exception as e:  # noqa: BLE001 — degrade, stay up
                         bump("errors")
                         self._reply(500, {"error": str(e)})
                 elif self.path == "/metrics":
-                    if agent.last_capacity is not None:
-                        scalars = dict(sorted(agent.last_capacity.items()))
-                    else:  # never probed yet: one probe to seed the snapshot
+                    if agent.last_capacity is None:
+                        # never probed yet: one probe to seed the snapshot
                         try:
                             info = new_node_info(agent.node_name)
                             agent.device.update_node_info(info)
-                            agent.last_capacity = dict(info.kube_cap)
-                            scalars = dict(sorted(info.kube_cap.items()))
+                            agent._capacity_snapshot(info.kube_cap)
                         except Exception:  # noqa: BLE001 — metrics never 500
                             bump("errors")
-                            scalars = {}
-                    with agent._counter_lock:
-                        counters = dict(agent.counters)
-                    lines = [
-                        "# TYPE kubetpu_agent_uptime_seconds gauge",
-                        f"kubetpu_agent_uptime_seconds {time.time() - agent.started_at:.1f}",
-                    ]
-                    for key, val in sorted(counters.items()):
-                        lines.append(f"# TYPE kubetpu_agent_{key}_total counter")
-                        lines.append(f"kubetpu_agent_{key}_total {val}")
-                    for res, val in scalars.items():
-                        lines.append(
-                            'kubetpu_agent_capacity{resource="%s",node="%s"} %d'
-                            % (res, agent.node_name, val)
-                        )
-                    self._reply_text(200, "\n".join(lines) + "\n")
+                    self._reply_text(200, agent.registry.render())
+                elif self.path.startswith("/trace/"):
+                    tid = self.path[len("/trace/"):]
+                    self._reply(200, {
+                        "trace": tid,
+                        "spans": obs_trace.tracer().spans(tid),
+                    })
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -232,6 +229,33 @@ class NodeAgentServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+
+    # -- observability -------------------------------------------------------
+
+    def _capacity_snapshot(self, kube_cap: dict) -> None:
+        """Apply a fresh advertisement to the capacity gauges — /metrics
+        serves this snapshot instead of re-probing hardware per scrape.
+        A resource that stopped being advertised reads 0 (the operator
+        sees the loss, the series stays stable for dashboards)."""
+        prev = self.last_capacity or {}
+        self.last_capacity = dict(kube_cap)
+        for res in sorted(set(prev) | set(kube_cap)):
+            self.registry.gauge(
+                "kubetpu_agent_capacity", resource=res, node=self.node_name
+            ).set(kube_cap.get(res, 0))
+
+    @property
+    def counters(self) -> dict:
+        """Back-compat counter snapshot ({short name: int}) over the
+        registry — what the old hand-rolled dict exposed."""
+        out = {}
+        for name, labels, kind, inst in self.registry.snapshot():
+            if (kind == "counter" and not labels
+                    and name.startswith("kubetpu_agent_")
+                    and name.endswith("_total")):
+                out[name[len("kubetpu_agent_"):-len("_total")]] = int(
+                    inst.value)
+        return out
 
     # -- lifecycle -----------------------------------------------------------
 

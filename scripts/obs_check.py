@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""``make obs-check`` — the observability smoke oracle.
+
+Starts a controller + 2 fake agents in-process, submits a pod so every
+layer records something, scrapes the controller's FEDERATED ``/metrics``,
+and fails (exit 1) on:
+
+- malformed Prometheus text (``obs.validate_prometheus_text``);
+- any missing REQUIRED series: scheduler latency summary, per-node agent
+  allocate counters, the breaker-state node gauge, chips/pending gauges;
+- a submit whose trace does not stitch (no shared trace_id across
+  controller and agent spans).
+
+Runs in a few seconds with no accelerator; wired into the chaos target so
+every fault-injection run also proves the fleet is observable.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from kubetpu.api.types import ContainerInfo, PodInfo  # noqa: E402
+from kubetpu.device import (  # noqa: E402
+    make_fake_tpus_info,
+    new_fake_tpu_dev_manager,
+)
+from kubetpu.obs import span, validate_prometheus_text  # noqa: E402
+from kubetpu.plugintypes import ResourceTPU  # noqa: E402
+from kubetpu.wire import ControllerServer, NodeAgentServer  # noqa: E402
+from kubetpu.wire.controller import pod_to_json  # noqa: E402
+from kubetpu.wire.httpcommon import request_json  # noqa: E402
+
+REQUIRED_SERIES = (
+    'kubetpu_schedule_latency_seconds{op="schedule_pod",quantile="0.5"}',
+    'kubetpu_agent_allocate_requests_total{node="obs-h0"}',
+    'kubetpu_agent_allocate_requests_total{node="obs-h1"}',
+    'kubetpu_nodes{state="healthy"} 2',
+    'kubetpu_nodes{state="suspect"}',
+    "kubetpu_pending_pods",
+    'kubetpu_chips_free{device="kubedevice/tpu"}',
+    'kubetpu_chips_held{device="kubedevice/tpu"}',
+    "kubetpu_controller_submits_total 2",
+    "kubetpu_agent_capacity",
+)
+
+
+def main() -> int:
+    failures = []
+    agents = [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-64", host_index=h)),
+            f"obs-h{h}",
+        )
+        for h in range(2)
+    ]
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    try:
+        for a in agents:
+            a.start()
+            request_json(controller.address + "/nodes", {"url": a.address})
+        # one single-pod submit + one gang submit so both schedule ops and
+        # both agents' allocate paths record
+        with span("obs-check.submit") as root:
+            request_json(
+                controller.address + "/pods",
+                {"pod": pod_to_json(PodInfo(
+                    name="obs-p0",
+                    running_containers={"main": ContainerInfo(
+                        requests={ResourceTPU: 4})},
+                ))},
+                idempotency_key="obs-check-p0",
+            )
+            trace_id = root.trace_id
+        request_json(
+            controller.address + "/pods",
+            {"gang": [pod_to_json(PodInfo(
+                name=f"obs-g{i}",
+                running_containers={"main": ContainerInfo(
+                    requests={ResourceTPU: 4})},
+            )) for i in range(2)]},
+            idempotency_key="obs-check-gang",
+        )
+        controller.poll_once()
+
+        text = controller._metrics_text()
+        problems = validate_prometheus_text(text)
+        if problems:
+            failures.append("malformed Prometheus text:\n  " +
+                            "\n  ".join(problems))
+        for needle in REQUIRED_SERIES:
+            if needle not in text:
+                failures.append(f"missing required series: {needle!r}")
+
+        trace = controller._trace(trace_id)
+        comps = {s.get("component", "") for s in trace["spans"]}
+        if "controller" not in comps or not any(
+                c.startswith("agent:") for c in comps):
+            failures.append(
+                f"trace {trace_id} did not stitch across controller and "
+                f"agent spans (components: {sorted(comps)})")
+    finally:
+        controller.shutdown()
+        for a in agents:
+            a.shutdown()
+    if failures:
+        print("obs-check FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("obs-check OK: federated /metrics valid, required series "
+          "present, submit trace stitched")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+"""Serving-side Round-8 observability: TTFT / inter-token latency /
+queue-wait histograms recorded by the slot servers, their Prometheus
+exposition, and the chunked-vs-monolithic TTFT ordering under a
+long-prompt admission storm (ISSUE 3 satellite, via the
+``serving_mixed_load`` harness family in bench_model).
+
+Shapes deliberately mirror test_chunked_prefill / test_serving (same CFG,
+n_slots, max_seq) so the process-wide jit caches are already warm when
+tier-1 reaches this file.
+"""
+
+import jax
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.jobs.serving import DecodeServer
+from kubetpu.obs.registry import validate_prometheus_text
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PROMPTS = [[3, 14, 15, 9, 2, 6, 5], [(i * 7) % 60 + 1 for i in range(19)]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def run_mixed(server):
+    rids = [server.enqueue(p) for p in PROMPTS]
+    for _ in range(2):
+        server.step()
+    server.drain()
+    return rids
+
+
+def test_server_records_ttft_itl_queue_wait(params):
+    srv = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=6,
+                       prefill_budget=3)
+    rids = run_mixed(srv)
+    stats = srv.metrics_summary()
+    # one TTFT sample per finished request; decode gaps feed itl
+    assert stats["ttft"]["count"] == len(rids)
+    assert stats["itl"]["count"] > 0
+    assert stats["queue_wait"]["count"] == len(rids)
+    for op in ("ttft", "itl", "queue_wait"):
+        assert stats[op]["p50_ms"] >= 0
+        assert stats[op]["p50_ms"] <= stats[op]["p99_ms"]
+        assert {"count", "p50_ms", "p90_ms", "p99_ms"} <= set(stats[op])
+    # the SAME histograms render as valid Prometheus text, gauges included
+    text = srv.metrics_text()
+    assert validate_prometheus_text(text) == []
+    assert 'kubetpu_serving_latency_seconds{op="ttft",quantile="0.5"}' in text
+    assert 'kubetpu_serving_latency_seconds{op="itl",quantile="0.99"}' in text
+    assert "kubetpu_serving_slots 2" in text
+    assert "kubetpu_serving_active_slots 0" in text  # drained
+    assert "kubetpu_serving_queue_depth 0" in text
+    # pop_result releases the observability stamps with the bookkeeping
+    for r in rids:
+        srv.pop_result(r)
+    assert not srv._arrive and not srv._last_emit
+
+
+def test_paged_pool_gauges(params):
+    srv = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=4, page_size=4)
+    rid = srv.enqueue(PROMPTS[0])
+    srv.step()
+    text = srv.metrics_text()
+    assert validate_prometheus_text(text) == []
+    total = srv.pool_pages
+    in_use = srv.pages_in_use()
+    assert in_use > 0  # the admitted request holds pages
+    assert f"kubetpu_serving_pool_pages {total}" in text
+    assert f"kubetpu_serving_pages_in_use {in_use}" in text
+    assert f"kubetpu_serving_pages_free {total - in_use}" in text
+    srv.drain()
+    assert srv.finished(rid)
+    assert "kubetpu_serving_pages_in_use 0" in srv.metrics_text()
+
+
+def test_submit_path_records_ttft_immediately(params):
+    """The synchronous submit path has no queue wait and a first token at
+    admission — TTFT records there too (not only on the deferred path)."""
+    srv = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=3)
+    srv.submit(PROMPTS[0])
+    stats = srv.metrics_summary()
+    assert stats["ttft"]["count"] == 1
+    assert stats["queue_wait"]["count"] == 1
+    assert stats["queue_wait"]["p50_ms"] <= stats["ttft"]["p50_ms"]
+
+
+@pytest.mark.slow
+def test_chunked_ttft_p50_beats_monolithic_under_storm():
+    """ISSUE 3 satellite ordering, via the bench harness: under a
+    long-prompt admission storm (one long + shorts behind it per round),
+    the chunked scheduler's SERVER-RECORDED TTFT p50 is strictly below
+    the monolithic server's — shorts finish with leftover per-step
+    budget while the long trickles, instead of every first token waiting
+    out the whole backlog's prefill. Sized so prefill compute dominates
+    step overhead (the regime the knob exists for); slow-marked for the
+    bucket warmup compiles."""
+    import bench_model
+
+    mono, chunked = bench_model.mixed_load_storm(
+        CFG, long_len=384, max_seq=512, prefill_budget=64,
+        n_shorts=3, rounds=2, max_new=4)
+    assert mono["ttft"]["count"] == chunked["ttft"]["count"] == 8
+    assert chunked["ttft"]["p50_ms"] < mono["ttft"]["p50_ms"], (
+        f"chunked ttft p50 {chunked['ttft']['p50_ms']:.2f}ms not below "
+        f"monolithic {mono['ttft']['p50_ms']:.2f}ms")
+    # ITL distributions exist on both sides (the chunked server pays its
+    # TTFT win with per-step chunk work — the trade the operator tunes)
+    assert mono["itl"]["count"] > 0 and chunked["itl"]["count"] > 0
