@@ -34,10 +34,20 @@ test: tpuinfo gpuinfo dataio
 
 # seeded fault-injection soaks + the resilience suite (the short soak
 # also runs in tier-1; this target adds the slow 30% one). obs-check runs
-# first: a chaos run whose faults are invisible proves nothing.
+# first (a chaos run whose faults are invisible proves nothing), then
+# prefix-check (a chaos run over a pool the prefix tree corrupted proves
+# the wrong thing).
 .PHONY: chaos
-chaos: obs-check
+chaos: obs-check prefix-check
 	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+
+# shared-prefix KV reuse oracle: cold-vs-warm token parity through
+# prefix-cache hits on a short shared-system-prompt storm, plus the pool
+# accounting invariant (free + slot-owned + tree-owned == n_pages,
+# refcounts == live pins) after every drain
+.PHONY: prefix-check
+prefix-check:
+	python scripts/prefix_check.py
 
 # observability smoke oracle: controller + 2 fake agents, scrape the
 # federated /metrics, fail on malformed Prometheus text / missing

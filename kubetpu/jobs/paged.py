@@ -44,6 +44,7 @@ import numpy as np
 from kubetpu.jobs import model as model_lib
 from kubetpu.jobs.decode import forward_chunk_io
 from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.prefix_cache import RadixPrefixCache
 from kubetpu.jobs.quant import maybe_dequantize, quantize_kv_chunk
 from kubetpu.jobs.sampling import chosen_logprob
 from kubetpu.jobs.serving import SlotServerBase, _cached_legs
@@ -329,6 +330,25 @@ class PagedDecodeServer(SlotServerBase):
     free some; if every holder is itself a parked prefill (nothing will
     ever free), the scheduler sends all but the oldest back to the queue
     with their pages released — no deadlock, no leak.
+
+    ``prefix_cache_pages > 0`` turns on SHARED-PREFIX KV REUSE
+    (``kubetpu.jobs.prefix_cache``): on admission the server matches the
+    longest cached full-page prefix of the prompt in a host-side radix
+    tree, maps the shared physical pages into the slot's page table
+    READ-ONLY (they form the leading prefix of the table; every write the
+    slot ever issues lands past them — the structural copy-on-write
+    rule), and starts prefill at ``pos = matched_tokens``. On retire the
+    slot's full prompt pages are PUBLISHED into the tree (ownership
+    donated — no device copy), bounded by the ``prefix_cache_pages``
+    budget with LRU eviction of unpinned branches; under pool pressure
+    ``_alloc_pages`` reclaims evictable tree pages before refusing, so
+    admission never deadlocks while the tree holds reclaimable pages.
+    Greedy decode through a cache hit is token-exact vs a cold run
+    (pinned by test); ``check_invariants()`` is the pool accounting
+    oracle (free + slot-owned + tree-owned == n_pages, refcounts
+    consistent). Incompatible with windowed (``cfg.window > 0``) serving:
+    the ring table aliases logical pages onto a per-slot physical ring,
+    which cannot be shared across slots.
     """
 
     def __init__(
@@ -352,7 +372,16 @@ class PagedDecodeServer(SlotServerBase):
         prefill_budget: int = 0,
         overlap: bool = False,
         queue_ttl: Optional[float] = None,
+        prefix_cache_pages: int = 0,
     ) -> None:
+        if prefix_cache_pages < 0:
+            raise ValueError("prefix_cache_pages must be >= 0 (0 = off)")
+        if prefix_cache_pages and cfg.window > 0:
+            raise ValueError(
+                "prefix_cache_pages is incompatible with windowed serving: "
+                "the ring table aliases logical pages onto a per-slot "
+                "physical ring, which cannot be shared across slots"
+            )
         if cfg.window > 0 and use_kernel:
             raise NotImplementedError(
                 "the Pallas paged-attention kernel does not implement the "
@@ -423,6 +452,42 @@ class PagedDecodeServer(SlotServerBase):
                           lambda: self.pages_in_use())
         self.obs.gauge_fn("kubetpu_serving_pages_free",
                           lambda: len(self._free))
+        # -- shared-prefix KV reuse (Round-9): host-side radix tree over
+        # token prefixes whose nodes OWN pool pages; per-slot: how many
+        # leading table rows are shared (read-only) mappings, the pinned
+        # deepest-match node, and the prompt to publish at retirement
+        self.prefix_cache_pages = int(prefix_cache_pages)
+        self._prefix_cache = (
+            RadixPrefixCache(page_size, self.prefix_cache_pages)
+            if self.prefix_cache_pages else None
+        )
+        self._slot_shared = [0] * n_slots
+        self._slot_pin = [None] * n_slots
+        self._slot_prompt: List[Optional[List[int]]] = [None] * n_slots
+        # (matched, start) from the slot's LAST _prefill_start, committed
+        # to the reuse counters only when the admission completes
+        self._slot_pending_stats: List[Optional[Tuple[int, int]]] = (
+            [None] * n_slots)
+        if self._prefix_cache is not None:
+            self._c_hit_tokens = self.obs.counter(
+                "kubetpu_prefix_hit_tokens_total",
+                "full-page prefix tokens found cached at admission")
+            self._c_saved_tokens = self.obs.counter(
+                "kubetpu_prefill_tokens_saved_total",
+                "prompt tokens whose prefill was skipped via mapped "
+                "shared pages")
+            self._c_req_hit = self.obs.counter(
+                "kubetpu_prefix_requests_total", result="hit")
+            self._c_req_miss = self.obs.counter(
+                "kubetpu_prefix_requests_total", result="miss")
+            self._c_evicted = self.obs.counter(
+                "kubetpu_prefix_evicted_pages_total")
+            self._c_inserted = self.obs.counter(
+                "kubetpu_prefix_inserted_pages_total")
+            self.obs.gauge_fn("kubetpu_prefix_tree_pages",
+                              lambda: self._prefix_cache.total_pages)
+            self.obs.gauge_fn("kubetpu_prefix_tree_nodes",
+                              lambda: self._prefix_cache.n_nodes())
 
         attend = partial(_attend_paged, window=cfg.window)
         if use_kernel:
@@ -451,7 +516,12 @@ class PagedDecodeServer(SlotServerBase):
         pool is exhausted (caller must not admit). Windowed configs map a
         physical ring and alias every logical page onto it (see
         ``_ring_pages``) — the pool cost per slot is the ring, not the
-        sequence length."""
+        sequence length. With a prefix cache, pool pressure first
+        RECLAIMS evictable (unpinned, LRU) tree pages into the free list
+        — admission must never park while the tree is hoarding
+        reclaimable pages. Shared (tree-owned) pages already mapped into
+        the slot count toward ``have``: the slot only allocates the
+        uncached suffix."""
         need = self._pages_needed(upto_tokens)
         if self._ring_pages:
             if (self._table[slot] >= 0).any():
@@ -470,20 +540,34 @@ class PagedDecodeServer(SlotServerBase):
                 self._table[slot, lp] = ring[lp % phys_need]
             return True
         have = int((self._table[slot] >= 0).sum())
+        short = (need - have) - len(self._free)
+        if short > 0 and self._prefix_cache is not None:
+            reclaimed = self._prefix_cache.evict(short)
+            if reclaimed:
+                self._free.extend(reclaimed)
+                self._c_evicted.inc(len(reclaimed))
         if need - have > len(self._free):
             return False
         for lp in range(have, need):
             self._table[slot, lp] = self._free.pop()
         return True
 
-    def _release_pages(self, slot: int) -> None:
+    def _release_pages(self, slot: int, keep=()) -> None:
+        """Unmap the slot's table; slot-OWNED pages return to the free
+        list. Leading shared rows (``_slot_shared``) are tree property —
+        cleared from the table but never freed here; *keep* pages were
+        just DONATED to the tree by ``_publish_prefix`` (ownership moved,
+        not freed)."""
+        shared = self._slot_shared[slot]
         freed = set()  # ring tables alias: free each physical page once
         for lp in range(self.max_pages_per_slot):
             phys = int(self._table[slot, lp])
-            if phys >= 0 and phys not in freed:
+            if (phys >= 0 and phys not in freed and lp >= shared
+                    and phys not in keep):
                 self._free.append(phys)
                 freed.add(phys)
             self._table[slot, lp] = -1
+        self._slot_shared[slot] = 0
 
     # -- lifecycle hooks -----------------------------------------------------
 
@@ -503,13 +587,196 @@ class PagedDecodeServer(SlotServerBase):
 
     def _note_admitted(self, slot: int, prompt: List[int]) -> None:
         self._host_len[slot] = len(prompt) + 1
+        # prompt held for retirement-time PUBLICATION into the prefix
+        # tree; only set once the prefill COMPLETED (an aborted/parked
+        # prefill never reaches here, so its half-written pages are
+        # never published)
+        self._slot_prompt[slot] = list(prompt)
+        # reuse counters COMMIT here — once per completed admission, not
+        # per attempt (a pool-starved monolithic admission re-runs
+        # ``_prefill_start`` every step until it fits; counting attempts
+        # would inflate saved-token/hit-rate numbers with work that was
+        # never actually skipped)
+        pending = self._slot_pending_stats[slot]
+        if pending is not None:
+            matched, start = pending
+            if start > 0:
+                self._c_req_hit.inc()
+                self._c_hit_tokens.inc(matched)
+                self._c_saved_tokens.inc(start)
+            else:
+                self._c_req_miss.inc()
+            self._slot_pending_stats[slot] = None
 
     def _note_emitted(self, slot: int) -> None:
         self._host_len[slot] += 1
 
     def _on_retire(self, slot: int) -> None:
         self._host_len[slot] = 0
-        self._release_pages(slot)          # pages back to the pool NOW
+        published = self._publish_prefix(slot)
+        self._release_pages(slot, keep=published)  # rest back to the pool
+        if self._slot_pin[slot] is not None:
+            self._prefix_cache.release(self._slot_pin[slot])
+            self._slot_pin[slot] = None
+        self._slot_prompt[slot] = None
+        self._slot_pending_stats[slot] = None   # parked prefill: no commit
+
+    # -- shared-prefix KV reuse (Round-9) ------------------------------------
+
+    def _prefill_start(self, prompt: List[int], slot: int) -> int:
+        """Prefix-cache admission hook (base: 0): match the longest
+        cached full-page prefix, map its physical pages READ-ONLY as the
+        slot's leading table rows, pin the deepest matched node for the
+        slot's lifetime, and return the matched token count — prefill
+        starts there. The match is capped one token short of the prompt:
+        the last prompt token must be FORWARDED (not just cached) to
+        produce the logits that sample the first new token — its page, if
+        cached, is recomputed into a private page instead of written into
+        (the COW boundary rule)."""
+        if self._prefix_cache is None:
+            return 0
+        ps = self.page_size
+        matched, pages, node = self._prefix_cache.match(prompt)
+        start = min(matched, ((len(prompt) - 1) // ps) * ps)
+        if start <= 0:
+            self._slot_pending_stats[slot] = (matched, 0)
+            return 0
+        use = start // ps
+        self._table[slot, :use] = np.asarray(pages[:use], np.int32)
+        self._slot_shared[slot] = use
+        self._prefix_cache.pin(node)
+        self._slot_pin[slot] = node
+        self._slot_pending_stats[slot] = (matched, start)
+        return start
+
+    def _prefix_unmap(self, slot: int) -> None:
+        """Roll back a ``_prefill_start`` mapping after a FAILED
+        monolithic admission (nothing may stay mutated — the request
+        returns to the queue and the slot must read as empty)."""
+        self._release_pages(slot)   # shared rows cleared, nothing freed
+        if self._slot_pin[slot] is not None:
+            self._prefix_cache.release(self._slot_pin[slot])
+            self._slot_pin[slot] = None
+        self._slot_pending_stats[slot] = None
+
+    def _publish_prefix(self, slot: int):
+        """Donate the retiring slot's full prompt pages into the tree
+        (the pages already hold exactly the prompt's KV — publication is
+        pure host bookkeeping). Budget-bounded: evicts LRU unpinned
+        branches to make room, then truncates the donation to what fits.
+        Returns the set of donated physical pages (``_release_pages``
+        must not free them)."""
+        prompt = self._slot_prompt[slot]
+        if self._prefix_cache is None or not prompt:
+            return ()
+        ps = self.page_size
+        full = len(prompt) // ps
+        if full <= 0:
+            return ()
+        tokens = prompt[:full * ps]
+        pages = [int(self._table[slot, j]) for j in range(full)]
+        if any(p < 0 for p in pages):   # defensive: never publish holes
+            return ()
+        tree = self._prefix_cache
+        need = tree.missing_pages(tokens)
+        over = tree.total_pages + need - tree.max_pages
+        if over > 0:
+            reclaimed = tree.evict(over)
+            if reclaimed:
+                self._free.extend(reclaimed)
+                self._c_evicted.inc(len(reclaimed))
+        consumed = tree.insert(tokens, pages)
+        if consumed:
+            self._c_inserted.inc(len(consumed))
+        return consumed
+
+    def prefix_cache_stats(self) -> dict:
+        """Host-side reuse stats (0s when the cache is off): requests
+        hit/miss, hit rate, tokens matched/saved, tree pages/nodes,
+        evicted + inserted pages — the same numbers the obs registry
+        exports as ``kubetpu_prefix_*`` series."""
+        if self._prefix_cache is None:
+            return {"enabled": False}
+        hits = int(self._c_req_hit.value)
+        misses = int(self._c_req_miss.value)
+        total = hits + misses
+        return {
+            "enabled": True,
+            "requests_hit": hits,
+            "requests_miss": misses,
+            "hit_rate": (hits / total) if total else 0.0,
+            "hit_tokens": int(self._c_hit_tokens.value),
+            "prefill_tokens_saved": int(self._c_saved_tokens.value),
+            "tree_pages": self._prefix_cache.total_pages,
+            "tree_nodes": self._prefix_cache.n_nodes(),
+            "evicted_pages": int(self._c_evicted.value),
+            "inserted_pages": int(self._c_inserted.value),
+        }
+
+    def check_invariants(self) -> None:
+        """The pool accounting ORACLE (``Cluster.check_invariants``'s
+        serving sibling): every physical page is owned by exactly one of
+        {free list, a slot's private mapping, the prefix tree}; shared
+        table rows point only at tree-owned pages; node refcounts equal
+        the live pins; the tree's own structure checks out. AssertionError
+        on any violation — tests and the ``make prefix-check`` storm
+        assert it after every scenario."""
+        free = list(self._free)
+        free_set = set(free)
+        assert len(free) == len(free_set), "free list holds a page twice"
+        assert free_set <= set(range(self.pool_pages)), \
+            "free list holds an out-of-range page"
+        tree_pages = (self._prefix_cache.owned_pages()
+                      if self._prefix_cache is not None else set())
+        assert not (free_set & tree_pages), \
+            "page both free and tree-owned"
+        slot_owned = set()
+        for slot in range(self.n_slots):
+            shared = self._slot_shared[slot]
+            seen_ring = set()   # ring tables alias the same physical page
+            for lp in range(self.max_pages_per_slot):
+                phys = int(self._table[slot, lp])
+                if phys < 0:
+                    continue
+                if lp < shared:
+                    assert phys in tree_pages, (
+                        f"slot {slot} shared row {lp} -> page {phys} "
+                        f"not tree-owned")
+                    continue
+                if self._ring_pages:
+                    seen_ring.add(phys)
+                    continue
+                assert phys not in slot_owned, \
+                    f"page {phys} mapped privately by two slots"
+                assert phys not in tree_pages, (
+                    f"slot {slot} private row {lp} -> tree-owned "
+                    f"page {phys}")
+                assert phys not in free_set, \
+                    f"page {phys} both mapped and free"
+                slot_owned.add(phys)
+            slot_owned |= seen_ring
+        assert len(free_set) + len(slot_owned) + len(tree_pages) \
+            == self.pool_pages, (
+                f"pages leaked or double-owned: free {len(free_set)} + "
+                f"slots {len(slot_owned)} + tree {len(tree_pages)} != "
+                f"pool {self.pool_pages}")
+        if self._prefix_cache is not None:
+            self._prefix_cache.check()
+            pins: dict = {}
+            for slot in range(self.n_slots):
+                node = self._slot_pin[slot]
+                if node is not None:
+                    pins[id(node)] = pins.get(id(node), 0) + 1
+                    assert self._slot_shared[slot] > 0, (
+                        f"slot {slot} pins a node but maps no shared "
+                        f"pages")
+                else:
+                    assert self._slot_shared[slot] == 0, (
+                        f"slot {slot} maps shared pages without a pin")
+            for node in self._prefix_cache.nodes():
+                assert node.refcount == pins.get(id(node), 0), (
+                    f"node refcount {node.refcount} != "
+                    f"{pins.get(id(node), 0)} live pins")
 
     # -- device legs ---------------------------------------------------------
 
@@ -527,10 +794,19 @@ class PagedDecodeServer(SlotServerBase):
         return min(n, self.max_pages_per_slot)
 
     def _admit_device(self, prompt: List[int], slot: int):
-        """Whole-prompt prefill as one pos-0 final chunk — the chunk leg
-        owns the worst-case page reservation (its ``final`` branch) and
-        returns None on pool exhaustion with nothing mutated."""
-        return self._prefill_chunk_device(prompt, slot, 0, len(prompt), True)
+        """Whole-prompt prefill as one final chunk — starting at the
+        prefix-cache match (pos 0 on a miss); the chunk leg owns the
+        worst-case page reservation (its ``final`` branch) and returns
+        None on pool exhaustion. A failed admission unmaps the shared
+        prefix too: the request goes back to the queue and NOTHING may
+        stay mutated (the slot must read as empty for the next
+        occupant's ``_alloc_pages`` row count)."""
+        start = self._prefill_start(prompt, slot)
+        res = self._prefill_chunk_device(
+            prompt, slot, start, len(prompt) - start, True)
+        if res is None and start:
+            self._prefix_unmap(slot)
+        return res
 
     def _prefill_chunk_device(self, prompt: List[int], slot: int, pos: int,
                               take: int, final: bool):
@@ -627,7 +903,12 @@ class PagedDecodeServer(SlotServerBase):
     def warmup(self) -> None:
         """Pre-compile every prompt bucket + the step (serving.warmup's
         rationale). Only valid while NO request is active: the dummy
-        prefill scribbles on pool pages a live sequence may have mapped."""
+        prefill scribbles on pool pages a live sequence may have mapped —
+        including tree-owned ones, so the prefix cache is FLUSHED first
+        (idle server => nothing pinned; the pages return to the free
+        list and the tree repopulates from live traffic)."""
+        if self._prefix_cache is not None:
+            self._free.extend(self._prefix_cache.clear())
         d_temp, d_tk, d_tp = self._default_sampling
         row = np.full((self.max_pages_per_slot,), -1, np.int32)
         row[: self._pages_needed(self.max_seq)] = np.arange(

@@ -1,0 +1,345 @@
+"""Shared-prefix KV reuse: a host-side RADIX TREE over token-ID prefixes
+whose nodes own page-granular spans of the paged server's page pool.
+
+A fleet serving millions of users re-prefills the same system prompt /
+few-shot preamble on every admission — the dominant share of prefill
+FLOPs under mixed load, and the admission-stall tail BENCH_MODEL already
+shows. The page pool + host-owned page tables (``kubetpu.jobs.paged``)
+are exactly the substrate for cross-request sharing: KV at position ``p``
+depends only on ``tokens[0..p]`` and the params (causal attention, RoPE
+by absolute position), so two requests with the same token prefix compute
+bit-identical KV for it — one of them can simply *map* the other's pages.
+
+Design (the same "share hardware along the natural hierarchy" move the
+reference makes for topology groups, applied to pool pages):
+
+- the tree's unit of sharing is the PAGE: a node owns ``k`` physical pool
+  pages covering ``k * page_size`` token positions. Children are keyed by
+  their edge's FIRST PAGE of tokens (a ``page_size``-tuple), so sibling
+  edges never collide and splits only ever happen at page boundaries —
+  sub-page divergence is simply not shareable and never enters the tree;
+- ``match(tokens)`` walks greedily and returns the longest FULL-PAGE
+  cached prefix plus the deepest node, which the caller pins
+  (``refcount += 1``) for the lifetime of the slot that maps the pages.
+  Eviction only ever removes LEAF nodes with ``refcount == 0`` (LRU by a
+  logical clock), so a pinned node protects itself and every ancestor
+  (ancestors have children by construction) — mapped pages can never be
+  reclaimed under a live reader;
+- ``insert(tokens, pages)`` publishes a retiring slot's prompt KV by
+  DONATING the slot's physical pages to the tree (ownership transfer, no
+  device copy): the walk consumes existing coverage, splits a mid-node
+  divergence at the page boundary, and attaches the uncovered suffix as a
+  new branch. Pages the tree already covers are NOT consumed — the caller
+  returns them to the pool free-list;
+- the COPY-ON-WRITE rule is structural, not a runtime check: a slot maps
+  shared pages READ-ONLY as the leading prefix of its page table and
+  starts chunked prefill at ``pos = matched_tokens`` (page-aligned), so
+  every scatter the slot ever issues — prefill chunks and decode steps
+  alike — lands at page indices past the shared prefix, into pages the
+  slot allocated privately. The partially-covered boundary page (a prompt
+  whose last cached page would also hold the token that must be forwarded
+  to sample) is handled by RECOMPUTING it into a private page (the
+  "copy" is a deterministic re-prefill — bit-identical by the argument
+  above) rather than ever writing into a shared page.
+
+The tree is pure host bookkeeping: device code stays purely functional
+and the page table is still just a jit input, so greedy decode through a
+cache *hit* is token-exact vs a cold run (pinned by test, same
+discipline as the paged-vs-dense parity pin).
+
+Reference: the radix-tree prefix cache follows the public RadixAttention
+pattern (SGLang) re-shaped for this repo's host-owned tables; no
+inference stack exists in the reference (SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+class PrefixNode:
+    """One radix-tree node: a page-granular span of cached tokens.
+
+    ``tokens`` has length ``len(pages) * page_size``; ``pages`` are
+    physical pool page indices the node OWNS (the pool's accounting
+    oracle counts them as tree-owned). ``refcount`` counts live slots
+    pinning this node as their deepest match; ``stamp`` is the LRU
+    logical clock."""
+
+    __slots__ = ("tokens", "pages", "children", "parent", "refcount",
+                 "stamp")
+
+    def __init__(self, tokens: Tuple[int, ...], pages: List[int],
+                 parent: Optional["PrefixNode"]) -> None:
+        self.tokens = tokens
+        self.pages = list(pages)
+        self.children: Dict[Tuple[int, ...], "PrefixNode"] = {}
+        self.parent = parent
+        self.refcount = 0
+        self.stamp = 0
+
+
+class RadixPrefixCache:
+    """Radix tree of page-granular token prefixes over a shared page pool.
+
+    The tree never touches device memory — it trades in physical page
+    INDICES. Allocation/free of the underlying pages stays with the
+    paged server's free-list; the tree only records ownership while a
+    prefix is cached, and hands pages back via ``evict``/``clear``.
+
+    ``max_pages`` bounds the tree's total owned pages (the
+    ``prefix_cache_pages`` budget); ``insert`` refuses (truncates) past
+    it — the caller evicts first if it wants room.
+    """
+
+    def __init__(self, page_size: int, max_pages: int) -> None:
+        if page_size <= 0:
+            raise ValueError("page_size must be positive")
+        if max_pages <= 0:
+            raise ValueError("max_pages must be positive (0 pages = "
+                             "construct no cache at all)")
+        self.page_size = page_size
+        self.max_pages = max_pages
+        self.root = PrefixNode((), [], None)
+        self.total_pages = 0
+        self._clock = 0
+
+    # -- internal helpers ----------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _key(self, tokens: Sequence[int], i: int) -> Tuple[int, ...]:
+        return tuple(tokens[i:i + self.page_size])
+
+    @staticmethod
+    def _common(a: Sequence[int], b: Sequence[int]) -> int:
+        n = min(len(a), len(b))
+        i = 0
+        while i < n and a[i] == b[i]:
+            i += 1
+        return i
+
+    def _walk(self, tokens: Sequence[int], stamp: bool):
+        """The one greedy radix walk every operation shares — match,
+        missing_pages and insert must agree on exactly which full pages
+        of *tokens* the tree covers, or the budget math (plan with
+        ``missing_pages``, consume with ``insert``) desynchronizes.
+
+        Returns ``(node, i, pages, deepest, div_child, div_jp)``: the
+        last FULLY-traversed node, the covered token count ``i`` (page-
+        aligned), the physical pages covering ``tokens[:i]`` in order,
+        the deepest node touched (``None`` on a zero match), and — when
+        the walk stopped mid-child — that child plus how many of its
+        pages matched (``None, 0`` otherwise). ``stamp`` refreshes the
+        LRU clock on every node touched (a hit is a use)."""
+        ps = self.page_size
+        node = self.root
+        i = 0
+        pages: List[int] = []
+        deepest: Optional[PrefixNode] = None
+        while len(tokens) - i >= ps:
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            j = self._common(child.tokens, tokens[i:])
+            jp = j // ps
+            if jp == 0:  # defensive: keyed lookup guarantees jp >= 1
+                break
+            if stamp:
+                child.stamp = self._tick()
+            pages.extend(child.pages[:jp])
+            i += jp * ps
+            deepest = child
+            if j < len(child.tokens):
+                return node, i, pages, deepest, child, jp
+            node = child
+        return node, i, pages, deepest, None, 0
+
+    # -- queries -------------------------------------------------------------
+
+    def match(self, tokens: Sequence[int]):
+        """Longest cached full-page prefix of *tokens*.
+
+        Returns ``(matched_tokens, pages, node)`` where ``pages`` are the
+        physical pages covering ``tokens[:matched_tokens]`` in order and
+        ``node`` is the deepest node touched (``None`` on a zero match).
+        Does NOT pin — callers that map the pages must ``pin(node)``
+        before anything else can run. Every node on the path gets a fresh
+        LRU stamp (a hit is a use, even of the ancestors)."""
+        _, i, pages, deepest, _, _ = self._walk(tokens, stamp=True)
+        return i, pages, deepest
+
+    def missing_pages(self, tokens: Sequence[int]) -> int:
+        """How many NEW pages ``insert(tokens, ...)`` would need — the
+        budget/eviction planner's question. Read-only (no stamps)."""
+        _, i, _, _, _, _ = self._walk(tokens, stamp=False)
+        return (len(tokens) - i) // self.page_size
+
+    # -- pinning -------------------------------------------------------------
+
+    def pin(self, node: PrefixNode) -> None:
+        node.refcount += 1
+
+    def release(self, node: PrefixNode) -> None:
+        if node.refcount <= 0:
+            raise AssertionError("release without a matching pin")
+        node.refcount -= 1
+
+    # -- publication ---------------------------------------------------------
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> Set[int]:
+        """Publish ``tokens`` (full pages only: ``len(tokens)`` must be a
+        multiple of ``page_size`` and equal ``len(pages) * page_size``)
+        by donating the aligned physical *pages*.
+
+        Returns the set of page indices the tree CONSUMED (took
+        ownership of). Pages covering spans the tree already holds are
+        not consumed — the caller frees them. Consumption is clamped to
+        the remaining ``max_pages`` budget; the donated suffix is
+        truncated to a contiguous prefix of it, never fragmented."""
+        ps = self.page_size
+        if len(tokens) != len(pages) * ps:
+            raise ValueError("tokens must cover exactly len(pages) pages")
+        node, i, _, _, div_child, div_jp = self._walk(tokens, stamp=True)
+        if div_child is not None and len(tokens) - i >= ps:
+            # diverged mid-child with a full page still to attach: split
+            # at the page boundary so the shared span becomes its own
+            # node and the new branch can attach beside the old suffix
+            node = self._split(div_child, div_jp)
+        remaining = (len(tokens) - i) // ps
+        budget_room = self.max_pages - self.total_pages
+        remaining = min(remaining, max(0, budget_room))
+        if remaining <= 0:
+            return set()
+        new_tokens = tuple(tokens[i:i + remaining * ps])
+        new_pages = list(pages[i // ps: i // ps + remaining])
+        leaf = PrefixNode(new_tokens, new_pages, node)
+        leaf.stamp = self._tick()
+        node.children[self._key(new_tokens, 0)] = leaf
+        self.total_pages += remaining
+        return set(new_pages)
+
+    def _split(self, child: PrefixNode, jp: int) -> PrefixNode:
+        """Split *child* at page *jp* into (prefix mid, suffix child);
+        returns the new mid node. Pure bookkeeping — no page moves, and
+        the child keeps its identity so existing pins stay valid (a pin
+        on the suffix protects the mid transitively: mid has a child)."""
+        ps = self.page_size
+        assert 0 < jp * ps < len(child.tokens)
+        parent = child.parent
+        mid = PrefixNode(child.tokens[:jp * ps], child.pages[:jp], parent)
+        mid.stamp = child.stamp
+        suffix_tokens = child.tokens[jp * ps:]
+        child.tokens = suffix_tokens
+        child.pages = child.pages[jp:]
+        child.parent = mid
+        mid.children[self._key(suffix_tokens, 0)] = child
+        parent.children[self._key(mid.tokens, 0)] = mid
+        return mid
+
+    # -- eviction ------------------------------------------------------------
+
+    def evict(self, n_pages: int) -> List[int]:
+        """Reclaim >= *n_pages* pages by removing LRU refcount-0 LEAF
+        nodes (oldest stamp first; removing a leaf can expose its parent
+        as the next candidate). Returns the freed physical pages — the
+        caller appends them to the pool free-list. May return fewer than
+        asked when everything left is pinned or an ancestor of a pin.
+
+        One DFS to seed the candidate heap, then O(log n) per victim —
+        this runs on the admission path under pool pressure, where a
+        per-victim full-tree rescan would stack host latency onto an
+        already-stalling TTFT. Only a victim's parent can become newly
+        evictable (nothing else changes), so it alone is re-examined."""
+        heap: List[Tuple[int, int, PrefixNode]] = []
+        seq = 0                      # tie-break: never compare nodes
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            if not n.children and n.refcount == 0:
+                heap.append((n.stamp, seq, n))
+                seq += 1
+            stack.extend(n.children.values())
+        heapq.heapify(heap)
+        freed: List[int] = []
+        while len(freed) < n_pages and heap:
+            _, _, victim = heapq.heappop(heap)
+            freed.extend(victim.pages)
+            self.total_pages -= len(victim.pages)
+            parent = victim.parent
+            del parent.children[self._key(victim.tokens, 0)]
+            victim.parent = None
+            if (parent is not self.root and not parent.children
+                    and parent.refcount == 0):
+                heapq.heappush(heap, (parent.stamp, seq, parent))
+                seq += 1
+        return freed
+
+    def clear(self) -> List[int]:
+        """Drop the whole tree, returning every owned page. Only valid
+        when nothing is pinned (asserted) — the paged server calls this
+        from ``warmup``, whose contract already requires an idle server."""
+        pages: List[int] = []
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            assert n.refcount == 0, "clear() under a live pin"
+            pages.extend(n.pages)
+            stack.extend(n.children.values())
+        self.root.children.clear()
+        self.total_pages = 0
+        return pages
+
+    # -- introspection / the accounting oracle -------------------------------
+
+    def nodes(self) -> List[PrefixNode]:
+        out: List[PrefixNode] = []
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            out.append(n)
+            stack.extend(n.children.values())
+        return out
+
+    def n_nodes(self) -> int:
+        return len(self.nodes())
+
+    def owned_pages(self) -> Set[int]:
+        pages: List[int] = []
+        for n in self.nodes():
+            pages.extend(n.pages)
+        owned = set(pages)
+        assert len(owned) == len(pages), "tree owns a page twice"
+        return owned
+
+    def check(self) -> None:
+        """Structural invariants: span lengths page-exact, child keys
+        consistent, page ownership disjoint, total_pages exact, and no
+        negative refcounts. AssertionError on violation — the pool
+        oracle's tree half."""
+        ps = self.page_size
+        total = 0
+        seen: Set[int] = set()
+        stack = [(self.root, True)]
+        while stack:
+            n, is_root = stack.pop()
+            if not is_root:
+                assert len(n.tokens) == len(n.pages) * ps, (
+                    f"node span {len(n.tokens)} tokens != "
+                    f"{len(n.pages)} pages * {ps}")
+                assert len(n.tokens) >= ps, "empty non-root node"
+                assert n.refcount >= 0, "negative refcount"
+                for p in n.pages:
+                    assert p not in seen, f"page {p} owned twice"
+                    seen.add(p)
+                total += len(n.pages)
+            for key, child in n.children.items():
+                assert key == tuple(child.tokens[:ps]), "mis-keyed child"
+                assert child.parent is n, "broken parent link"
+                stack.append((child, False))
+        assert total == self.total_pages, (
+            f"total_pages {self.total_pages} != counted {total}")
+        assert total <= self.max_pages, "tree exceeds its page budget"

@@ -609,17 +609,21 @@ class SlotServerBase:
 
     def _begin_prefill(self, rid: int, prompt: List[int], slot: int,
                        deadline: Optional[float] = None) -> None:
-        """Occupy *slot* with a chunked prefill at progress 0. Device
-        resources are claimed chunk by chunk in ``_advance_prefill``. Once
-        chunks start the TTL no longer applies (device work is under way);
-        *deadline* is kept only so deadlock PARKING can re-queue the
-        request without resetting its clock."""
+        """Occupy *slot* with a chunked prefill. Device resources are
+        claimed chunk by chunk in ``_advance_prefill``. Progress starts at
+        ``_prefill_start`` — 0 unless a subclass can reuse cached work
+        (the paged server's prefix-cache hit maps shared pages and skips
+        straight to the first uncached token). Once chunks start the TTL
+        no longer applies (device work is under way); *deadline* is kept
+        only so deadlock PARKING can re-queue the request without
+        resetting its clock."""
         self._bind_slot(rid, slot)
         self._record_queue_wait(rid, time.perf_counter())
         self._slot_rid[slot] = rid        # cancel() finds mid-prefills
         self._done[rid] = False
         self._prefills[slot] = {
-            "rid": rid, "prompt": list(prompt), "done": 0, "t": 0.0,
+            "rid": rid, "prompt": list(prompt),
+            "done": self._prefill_start(prompt, slot), "t": 0.0,
             "deadline": deadline,
         }
         self._prefill_fifo.append(slot)
@@ -743,6 +747,16 @@ class SlotServerBase:
         return False
 
     # hooks ------------------------------------------------------------------
+
+    def _prefill_start(self, prompt: List[int], slot: int) -> int:
+        """Position prefill should START at for a fresh admission into
+        *slot* — 0 unless a subclass already holds the prefix's KV (the
+        paged server's prefix cache maps shared pool pages read-only and
+        returns the matched, page-aligned token count). Called once per
+        admission attempt, after ``_bind_slot``, before any device leg.
+        An implementation that maps resources here must release them in
+        ``_on_retire`` (retire/abort both route through it)."""
+        return 0
 
     def _note_admitted(self, slot: int, prompt: List[int]) -> None:
         pass

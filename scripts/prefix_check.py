@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""``make prefix-check`` — the shared-prefix KV reuse oracle.
+
+Runs a short shared-system-prompt storm through the paged server on the
+CPU backend and fails (exit 1) on:
+
+- PARITY: greedy tokens through prefix-cache HITS differing from the
+  cold (reuse-off) server on any request — the bit-exactness contract
+  the device path promises (the table is just a jit input);
+- the POOL ACCOUNTING ORACLE (``PagedDecodeServer.check_invariants``)
+  after every drain: free + slot-owned + tree-owned pages must equal the
+  pool, shared mappings must point at tree-owned pages, refcounts must
+  match live pins;
+- REUSE not actually engaging (zero hits / zero tokens saved would make
+  the parity check vacuous);
+- leftover pins or a tree past its budget after the storm retires.
+
+Runs in under a minute with no accelerator; wired into ``make chaos`` so
+every fault-injection run also proves prefix sharing doesn't corrupt the
+pool.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+BUDGET = 8
+
+
+def fail(msg: str) -> None:
+    print(f"prefix-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def storm_prompts():
+    """Three shared-prefix families x tails + one loner: exercises hits,
+    misses, branch splits and (with BUDGET=8 pages) LRU eviction."""
+    fams = []
+    for seed in (5, 7, 11):
+        fams.append([(i * seed) % 60 + 1 for i in range(2 * PS)])
+    prompts = []
+    for f, fam in enumerate(fams):
+        for tail in range(3):
+            prompts.append(fam + [f * 10 + tail + 1])
+    prompts.append([63] * 3)   # sub-page loner: never cacheable
+    return prompts
+
+
+def run(server, prompts, check=False):
+    outs = []
+    for wave in (prompts[: len(prompts) // 2], prompts[len(prompts) // 2:]):
+        rids = [server.enqueue(p) for p in wave]
+        server.drain()
+        outs.extend(server.pop_result(r) for r in rids)
+        if check:
+            server.check_invariants()
+    return outs
+
+
+def main() -> int:
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = storm_prompts()
+
+    cold = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=6, page_size=PS,
+                             prefill_budget=PS)
+    ref = run(cold, prompts)
+
+    warm = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=6, page_size=PS,
+                             prefill_budget=PS,
+                             prefix_cache_pages=BUDGET)
+    try:
+        got = run(warm, prompts, check=True)
+    except AssertionError as e:
+        fail(f"pool oracle violated mid-storm: {e}")
+
+    if got != ref:
+        bad = [i for i, (g, r) in enumerate(zip(got, ref)) if g != r]
+        fail(f"parity: requests {bad} diverged through prefix-cache hits")
+
+    stats = warm.prefix_cache_stats()
+    if stats["requests_hit"] == 0 or stats["prefill_tokens_saved"] == 0:
+        fail(f"reuse never engaged: {stats}")
+    if warm._prefix_cache.total_pages > BUDGET:
+        fail(f"tree past its budget: {warm._prefix_cache.total_pages}")
+    if any(n.refcount for n in warm._prefix_cache.nodes()):
+        fail("leaked pins after the storm retired")
+    try:
+        warm.check_invariants()
+    except AssertionError as e:
+        fail(f"pool oracle violated after the storm: {e}")
+
+    print(f"prefix-check: OK — {len(prompts)} requests, "
+          f"hits {stats['requests_hit']}, "
+          f"saved {stats['prefill_tokens_saved']} prefill tokens, "
+          f"evicted {stats['evicted_pages']} pages, oracle clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

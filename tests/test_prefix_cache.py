@@ -1,0 +1,352 @@
+"""Shared-prefix KV reuse (Round-9): the radix tree's structural
+contracts, token-EXACT greedy parity through a prefix-cache hit vs the
+cold path (f32 and kv_int8 pools), the structural copy-on-write rule
+(shared pages are never written), LRU eviction under budget pressure,
+and the pool accounting oracle after every storm."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.jobs.prefix_cache import RadixPrefixCache
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _sys_prompt(n, seed=5):
+    return [(i * seed) % (CFG.vocab - 4) + 1 for i in range(n)]
+
+
+# -- radix tree unit contracts ------------------------------------------------
+
+
+def test_tree_match_insert_roundtrip():
+    t = RadixPrefixCache(page_size=4, max_pages=16)
+    toks = list(range(1, 13))                    # 3 full pages
+    consumed = t.insert(toks, [10, 11, 12])
+    assert consumed == {10, 11, 12}
+    assert t.total_pages == 3
+    m, pages, node = t.match(toks + [99, 98])    # longer query, same prefix
+    assert m == 12 and pages == [10, 11, 12] and node is not None
+    # partial-page tail is not matchable
+    m, pages, _ = t.match(toks[:6])
+    assert m == 4 and pages == [10]
+    t.check()
+
+
+def test_tree_split_on_mid_node_divergence():
+    t = RadixPrefixCache(page_size=2, max_pages=16)
+    t.insert([1, 2, 3, 4, 5, 6], [7, 8, 9])
+    # diverges after page 1 (tokens [1,2]): the node must split at the
+    # page boundary and both branches stay matchable
+    consumed = t.insert([1, 2, 30, 40], [7, 5])
+    assert consumed == {5}                       # page [1,2] already owned
+    assert t.total_pages == 4
+    m, pages, _ = t.match([1, 2, 3, 4, 5, 6])
+    assert m == 6 and pages == [7, 8, 9]
+    m, pages, _ = t.match([1, 2, 30, 40])
+    assert m == 4 and pages == [7, 5]
+    assert t.n_nodes() == 3                      # shared page + two suffixes
+    t.check()
+
+
+def test_tree_insert_respects_budget():
+    t = RadixPrefixCache(page_size=2, max_pages=2)
+    consumed = t.insert([1, 2, 3, 4, 5, 6], [7, 8, 9])
+    assert consumed == {7, 8}                    # truncated to the budget
+    assert t.total_pages == 2
+    t.check()
+
+
+def test_tree_lru_eviction_order_and_pin_protection():
+    t = RadixPrefixCache(page_size=2, max_pages=16)
+    t.insert([1, 2], [0])
+    t.insert([3, 4], [1])
+    t.insert([5, 6], [2])
+    # touch branch [1,2]: it becomes most-recent; [3,4] is now LRU
+    _, _, node12 = t.match([1, 2])
+    t.pin(node12)
+    freed = t.evict(1)
+    assert freed == [1]                          # LRU unpinned leaf first
+    freed = t.evict(2)
+    assert freed == [2]                          # pinned [1,2] survives
+    assert t.total_pages == 1
+    t.release(node12)
+    assert t.evict(1) == [0]
+    t.check()
+
+
+def test_tree_evict_walks_up_freed_branches():
+    t = RadixPrefixCache(page_size=2, max_pages=16)
+    t.insert([1, 2, 3, 4], [0, 1])
+    t.insert([1, 2, 5, 6], [0, 2])               # splits: [1,2] -> two leaves
+    assert t.n_nodes() == 3
+    freed = t.evict(3)
+    # leaves evict first, which exposes the shared parent as a leaf
+    assert set(freed) == {0, 1, 2}
+    assert t.total_pages == 0 and t.n_nodes() == 0
+    t.check()
+
+
+def test_tree_clear_returns_everything():
+    t = RadixPrefixCache(page_size=2, max_pages=16)
+    t.insert([1, 2, 3, 4], [4, 5])
+    t.insert([9, 8], [6])
+    assert sorted(t.clear()) == [4, 5, 6]
+    assert t.total_pages == 0
+    m, pages, node = t.match([1, 2, 3, 4])
+    assert m == 0 and pages == [] and node is None
+
+
+# -- server integration: parity, COW, accounting ------------------------------
+
+
+def _run_seq(server, prompts):
+    outs = []
+    for p in prompts:
+        rid = server.submit(p)
+        assert rid is not None
+        server.drain()
+        outs.append(server.result(rid))
+    return outs
+
+
+def test_hit_parity_exact_f32(params):
+    """Greedy decode through a prefix-cache HIT is token-exact vs the
+    cold path — monolithic and chunked admission."""
+    sys = _sys_prompt(20)
+    prompts = [sys + t for t in ([7, 8], [9, 3, 1], [11], [9, 3, 2])]
+    cold = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=8, page_size=PS)
+    ref = _run_seq(cold, prompts)
+
+    warm = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=8, page_size=PS,
+                             prefix_cache_pages=16)
+    assert _run_seq(warm, prompts) == ref
+    warm.check_invariants()
+    stats = warm.prefix_cache_stats()
+    assert stats["requests_hit"] >= len(prompts) - 1
+    assert stats["prefill_tokens_saved"] >= (len(prompts) - 1) * 16
+
+    chunked = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                                max_new_tokens=8, page_size=PS,
+                                prefill_budget=PS, prefix_cache_pages=16)
+    rids = [chunked.enqueue(p) for p in prompts]
+    chunked.drain()
+    assert [chunked.result(r) for r in rids] == ref
+    chunked.check_invariants()
+    assert chunked.prefix_cache_stats()["requests_hit"] >= 1
+
+
+def test_hit_parity_exact_kv_int8(params):
+    """The same exactness through the int8 pool: the hit path reads the
+    publisher's quantized pages, the cold path re-quantizes identical
+    values — bit-identical either way."""
+    sys = _sys_prompt(20, seed=7)
+    prompts = [sys + t for t in ([3, 4, 5], [6], [2, 9])]
+    cold = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=8, page_size=PS, kv_int8=True)
+    ref = _run_seq(cold, prompts)
+    warm = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=8, page_size=PS, kv_int8=True,
+                             prefix_cache_pages=16)
+    assert _run_seq(warm, prompts) == ref
+    warm.check_invariants()
+    assert warm.prefix_cache_stats()["requests_hit"] >= 2
+
+
+def test_cow_boundary_page_never_written(params):
+    """A prompt FULLY covered by the cache still re-prefills its final
+    page into a private page (the last token must be forwarded to sample)
+    — and the shared pages' bytes are untouched by the whole second
+    request (the structural copy-on-write pin)."""
+    ps = PS
+    prompt = _sys_prompt(3 * ps)          # exactly 3 full pages
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                               max_new_tokens=6, page_size=ps,
+                               prefix_cache_pages=16)
+    r0 = server.submit(prompt)
+    server.drain()
+    ref = server.result(r0)
+    server.check_invariants()
+    tree_pages = sorted(server._prefix_cache.owned_pages())
+    assert len(tree_pages) == 3           # the whole prompt is published
+    before = np.asarray(server.k_pages)[:, tree_pages].copy()
+
+    r1 = server.submit(prompt)            # full-coverage hit
+    # capped one page short: pages 0-1 mapped shared, page 2 recomputed
+    assert max(server._slot_shared) == 2
+    server.drain()
+    assert server.result(r1) == ref       # token-exact with itself
+    server.check_invariants()
+    after = np.asarray(server.k_pages)[:, tree_pages]
+    np.testing.assert_array_equal(before, after)
+    stats = server.prefix_cache_stats()
+    assert stats["requests_hit"] == 1
+    # matched all 3 pages, mapped only 2 (the COW cap)
+    assert stats["hit_tokens"] == 3 * ps
+    assert stats["prefill_tokens_saved"] == 2 * ps
+
+
+def test_concurrent_slots_share_pages(params):
+    """Two live slots mapping the SAME shared pages simultaneously:
+    tokens match the cold run, refcounts track both pins, and the pages
+    survive until the last reader retires."""
+    sys = _sys_prompt(2 * PS)
+    pa, pb = sys + [5, 6, 7], sys + [9, 1]
+    cold = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=8, page_size=PS)
+    ca = cold.submit(pa)
+    cold.drain()
+    cb = cold.submit(pb)
+    cold.drain()
+    ref = [cold.result(ca), cold.result(cb)]
+
+    warm = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=8, page_size=PS,
+                             prefix_cache_pages=16)
+    seed = warm.submit(sys + [2])         # publish the prefix
+    warm.drain()
+    ra, rb = warm.submit(pa), warm.submit(pb)   # both map the shared pages
+    pinned = [n for n in warm._prefix_cache.nodes() if n.refcount]
+    assert pinned and sum(n.refcount for n in pinned) == 2
+    warm.check_invariants()               # oracle holds MID-FLIGHT too
+    warm.drain()
+    assert [warm.result(ra), warm.result(rb)] == ref
+    warm.check_invariants()
+    assert all(n.refcount == 0 for n in warm._prefix_cache.nodes())
+    warm.pop_result(seed)
+
+
+# -- eviction under pressure (satellite) --------------------------------------
+
+
+def test_eviction_under_budget_pressure_lru_and_no_leaks(params):
+    """Storm DISTINCT prompts past ``prefix_cache_pages``: the tree stays
+    within budget, evicts in LRU order, leaks no refcounts, and the pool
+    oracle holds after every retirement."""
+    budget = 4
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                               max_new_tokens=4, page_size=PS,
+                               n_pages=24, prefix_cache_pages=budget)
+    prompts = [_sys_prompt(2 * PS, seed=s) + [s] for s in (3, 7, 11, 13, 17)]
+    for p in prompts:
+        rid = server.submit(p)
+        server.drain()
+        server.pop_result(rid)
+        server.check_invariants()
+        assert server._prefix_cache.total_pages <= budget
+        assert all(n.refcount == 0 for n in server._prefix_cache.nodes())
+    # the LAST storm prompts must be resident (LRU evicted the oldest)
+    m, _, _ = server._prefix_cache.match(prompts[-1])
+    assert m == 2 * PS
+    m0, _, _ = server._prefix_cache.match(prompts[0])
+    assert m0 == 0
+    assert server.prefix_cache_stats()["evicted_pages"] > 0
+
+
+def test_admission_reclaims_tree_pages_instead_of_deadlocking(params):
+    """A pool sized so a request CANNOT be admitted while the tree holds
+    its budget: admission must evict reclaimable tree pages and proceed —
+    never park forever behind the cache's own hoard."""
+    ps = PS
+    # pool 8 pages; worst case for a 17-token prompt + 8 new = 26 tokens
+    # = 4 pages; tree budget 6 — after one request publishes 2 pages and
+    # a second DISTINCT branch publishes 2 more, free pages (4) cannot
+    # cover a fresh worst case alone once a third branch lands
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                               max_new_tokens=8, page_size=ps,
+                               n_pages=8, prefix_cache_pages=6)
+    outs = []
+    for s in (3, 7, 11, 13):
+        p = _sys_prompt(2 * ps, seed=s) + [s]
+        rid = server.submit(p)
+        assert rid is not None, "admission parked behind reclaimable pages"
+        server.drain()
+        outs.append(server.pop_result(rid))
+        server.check_invariants()
+    # the queue path reclaims too
+    rid = server.enqueue(_sys_prompt(2 * ps, seed=19) + [1])
+    server.drain()
+    assert server.finished(rid)
+    server.check_invariants()
+
+
+def test_warmup_flushes_tree_and_serving_continues(params):
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=32,
+                               max_new_tokens=3, page_size=PS,
+                               prefix_cache_pages=8)
+    rid = server.submit(_sys_prompt(PS) + [2, 3])
+    server.drain()
+    server.pop_result(rid)
+    assert server._prefix_cache.total_pages > 0
+    server.warmup()                       # idle: flush + precompile
+    assert server._prefix_cache.total_pages == 0
+    server.check_invariants()
+    rid = server.submit(_sys_prompt(PS) + [2, 3])
+    server.drain()
+    assert server.finished(rid)
+    server.check_invariants()
+
+
+def test_overlap_composes_with_prefix_reuse(params):
+    """overlap=True (emission lags one step; retirement — and therefore
+    PUBLICATION — happens while a dispatched step is still in flight):
+    the stray in-flight write for a retiring slot lands past its prompt
+    pages, so donated pages stay clean — tokens must still match the
+    cold path exactly."""
+    sys = _sys_prompt(2 * PS, seed=9)
+    prompts = [sys + [t] for t in (5, 6, 7, 8)]
+    cold = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=6, page_size=PS)
+    ref = _run_seq(cold, prompts)
+    warm = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=6, page_size=PS,
+                             prefill_budget=PS, overlap=True,
+                             prefix_cache_pages=16)
+    rids = [warm.enqueue(p) for p in prompts]
+    warm.drain()
+    assert [warm.result(r) for r in rids] == ref
+    warm.check_invariants()
+    assert warm.prefix_cache_stats()["requests_hit"] >= 1
+
+
+def test_prefix_cache_refuses_windowed_configs(params):
+    import dataclasses
+
+    wcfg = dataclasses.replace(CFG, window=8)
+    with pytest.raises(ValueError, match="window"):
+        PagedDecodeServer(wcfg, params, n_slots=2, max_seq=64,
+                          max_new_tokens=8, page_size=PS,
+                          prefix_cache_pages=8)
+
+
+def test_metrics_exposed_on_serving_registry(params):
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                               max_new_tokens=4, page_size=PS,
+                               prefix_cache_pages=8)
+    sys = _sys_prompt(2 * PS)
+    for tail in ([1], [2], [3]):
+        rid = server.submit(sys + tail)
+        server.drain()
+        server.pop_result(rid)
+    text = server.metrics_text()
+    for series in ("kubetpu_prefix_hit_tokens_total",
+                   "kubetpu_prefill_tokens_saved_total",
+                   'kubetpu_prefix_requests_total{result="hit"}',
+                   'kubetpu_prefix_requests_total{result="miss"}',
+                   "kubetpu_prefix_tree_pages",
+                   "kubetpu_prefix_evicted_pages_total"):
+        assert series in text, f"missing {series}"
+    from kubetpu.obs.registry import validate_prometheus_text
+
+    assert validate_prometheus_text(text) == []
