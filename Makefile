@@ -36,10 +36,19 @@ test: tpuinfo gpuinfo dataio
 # also runs in tier-1; this target adds the slow 30% one). obs-check runs
 # first (a chaos run whose faults are invisible proves nothing), then
 # prefix-check (a chaos run over a pool the prefix tree corrupted proves
-# the wrong thing).
+# the wrong thing), then spec-check (speculative rounds must be invisible
+# in the output stream before chaos means anything).
 .PHONY: chaos
-chaos: obs-check prefix-check
+chaos: obs-check prefix-check spec-check
 	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+
+# paged speculative-decoding oracle: greedy parity of draft+verify rounds
+# vs plain paged decode (monolithic + chunked + prefix-hit, f32 + int8),
+# the pool accounting invariant after every drain, adaptive-gamma
+# convergence, and the self-draft tokens/round ceiling
+.PHONY: spec-check
+spec-check:
+	python scripts/spec_check.py
 
 # shared-prefix KV reuse oracle: cold-vs-warm token parity through
 # prefix-cache hits on a short shared-system-prompt storm, plus the pool

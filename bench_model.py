@@ -304,30 +304,29 @@ def speculative_throughput(cfg, batch, prompt_len, gen_steps, gamma,
     }
 
 
-def speculative_trained_pair(prompt_len, gen_steps, gamma, small=False):
-    """The number that decides whether speculation is a CAPABILITY: a
-    TRAINED draft/target pair (target trained on the skewed synthetic
-    corpus, draft distilled against it — tests/test_distill.py's recipe at
-    bench scale) measured against PLAIN greedy decode of the SAME target.
-    Reports tokens/s for both, the ratio, and the realized tokens/round.
-    Training cost is bounded (a few hundred small-model steps) and runs
-    on-device; the speedup claim is apples-to-apples because both paths
-    decode the identical trained target."""
+_TRAINED_PAIR_CACHE: dict = {}
+
+
+def _train_spec_pair(small: bool):
+    """A TRAINED draft/target pair: target trained on the skewed
+    synthetic corpus, draft distilled against it (tests/test_distill.py's
+    recipe at bench scale). Returns ``(tcfg, dcfg, t_params, d_params,
+    data, agreement)`` — memoized per size so a full bench run training
+    the pair for the spec section doesn't retrain it for the serving
+    storm. Training cost is bounded (a few hundred small-model steps) and
+    runs on-device."""
     import dataclasses
 
-    from kubetpu.jobs import init_state, make_mesh, make_train_step
+    from kubetpu.jobs import ModelConfig, init_state, make_mesh, make_train_step
     from kubetpu.jobs.data import SyntheticCorpus
-    from kubetpu.jobs.decode import make_generate
     from kubetpu.jobs.distill import (
         agreement_rate,
         init_draft_state,
         make_distill_step,
     )
-    from kubetpu.jobs.profiling import marginal_ms
-    from kubetpu.jobs.speculative import make_speculative_generate
 
-    from kubetpu.jobs import ModelConfig
-
+    if small in _TRAINED_PAIR_CACHE:
+        return _TRAINED_PAIR_CACHE[small]
     if small:  # CPU smoke: same recipe, toy sizes
         tcfg = ModelConfig(vocab=64, d_model=64, n_layers=2, n_heads=4,
                            d_ff=128, max_seq=256)
@@ -359,8 +358,30 @@ def speculative_trained_pair(prompt_len, gen_steps, gamma, small=False):
     for i in range(d_steps):
         tokens, targets = data[i % len(data)]
         dstate, _dl = dstep(dstate, state.params, tokens, targets)
-    t_params, d_params = state.params, dstate.params
-    agree = agreement_rate(tcfg, dcfg, t_params, d_params, held_out[0])
+    agree = agreement_rate(tcfg, dcfg, state.params, dstate.params,
+                           held_out[0])
+    # strip the training mesh's committed shardings: serving-side jits
+    # would otherwise recompile every leg once more at serve time (the
+    # warmed entries were keyed on differently-committed pool inputs)
+    unshard = lambda p: jax.tree.map(  # noqa: E731 — local one-liner
+        lambda x: jax.device_put(jax.device_get(x)), p)
+    out = (tcfg, dcfg, unshard(state.params), unshard(dstate.params),
+           data, agree)
+    _TRAINED_PAIR_CACHE[small] = out
+    return out
+
+
+def speculative_trained_pair(prompt_len, gen_steps, gamma, small=False):
+    """The number that decides whether speculation is a CAPABILITY: a
+    TRAINED draft/target pair (``_train_spec_pair``) measured against
+    PLAIN greedy decode of the SAME target. Reports tokens/s for both,
+    the ratio, and the realized tokens/round — apples-to-apples because
+    both paths decode the identical trained target."""
+    from kubetpu.jobs.decode import make_generate
+    from kubetpu.jobs.profiling import marginal_ms
+    from kubetpu.jobs.speculative import make_speculative_generate
+
+    tcfg, dcfg, t_params, d_params, data, agree = _train_spec_pair(small)
 
     batch = 4
     prompt = jnp.asarray(data[0][0][:batch, :prompt_len])
@@ -729,6 +750,81 @@ def spec_serving_throughput(cfg, n_slots, prompt_len, rounds):
     }
 
 
+def speculative_paged_storm(n_slots=4, long_len=48, short_len=12, n_shorts=3,
+                            rounds=3, max_new=24, gamma_max=4, page_size=16,
+                            small=False):
+    """Round-10 headline: speculative decoding over the paged pool vs
+    plain paged decode, under the mixed-load storm (each wave enqueues a
+    long prompt with shorts right behind it), with a TRAINED draft
+    (``_train_spec_pair`` — the well-agreeing pair; storm prompts come
+    from the same corpus so decode-time agreement holds). Both arms run
+    the identical trained target through the identical pool; the
+    speculative arm adds draft+verify rounds with adaptive gamma.
+    Reports decode tok/s, TTFT p50 (server-recorded Round-8 histogram),
+    realized tokens/round and the device acceptance rate — the
+    rounds-not-tokens win, measured on the production serving path."""
+    import time as _time
+
+    from kubetpu.jobs.paged import PagedDecodeServer
+    from kubetpu.jobs.spec_serving import PagedSpeculativeDecodeServer
+
+    tcfg, dcfg, t_params, d_params, data, agree = _train_spec_pair(small)
+    rows = [[int(t) for t in data[i % len(data)][0][i % 4]]
+            for i in range(rounds * (1 + n_shorts))]
+    prompts = []
+    for r in range(rounds):
+        wave = rows[r * (1 + n_shorts):(r + 1) * (1 + n_shorts)]
+        prompts.append([wave[0][:long_len]]
+                       + [w[:short_len] for w in wave[1:]])
+    # page-aligned max_seq (the paged warmup's bucket grid requires it)
+    max_seq = -(-(long_len + max_new + gamma_max + 2) // page_size) * page_size
+    n_pages = n_slots * ((max_seq + gamma_max + page_size - 1) // page_size)
+
+    def run(server, spec):
+        server.warmup()
+        rid_prompt = []
+        t0 = _time.perf_counter()
+        for wave in prompts:
+            for p in wave:
+                rid_prompt.append((server.enqueue(p), p))
+            server.drain()
+        dt = _time.perf_counter() - t0
+        emitted = sum(len(server.result(rid)) - len(p)
+                      for rid, p in rid_prompt)
+        stats = server.metrics_summary()
+        row = {
+            "metric": "speculative_paged_storm",
+            "variant": "speculative" if spec else "plain",
+            "value": round(emitted / dt, 1),
+            "unit": "decode tokens/s",
+            "ttft_p50_ms": round(stats["ttft"]["p50_ms"], 3),
+            "requests": len(rid_prompt),
+            "tokens_emitted": emitted,
+            "n_slots": n_slots,
+            "gamma_max": gamma_max,
+            "teacher_forced_agreement": round(agree, 3),
+        }
+        if spec:
+            proposed = server._c_spec_proposed.value
+            row["tokens_per_round"] = round(server.mean_tokens_per_round(), 2)
+            row["acceptance_rate"] = round(
+                server._c_spec_accepted.value / proposed, 3) if proposed else 0.0
+            server.check_invariants()    # the pool oracle rides the bench
+        return row
+
+    plain = run(PagedDecodeServer(
+        tcfg, t_params, n_slots=n_slots, max_seq=max_seq,
+        max_new_tokens=max_new, page_size=page_size, n_pages=n_pages,
+    ), spec=False)
+    spec = run(PagedSpeculativeDecodeServer(
+        tcfg, dcfg, t_params, d_params, n_slots=n_slots, max_seq=max_seq,
+        max_new_tokens=max_new, page_size=page_size, n_pages=n_pages,
+        gamma_max=gamma_max,
+    ), spec=True)
+    spec["speedup_vs_plain"] = round(spec["value"] / plain["value"], 2)
+    return plain, spec
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -880,6 +976,16 @@ def main() -> int:
         emit(spec_serving_throughput(cfg, n_slots=2 if args.smoke else 4,
                                      prompt_len=16 if args.smoke else 128,
                                      rounds=10 if args.smoke else 40))
+        # Round-10: speculation over the paged pool with a trained draft
+        # (prompt lengths bounded by the trained corpus' seq=64 rows)
+        for row in speculative_paged_storm(
+                n_slots=2 if args.smoke else 4,
+                long_len=48 if args.smoke else 64,
+                short_len=12 if args.smoke else 16,
+                max_new=16 if args.smoke else 32,
+                gamma_max=4, page_size=16,
+                small=args.smoke):
+            emit(row)
     return 0
 
 

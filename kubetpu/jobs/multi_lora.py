@@ -122,6 +122,7 @@ class MultiLoraDecodeServer(DecodeServer):
                 0 if self._submit_adapter is None else self._submit_adapter
             )
         self._slot_adapter[slot] = self._rid_adapter[rid]
+        self._invalidate_dev("adapter")
         super()._bind_slot(rid, slot)
 
     def cancel(self, rid: int) -> bool:
@@ -141,4 +142,5 @@ class MultiLoraDecodeServer(DecodeServer):
         return self.lora_stack, jnp.int32(self._slot_adapter[slot])
 
     def _step_lora(self):
-        return self.lora_stack, jnp.asarray(self._slot_adapter)
+        return self.lora_stack, self._dev(
+            "adapter", lambda: self._slot_adapter)

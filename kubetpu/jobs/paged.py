@@ -123,7 +123,10 @@ def _write_token_kv(pages_l, new, phys_page, offset):
     """Scatter one token's K or V per slot into its page.
     pages_l: (P, ps, H_kv, D) — or the int8 (values, scales) pair, where
     the token quantizes at write time; new: (B, H_kv, D); phys_page/
-    offset: (B,). mode="drop": an INACTIVE slot's table row is -1 (mapped
+    offset: (B,). (The speculative verify chunk reuses this with (B, T)
+    index arrays and (B, T, H_kv, D) payloads — the advanced-index
+    scatter and the per-token quantization are shape-generic.)
+    mode="drop": an INACTIVE slot's table row is -1 (mapped
     to the out-of-bounds sentinel by the caller) — without drop, the
     negative index would wrap and scribble on the last pool page, which
     may belong to a live request."""
@@ -186,6 +189,100 @@ def paged_forward_one(
     head = maybe_dequantize(params["head"])
     logits = jnp.einsum("bsd,dv->bsv", x, head).astype(jnp.float32)
     return logits[:, 0], k_pages, v_pages
+
+
+def _attend_paged_chunk(q, k_pages_l, v_pages_l, table, pos):
+    """``_attend_paged`` for a T-token chunk of queries per slot at
+    per-slot positions ``pos..pos+T-1`` — the speculative VERIFY read.
+    q: (B, T, H, D); pos: (B,) position of q[:, 0]. Per query the score
+    math (f32 scores/softmax over the gathered logical view, grouped-
+    query groups, positional + unmapped masks) is exactly
+    ``_attend_paged``'s, so the verify chunk stays token-exact against
+    one-token paged decode. No ``window``: the speculative server
+    refuses windowed configs (ring aliasing vs overshoot writes)."""
+    b, t, h, d = q.shape
+    vals_k = k_pages_l[0] if isinstance(k_pages_l, tuple) else k_pages_l
+    ps = vals_k.shape[1]
+    h_kv = vals_k.shape[2]
+    g = h // h_kv
+    max_pages = table.shape[1]
+    scale = d ** -0.5
+
+    safe = jnp.maximum(table, 0)
+    k = _gather_pages(k_pages_l, safe).reshape(b, max_pages * ps, h_kv, d)
+    v = _gather_pages(v_pages_l, safe).reshape(b, max_pages * ps, h_kv, d)
+
+    qg = q.reshape(b, t, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg,
+                        k.astype(jnp.float32)) * scale
+    k_pos = jnp.arange(max_pages * ps)
+    q_pos = pos[:, None] + jnp.arange(t)                       # (B, T)
+    mask = k_pos[None, None, :] <= q_pos[:, :, None]           # (B, T, S)
+    mask = mask & (jnp.repeat(table, ps, axis=1) >= 0)[:, None, :]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, t, h, d).astype(q.dtype)
+
+
+def paged_forward_chunk(
+    cfg: ModelConfig, params: Params, tokens, k_pages, v_pages, table, pos,
+    write_enable=None,
+):
+    """T-token chunk forward per slot through the page pool at PER-SLOT
+    positions ``pos..pos+T-1`` — the speculative VERIFY leg (T = gamma+1;
+    ``paged_forward_one`` is the T == 1 decode sibling). tokens: (B, T);
+    pos: (B,). Returns (logits (B, T, V) f32, k_pages, v_pages).
+
+    The chunk's K/V scatter COMMITS to the pool first, then the gathered
+    logical view is attended under the positional mask — the same
+    write-then-read order as one-token decode, so in-chunk causality is
+    the mask's job and, with an int8 pool, every query reads the
+    DEQUANTIZED QUANTIZED in-chunk entries — exactly what a plain decode
+    step would read back — keeping kv_int8 verify token-exact. Rejected
+    tokens' entries are never rolled back: positions rewind and the
+    position-bounded mask never reads past ``pos``, until the position is
+    re-fed and overwritten (jobs.speculative's argument, through pages).
+    *write_enable* (B,) bool drops an inactive slot's writes entirely
+    (phys -> out-of-bounds sentinel), protecting mid-prefill neighbors'
+    pages like the decode step does."""
+    vals = k_pages[0] if isinstance(k_pages, tuple) else k_pages
+    ps = vals.shape[2]
+    n_pool = vals.shape[1]
+    t = tokens.shape[1]
+    tpos = pos[:, None] + jnp.arange(t)                        # (B, T)
+    phys = jnp.take_along_axis(table, tpos // ps, axis=1)      # (B, T)
+    phys = jnp.where(phys >= 0, phys, n_pool)  # unmapped -> dropped write
+    if write_enable is not None:
+        phys = jnp.where(write_enable[:, None], phys, n_pool)
+    offset = tpos % ps
+    x = params["embed"][tokens]                                # (B, T, D)
+
+    def layer_body(carry, inputs):
+        x = carry
+        layer, k_l, v_l = inputs
+        layer = maybe_dequantize(layer)
+        h = model_lib.rms_norm(x, layer["ln1"])
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+        q = model_lib.rope(q, tpos, cfg.rope_theta, cfg.rope_llama3_scaling)
+        k = model_lib.rope(k, tpos, cfg.rope_theta, cfg.rope_llama3_scaling)
+        k_l = _write_token_kv(k_l, k, phys, offset)   # (B, T) scatter
+        v_l = _write_token_kv(v_l, v, phys, offset)
+        attn = _attend_paged_chunk(q, k_l, v_l, table, pos)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
+        h2 = model_lib.rms_norm(x, layer["ln2"])
+        delta, _aux = model_lib._mlp(cfg, h2, layer)
+        return x + delta, (k_l, v_l)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer_body, x, (params["blocks"], k_pages, v_pages)
+    )
+    x = model_lib.rms_norm(x, params["ln_f"])
+    head = maybe_dequantize(params["head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head).astype(jnp.float32)
+    return logits, k_pages, v_pages
 
 
 def _paged_prefill_io(write_phys, gather_row, ps: int, window: int):
@@ -400,7 +497,11 @@ class PagedDecodeServer(SlotServerBase):
                          queue_ttl=queue_ttl)
         self.page_size = page_size
         self._min_bucket = page_size  # bucket >= one page keeps shapes few
-        self.max_pages_per_slot = (max_seq + page_size - 1) // page_size
+        # _seq_margin(): extra positions past max_seq a slot's table must
+        # cover (0 here; the speculative server's verify chunk overshoots
+        # by up to gamma_max tokens per round)
+        self.max_pages_per_slot = (
+            max_seq + self._seq_margin() + page_size - 1) // page_size
         # Windowed (banded) serving: a slot's LOGICAL pages map onto a
         # small physical RING of ceil(window/ps) + 1 pages (table entry
         # lp -> ring[lp % ring]). Soundness: ring * ps >= window + ps, so
@@ -508,8 +609,15 @@ class PagedDecodeServer(SlotServerBase):
     def _pages_needed(self, n_tokens: int) -> int:
         return (n_tokens + self.page_size - 1) // self.page_size
 
+    def _seq_margin(self) -> int:
+        """Positions past ``max_seq`` every slot's reservation (and the
+        table width) must additionally cover — 0 for one-token decode;
+        the speculative subclass returns ``gamma_max`` (a verify chunk
+        writes up to gamma tokens past the final accepted position)."""
+        return 0
+
     def _worst_case_tokens(self, prompt_len: int) -> int:
-        return prompt_len + self.max_new_tokens + 1
+        return prompt_len + self.max_new_tokens + 1 + self._seq_margin()
 
     def _alloc_pages(self, slot: int, upto_tokens: int) -> bool:
         """Map pages so slot can hold *upto_tokens* tokens; False if the
@@ -538,6 +646,7 @@ class PagedDecodeServer(SlotServerBase):
             ring = [self._free.pop() for _ in range(phys_need)]
             for lp in range(need):
                 self._table[slot, lp] = ring[lp % phys_need]
+            self._invalidate_dev("table")
             return True
         have = int((self._table[slot] >= 0).sum())
         short = (need - have) - len(self._free)
@@ -548,8 +657,13 @@ class PagedDecodeServer(SlotServerBase):
                 self._c_evicted.inc(len(reclaimed))
         if need - have > len(self._free):
             return False
-        for lp in range(have, need):
-            self._table[slot, lp] = self._free.pop()
+        if need > have:
+            # only a real mapping dirties the device mirror: the no-op
+            # paths (pages already cover the chunk, pool-exhausted False)
+            # must not force the next step to re-upload the table
+            for lp in range(have, need):
+                self._table[slot, lp] = self._free.pop()
+            self._invalidate_dev("table")
         return True
 
     def _release_pages(self, slot: int, keep=()) -> None:
@@ -559,6 +673,7 @@ class PagedDecodeServer(SlotServerBase):
         just DONATED to the tree by ``_publish_prefix`` (ownership moved,
         not freed)."""
         shared = self._slot_shared[slot]
+        self._invalidate_dev("table")
         freed = set()  # ring tables alias: free each physical page once
         for lp in range(self.max_pages_per_slot):
             phys = int(self._table[slot, lp])
@@ -643,6 +758,7 @@ class PagedDecodeServer(SlotServerBase):
             return 0
         use = start // ps
         self._table[slot, :use] = np.asarray(pages[:use], np.int32)
+        self._invalidate_dev("table")
         self._slot_shared[slot] = use
         self._prefix_cache.pin(node)
         self._slot_pin[slot] = node
@@ -783,6 +899,25 @@ class PagedDecodeServer(SlotServerBase):
     def _chunk_quantum(self) -> int:
         return self.page_size       # chunk starts stay page-aligned
 
+    def _chunk_bucket(self, pos: int, take: int, final: bool) -> int:
+        """Padded length of a prefill chunk: FINAL chunks bucket-pad
+        (finish-the-tail, ``_chunk_take``) — pad K/V land at positions
+        decode overwrites before any read, pad-only pages are dropped by
+        the write row; non-final chunks are grid-sized, page-rounded so
+        starts stay page-aligned. Shared with the speculative server's
+        draft prefill so both caches see the identical chunk."""
+        ps = self.page_size
+        if final:
+            # page-round the grid bucket: _bucket caps at max_seq, which
+            # need not be a page multiple, but the pool scatter writes
+            # whole pages — the rounded tail stays inside the table (its
+            # width is page-aligned and >= max_seq)
+            bucket = ((self._bucket(take) + ps - 1) // ps) * ps
+            if pos + bucket > self.max_pages_per_slot * ps:
+                bucket = ((take + ps - 1) // ps) * ps   # defensive clamp
+            return bucket
+        return ((take + ps - 1) // ps) * ps
+
     def _gather_prefix(self, upto_tokens: int) -> int:
         """Power-of-two page count covering *upto_tokens* positions
         (capped at the slot's table) — the attend-prefix shape rule,
@@ -830,16 +965,7 @@ class PagedDecodeServer(SlotServerBase):
             if not self._alloc_pages(slot, upto):
                 return None
         ps = self.page_size
-        if final:
-            # final chunks bucket-pad (finish-the-tail, _chunk_take) —
-            # pad K/V land at positions decode overwrites before any
-            # read, pad-only pages are dropped below
-            bucket = self._bucket(take)
-            if pos + bucket > self.max_pages_per_slot * ps:
-                bucket = ((take + ps - 1) // ps) * ps   # defensive clamp
-        else:
-            # grid-sized chunk, page-rounded so starts stay page-aligned
-            bucket = ((take + ps - 1) // ps) * ps
+        bucket = self._chunk_bucket(pos, take, final)
         chunk = prompt[pos:pos + take] + [0] * (bucket - take)
         n_write = (bucket + ps - 1) // ps
         p0 = pos // ps
@@ -888,14 +1014,17 @@ class PagedDecodeServer(SlotServerBase):
         # worst-case pages were reserved by admission / the final prefill
         # chunk, so boundary crossings never fail; the REAL table (with
         # -1 sentinels) flows to the device — the attention core masks
-        # unmapped pages
+        # unmapped pages. Table and slot state ride the device-resident
+        # upload cache: a steady-state step re-uploads nothing.
         self.k_pages, self.v_pages, nxt, self.pos, lp = self._step_all(
             self.params, self.k_pages, self.v_pages,
-            jnp.asarray(self._table),
-            self.last, self.pos, jnp.asarray(self.active),
-            jnp.asarray(self._slot_reqkey),
-            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
-            jnp.asarray(self._slot_topp),
+            self._dev("table", lambda: self._table),
+            self.last, self.pos,
+            self._dev("active", lambda: self.active),
+            self._dev("reqkey", lambda: self._slot_reqkey),
+            self._dev("temp", lambda: self._slot_temp),
+            self._dev("topk", lambda: self._slot_topk),
+            self._dev("topp", lambda: self._slot_topp),
         )
         self.last = nxt
         return nxt, lp

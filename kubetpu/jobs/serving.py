@@ -60,6 +60,14 @@ serving pattern behind modern LLM inference engines, TPU-shaped:
   chunked server token-exact against the monolithic one under seeded
   sampling (pinned by test).
 
+- the hot loop is UPLOAD-FREE in steady state (Round 10): the step legs'
+  host-owned inputs — active mask, request keys, per-slot sampling
+  settings, the multi-LoRA adapter ids, the paged server's page table —
+  live in device-resident mirrors (``_dev``/``_invalidate_dev``)
+  invalidated only by admission/retire/sampling/table changes, so a
+  steady-state ``step()`` issues zero ``jnp.asarray`` uploads (pinned by
+  regression test; greedy output is unchanged — only the upload moved);
+
 - graceful degradation under overload: ``queue_ttl`` (server default) /
   ``enqueue(ttl=)`` (per request) bound the ADMISSION-QUEUE wait — a
   queued prompt past its deadline is expired (finished empty, reason
@@ -223,6 +231,29 @@ class SlotServerBase:
         self._arrive: Dict[int, float] = {}    # rid -> arrival perf stamp
         self._last_emit: Dict[int, float] = {}  # rid -> last emission stamp
         self._qw_recorded: set = set()         # rids with a queue_wait sample
+        # -- hot-loop upload cache: device-resident mirrors of the host
+        # slot state the step legs consume every step (active mask,
+        # request keys, sampling settings; the paged server adds its page
+        # table). The hot loop re-uploaded these unchanged arrays every
+        # step; now a step issues zero ``jnp.asarray`` calls unless
+        # admission / retirement / a sampling change dirtied a mirror
+        # (pinned by regression test). Safe because no step leg donates
+        # these arguments — the same device buffer serves every step.
+        self._dev_cache: Dict[str, object] = {}
+        self._dev_dirty: set = set()
+
+    def _dev(self, name: str, fn):
+        """Device-resident mirror of the host array ``fn()`` — uploaded
+        once, then reused until ``_invalidate_dev(name)``. Mutation sites
+        of the mirrored host state MUST invalidate, or the step reads a
+        stale mirror (the invariant the upload-cache test pins)."""
+        if name in self._dev_dirty or name not in self._dev_cache:
+            self._dev_cache[name] = jnp.asarray(fn())
+            self._dev_dirty.discard(name)
+        return self._dev_cache[name]
+
+    def _invalidate_dev(self, *names: str) -> None:
+        self._dev_dirty.update(names)
 
     def _request_key(self, rid: int) -> np.ndarray:
         """The request's sampling key: fold_in(PRNGKey(seed), rid)."""
@@ -239,6 +270,7 @@ class SlotServerBase:
         self._slot_topk[slot] = tk
         self._slot_topp[slot] = tp
         self._slot_reqkey[slot] = self._request_key(rid)
+        self._invalidate_dev("reqkey", "temp", "topk", "topp")
 
     def _free_slots(self) -> List[int]:
         """Slots holding neither an active decode nor an in-flight
@@ -283,6 +315,7 @@ class SlotServerBase:
         self.pos = self.pos.at[slot].set(len(prompt))
         self.last = self.last.at[slot].set(first)
         self.active[slot] = True
+        self._invalidate_dev("active")
         self._slot_rid[slot] = rid
         self._prompts[rid] = list(prompt)
         self._done[rid] = False
@@ -516,7 +549,9 @@ class SlotServerBase:
         bucket = self._min_bucket
         while True:
             dummy = [0] * min(bucket, self.max_seq)
-            prefill_dummy(dummy + [0] * (self._bucket(len(dummy)) - len(dummy)))
+            prefill_dummy(
+                dummy + [0] * (self._chunk_bucket(0, len(dummy), True)
+                               - len(dummy)))
             if bucket >= self.max_seq:
                 break
             bucket *= 2
@@ -543,6 +578,17 @@ class SlotServerBase:
         """Smallest chunk granularity (1 for contiguous caches; the page
         size for paged ones, so chunk starts stay page-aligned)."""
         return 1
+
+    def _chunk_bucket(self, pos: int, take: int, final: bool) -> int:
+        """Padded length of a prefill chunk: FINAL chunks bucket-pad,
+        grid-exact when the pad would run past the cache end; non-final
+        chunks are exact grid sizes. Subclasses reshape the rule (the
+        paged server page-rounds), and warmup pads its dummies through
+        this same hook — a warmed shape is exactly a served shape."""
+        bucket = self._bucket(take) if final else take
+        if pos + bucket > self.max_seq:
+            bucket = take          # grid-exact tail: never overflows
+        return bucket
 
     def _chunk_take(self, budget: int, pos: int, remaining: int) -> int:
         """Largest bucket-grid chunk (q * 2^k tokens) within
@@ -663,6 +709,7 @@ class SlotServerBase:
             self.pos = self.pos.at[slot].set(len(st["prompt"]))
             self.last = self.last.at[slot].set(first)
             self.active[slot] = True
+            self._invalidate_dev("active")
             self._note_admitted(slot, st["prompt"])
             self._pending_first[slot] = (first, first_lp)
             self._metrics.record("admission_stall", st["t"])
@@ -710,6 +757,7 @@ class SlotServerBase:
         rid = self._slot_rid[slot]
         self._done[rid] = True
         self.active[slot] = False           # slot immediately reusable
+        self._invalidate_dev("active")
         self._slot_rid[slot] = None
         self._prefills.pop(slot, None)      # cancel() mid-prefill
         if slot in self._prefill_fifo:
@@ -1042,9 +1090,7 @@ class DecodeServer(SlotServerBase):
         overwrite-before-read, and the pad never runs past the cache end
         (``_chunk_take`` only returns a paddable final; the clamp is a
         defensive spelling of the same bound)."""
-        bucket = self._bucket(take) if final else take
-        if pos + bucket > self.max_seq:
-            bucket = take          # grid-exact tail: never overflows
+        bucket = self._chunk_bucket(pos, take, final)
         chunk = prompt[pos:pos + take] + [0] * (bucket - take)
         lora, aid = self._admit_lora(slot)
         self.cache, first, first_lp = self._prefill_chunk(
@@ -1060,12 +1106,18 @@ class DecodeServer(SlotServerBase):
         return (first, first_lp) if final else True
 
     def _device_step(self):
+        # slot state flows through the device-resident upload cache
+        # (SlotServerBase._dev): unchanged arrays are never re-uploaded,
+        # so a steady-state step issues no host->device transfers beyond
+        # the compiled call itself
         lora, aids = self._step_lora()
         self.cache, nxt, self.pos, lp = self._step_all(
             self.params, self.cache, self.last, self.pos,
-            jnp.asarray(self.active), jnp.asarray(self._slot_reqkey),
-            jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
-            jnp.asarray(self._slot_topp),
+            self._dev("active", lambda: self.active),
+            self._dev("reqkey", lambda: self._slot_reqkey),
+            self._dev("temp", lambda: self._slot_temp),
+            self._dev("topk", lambda: self._slot_topk),
+            self._dev("topp", lambda: self._slot_topp),
             lora, aids,
         )
         self.last = nxt

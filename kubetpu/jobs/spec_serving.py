@@ -11,14 +11,35 @@ target's correction/bonus token. Per-slot positions diverge naturally
 rollback — positions rewind and the position-bounded attention mask never
 reads them (``jobs.speculative``'s argument, per slot).
 
-Greedy only: speculative acceptance is exactly-greedy-equivalent, so the
-server's output is token-identical to ``DecodeServer``'s greedy stream —
-the parity test pins this. Sampling overrides are rejected at admission.
+``PagedSpeculativeDecodeServer`` is the PRODUCTION-PATH sibling (Round
+10): the same draft+verify rounds over ``paged.PagedDecodeServer``'s page
+pool — the target's (gamma+1)-token verify chunk reads and writes THROUGH
+the slot page table (``paged.paged_forward_chunk``), so speculation
+composes with everything the pool already carries: chunked prefill,
+kv_int8 pools, and shared-prefix radix-cache hits (a matched prefix skips
+the DRAFT's prefill too — draft staleness there can only lower
+acceptance, never change output, because verification is greedy-exact).
+Copy-on-write boundary rules are untouched: every speculative write lands
+at ``>= pos``, strictly past any shared prefix. Rounds add ADAPTIVE
+GAMMA: a per-slot EMA of the acceptance rate walks each slot's gamma
+within [1, gamma_max] (one jitted round per gamma value, all warmable);
+the device round runs at the max over active slots and per-slot
+acceptance is capped at the slot's own gamma, so a batch of
+low-agreement slots stops paying for verify bandwidth it never converts.
+
+Greedy only: speculative acceptance is exactly-greedy-equivalent, so both
+servers' output is token-identical to their plain siblings' greedy stream
+— the parity tests pin this (for the paged server: f32 + kv_int8, cold +
+prefix-hit, chunked + monolithic admission). Sampling overrides are
+rejected at admission.
 
 The win is rounds, not tokens: decode is memory-bound, and the target's
 weights stream once per ROUND instead of once per token; a slot with mean
 acceptance a emits a+1 tokens per round. ``mean_tokens_per_round()``
-reports the measured rate.
+reports the measured rate; the serving registry exports
+``kubetpu_spec_rounds_total`` / ``kubetpu_spec_accepted_tokens_total`` /
+``kubetpu_spec_proposed_tokens_total`` and (paged) a per-slot
+``kubetpu_spec_gamma`` gauge.
 
 Reference: none (the reference has no inference stack, SURVEY.md §2).
 """
@@ -32,16 +53,100 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubetpu.jobs.decode import forward_chunk, forward_chunk_at, init_kv_cache
+from kubetpu.jobs.decode import (
+    _dense_cache_io,
+    forward_chunk,
+    forward_chunk_at,
+    init_kv_cache,
+)
 from kubetpu.jobs.model import ModelConfig, Params
+from kubetpu.jobs.paged import PagedDecodeServer, paged_forward_chunk
 from kubetpu.jobs.sampling import chosen_logprob
-from kubetpu.jobs.serving import SlotServerBase
-from kubetpu.jobs.speculative import draft_and_verify
+from kubetpu.jobs.serving import SlotServerBase, _build_dense_legs, _cached_legs
+from kubetpu.jobs.speculative import draft_and_verify, draft_propose
 
 import time
 
+# adaptive-gamma controller (PagedSpeculativeDecodeServer): the per-slot
+# acceptance EMA walks gamma down when fewer than half the proposals
+# convert and back up when nearly all do — one step per round, so a slot
+# reaches gamma 1 from gamma_max within a handful of hopeless rounds
+_EMA_ALPHA = 0.5
+_GAMMA_UP = 0.85
+_GAMMA_DOWN = 0.5
 
-class SpeculativeDecodeServer(SlotServerBase):
+
+class _SpecRoundsMixin:
+    """Tokens-per-round bookkeeping shared by the dense and paged
+    speculative servers; the backing counters are zeroed by
+    ``_init_spec_obs`` and fed by ``_route_round``."""
+
+    def mean_tokens_per_round(self) -> float:
+        """Measured accepted tokens per live (slot, round) — the speedup
+        factor over one-token decoding for a memory-bound target."""
+        return self._round_tokens / self._rounds if self._rounds else 0.0
+
+
+def _init_spec_obs(server) -> None:
+    """Speculation counters on the server's serving registry — shared by
+    the dense and paged speculative servers so dashboards read one set of
+    series: rounds executed, draft tokens proposed/accepted (acceptance
+    rate = accepted/proposed), and the measured tokens-per-round."""
+    server._rounds = 0
+    server._round_tokens = 0
+    server._c_spec_rounds = server.obs.counter(
+        "kubetpu_spec_rounds_total", "device draft+verify rounds executed")
+    server._c_spec_accepted = server.obs.counter(
+        "kubetpu_spec_accepted_tokens_total",
+        "draft tokens accepted by the target verifier")
+    server._c_spec_proposed = server.obs.counter(
+        "kubetpu_spec_proposed_tokens_total",
+        "draft tokens proposed for verification")
+    server.obs.gauge_fn("kubetpu_spec_mean_tokens_per_round",
+                        server.mean_tokens_per_round)
+
+
+def _route_round(server, toks, n_emit, lps, out):
+    """Host-side routing of one device round's results, SHARED by the
+    dense and paged speculative servers (a change to the clip/emit rules
+    lands in both): agreement counters at DEVICE level before host
+    clipping (the honest acceptance numerator/denominator for the obs
+    series), room + EOS clipping, emit/logprob bookkeeping, retire.
+    Server hooks supply the variance: ``_slot_proposed(slot)`` (constant
+    gamma vs the slot's adaptive gamma) and ``_note_round_result`` (the
+    paged server's adaptive-gamma controller)."""
+    server._c_spec_rounds.inc()
+    for slot in range(server.n_slots):
+        if not server.active[slot]:
+            continue
+        rid = server._slot_rid[slot]
+        n_dev = int(n_emit[slot])
+        proposed = server._slot_proposed(slot)
+        server._c_spec_proposed.inc(proposed)
+        server._c_spec_accepted.inc(max(n_dev - 1, 0))
+        server._note_round_result(slot, max(n_dev - 1, 0), proposed)
+        accepted = [int(t) for t in toks[slot][:n_dev]]
+        room = server.max_new_tokens - len(server._emitted[rid])
+        accepted = accepted[:room]
+        if server.eos_id is not None and server.eos_id in accepted:
+            accepted = accepted[: accepted.index(server.eos_id) + 1]
+        if not accepted:
+            server._retire_if_done(slot)
+            continue
+        server._rounds += 1
+        server._round_tokens += len(accepted)
+        server._emitted[rid].extend(accepted)
+        server._logprobs[rid].extend(
+            float(x) for x in lps[slot][: len(accepted)])
+        for _ in accepted:
+            server._note_emitted(slot)   # paged: per-token host length
+        out.setdefault(rid, []).extend(accepted)
+        server._obs_tokens(rid, len(accepted))
+        server._retire_if_done(slot)
+    return out
+
+
+class SpeculativeDecodeServer(_SpecRoundsMixin, SlotServerBase):
     """Continuous batching with draft+verify rounds (greedy-exact).
 
     ``target_cfg``/``draft_cfg`` must share a vocabulary; the draft is
@@ -78,8 +183,7 @@ class SpeculativeDecodeServer(SlotServerBase):
         cache_len = max_seq + gamma + 1
         self.k_cache, self.v_cache = init_kv_cache(target_cfg, n_slots, cache_len)
         self.dk_cache, self.dv_cache = init_kv_cache(draft_cfg, n_slots, cache_len)
-        self._rounds = 0
-        self._round_tokens = 0
+        _init_spec_obs(self)
 
         tcfg, dcfg = target_cfg, draft_cfg
 
@@ -154,7 +258,8 @@ class SpeculativeDecodeServer(SlotServerBase):
          self.last, self.pos, toks, n_emit, lps) = self._round_jit(
             self.params, self.draft_params,
             self.k_cache, self.v_cache, self.dk_cache, self.dv_cache,
-            self.last, self.pos, jnp.asarray(self.active),
+            self.last, self.pos,
+            self._dev("active", lambda: self.active),
         )
         return np.asarray(toks), np.asarray(n_emit), np.asarray(lps)
 
@@ -172,33 +277,14 @@ class SpeculativeDecodeServer(SlotServerBase):
         toks, n_emit, lps = self._device_round()
         out = self._materialize_pending()
         self._metrics.record("step", time.perf_counter() - t0)
-        for slot in range(self.n_slots):
-            if not self.active[slot]:
-                continue
-            rid = self._slot_rid[slot]
-            accepted = [int(t) for t in toks[slot][: int(n_emit[slot])]]
-            room = self.max_new_tokens - len(self._emitted[rid])
-            accepted = accepted[:room]
-            if self.eos_id is not None and self.eos_id in accepted:
-                accepted = accepted[: accepted.index(self.eos_id) + 1]
-            if not accepted:
-                self._retire_if_done(slot)
-                continue
-            self._rounds += 1
-            self._round_tokens += len(accepted)
-            self._emitted[rid].extend(accepted)
-            self._logprobs[rid].extend(
-                float(x) for x in lps[slot][: len(accepted)])
-            self._note_emitted(slot)
-            out.setdefault(rid, []).extend(accepted)
-            self._obs_tokens(rid, len(accepted))
-            self._retire_if_done(slot)
-        return out
+        return _route_round(self, toks, n_emit, lps, out)
 
-    def mean_tokens_per_round(self) -> float:
-        """Measured accepted tokens per live (slot, round) — the speedup
-        factor over one-token decoding for a memory-bound target."""
-        return self._round_tokens / self._rounds if self._rounds else 0.0
+    def _slot_proposed(self, slot: int) -> int:
+        return self.gamma            # fixed gamma: every slot proposes it
+
+    def _note_round_result(self, slot: int, accepted: int,
+                           proposed: int) -> None:
+        pass                         # no adaptive controller here
 
     def warmup(self) -> None:
         """Pre-compile every prompt bucket's dual prefill and the round."""
@@ -220,3 +306,303 @@ class SpeculativeDecodeServer(SlotServerBase):
             jnp.asarray(np.zeros((self.n_slots,), bool)),
         )
         jax.block_until_ready((self.k_cache, self.v_cache))
+
+
+def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos):
+    """The jitted paged speculative ROUND for one static *gamma*: draft
+    ``gamma`` greedy tokens through the (dense, per-slot) draft cache at
+    per-slot positions (``speculative.draft_propose`` — the same
+    implementation the dense server and the batch loop run), verify them
+    in ONE (gamma+1)-token target chunk THROUGH the page pool
+    (``paged.paged_forward_chunk``), and emit each slot's longest
+    agreeing prefix plus the bonus/correction token, capped at the slot's
+    own adaptive gamma (``slot_gamma``; the round runs at the batch max).
+
+    *dead_pos*: the draft-cache row an INACTIVE slot's draft writes are
+    redirected to — a mid-(chunked-)prefill slot is inactive but its
+    draft rows already hold real prompt KV, so a stale-position write
+    would corrupt them (the same hazard the dense step's ``pos_w``
+    redirect covers); row ``dead_pos`` is past every position a real
+    query can ever attend. The target side needs no redirect: inactive
+    slots' pool writes are dropped via ``write_enable``."""
+
+    @partial(jax.jit, donate_argnums=(2, 3, 4))
+    def round_all(t_params, d_params, k_pages, v_pages, dcache,
+                  table, last, pos, active, slot_gamma):
+        dk, dv = dcache
+        pos_d = jnp.where(active, pos, dead_pos)
+        dk, dv, drafts = draft_propose(
+            dcfg, gamma, d_params, dk, dv, last, pos_d)
+        chunk = jnp.concatenate([last[:, None], drafts], axis=1)
+        t_logits, k_pages, v_pages = paged_forward_chunk(
+            tcfg, t_params, chunk, k_pages, v_pages, table, pos,
+            write_enable=active,
+        )
+        target_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        agree = (drafts == target_tok[:, :gamma]).astype(jnp.int32)
+        accepted = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)   # (B,)
+        # a slot whose adaptive gamma sits below the round's max emits at
+        # most its OWN gamma of draft tokens — a prefix of an accepted
+        # run is still exactly the target's greedy stream
+        accepted = jnp.minimum(accepted, slot_gamma)
+        n_emit = jnp.where(active, accepted + 1, 0)
+        new_last = jnp.take_along_axis(
+            target_tok, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+        )[:, 0]
+        new_last = jnp.where(active, new_last, last)
+        new_pos = pos + n_emit
+        lps = chosen_logprob(t_logits, target_tok)               # (B, g+1)
+        return (k_pages, v_pages, (dk, dv), new_last, new_pos,
+                target_tok, n_emit, lps)
+
+    return round_all
+
+
+class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
+    """Speculative draft+verify rounds over the PAGED KV pool — the
+    production serving path (``PagedDecodeServer``: pool pages, chunked
+    prefill, kv_int8, shared-prefix radix cache) with the one-token
+    decode step replaced by a speculative round, greedy token-exact
+    against its plain sibling.
+
+    Composition rules (module docstring):
+
+    - the verify chunk writes through the slot page table; every write
+      lands at ``>= pos``, strictly past any read-only shared prefix, so
+      the prefix cache's structural COW argument is untouched — hits,
+      publication and reclamation all behave exactly as in the plain
+      server, and ``check_invariants()`` is inherited unchanged;
+    - a prefix-cache hit skips the DRAFT's prefill over the matched
+      tokens too: the draft's dense cache simply starts at ``pos =
+      matched_tokens`` with whatever its rows held before (zeros, or a
+      previous occupant's KV). That can only lower acceptance — never
+      change output — because verification is greedy-exact; the pinned
+      hit-vs-cold parity test relies on exactly this;
+    - page reservation extends by ``gamma_max`` positions per slot
+      (``_seq_margin``): a round may write up to gamma tokens past the
+      final accepted position, and those entries are never rolled back —
+      positions rewind and the position-bounded mask never reads them;
+    - ADAPTIVE GAMMA: per-slot EMA of the acceptance rate walks gamma in
+      [1, gamma_max] (reset at admission); the device round runs at the
+      max over active slots (one compiled round per gamma value — all
+      warmed by ``warmup``) with per-slot acceptance capped at the
+      slot's own gamma;
+    - windowed (``cfg.window > 0``) configs are refused: the ring table
+      aliases logical pages, and an overshoot write past the accepted
+      position could evict a band entry a REWOUND position still needs;
+    - greedy only (sampling overrides rejected), no ``overlap`` (a round
+      emits a variable burst; the one-step pipeline doesn't apply) and
+      no Pallas kernel (the verify chunk uses the gather core).
+    """
+
+    def __init__(
+        self,
+        target_cfg: ModelConfig,
+        draft_cfg: ModelConfig,
+        target_params: Params,
+        draft_params: Params,
+        n_slots: int = 8,
+        max_seq: int = 512,
+        max_new_tokens: int = 64,
+        page_size: int = 16,
+        n_pages: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+        mesh=None,
+        kv_int8: bool = False,
+        prefill_budget: int = 0,
+        queue_ttl: Optional[float] = None,
+        prefix_cache_pages: int = 0,
+        gamma_max: int = 4,
+        adaptive_gamma: bool = True,
+    ) -> None:
+        if target_cfg.vocab != draft_cfg.vocab:
+            raise ValueError("target and draft must share a vocabulary")
+        if target_cfg.window > 0 or draft_cfg.window > 0:
+            raise NotImplementedError(
+                "paged speculative serving does not compose with windowed "
+                "configs: the ring table aliases logical pages, and a "
+                "verify overshoot write could evict a band entry a rewound "
+                "position still reads"
+            )
+        if gamma_max < 1:
+            raise ValueError("gamma_max must be >= 1")
+        # consumed by _seq_margin() during super().__init__ (table width
+        # and worst-case reservations include the verify overshoot)
+        self.gamma_max = int(gamma_max)
+        self.adaptive_gamma = bool(adaptive_gamma)
+        super().__init__(
+            target_cfg, target_params, n_slots=n_slots, max_seq=max_seq,
+            max_new_tokens=max_new_tokens, page_size=page_size,
+            n_pages=n_pages, eos_id=eos_id, seed=seed, mesh=mesh,
+            kv_int8=kv_int8, prefill_budget=prefill_budget,
+            queue_ttl=queue_ttl, prefix_cache_pages=prefix_cache_pages,
+        )
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        # draft: a small DENSE per-slot cache spanning the TARGET's table
+        # width, +1 for the dead row — any prefill bucket the base
+        # clamp admits (pos + bucket <= table width) fits the draft
+        # cache by construction (a non-page-aligned max_seq can round a
+        # final chunk's bucket past max_seq + gamma_max), and the round's
+        # deepest draft write (pos + gamma) stays strictly below the
+        # dead row
+        self._draft_len = self.max_pages_per_slot * page_size + 1
+        self.dcache = init_kv_cache(draft_cfg, n_slots, self._draft_len)
+        self._gamma = np.full((n_slots,), self.gamma_max, np.int32)
+        self._accept_ema = np.ones((n_slots,), np.float64)
+        _init_spec_obs(self)
+        for s in range(n_slots):
+            self.obs.gauge_fn("kubetpu_spec_gamma",
+                              lambda s=s: float(self._gamma[s]),
+                              slot=str(s))
+        # draft prefill rides the SAME compiled dense legs a DecodeServer
+        # over draft_cfg would use (shared process-wide leg cache)
+        self._draft_prefill, _ = _cached_legs(
+            ("dense", draft_cfg, False, 1.0),
+            lambda: _build_dense_legs(
+                draft_cfg, _dense_cache_io(draft_cfg.window), 1.0),
+        )
+
+    # -- adaptive gamma -------------------------------------------------------
+
+    def _seq_margin(self) -> int:
+        return self.gamma_max
+
+    def _round_leg(self, gamma: int):
+        return _cached_legs(
+            ("paged_spec", self.cfg, self.draft_cfg, self.page_size,
+             self.kv_int8, gamma, self._draft_len - 1),
+            lambda: _build_paged_spec_round(
+                self.cfg, self.draft_cfg, gamma, self._draft_len - 1),
+        )
+
+    def _note_admitted(self, slot: int, prompt: List[int]) -> None:
+        super()._note_admitted(slot, prompt)
+        # every request starts optimistic at gamma_max; the EMA walks it
+        # down within a few rounds if this stream disagrees with the draft
+        if int(self._gamma[slot]) != self.gamma_max:
+            self._gamma[slot] = self.gamma_max
+            self._invalidate_dev("gamma")
+        self._accept_ema[slot] = 1.0
+
+    def _update_gamma(self, slot: int, accepted: int, proposed: int) -> None:
+        if not self.adaptive_gamma:
+            return
+        frac = accepted / max(proposed, 1)
+        ema = (1.0 - _EMA_ALPHA) * self._accept_ema[slot] + _EMA_ALPHA * frac
+        self._accept_ema[slot] = ema
+        g = int(self._gamma[slot])
+        if ema >= _GAMMA_UP and g < self.gamma_max:
+            self._gamma[slot] = g + 1
+            self._invalidate_dev("gamma")
+        elif ema < _GAMMA_DOWN and g > 1:
+            self._gamma[slot] = g - 1
+            self._invalidate_dev("gamma")
+
+    def slot_gammas(self) -> List[int]:
+        """Current per-slot adaptive gamma (the ``kubetpu_spec_gamma``
+        gauge's values)."""
+        return [int(g) for g in self._gamma]
+
+    # -- request lifecycle ----------------------------------------------------
+
+    def _normalize_sampling(self, sampling):
+        if sampling is not None:
+            raise ValueError(
+                "PagedSpeculativeDecodeServer is greedy-exact; per-request "
+                "sampling is not supported"
+            )
+        return self._default_sampling
+
+    def _prefill_chunk_device(self, prompt: List[int], slot: int, pos: int,
+                              take: int, final: bool):
+        """Target chunk through the pool (inherited), then the SAME chunk
+        into the draft's dense cache — both caches stay position-aligned
+        whatever the admission path (monolithic, chunked, prefix-hit:
+        a hit starts BOTH at ``pos = matched_tokens``)."""
+        res = super()._prefill_chunk_device(prompt, slot, pos, take, final)
+        if res is None:
+            return None               # pool exhausted: nothing mutated
+        bucket = self._chunk_bucket(pos, take, final)
+        chunk = prompt[pos:pos + take] + [0] * (bucket - take)
+        self.dcache, _first, _lp = self._draft_prefill(
+            self.draft_params, self.dcache,
+            jnp.asarray(chunk, jnp.int32), jnp.int32(slot),
+            jnp.int32(pos), jnp.int32(take - 1),
+            jnp.asarray(self._slot_reqkey[slot]),
+            jnp.float32(0.0), jnp.int32(0), jnp.float32(1.0),
+            None, jnp.int32(0),
+        )
+        return res
+
+    def _device_step(self):  # pragma: no cover — step() is overridden
+        raise NotImplementedError("paged speculative serving steps in rounds")
+
+    def step(self) -> Dict[int, List[int]]:
+        """One speculative round for every active slot -> {rid: [tokens]};
+        each request receives 1..gamma+1 tokens (clipped at EOS and
+        max_new_tokens host-side; the device overshoot is never read).
+        Admission runs the base scheduler first — monolithic or
+        token-budget chunked, both composing with prefix-cache hits."""
+        self._schedule_prefills()
+        if not self.active.any():
+            return self._materialize_pending()
+        t0 = time.perf_counter()
+        g = max(int(self._gamma[s]) for s in range(self.n_slots)
+                if self.active[s])
+        round_all = self._round_leg(g)
+        (self.k_pages, self.v_pages, self.dcache, self.last, self.pos,
+         toks_d, n_emit_d, lps_d) = round_all(
+            self.params, self.draft_params, self.k_pages, self.v_pages,
+            self.dcache,
+            self._dev("table", lambda: self._table), self.last, self.pos,
+            self._dev("active", lambda: self.active),
+            self._dev("gamma", lambda: self._gamma),
+        )
+        toks = np.asarray(toks_d)
+        n_emit = np.asarray(n_emit_d)
+        lps = np.asarray(lps_d)
+        out = self._materialize_pending()
+        self._metrics.record("step", time.perf_counter() - t0)
+        return _route_round(self, toks, n_emit, lps, out)
+
+    def _slot_proposed(self, slot: int) -> int:
+        return int(self._gamma[slot])  # adaptive: the slot's own gamma
+
+    def _note_round_result(self, slot: int, accepted: int,
+                           proposed: int) -> None:
+        self._update_gamma(slot, accepted, proposed)
+
+    def warmup(self) -> None:
+        """Base warmup (target prompt buckets + chunked signatures + the
+        one-token step; flushes the prefix tree), then the draft's
+        buckets and EVERY round gamma the adaptive controller can pick —
+        a round compile mid-serving is exactly the stall warmup exists to
+        prevent."""
+        super().warmup()
+        d_temp, d_tk, d_tp = self._default_sampling
+
+        def draft_dummy(padded):
+            self.dcache, _f, _lp = self._draft_prefill(
+                self.draft_params, self.dcache,
+                jnp.asarray(padded, jnp.int32), jnp.int32(0), jnp.int32(0),
+                jnp.int32(0), jnp.asarray(self._slot_reqkey[0]),
+                jnp.float32(d_temp), jnp.int32(d_tk), jnp.float32(d_tp),
+                None, jnp.int32(0),
+            )
+
+        self._warmup_buckets(draft_dummy)
+        gammas = (range(1, self.gamma_max + 1) if self.adaptive_gamma
+                  else (self.gamma_max,))
+        idle = jnp.asarray(np.zeros((self.n_slots,), bool))
+        for g in gammas:
+            round_all = self._round_leg(g)
+            (self.k_pages, self.v_pages, self.dcache,
+             _l, _p, _t, _n, _lps) = round_all(
+                self.params, self.draft_params, self.k_pages, self.v_pages,
+                self.dcache,
+                self._dev("table", lambda: self._table), self.last, self.pos,
+                idle, self._dev("gamma", lambda: self._gamma),
+            )
+        jax.block_until_ready((self.k_pages, self.v_pages))

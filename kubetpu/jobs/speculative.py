@@ -34,16 +34,12 @@ from kubetpu.jobs.decode import init_kv_cache, prefill
 from kubetpu.jobs.model import ModelConfig
 
 
-def draft_and_verify(target_cfg, draft_cfg, gamma, target_params,
-                     draft_params, tk, tv, dk, dv, last, pos):
-    """One speculative round's device math, shared by the batch generate
-    loop and the continuous-batching server (a fix here lands in both):
-    draft ``gamma`` tokens sequentially through the draft cache, verify
-    them in ONE (gamma+1)-chunk target forward, and compute the longest
-    agreeing prefix. Returns
-    ``(tk, tv, dk, dv, target_tok (B, gamma+1), accepted (B,), t_logits)``
-    — per sequence, tokens ``target_tok[:, :accepted+1]`` are the round's
-    greedy-exact emissions."""
+def draft_propose(draft_cfg, gamma, draft_params, dk, dv, last, pos):
+    """Draft ``gamma`` greedy tokens sequentially through the draft's
+    dense cache at per-sequence positions — the proposal half of a round,
+    shared by ``draft_and_verify`` and the paged speculative server (a
+    draft-cache fix lands in all three paths). Returns
+    ``(dk, dv, drafts (B, gamma))``."""
 
     def draft_step(c, _):
         dk, dv, tok, p = c
@@ -65,6 +61,22 @@ def draft_and_verify(target_cfg, draft_cfg, gamma, target_params,
     # position is next fed.
     _lg, dk, dv = _forward_chunk_at(
         draft_cfg, draft_params, last_draft[:, None], dk, dv, pos + gamma
+    )
+    return dk, dv, drafts
+
+
+def draft_and_verify(target_cfg, draft_cfg, gamma, target_params,
+                     draft_params, tk, tv, dk, dv, last, pos):
+    """One speculative round's device math, shared by the batch generate
+    loop and the continuous-batching server (a fix here lands in both):
+    draft ``gamma`` tokens sequentially through the draft cache, verify
+    them in ONE (gamma+1)-chunk target forward, and compute the longest
+    agreeing prefix. Returns
+    ``(tk, tv, dk, dv, target_tok (B, gamma+1), accepted (B,), t_logits)``
+    — per sequence, tokens ``target_tok[:, :accepted+1]`` are the round's
+    greedy-exact emissions."""
+    dk, dv, drafts = draft_propose(
+        draft_cfg, gamma, draft_params, dk, dv, last, pos
     )
 
     # verify: ONE (gamma+1)-chunk forward of [last, d_0..d_{gamma-1}]

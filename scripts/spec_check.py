@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""``make spec-check`` — the paged speculative-decoding oracle.
+
+Runs short storms through ``PagedSpeculativeDecodeServer`` on the CPU
+backend and fails (exit 1) on:
+
+- PARITY: greedy tokens through speculative rounds differing from the
+  plain ``PagedDecodeServer`` on any request — across monolithic AND
+  chunked+prefix-cache admission, f32 AND kv_int8 pools (the
+  rounds-are-invisible contract every serving path promises);
+- the POOL ACCOUNTING ORACLE (``check_invariants``) after every drain:
+  speculative overshoot writes must never perturb page ownership;
+- SPECULATION not actually engaging (zero rounds, or a self-draft arm
+  below the gamma+1 tokens/round ceiling, would make parity vacuous);
+- the ADAPTIVE-GAMMA controller failing to converge: a random
+  (disagreeing) draft must end at gamma 1, a self-draft at gamma_max,
+  and the acceptance counters must satisfy 0 <= accepted <= proposed.
+
+Runs in under a minute with no accelerator; wired into ``make chaos`` so
+every fault-injection run also proves speculation doesn't corrupt the
+pool.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.jobs.spec_serving import PagedSpeculativeDecodeServer  # noqa: E402
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+DCFG = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2, d_ff=32)
+PS = 8
+
+
+def fail(msg: str) -> None:
+    print(f"spec-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def run(server, prompts, check=False):
+    outs = []
+    for wave in (prompts[: len(prompts) // 2], prompts[len(prompts) // 2:]):
+        rids = [server.enqueue(p) for p in wave]
+        server.drain()
+        outs.extend(server.pop_result(r) for r in rids)
+        if check:
+            server.check_invariants()
+    return outs
+
+
+def storm_prompts():
+    fam = [(i * 5) % 60 + 1 for i in range(2 * PS)]
+    return ([fam + [t] for t in (1, 2, 3)]
+            + [[35, 8, 9, 7, 9, 3, 2, 1, 4], [26, 5], [63] * 3])
+
+
+def main() -> int:
+    t_params = init_params(jax.random.PRNGKey(0), CFG)
+    d_params = init_params(jax.random.PRNGKey(7), DCFG)
+    prompts = storm_prompts()
+
+    for kv_int8 in (False, True):
+        tag = "kv_int8" if kv_int8 else "f32"
+        plain = PagedDecodeServer(
+            CFG, t_params, n_slots=2, max_seq=64, max_new_tokens=8,
+            page_size=PS, kv_int8=kv_int8)
+        ref = run(plain, prompts)
+        # monolithic admission
+        spec = PagedSpeculativeDecodeServer(
+            CFG, DCFG, t_params, d_params, n_slots=2, max_seq=64,
+            max_new_tokens=8, page_size=PS, kv_int8=kv_int8, gamma_max=3)
+        got = run(spec, prompts, check=True)
+        if got != ref:
+            fail(f"{tag} monolithic speculative tokens != plain paged")
+        if spec._c_spec_rounds.value <= 0:
+            fail(f"{tag}: no speculative rounds ran — parity was vacuous")
+        acc, prop = spec._c_spec_accepted.value, spec._c_spec_proposed.value
+        if not 0 <= acc <= prop:
+            fail(f"{tag}: acceptance counters inconsistent ({acc}/{prop})")
+        # chunked + prefix-cache admission (shared-family storm hits)
+        spec2 = PagedSpeculativeDecodeServer(
+            CFG, DCFG, t_params, d_params, n_slots=2, max_seq=64,
+            max_new_tokens=8, page_size=PS, kv_int8=kv_int8,
+            prefill_budget=PS, prefix_cache_pages=8, gamma_max=3)
+        got2 = run(spec2, prompts, check=True)
+        if got2 != ref:
+            fail(f"{tag} chunked+prefix speculative tokens != plain paged")
+        if spec2.prefix_cache_stats()["requests_hit"] < 1:
+            fail(f"{tag}: prefix cache never hit — hit parity was vacuous")
+        if any(g != 1 for g in spec2.slot_gammas()):
+            fail(f"{tag}: disagreeing draft did not converge to gamma 1 "
+                 f"({spec2.slot_gammas()})")
+        print(f"spec-check: {tag}: parity ok over {len(ref)} requests, "
+              f"{int(spec2._c_spec_rounds.value)} rounds, "
+              f"{spec2.prefix_cache_stats()['requests_hit']} prefix hits, "
+              f"gammas {spec2.slot_gammas()}")
+
+    # self-draft ceiling: full agreement must pin gamma at gamma_max and
+    # tokens/round at the gamma+1 ceiling (the rounds-not-tokens win)
+    ceiling = PagedSpeculativeDecodeServer(
+        CFG, CFG, t_params, t_params, n_slots=1, max_seq=64,
+        max_new_tokens=31, page_size=PS, n_pages=8, gamma_max=2)
+    rid = ceiling.submit([3, 14, 15, 9])
+    ceiling.drain()
+    ceiling.check_invariants()
+    if ceiling.mean_tokens_per_round() != 3.0:
+        fail(f"self-draft tokens/round {ceiling.mean_tokens_per_round()} "
+             f"!= gamma_max+1 ceiling")
+    if ceiling.slot_gammas() != [2]:
+        fail(f"self-draft walked gamma off gamma_max: {ceiling.slot_gammas()}")
+    plain = PagedDecodeServer(CFG, t_params, n_slots=1, max_seq=64,
+                              max_new_tokens=31, page_size=PS, n_pages=8)
+    rp = plain.submit([3, 14, 15, 9])
+    plain.drain()
+    if ceiling.result(rid) != plain.result(rp):
+        fail("self-draft output != plain paged greedy")
+    print("spec-check: self-draft ceiling ok (tokens/round == gamma_max+1)")
+    print("spec-check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,20 @@ def test_warmup_and_queue_admission(params):
     assert server.pages_in_use() == 0
 
 
+def test_warmup_with_unaligned_max_seq(params):
+    """warmup() must pad its dummies with the same page-rounded bucket
+    the serve path uses — regression for the reshape crash when max_seq
+    is not a page multiple (_bucket caps at max_seq, the pool scatter
+    writes whole pages)."""
+    server = PagedDecodeServer(CFG, params, n_slots=1, max_seq=24,
+                               max_new_tokens=3, page_size=16, n_pages=2)
+    server.warmup()
+    rid = server.enqueue([5, 6, 7])
+    server.drain()
+    assert server.finished(rid)
+    assert server.pages_in_use() == 0
+
+
 def test_pool_smaller_than_worst_case_rejects_up_front(params):
     """A request whose worst case exceeds the WHOLE pool must raise at
     enqueue/submit — accepted-but-never-admittable would park the queue
@@ -334,3 +348,40 @@ def test_int8_windowed_paged_triple_composition(trained_small):
     assert ref.result(rr) == q8.result(rq)
     # the ring bound still holds with the int8 pool
     assert q8.pages_in_use() == 0  # retired
+
+
+def test_paged_steady_state_step_uploads_no_slot_state(params, monkeypatch):
+    """Round-10 upload cache, paged edition: the page TABLE rides the
+    device-resident mirror too — a steady-state decode step issues zero
+    ``jnp.asarray`` uploads, and table mutations (admission mapping new
+    pages, retirement releasing them) dirty the mirror so the next step
+    re-uploads exactly once."""
+    import jax.numpy as jnp
+
+    server = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                               max_new_tokens=30, page_size=8)
+    server.submit([1, 2, 3, 4])
+    server.step()
+    calls = []
+    real = jnp.asarray
+
+    def counting(x, *a, **k):
+        calls.append(np.shape(x))
+        return real(x, *a, **k)
+
+    monkeypatch.setattr(jnp, "asarray", counting)
+    for _ in range(3):
+        server.step()
+    monkeypatch.undo()
+    assert calls == [], f"steady-state step re-uploaded host state: {calls}"
+    # page-boundary crossings mid-decode map new pages host-side; the
+    # mirror must follow (parity tests pin the VALUES; this pins that the
+    # invalidation actually fires so the device never reads a stale table)
+    server.drain()
+    rid2 = server.submit([5] * 9)      # fresh admission re-maps the table
+    monkeypatch.setattr(jnp, "asarray", counting)
+    server.step()
+    monkeypatch.undo()
+    assert any(s == np.shape(server._table) for s in calls), calls
+    server.drain()
+    assert server.finished(rid2)

@@ -1,0 +1,234 @@
+"""Speculative decoding over the paged KV pool (Round 10): greedy output
+must be token-identical to ``PagedDecodeServer``'s — across f32 and
+kv_int8 pools, cold and prefix-cache-hit admissions, chunked and
+monolithic prefill — the pool accounting oracle must hold after every
+speculative storm, and the adaptive-gamma controller must converge (down
+under a disagreeing draft, pinned at gamma_max under self-draft).
+
+Shape discipline: tests share ``max_seq=64``/``gamma_max`` values on
+purpose — the compiled round legs are cached per (cfgs, page_size,
+kv_int8, gamma, draft length), so aligned shapes keep this file's
+compile bill to one set of rounds per pool dtype."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.jobs.spec_serving import PagedSpeculativeDecodeServer
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+DCFG = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2, d_ff=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return (init_params(jax.random.PRNGKey(0), CFG),
+            init_params(jax.random.PRNGKey(7), DCFG))
+
+
+def _spec(params, **kw):
+    t, d = params
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq", 64)
+    return PagedSpeculativeDecodeServer(CFG, DCFG, t, d, **kw)
+
+
+def _staggered(server, prompts):
+    ra = server.submit(prompts[0])
+    server.step()
+    rb = server.submit(prompts[1])
+    server.drain()
+    rc = server.submit(prompts[2])
+    server.drain()
+    return [server.result(r) for r in (ra, rb, rc)]
+
+
+def test_paged_spec_matches_plain_paged_greedy_staggered(params):
+    """Same tokens as PagedDecodeServer for staggered requests crossing
+    page boundaries mid-decode — speculation through the pool must be
+    invisible in the output stream."""
+    t, _d = params
+    prompts = [[3, 14, 15, 9, 2, 6], [26, 5], [35, 8, 9, 7, 9, 3, 2, 1, 4]]
+    plain = PagedDecodeServer(CFG, t, n_slots=2, max_seq=64,
+                              max_new_tokens=12, page_size=8)
+    spec = _spec(params, n_slots=2, max_new_tokens=12, gamma_max=3)
+    got = _staggered(spec, prompts)
+    assert got == _staggered(plain, prompts)
+    assert spec.mean_tokens_per_round() >= 1.0
+    spec.check_invariants()
+    assert spec.pages_in_use() == 0
+
+
+def test_paged_spec_self_draft_hits_the_ceiling(params):
+    """Target as its own draft: total agreement, so every round emits
+    gamma_max+1 tokens, gamma never leaves gamma_max, and the round
+    count is exactly the ceiling — regression for both the draft-cache
+    hole and an adaptive controller that would walk gamma down under
+    full agreement."""
+    t, _d = params
+    srv = PagedSpeculativeDecodeServer(CFG, CFG, t, t, n_slots=1,
+                                       max_seq=64, max_new_tokens=31,
+                                       page_size=8, n_pages=8, gamma_max=2)
+    rid = srv.submit([3, 14, 15, 9])
+    rounds = 0
+    while not srv.finished(rid):
+        srv.step()
+        rounds += 1
+    # 30 post-first tokens at exactly 3/round = 10 rounds, no decay slack
+    assert rounds == 10, rounds
+    assert srv.mean_tokens_per_round() == 3.0
+    assert srv.slot_gammas() == [2]
+    plain = PagedDecodeServer(CFG, t, n_slots=1, max_seq=64,
+                              max_new_tokens=31, page_size=8, n_pages=8)
+    rp = plain.submit([3, 14, 15, 9])
+    plain.drain()
+    assert srv.result(rid) == plain.result(rp)
+
+
+def test_adaptive_gamma_converges_down_on_disagreeing_draft(params):
+    """A random-init draft (near-zero agreement with the target) must
+    walk every serving slot's gamma down to 1 within a few rounds — the
+    low-agreement stream stops buying verify bandwidth it never
+    converts. Output stays exact regardless (greedy verification)."""
+    srv = _spec(params, n_slots=1, max_new_tokens=24, gamma_max=3)
+    rid = srv.submit([5, 9, 3, 1, 7, 2])
+    srv.drain()
+    assert srv.finished(rid)
+    assert srv.slot_gammas() == [1]
+    # acceptance counters: proposed > 0, accepted <= proposed
+    text = srv.metrics_text()
+    assert "kubetpu_spec_rounds_total" in text
+    proposed = srv._c_spec_proposed.value
+    accepted = srv._c_spec_accepted.value
+    assert proposed > 0 and 0 <= accepted <= proposed
+    # a NEW request on the same slot starts optimistic again
+    rid2 = srv.submit([1, 2, 3])
+    assert srv.slot_gammas() == [3]
+    srv.drain()
+    assert srv.finished(rid2)
+
+
+def test_paged_spec_kv_int8_matches_plain_int8_pool(params):
+    """kv_int8 pool: verify-chunk writes quantize with the same
+    per-token scales a one-token decode would use, so the speculative
+    int8 server matches the plain int8 paged server EXACTLY."""
+    t, _d = params
+    prompts = [[3, 14, 15, 9, 2, 6], [26, 5, 1], [7, 9, 2, 8, 4, 6, 1, 3, 5]]
+    plain = PagedDecodeServer(CFG, t, n_slots=2, max_seq=64,
+                              max_new_tokens=10, page_size=8, kv_int8=True)
+    spec = _spec(params, n_slots=2, max_new_tokens=10,
+                 kv_int8=True, gamma_max=2)
+    assert _staggered(spec, prompts) == _staggered(plain, prompts)
+    spec.check_invariants()
+
+
+def test_paged_spec_chunked_and_prefix_hit_parity(params):
+    """Chunked admission + shared-prefix radix-cache hits: the matched
+    prefix skips BOTH the target's and the draft's prefill, and the
+    warm (hit) output is token-identical to the cold plain server's —
+    f32 and kv_int8."""
+    t, _d = params
+    sys_p = [(i * 5) % 60 + 1 for i in range(24)]      # 3 full pages
+    tails = [[7, 8], [9, 1], [11, 2], [13, 4]]
+
+    def run(server):
+        outs = []
+        for tl in tails:
+            rid = server.enqueue(sys_p + tl)
+            server.drain()
+            outs.append(server.pop_result(rid))
+        return outs
+
+    for int8 in (False, True):
+        plain = PagedDecodeServer(CFG, t, n_slots=2, max_seq=64,
+                                  max_new_tokens=8, page_size=8,
+                                  kv_int8=int8)
+        spec = _spec(params, n_slots=2, max_new_tokens=8,
+                     prefill_budget=8, prefix_cache_pages=8,
+                     kv_int8=int8, gamma_max=2 if int8 else 3)
+        assert run(spec) == run(plain), f"kv_int8={int8}"
+        stats = spec.prefix_cache_stats()
+        assert stats["requests_hit"] >= 2      # the hit path actually ran
+        assert stats["prefill_tokens_saved"] > 0
+        spec.check_invariants()
+
+
+def test_paged_spec_storm_keeps_pool_invariants(params):
+    """A mixed speculative storm — chunked admissions, prefix families,
+    pool churn, queue pressure — must leave the accounting oracle clean
+    after every drain and return every non-tree page."""
+    srv = _spec(params, n_slots=2, max_new_tokens=6,
+                prefill_budget=8, prefix_cache_pages=8, gamma_max=3)
+    fam_a = [(i * 5) % 60 + 1 for i in range(16)]
+    fam_b = [(i * 11) % 60 + 1 for i in range(16)]
+    waves = [
+        [fam_a + [1], fam_b + [2], [9, 9, 9]],
+        [fam_a + [3], fam_b + [4], fam_a + [5], [1] * 20],
+        [fam_b + [6], [2] * 9, fam_a + [7]],
+    ]
+    rids = []
+    for wave in waves:
+        rids.extend(srv.enqueue(p) for p in wave)
+        srv.drain()
+        srv.check_invariants()
+    assert all(srv.finished(r) for r in rids)
+    stats = srv.metrics_summary()
+    assert stats["admission_stall"]["count"] == len(rids)
+    assert srv._c_spec_rounds.value > 0
+
+
+def test_paged_spec_unaligned_max_seq_chunked_parity(params):
+    """A NON-page-aligned max_seq whose final chunk bucket rounds past
+    ``max_seq + gamma_max``: the draft cache spans the target's table
+    width, so the chunk's padded write fits it outright — regression for
+    the clamp-shifted draft write that silently misaligned draft KV
+    (output stayed exact; acceptance and the compile cache degraded)."""
+    t, _d = params
+    plain = PagedDecodeServer(CFG, t, n_slots=1, max_seq=57,
+                              max_new_tokens=6, page_size=16, n_pages=4)
+    spec = PagedSpeculativeDecodeServer(
+        CFG, CFG, t, t, n_slots=1, max_seq=57, max_new_tokens=6,
+        page_size=16, n_pages=4, prefill_budget=16, gamma_max=4)
+    assert spec._draft_len > 57 + 4          # spans the padded table
+    prompt = [(i * 7) % 60 + 1 for i in range(50)]
+    rp, rs = plain.enqueue(prompt), spec.enqueue(prompt)
+    plain.drain(), spec.drain()
+    assert spec.result(rs) == plain.result(rp)
+    # self-draft + in-range draft rows: acceptance stays at the ceiling
+    assert spec.mean_tokens_per_round() == 5.0
+    spec.check_invariants()
+
+
+def test_paged_spec_rejects_sampling_window_and_bad_gamma(params):
+    import dataclasses
+
+    t, d = params
+    srv = _spec(params, n_slots=1, max_new_tokens=4)
+    with pytest.raises(ValueError):
+        srv.submit([1, 2], sampling={"temperature": 1.0})
+    with pytest.raises(ValueError):
+        PagedSpeculativeDecodeServer(
+            CFG, dataclasses.replace(DCFG, vocab=32), t, d)
+    with pytest.raises(NotImplementedError):
+        PagedSpeculativeDecodeServer(
+            dataclasses.replace(CFG, window=8), DCFG, t, d)
+    with pytest.raises(ValueError):
+        PagedSpeculativeDecodeServer(CFG, DCFG, t, d, gamma_max=0)
+
+
+@pytest.mark.slow
+def test_paged_spec_warmup_then_serve(params):
+    """warmup() compiles draft buckets + every adaptive gamma's round and
+    leaves the server fully serviceable (queue admission included).
+    Slow: warmup exists to pay compile cost up front, so the test is
+    compile-bound by construction (spec-check covers the serve path)."""
+    srv = _spec(params, n_slots=2, max_seq=32, max_new_tokens=3,
+                prefill_budget=8, gamma_max=2)
+    srv.warmup()
+    rids = [srv.enqueue([i + 1, i + 2]) for i in range(3)]
+    srv.drain()
+    assert all(srv.finished(r) for r in rids)
+    srv.check_invariants()
+    assert srv.pages_in_use() == 0

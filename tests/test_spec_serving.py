@@ -98,6 +98,55 @@ def test_spec_server_rejects_sampling_and_mismatched_vocab(params):
                              d_ff=32), t, d)
 
 
+def test_spec_server_queue_ttl_and_queue_wait(params):
+    """The dense speculative server inherits the SHARED graceful-
+    degradation path (Round-7/8 audit): a queued request past its TTL
+    expires with the counted reason, and admitted-from-queue requests
+    record queue_wait like every SlotServerBase peer."""
+    import time as _time
+
+    srv = _spec(params, n_slots=1, max_seq=64, max_new_tokens=4, gamma=2)
+    ra = srv.submit([1, 2, 3])           # occupies the only slot
+    rb = srv.enqueue([4, 5], ttl=0.0)    # expires at the next round
+    rc = srv.enqueue([6, 7, 8])          # no TTL: admitted once a frees
+    _time.sleep(0.01)
+    srv.step()
+    assert srv.finished(rb) and not srv._emitted[rb]
+    assert srv.expire_reason(rb) == "queue_ttl"
+    assert srv.expire_reason(rc) is None
+    srv.drain()
+    assert srv.finished(ra) and srv.finished(rc)
+    stats = srv.metrics_summary()
+    assert stats["queue_expired"]["count"] == 1
+    # queue_wait: one sample per ADMITTED request (ra via submit, rc via
+    # the queue; the expired rb records queue_expired instead)
+    assert stats["queue_wait"]["count"] == 2
+
+
+def test_spec_server_exports_round_metrics(params):
+    """Round/acceptance counters + the tokens-per-round gauge land on
+    the serving registry (the obs satellite of Round 10)."""
+    t, _d = params
+    srv = SpeculativeDecodeServer(CFG, CFG, t, t, n_slots=1, max_seq=64,
+                                  max_new_tokens=9, gamma=3)
+    rid = srv.submit([3, 14, 15, 9])
+    srv.drain()
+    assert srv.finished(rid)
+    text = srv.metrics_text()
+    for series in ("kubetpu_spec_rounds_total",
+                   "kubetpu_spec_accepted_tokens_total",
+                   "kubetpu_spec_proposed_tokens_total",
+                   "kubetpu_spec_mean_tokens_per_round"):
+        assert series in text, series
+    # self-draft: every proposal accepted, gauge matches the method
+    assert srv._c_spec_accepted.value == srv._c_spec_proposed.value > 0
+    assert srv._c_spec_rounds.value >= 2
+    line = next(l for l in text.splitlines()
+                if l.startswith("kubetpu_spec_mean_tokens_per_round "))
+    assert float(line.split()[-1]) == pytest.approx(
+        srv.mean_tokens_per_round())
+
+
 def test_spec_server_acceptance_sustains_over_long_generation(params):
     """Self-draft acceptance must hold the gamma+1 ceiling across MANY
     rounds — regression for the draft-cache hole: the scan fed only
