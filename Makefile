@@ -37,10 +37,34 @@ test: tpuinfo gpuinfo dataio
 # first (a chaos run whose faults are invisible proves nothing), then
 # prefix-check (a chaos run over a pool the prefix tree corrupted proves
 # the wrong thing), then spec-check (speculative rounds must be invisible
-# in the output stream before chaos means anything).
+# in the output stream before chaos means anything), then bench-gate in
+# smoke mode (a chaos pass that silently regressed serving throughput
+# still fails the round).
 .PHONY: chaos
-chaos: obs-check prefix-check spec-check
+chaos: obs-check prefix-check spec-check bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+
+# bench regression gate: compare the newest BENCH_r0*.json against its
+# predecessor and fail on a >15% regression in any shared storm metric
+# (decode tok/s up-is-good; TTFT p50 / ITL p99 down-is-good). Run
+# `make bench-gate-record` first in a round to measure + persist the
+# round's BENCH_r0N.json.
+.PHONY: bench-gate
+bench-gate:
+	python scripts/bench_gate.py
+
+# smoke mode re-measures a tiny storm in-process and gates it against the
+# newest persisted round — fast enough to ride `make chaos`. The wider
+# threshold absorbs co-tenant wall-clock noise (uniform ~15-20% swings
+# observed on shared machines); the round-to-round file gate above stays
+# at the strict 15%.
+.PHONY: bench-gate-smoke
+bench-gate-smoke:
+	python scripts/bench_gate.py --smoke --threshold 0.35
+
+.PHONY: bench-gate-record
+bench-gate-record:
+	python scripts/bench_gate.py --record
 
 # paged speculative-decoding oracle: greedy parity of draft+verify rounds
 # vs plain paged decode (monolithic + chunked + prefix-hit, f32 + int8),

@@ -605,8 +605,18 @@ def mixed_load_storm(cfg, params=None, n_slots=4, long_len=56, short_len=8,
               for _ in range(rounds * n_shorts)]
 
     def run(budget):
+        from kubetpu.obs.slo import serving_slos
+
         server = DecodeServer(dcfg, params, n_slots=n_slots, max_seq=max_seq,
                               max_new_tokens=max_new, prefill_budget=budget)
+        if budget:
+            # Round-11 signal layer rides the chunked arm: sampled
+            # profiler (enabled pre-warmup so the compile storm is
+            # attributed per leg) + a declared TTFT/ITL SLO judged from
+            # the server's own histograms
+            server.enable_profiler(sample_every=4)
+            server.declare_slos(serving_slos(
+                ttft_p95_s=0.25, itl_p99_s=0.05), eval_interval=0.05)
         server.warmup()
         for r in range(rounds):
             server.enqueue(longs[r])
@@ -614,7 +624,7 @@ def mixed_load_storm(cfg, params=None, n_slots=4, long_len=56, short_len=8,
                 server.enqueue(shorts[r * n_shorts + s])
             server.drain()
         stats = server.metrics_summary()
-        return {
+        row = {
             "metric": "serving_storm",
             "variant": "chunked" if budget else "monolithic",
             "value": round(stats["ttft"]["p50_ms"], 3),
@@ -626,6 +636,22 @@ def mixed_load_storm(cfg, params=None, n_slots=4, long_len=56, short_len=8,
             "n_slots": n_slots,
             "requests": rounds * (1 + n_shorts),
         }
+        if budget:
+            prof = server.profile_summary()
+            row["profile"] = {
+                "coverage": prof["coverage"],
+                "sampled_steps": prof["sampled_steps"],
+                "phases": {k: v["frac"] for k, v in prof["phases"].items()},
+                "recompiles": {k: v["recompiles"]
+                               for k, v in prof["recompiles"].items()},
+            }
+            row["slo"] = {
+                name: {"ok": res["ok"],
+                       "burn_fast": round(res["burn_fast"], 2)}
+                for name, res in server.slo.results().items()
+            }
+            row["events"] = server.events.counts()
+        return row
 
     return run(0), run(prefill_budget)
 
@@ -781,6 +807,11 @@ def speculative_paged_storm(n_slots=4, long_len=48, short_len=12, n_shorts=3,
     n_pages = n_slots * ((max_seq + gamma_max + page_size - 1) // page_size)
 
     def run(server, spec):
+        if spec:
+            # Round-11: recompile tracking on the speculative arm — the
+            # adaptive-gamma walk compiles one round leg per gamma, and
+            # the profiler's per-leg counters make that storm legible
+            server.enable_profiler(sample_every=8)
         server.warmup()
         rid_prompt = []
         t0 = _time.perf_counter()
@@ -809,6 +840,10 @@ def speculative_paged_storm(n_slots=4, long_len=48, short_len=12, n_shorts=3,
             row["tokens_per_round"] = round(server.mean_tokens_per_round(), 2)
             row["acceptance_rate"] = round(
                 server._c_spec_accepted.value / proposed, 3) if proposed else 0.0
+            prof = server.profile_summary()
+            row["recompiles"] = {k: v["recompiles"]
+                                 for k, v in prof["recompiles"].items()}
+            row["gamma_events"] = len(server.events.events(kind="gamma"))
             server.check_invariants()    # the pool oracle rides the bench
         return row
 
@@ -959,6 +994,13 @@ def main() -> int:
                 long_len=384 if args.smoke else 2048,
                 prefill_budget=128 if args.smoke else 256,
                 smoke=args.smoke):
+            emit(row)
+        # admission storm measured by the server's OWN histograms, with
+        # the Round-11 signal layer riding the chunked arm (sampled
+        # profiler phase breakdown + recompiles, declared SLOs, event
+        # counts in the row)
+        for row in mixed_load_storm(
+                cfg, n_slots=4, rounds=2 if args.smoke else 4):
             emit(row)
         # shared-prefix KV reuse: identical system prompt across a storm,
         # radix prefix cache on vs off (Round-9)

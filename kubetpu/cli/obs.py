@@ -8,9 +8,21 @@ scheduler latency percentiles, per-node agent counters, and serving
 TTFT/ITL/queue when a serving endpoint is scraped. ``--trace ID`` renders
 one stitched trace as an indented timeline instead.
 
-    python -m kubetpu.cli.obs --controller URL [--token T]
+    python -m kubetpu.cli.obs [VIEW] --controller URL [--token T]
                               [--scrape URL ...] [--watch SECONDS]
     python -m kubetpu.cli.obs --controller URL --trace TRACE_ID
+
+Round-11 VIEWs over the same endpoints (default ``summary``):
+
+    slo       the declared objectives' judgment surface — SLI value vs
+              threshold, fast/slow burn rates, FIRING flags — from each
+              target's ``kubetpu_slo_*`` gauges (the controller's are
+              fleet-level, a serving exporter's are per-replica)
+    profile   the sampled profiler's per-phase step breakdown + per-leg
+              jit recompile counters from ``kubetpu_profile_*`` /
+              ``kubetpu_jit_*`` (empty unless ``enable_profiler`` ran)
+    events    each target's ``GET /events`` structured event log as a
+              merged timeline (``--kind`` filters, ``--limit`` tails)
 
 One-shot by default; ``--watch N`` redraws every N seconds until ^C.
 Auth: ``KUBETPU_WIRE_TOKEN`` (or ``--token``) rides as the bearer token.
@@ -23,6 +35,7 @@ import json
 import os
 import sys
 import time
+import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
@@ -58,6 +71,16 @@ def render_summary(metrics_text: str, source: str) -> str:
     """One fleet summary block from one exposition text."""
     idx = _index(parse_prometheus_text(metrics_text))
     lines = [f"== {source} =="]
+
+    # replica identification (Round-11 standard process gauges)
+    for labels, _v in idx.get("kubetpu_build_info", []):
+        up = _pick(idx, "kubetpu_process_uptime_seconds") or 0.0
+        rss = _pick(idx, "kubetpu_process_rss_bytes")
+        rss_s = f" rss={rss / 1e6:.0f}MB" if rss and rss == rss else ""
+        lines.append(f"build     {labels.get('component', '?')} "
+                     f"v{labels.get('version', '?')} "
+                     f"up={up:.0f}s{rss_s}")
+        break
 
     states = {labels.get("state"): int(v)
               for labels, v in idx.get("kubetpu_nodes", [])}
@@ -127,6 +150,109 @@ def render_summary(metrics_text: str, source: str) -> str:
     return "\n".join(lines)
 
 
+def render_slo(metrics_text: str, source: str) -> str:
+    """The SLO judgment surface from one exposition text's
+    ``kubetpu_slo_*`` gauges — one row per objective: SLI value vs
+    threshold, OK bit, fast/slow burn rates, FIRING flag. This is the
+    view an operator (or the autoscaler, programmatically) reads to
+    answer "is the fleet inside its objectives, and how fast is the
+    budget burning"."""
+    idx = _index(parse_prometheus_text(metrics_text))
+    lines = [f"== {source} =="]
+    slos: Dict[str, dict] = {}
+    for short in ("value", "threshold", "ok", "firing", "data"):
+        for labels, v in idx.get(f"kubetpu_slo_{short}", []):
+            name = labels.get("slo")
+            if name:
+                slos.setdefault(name, {})[short] = v
+    for labels, v in idx.get("kubetpu_slo_burn_rate", []):
+        name, window = labels.get("slo"), labels.get("window")
+        if name and window:
+            slos.setdefault(name, {})[f"burn_{window}"] = v
+    if not slos:
+        lines.append("no kubetpu_slo_* series (no objectives declared?)")
+        return "\n".join(lines)
+    for name in sorted(slos):
+        s = slos[name]
+        ok = s.get("ok")
+        # data==0: the SLI went absent — value/ok are the LAST definite
+        # verdict, not the current state; never let stale gauges read as
+        # fresh health
+        stale = s.get("data") == 0.0
+        if stale:
+            state, value = "no data", None
+        else:
+            state = ("FIRING" if s.get("firing") else
+                     "ok" if ok else "-" if ok is None else "violating")
+            value = s.get("value")
+        lines.append(
+            f"slo       {name}: "
+            f"value={'-' if value is None else f'{value:.4g}'} "
+            f"threshold={s.get('threshold', float('nan')):.4g} "
+            f"burn fast={s.get('burn_fast', 0.0):.2f} "
+            f"slow={s.get('burn_slow', 0.0):.2f}  {state}")
+    return "\n".join(lines)
+
+
+def render_profile(metrics_text: str, source: str) -> str:
+    """The sampled profiler's breakdown from one exposition text:
+    where a step's milliseconds go (per-phase seconds + share of sampled
+    wall) and what compiled when (per-leg recompile count + compile
+    seconds). Empty unless the replica ran ``enable_profiler``."""
+    idx = _index(parse_prometheus_text(metrics_text))
+    lines = [f"== {source} =="]
+    sampled = _pick(idx, "kubetpu_profile_sampled_steps_total")
+    wall = _pick(idx, "kubetpu_profile_step_seconds_total")
+    if sampled:
+        lines.append(f"profile   sampled_steps={int(sampled)} "
+                     f"wall={wall or 0.0:.3f}s")
+        for labels, v in sorted(
+                idx.get("kubetpu_profile_phase_seconds_total", []),
+                key=lambda lv: lv[0].get("phase", "")):
+            frac = v / wall if wall else 0.0
+            lines.append(f"phase     {labels.get('phase', '?')}: "
+                         f"{v:.3f}s ({frac:.0%})")
+    legs = {}
+    for labels, v in idx.get("kubetpu_jit_recompiles_total", []):
+        legs.setdefault(labels.get("leg", "?"), {})["n"] = v
+    for labels, v in idx.get("kubetpu_jit_compile_seconds_total", []):
+        legs.setdefault(labels.get("leg", "?"), {})["s"] = v
+    for leg in sorted(legs):
+        lines.append(f"compile   {leg}: recompiles="
+                     f"{int(legs[leg].get('n', 0))} "
+                     f"{legs[leg].get('s', 0.0):.3f}s")
+    if len(lines) == 1:
+        lines.append("no profiler series (enable_profiler not called?)")
+    return "\n".join(lines)
+
+
+def render_events(jsonl: str, source: str) -> str:
+    """One ``GET /events`` JSONL body as a human timeline: local time,
+    kind, component, the free-form fields, and a short trace-id link
+    when the event was raised inside a span."""
+    lines = [f"== {source} =="]
+    for raw in jsonl.splitlines():
+        if not raw.strip():
+            continue
+        try:
+            ev = json.loads(raw)
+        except ValueError:
+            lines.append(f"  (unparseable: {raw[:60]!r})")
+            continue
+        ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+        comp = ev.get("component", "")
+        rest = "  ".join(
+            f"{k}={v}" for k, v in ev.items()
+            if k not in ("ts", "seq", "kind", "component", "trace_id"))
+        tid = ev.get("trace_id")
+        link = f"  trace={tid[:8]}" if tid else ""
+        lines.append(f"{ts}  {ev.get('kind', '?'):<16} "
+                     f"{comp:<12} {rest}{link}".rstrip())
+    if len(lines) == 1:
+        lines.append("no events")
+    return "\n".join(lines)
+
+
 def render_trace(body: dict) -> str:
     """Indented span timeline of one stitched trace (children under
     parents, siblings by start time; orphaned parents render at root —
@@ -157,6 +283,14 @@ def render_trace(body: dict) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubetpu-obs", description=__doc__)
+    ap.add_argument("view", nargs="?", default="summary",
+                    choices=("summary", "slo", "profile", "events"),
+                    help="what to render from the scraped targets "
+                         "(default: the fleet summary)")
+    ap.add_argument("--kind", default=None,
+                    help="events view: only this event kind")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="events view: last N events per target")
     ap.add_argument("--controller", default=None,
                     help="controller base URL (its /metrics is already "
                          "fleet-federated)")
@@ -187,12 +321,26 @@ def main(argv=None) -> int:
         targets.append(("controller", args.controller.rstrip("/")))
     targets.extend(("scrape", u.rstrip("/")) for u in args.scrape)
 
+    renderers = {"summary": render_summary, "slo": render_slo,
+                 "profile": render_profile}
     while True:
         blocks = []
         for kind, base in targets:
             try:
-                text = _fetch(base + "/metrics", args.token).decode()
-                blocks.append(render_summary(text, f"{kind} {base}"))
+                if args.view == "events":
+                    q = {}
+                    if args.kind:
+                        q["kind"] = args.kind
+                    if args.limit is not None:
+                        q["limit"] = args.limit
+                    url = base + "/events" + (
+                        "?" + urllib.parse.urlencode(q) if q else "")
+                    body = _fetch(url, args.token).decode()
+                    blocks.append(render_events(body, f"{kind} {base}"))
+                else:
+                    text = _fetch(base + "/metrics", args.token).decode()
+                    blocks.append(
+                        renderers[args.view](text, f"{kind} {base}"))
             except Exception as e:  # noqa: BLE001 — show the gap, keep going
                 blocks.append(f"== {kind} {base} ==\nUNREACHABLE: {e}")
         if args.watch:

@@ -83,6 +83,7 @@ def save_checkpoint(path: str, state: TrainState) -> None:
     import orbax.checkpoint as ocp
 
     from kubetpu.obs import trace as obs_trace
+    from kubetpu.obs.events import event_log
 
     path = os.path.abspath(path)
     with obs_trace.span("checkpoint.save", path=path):
@@ -90,6 +91,7 @@ def save_checkpoint(path: str, state: TrainState) -> None:
             with ocp.StandardCheckpointer() as ckptr:
                 ckptr.save(path, state)
                 ckptr.wait_until_finished()
+            event_log().emit("checkpoint_save", path=path)
             return
         tmp = _tmp_path(path)
         if os.path.isdir(tmp):  # stale orphan from a crashed writer: replace
@@ -99,6 +101,7 @@ def save_checkpoint(path: str, state: TrainState) -> None:
                 ckptr.save(tmp, state)
                 ckptr.wait_until_finished()
             _commit(tmp, path)
+            event_log().emit("checkpoint_save", path=path)
         finally:
             if os.path.isdir(tmp):  # failed before commit: no orphan leak
                 shutil.rmtree(tmp, ignore_errors=True)
@@ -133,6 +136,9 @@ class AsyncCheckpointer:
             tmp, final = self._pending
             self._pending = None
             _commit(tmp, final)
+            from kubetpu.obs.events import event_log
+
+            event_log().emit("checkpoint_save", path=final, deferred=True)
 
     def _abort_pending(self) -> None:
         """The awaited write FAILED: never commit its torn tmp over the
@@ -236,10 +242,13 @@ def restore_checkpoint(path: str, target: TrainState) -> TrainState:
     import orbax.checkpoint as ocp
 
     from kubetpu.obs import trace as obs_trace
+    from kubetpu.obs.events import event_log
 
     path = os.path.abspath(path)
     with obs_trace.span("checkpoint.restore", path=path):
-        return _restore_inner(path, target, ocp)
+        out = _restore_inner(path, target, ocp)
+        event_log().emit("checkpoint_restore", path=path)
+    return out
 
 
 def _restore_inner(path: str, target: TrainState, ocp) -> TrainState:

@@ -655,6 +655,8 @@ class PagedDecodeServer(SlotServerBase):
             if reclaimed:
                 self._free.extend(reclaimed)
                 self._c_evicted.inc(len(reclaimed))
+                self.events.emit("prefix_evict", pages=len(reclaimed),
+                                 reason="pool_pressure")
         if need - have > len(self._free):
             return False
         if need > have:
@@ -763,6 +765,8 @@ class PagedDecodeServer(SlotServerBase):
         self._prefix_cache.pin(node)
         self._slot_pin[slot] = node
         self._slot_pending_stats[slot] = (matched, start)
+        self.events.emit("prefix_hit", slot=slot, matched_tokens=matched,
+                         prefill_start=start, pages=use)
         return start
 
     def _prefix_unmap(self, slot: int) -> None:
@@ -801,9 +805,13 @@ class PagedDecodeServer(SlotServerBase):
             if reclaimed:
                 self._free.extend(reclaimed)
                 self._c_evicted.inc(len(reclaimed))
+                self.events.emit("prefix_evict", pages=len(reclaimed),
+                                 reason="budget")
         consumed = tree.insert(tokens, pages)
         if consumed:
             self._c_inserted.inc(len(consumed))
+            self.events.emit("prefix_publish", slot=slot,
+                             pages=len(consumed))
         return consumed
 
     def prefix_cache_stats(self) -> dict:

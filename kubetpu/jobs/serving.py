@@ -99,7 +99,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubetpu.core.metrics import LatencyRecorder
-from kubetpu.obs.registry import Registry
+from kubetpu.obs.events import EventLog
+from kubetpu.obs.profile import ServingProfiler
+from kubetpu.obs.registry import Registry, install_process_gauges
+from kubetpu.obs.slo import Objective, SloEngine
 from kubetpu.jobs.decode import (
     _dense_cache_io,
     _int8_cache_io,
@@ -219,6 +222,17 @@ class SlotServerBase:
         # ``metrics_summary()`` dict. Occupancy is collect-time gauges —
         # the hot loop pays nothing for them.
         self.obs = Registry()
+        install_process_gauges(self.obs, "serving")
+        # -- Round-11 signal layer: bounded structured event log (always
+        # on — admission/retire/expiry are host bookkeeping, one dict
+        # each), sampled profiler and SLO engine (both OFF by default;
+        # ``enable_profiler`` / ``declare_slos`` opt in — the disabled
+        # paths cost one ``is not None`` check per step, no syncs, no
+        # uploads, pinned by regression test)
+        self.events = EventLog(component="serving")
+        self._profiler: Optional[ServingProfiler] = None
+        self.slo: Optional[SloEngine] = None
+        self._slo_interval = 1.0
         self._metrics = LatencyRecorder(
             registry=self.obs, metric="kubetpu_serving_latency_seconds")
         self.obs.gauge_fn("kubetpu_serving_active_slots",
@@ -320,6 +334,10 @@ class SlotServerBase:
         self._prompts[rid] = list(prompt)
         self._done[rid] = False
         self._note_admitted(slot, prompt)
+        # admit BEFORE any first-token retire: a request finishing on its
+        # very first token must still log admit -> retire in causal order
+        self.events.emit("admit", rid=rid, slot=slot,
+                         prompt_tokens=len(prompt), path="monolithic")
         if defer:
             self._emitted[rid] = []
             self._logprobs[rid] = []
@@ -433,6 +451,7 @@ class SlotServerBase:
                 self._rid_sampling.pop(rid, None)
                 self._arrive.pop(rid, None)  # no tokens ever: no TTFT
                 self._metrics.record("queue_expired", now - deadline)
+                self.events.emit("queue_expired", rid=rid)
             else:
                 keep.append((rid, prompt, deadline))
         if len(keep) != len(self._queue):
@@ -479,6 +498,48 @@ class SlotServerBase:
         ``obs.exporter.MetricsServer`` serves at ``/metrics``."""
         return self.obs.render()
 
+    # -- Round-11 signal layer ------------------------------------------------
+
+    def enable_profiler(self, sample_every: int = 16) -> ServingProfiler:
+        """Turn on the sampled continuous profiler (``obs.profile``):
+        every *sample_every*-th ``step()`` records a per-phase wall
+        breakdown (schedule / dispatch / device / materialize — the
+        device phase costs that one step a ``block_until_ready``), and
+        the compiled legs are wrapped for jit-recompile tracking
+        (``kubetpu_jit_recompiles_total{leg=...}`` + compile seconds).
+        Enable BEFORE ``warmup()`` to see the warmup compile storm
+        attributed per leg. Un-sampled steps (and the default, disabled
+        state) add zero device syncs and zero uploads."""
+        prof = ServingProfiler(sample_every=sample_every, registry=self.obs)
+        self._profiler = prof
+        for attr, leg in (("_prefill_chunk", "prefill"),
+                          ("_step_all", "step"),
+                          ("_draft_prefill", "draft_prefill"),
+                          ("_prefill_jit", "prefill"),
+                          ("_round_jit", "round")):
+            fn = getattr(self, attr, None)
+            if fn is not None:
+                setattr(self, attr, prof.watch(leg, fn))
+        return prof
+
+    def profile_summary(self) -> dict:
+        """The profiler's structured snapshot (phase breakdown, coverage,
+        per-leg recompiles) — {} while disabled."""
+        return self._profiler.summary() if self._profiler else {}
+
+    def declare_slos(self, objectives: List[Objective],
+                     eval_interval: float = 1.0, **engine_kw) -> SloEngine:
+        """Attach an SLO engine (``obs.slo``) over this server's own
+        registry — ``obs.slo.serving_slos(...)`` builds the standard
+        objective set. The engine re-evaluates at most once per
+        *eval_interval* seconds, from inside ``step()`` (one monotonic
+        read per step while declared); results render as
+        ``kubetpu_slo_*`` gauges on ``metrics_text()`` and are readable
+        via ``self.slo.results()``."""
+        self.slo = SloEngine(objectives, registry=self.obs, **engine_kw)
+        self._slo_interval = float(eval_interval)
+        return self.slo
+
     def step(self) -> Dict[int, List[int]]:
         """Admit/advance prefills under the token budget (monolithic when
         ``prefill_budget == 0``; first-token fetch deferred either way),
@@ -489,18 +550,37 @@ class SlotServerBase:
         ``overlap`` the decode materialization is DOUBLE-BUFFERED: this
         call dispatches step N and routes step N-1's tokens (decode
         emission lags one step; ``drain`` flushes the tail)."""
+        prof = self._profiler
+        rec = prof.begin_step() if prof is not None else None
+        if self.slo is not None:
+            self.slo.maybe_evaluate(self._slo_interval)
         self._schedule_prefills()
+        if rec is not None:
+            rec.mark("schedule")
         handle = None
         t0 = time.perf_counter()
         if self.active.any():
             handle = self._dispatch_step()
+        if rec is not None:
+            rec.mark("dispatch")
+            if handle is not None:
+                # the one sampled-step cost: wait for the dispatched leg
+                # so device execution time is attributable (un-sampled
+                # steps never sync here — the overlap pipeline is paused
+                # for exactly this step, not defeated)
+                jax.block_until_ready(handle[:2])
+                rec.mark("device")
         if self.overlap:
             handle, self._inflight = self._inflight, handle
         out = self._materialize_pending()
         if handle is not None:
             self._route_step(handle, out)
+        if rec is not None:
+            rec.mark("materialize")
         if handle is not None or self._inflight is not None:
             self._metrics.record("step", time.perf_counter() - t0)
+        if rec is not None:
+            prof.end_step(rec)
         return out
 
     def _dispatch_step(self):
@@ -715,6 +795,9 @@ class SlotServerBase:
             self._metrics.record("admission_stall", st["t"])
             self._prefills.pop(slot)
             self._prefill_fifo.remove(slot)
+            self.events.emit("admit", rid=rid, slot=slot,
+                             prompt_tokens=len(st["prompt"]),
+                             path="chunked")
         return take
 
     def _prefill_chunk_device(self, prompt: List[int], slot: int, pos: int,
@@ -755,6 +838,8 @@ class SlotServerBase:
 
     def _retire(self, slot: int) -> None:
         rid = self._slot_rid[slot]
+        self.events.emit("retire", rid=rid, slot=slot,
+                         emitted=len(self._emitted.get(rid, ())))
         self._done[rid] = True
         self.active[slot] = False           # slot immediately reusable
         self._invalidate_dev("active")
@@ -783,12 +868,14 @@ class SlotServerBase:
                 self._queue.pop(i)
                 self._done[rid] = True
                 self._rid_sampling.pop(rid, None)
+                self.events.emit("cancel", rid=rid, queued=True)
                 return True
         for slot in range(self.n_slots):
             if self._slot_rid[slot] == rid:
                 # a deferred first token for this slot must not be routed
                 # to the next occupant
                 self._pending_first.pop(slot, None)
+                self.events.emit("cancel", rid=rid, queued=False)
                 self._retire(slot)
                 self._rid_sampling.pop(rid, None)
                 return True

@@ -270,14 +270,32 @@ class SpeculativeDecodeServer(_SpecRoundsMixin, SlotServerBase):
         """One speculative round for every active slot -> {rid: [tokens]};
         each request receives 1..gamma+1 tokens (clipped at EOS and
         max_new_tokens host-side; the device overshoot is never read)."""
+        prof = self._profiler
+        rec = prof.begin_step() if prof is not None else None
+        if self.slo is not None:
+            self.slo.maybe_evaluate(self._slo_interval)
         self._drain_queue_into_slots()
+        if rec is not None:
+            rec.mark("schedule")
         if not self.active.any():
-            return self._materialize_pending()
+            out = self._materialize_pending()
+            if rec is not None:
+                rec.mark("materialize")
+                prof.end_step(rec)
+            return out
         t0 = time.perf_counter()
         toks, n_emit, lps = self._device_round()
+        if rec is not None:
+            # _device_round materializes internally: dispatch + device +
+            # fetch read as one "round" phase on this server
+            rec.mark("round")
         out = self._materialize_pending()
         self._metrics.record("step", time.perf_counter() - t0)
-        return _route_round(self, toks, n_emit, lps, out)
+        out = _route_round(self, toks, n_emit, lps, out)
+        if rec is not None:
+            rec.mark("materialize")
+            prof.end_step(rec)
+        return out
 
     def _slot_proposed(self, slot: int) -> int:
         return self.gamma            # fixed gamma: every slot proposes it
@@ -496,9 +514,13 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
         if ema >= _GAMMA_UP and g < self.gamma_max:
             self._gamma[slot] = g + 1
             self._invalidate_dev("gamma")
+            self.events.emit("gamma", slot=slot, old=g, new=g + 1,
+                             ema=round(ema, 3))
         elif ema < _GAMMA_DOWN and g > 1:
             self._gamma[slot] = g - 1
             self._invalidate_dev("gamma")
+            self.events.emit("gamma", slot=slot, old=g, new=g - 1,
+                             ema=round(ema, 3))
 
     def slot_gammas(self) -> List[int]:
         """Current per-slot adaptive gamma (the ``kubetpu_spec_gamma``
@@ -545,13 +567,28 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
         max_new_tokens host-side; the device overshoot is never read).
         Admission runs the base scheduler first — monolithic or
         token-budget chunked, both composing with prefix-cache hits."""
+        prof = self._profiler
+        rec = prof.begin_step() if prof is not None else None
+        if self.slo is not None:
+            self.slo.maybe_evaluate(self._slo_interval)
         self._schedule_prefills()
+        if rec is not None:
+            rec.mark("schedule")
         if not self.active.any():
-            return self._materialize_pending()
+            out = self._materialize_pending()
+            if rec is not None:
+                rec.mark("materialize")
+                prof.end_step(rec)
+            return out
         t0 = time.perf_counter()
         g = max(int(self._gamma[s]) for s in range(self.n_slots)
                 if self.active[s])
         round_all = self._round_leg(g)
+        if prof is not None:
+            # compile tracking per gamma: an adaptive walk onto an
+            # unwarmed gamma reads as a recompile on ITS leg, not a
+            # mystery stall (watch is idempotent per leg name)
+            round_all = prof.watch(f"round[gamma={g}]", round_all)
         (self.k_pages, self.v_pages, self.dcache, self.last, self.pos,
          toks_d, n_emit_d, lps_d) = round_all(
             self.params, self.draft_params, self.k_pages, self.v_pages,
@@ -560,12 +597,20 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
             self._dev("active", lambda: self.active),
             self._dev("gamma", lambda: self._gamma),
         )
+        if rec is not None:
+            rec.mark("dispatch")
+            jax.block_until_ready((toks_d, n_emit_d, lps_d))
+            rec.mark("device")
         toks = np.asarray(toks_d)
         n_emit = np.asarray(n_emit_d)
         lps = np.asarray(lps_d)
         out = self._materialize_pending()
         self._metrics.record("step", time.perf_counter() - t0)
-        return _route_round(self, toks, n_emit, lps, out)
+        out = _route_round(self, toks, n_emit, lps, out)
+        if rec is not None:
+            rec.mark("materialize")
+            prof.end_step(rec)
+        return out
 
     def _slot_proposed(self, slot: int) -> int:
         return int(self._gamma[slot])  # adaptive: the slot's own gamma
@@ -598,6 +643,13 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
         idle = jnp.asarray(np.zeros((self.n_slots,), bool))
         for g in gammas:
             round_all = self._round_leg(g)
+            if self._profiler is not None:
+                # warm up THROUGH the same watch wrapper step() uses:
+                # the warmup compile is attributed to its gamma leg, and
+                # the first live round at this gamma (same signature) is
+                # NOT falsely booked as a serving-time recompile
+                round_all = self._profiler.watch(
+                    f"round[gamma={g}]", round_all)
             (self.k_pages, self.v_pages, self.dcache,
              _l, _p, _t, _n, _lps) = round_all(
                 self.params, self.draft_params, self.k_pages, self.v_pages,

@@ -21,9 +21,24 @@ is the spine they all hang off:
   yields a single stitched trace across controller -> agent -> allocate
   (retries visible as child spans);
 - ``exporter`` — a tiny stdlib HTTP server exposing any ``Registry`` (and
-  the process tracer) as ``/metrics`` + ``/trace/<id>``, the wire path a
-  serving replica (DecodeServer and friends) publishes its histograms
-  through.
+  the process tracer) as ``/metrics`` + ``/trace/<id>`` + ``/events``,
+  the wire path a serving replica (DecodeServer and friends) publishes
+  its histograms through.
+
+Round-11 adds the SIGNAL layer on top of the recording spine — the
+judge-and-explain surface the autoscaling roadmap item runs on:
+
+- ``slo`` — declarative objectives (TTFT p95, ITL p99, queue-wait p99,
+  availability, pool-free-pages floor) evaluated over Registry
+  snapshots / federated scrapes with fast/slow multi-window burn rates,
+  rendered as ``kubetpu_slo_*`` gauges;
+- ``profile`` — a sampled, off-by-default continuous profiler for the
+  slot servers: per-phase step breakdown plus jit-recompile counters
+  (count + compile seconds per leg), zero cost while disabled;
+- ``events`` — a bounded structured event log (admission, retire,
+  prefix-cache hit/evict, breaker transitions, gamma changes, drain,
+  checkpoint) with JSONL sink and ``GET /events``, cross-linked to
+  trace ids.
 
 Deliberately dependency-free (stdlib only) and import-light: every other
 layer (wire, core, scheduler, jobs) may import ``obs``; ``obs`` imports
@@ -37,6 +52,7 @@ from kubetpu.obs.registry import (
     Registry,
     default_registry,
     federate,
+    install_process_gauges,
     parse_prometheus_text,
     validate_prometheus_text,
 )
@@ -49,21 +65,44 @@ from kubetpu.obs.trace import (
     tracer,
     wire_headers,
 )
+from kubetpu.obs.events import (
+    EventLog,
+    event_log,
+    merge_events,
+    validate_events_jsonl,
+)
+from kubetpu.obs.slo import (
+    Objective,
+    SloEngine,
+    fleet_slos,
+    serving_slos,
+)
+from kubetpu.obs.profile import ServingProfiler
 
 __all__ = [
     "Counter",
+    "EventLog",
     "Gauge",
     "Histogram",
+    "Objective",
     "Registry",
+    "ServingProfiler",
+    "SloEngine",
     "Tracer",
     "attach_wire_context",
     "current_span_id",
     "current_trace_id",
     "default_registry",
+    "event_log",
     "federate",
+    "fleet_slos",
+    "install_process_gauges",
+    "merge_events",
     "parse_prometheus_text",
+    "serving_slos",
     "span",
     "tracer",
+    "validate_events_jsonl",
     "validate_prometheus_text",
     "wire_headers",
 ]

@@ -4,37 +4,56 @@ over HTTP.
 The serving replicas (DecodeServer / PagedDecodeServer and friends) are
 in-process objects with registries but no wire surface of their own; this
 tiny stdlib server is the slot-server wire path: point it at one or more
-registries and scrape
+registries (and, Round-11, event logs) and scrape
 
     GET /metrics      merged Prometheus text of every attached registry
     GET /healthz      liveness
     GET /trace/<id>   finished spans of one trace from the process tracer
+    GET /events       attached event logs as JSON Lines, (ts, seq)-merged;
+                      ``?kind=...`` filters, ``?limit=N`` keeps the tail
 
-``kubetpu.cli.obs`` consumes both endpoints; so does the fleet federation
-test rig. Threaded, ephemeral-port friendly (port 0), same lifecycle
-shape as the wire servers (start/shutdown).
+``kubetpu.cli.obs`` consumes these endpoints; so does the fleet
+federation test rig. Threaded, ephemeral-port friendly (port 0), same
+lifecycle shape as the wire servers (start/shutdown).
 """
 
 from __future__ import annotations
 
+import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, Optional, Union
 
 from kubetpu.obs import trace as obs_trace
+from kubetpu.obs.events import EventLog, merge_events
 from kubetpu.obs.registry import Registry
-from kubetpu.wire.httpcommon import write_json, write_text
+from kubetpu.wire.httpcommon import (
+    serve_events_jsonl,
+    write_json,
+    write_text,
+)
 
 
 class MetricsServer:
-    """Expose named registries at ``/metrics`` + traces at ``/trace/<id>``."""
+    """Expose named registries at ``/metrics`` + traces at ``/trace/<id>``
+    + event logs at ``/events``."""
 
     def __init__(self, registries: Dict[str, Registry],
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 events: Union[EventLog, Dict[str, EventLog],
+                               None] = None) -> None:
         """*registries*: {component name -> Registry}; with more than one,
         every series gains a ``component="<name>"`` label via federation
-        so two replicas' histograms never collide."""
+        so two replicas' histograms never collide. *events*: one
+        ``EventLog`` (a single replica's ``server.events``) or a
+        {component name -> EventLog} map, served merged at /events."""
         self.registries = dict(registries)
+        if events is None:
+            events = {}
+        elif isinstance(events, EventLog):
+            events = {next(iter(registries), "replica"): events}
+        self.event_logs: Dict[str, EventLog] = dict(events)
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -42,12 +61,15 @@ class MetricsServer:
                 pass
 
             def do_GET(self):  # noqa: N802
-                if self.path == "/healthz":
+                url = urllib.parse.urlsplit(self.path)
+                if url.path == "/healthz":
                     write_json(self, 200, {"ok": True})
-                elif self.path == "/metrics":
+                elif url.path == "/metrics":
                     write_text(self, 200, exporter.render())
-                elif self.path.startswith("/trace/"):
-                    tid = self.path[len("/trace/"):]
+                elif url.path == "/events":
+                    serve_events_jsonl(self, exporter.render_events)
+                elif url.path.startswith("/trace/"):
+                    tid = url.path[len("/trace/"):]
                     spans = obs_trace.tracer().spans(tid)
                     write_json(self, 200, {"trace": tid, "spans": spans})
                 else:
@@ -65,6 +87,15 @@ class MetricsServer:
             "", {name: reg.render() for name, reg in self.registries.items()},
             label="component",
         )
+
+    def render_events(self, kind: Optional[str] = None,
+                      limit: Optional[int] = None) -> str:
+        evs = merge_events(self.event_logs, limit=None)
+        if kind is not None:
+            evs = [e for e in evs if e.get("kind") == kind]
+        if limit is not None and limit >= 0:
+            evs = evs[-limit:] if limit else []
+        return "".join(json.dumps(e) + "\n" for e in evs)
 
     @property
     def address(self) -> str:

@@ -33,6 +33,7 @@ from __future__ import annotations
 import random
 import re
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 # label values may contain anything except unescaped quotes/newlines;
@@ -58,12 +59,38 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote AND newline —
+    a raw newline inside a label would split the series line and corrupt
+    the whole exposition."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _unescape_label_value(s: str) -> str:
+    """Inverse of ``_escape_label_value`` — a proper left-to-right scan
+    (sequential ``str.replace`` calls mangle adjacent escapes: ``\\\\"``
+    must decode to ``\\"``, not ``"``)."""
+    out: List[str] = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt,
+                                                             "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
     body = ",".join(
-        '%s="%s"' % (k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
-        for k, v in labels
+        '%s="%s"' % (k, _escape_label_value(str(v))) for k, v in labels
     )
     return "{" + body + "}"
 
@@ -173,6 +200,15 @@ class Histogram:
                   max(0, int(round(p / 100.0 * (len(buf) - 1)))))
         return buf[idx]
 
+    def tail(self) -> Tuple[int, List[float]]:
+        """(exact observation count, copy of the reservoir) under the
+        lock — the SLO engine's windowed-percentile hook: below the cap
+        the reservoir is an append-only log, so an index cursor into it
+        delimits exactly the observations that arrived since the cursor
+        was taken."""
+        with self._lock:
+            return self._count, list(self._buf)
+
 
 class Registry:
     """Get-or-create instrument store, keyed by (name, labels).
@@ -279,6 +315,64 @@ class Registry:
         return "\n".join(lines) + "\n" if lines else ""
 
 
+# -- standard process gauges --------------------------------------------------
+
+# process start proxy: first kubetpu.obs import (the true start isn't
+# portably readable; for the uptime gauge's purpose — "how long has this
+# replica been up" on a federated dashboard — import time is the honest
+# approximation, since every kubetpu process imports obs at boot)
+_PROC_START = time.time()
+
+
+def _build_version() -> str:
+    """The version stamped into ``kubetpu_build_info`` — the installed
+    distribution's, falling back to the in-tree package constant (the
+    usual case for a checked-out repo), then a sentinel."""
+    try:
+        from importlib.metadata import version
+
+        return version("kubetpu")
+    except Exception:  # noqa: BLE001 — not installed as a distribution
+        pass
+    import sys
+
+    mod = sys.modules.get("kubetpu")
+    return getattr(mod, "__version__", None) or "0+unknown"
+
+
+def _rss_bytes() -> float:
+    """Resident set size via stdlib ``resource`` (the satellite's
+    contract): ``ru_maxrss`` is kilobytes on Linux, bytes on macOS.
+    Peak-RSS, not instantaneous — good enough to spot a leaking replica
+    on a dashboard, with zero dependencies."""
+    import sys
+
+    try:
+        import resource
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return float(rss if sys.platform == "darwin" else rss * 1024)
+    except Exception:  # noqa: BLE001 — non-unix: a scrape must never 500
+        return float("nan")
+
+
+def install_process_gauges(registry: Registry, component: str,
+                           version: Optional[str] = None) -> None:
+    """The standard identification trio every kubetpu registry carries
+    (agent, controller, serving replica): ``kubetpu_build_info{version,
+    component} 1`` (the Prometheus build-info idiom — the VALUE is
+    constant, the labels are the payload), process uptime seconds, and
+    RSS bytes. Federated scrapes then identify every replica (version
+    skew, restart storms, memory creep) without out-of-band
+    bookkeeping. Idempotent per registry."""
+    registry.gauge("kubetpu_build_info",
+                   version=version or _build_version(),
+                   component=component).set(1)
+    registry.gauge_fn("kubetpu_process_uptime_seconds",
+                      lambda: time.time() - _PROC_START)
+    registry.gauge_fn("kubetpu_process_rss_bytes", _rss_bytes)
+
+
 # -- process-default registry ------------------------------------------------
 
 _DEFAULT = Registry()
@@ -315,8 +409,7 @@ def parse_prometheus_text(text: str):
             # lenient here (strict grammar checks live in validate): pull
             # every well-formed pair, unescape
             for lm in _LABEL_RE.finditer(body):
-                labels[lm.group(1)] = lm.group(2).replace(
-                    '\\"', '"').replace("\\\\", "\\")
+                labels[lm.group(1)] = _unescape_label_value(lm.group(2))
         try:
             value = float(m.group("value"))
         except ValueError as e:

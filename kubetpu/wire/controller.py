@@ -16,6 +16,12 @@ agents, and serves a small operator HTTP API:
                              agent series relabeled node="<name>"
     GET    /trace/<id>       one stitched trace: controller spans merged
                              with each agent's /trace/<id> leg
+    GET    /events           the controller's structured event log
+                             (breaker transitions, drains, registrations)
+                             as JSON Lines, trace-id cross-linked
+    GET    /slo              last fleet-SLO evaluation (``slos=`` declares
+                             objectives; evaluated per reconcile pass over
+                             the federated /metrics) + firing list
     POST   /nodes            {"url": ..., "token"?: ...} -> register agent
     GET    /nodes            node name -> {url, free chips, pods}
     POST   /pods             {"pod": PodInfo} or {"gang": [PodInfo, ...]}
@@ -76,7 +82,9 @@ from kubetpu.api import utils
 from kubetpu.core import Cluster, SchedulingError
 from kubetpu.core.cluster import GangKey, _reset_for_reschedule, pod_priority
 from kubetpu.obs import trace as obs_trace
-from kubetpu.obs.registry import Registry, federate
+from kubetpu.obs.events import EventLog
+from kubetpu.obs.registry import Registry, federate, install_process_gauges
+from kubetpu.obs.slo import Objective, SloEngine
 from kubetpu.scheduler.deviceclass import GPU, TPU
 from kubetpu.scheduler.translate import pod_device_count, pod_wants_device
 from kubetpu.wire.codec import (
@@ -90,6 +98,7 @@ from kubetpu.wire.httpcommon import (
     check_bearer,
     handle_guarded,
     run_idempotent,
+    serve_events_jsonl,
     write_json,
     write_text,
 )
@@ -130,7 +139,14 @@ class ControllerServer:
         faults=None,
         agent_retry=None,
         idem_window: float = 300.0,
+        slos: Optional[List[Objective]] = None,
     ) -> None:
+        """(Round-11 additions) *slos*: declarative fleet objectives
+        (``obs.slo.fleet_slos(...)`` builds the standard set) evaluated
+        over the controller's OWN federated ``/metrics`` after every
+        reconcile pass — burn rates render as ``kubetpu_slo_*`` gauges
+        and structured results serve at ``GET /slo``, the decision
+        surface the autoscaling roadmap item consumes."""
         self.cluster = cluster or Cluster()
         self.poll_interval = poll_interval
         self.token = token or None
@@ -141,6 +157,13 @@ class ControllerServer:
         # read fresh state under the lock and mutations pay nothing.
         self.obs_component = "controller"
         self.registry = Registry()
+        install_process_gauges(self.registry, "controller")
+        # Round-11: structured event log (breaker transitions, drains,
+        # registrations) at GET /events + fleet SLO engine at GET /slo
+        self.events = EventLog(component="controller")
+        self.slo: Optional[SloEngine] = (
+            SloEngine(slos, registry=self.registry) if slos else None
+        )
         self.cluster.metrics.bind(
             self.registry, "kubetpu_schedule_latency_seconds")
         for key in ("submits", "reconcile_passes",
@@ -242,6 +265,15 @@ class ControllerServer:
                 elif self.path.startswith("/trace/"):
                     tid = self.path[len("/trace/"):]
                     self._reply(200, controller._trace(tid))
+                elif self.path.split("?")[0] == "/events":
+                    serve_events_jsonl(self, controller.events.to_jsonl)
+                elif self.path == "/slo":
+                    self._reply(200, {
+                        "slos": (controller.slo.results()
+                                 if controller.slo is not None else {}),
+                        "firing": (controller.slo.firing()
+                                   if controller.slo is not None else []),
+                    })
                 elif self.path == "/nodes":
                     with controller._lock:
                         status = controller.cluster.status()["nodes"]
@@ -420,6 +452,7 @@ class ControllerServer:
                     f"first, or start the agent with a distinct --name"
                 )
             self.cluster._event("register_remote", node=info.name, url=url)
+            self.events.emit("register", node=info.name, url=url)
             self.cluster.register_node(
                 info.name, device=dev, node_info=info, probe=False
             )
@@ -463,6 +496,7 @@ class ControllerServer:
             h.state = SUSPECT
             self._health_cordon(name)
             self.cluster._event("node_suspect", node=name, misses=h.misses)
+            self.events.emit("node_suspect", node=name, misses=h.misses)
         return False
 
     def _record_ok(self, name: str) -> None:
@@ -484,6 +518,7 @@ class ControllerServer:
             h.state = PROBATION
             h.oks = 0
             self.cluster._event("node_probation", node=name)
+            self.events.emit("node_probation", node=name)
             return
         h.oks += 1
         if h.oks >= self.probation_passes:
@@ -491,6 +526,7 @@ class ControllerServer:
             h.oks = 0
             self._health_uncordon(name)
             self.cluster._event("node_recovered", node=name)
+            self.events.emit("node_recovered", node=name)
 
     def _snapshot_placed(self, name: str, node_name: Optional[str] = None):
         """(device, pod copy) of a placed pod — caller holds the lock.
@@ -612,6 +648,8 @@ class ControllerServer:
                  *self._snapshot_placed(p.name, p.node_name))
                 for p in migrated
             ]
+        self.events.emit("drain", node=name, migrated=len(migrated),
+                         unplaced=len(unplaced))
         out = {"drained": name,
                "migrated": self._allocate_batch(snapshots)}
         with self._lock:
@@ -901,20 +939,33 @@ class ControllerServer:
         latency summaries, breaker/capacity/queue gauges, controller
         counters) merged with every registered agent's ``/metrics``,
         agent series relabeled ``node="<name>"``. Scrape failures skip
-        that agent and count — federation degrades, never 500s."""
+        that agent and count — federation degrades, never 500s. Agents
+        are scraped CONCURRENTLY (same shape as the reconcile probes):
+        the per-reconcile SLO evaluation rides this path, so N dark
+        agents must cost one timeout, not N sequential ones stalling
+        failover and placement."""
         with self._lock:
             targets = {
                 name: (url, self._agent_token(name))
                 for name, url in self._node_urls.items()
             }
         scraped: Dict[str, str] = {}
-        for name, (url, token) in sorted(targets.items()):
+
+        def scrape(item):
+            name, (url, token) = item
             try:
-                scraped[name] = self._scrape_agent_text(
-                    url + "/metrics", token)
+                return name, self._scrape_agent_text(url + "/metrics", token)
             except Exception:  # noqa: BLE001 — degrade per agent
                 self.registry.counter(
                     "kubetpu_controller_federation_scrape_errors_total").inc()
+                return name, None
+
+        if targets:
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(targets))) as pool:
+                for name, text in pool.map(scrape, sorted(targets.items())):
+                    if text is not None:
+                        scraped[name] = text
         return federate(self.registry.render(), scraped)
 
     def _trace(self, trace_id: str) -> dict:
@@ -945,11 +996,20 @@ class ControllerServer:
     def poll_once(self) -> dict:
         """One reconcile pass (see ``_poll_once``) wrapped in a root trace
         span — the reconcile loop runs with no inbound request to parent
-        under, so each pass is its own trace."""
+        under, so each pass is its own trace. With fleet SLOs declared,
+        each pass then evaluates them over the freshly-federated
+        ``/metrics`` — the controller's evaluation window IS its
+        reconcile cadence."""
         self.registry.counter(
             "kubetpu_controller_reconcile_passes_total").inc()
         with obs_trace.span("controller.reconcile", component="controller"):
-            return self._poll_once()
+            out = self._poll_once()
+        if self.slo is not None:
+            try:
+                self.slo.evaluate(self._metrics_text())
+            except Exception as e:  # noqa: BLE001 — judging must not
+                utils.errorf("slo evaluation failed: %s", e)  # stop reconciling
+        return out
 
     def _poll_once(self) -> dict:
         """One reconcile pass: probe remote agents (OUTSIDE the lock — a
@@ -1004,6 +1064,7 @@ class ControllerServer:
                     self._node_urls.pop(name, None)
                     self._pending.extend(self.cluster.fail_node(name))
                     failed.append(name)
+                    self.events.emit("node_dead", node=name)
                 elif self._health_state(name) != HEALTHY:
                     # transient so far: pods stay placed, node is health-
                     # cordoned — a blip shorter than the threshold costs
@@ -1166,6 +1227,8 @@ class ControllerServer:
         reconcile loop pauses — no eviction or re-placement moves pods
         out from under the operator. Named apart from the node-drain
         route (``_drain``)."""
+        if not self.draining:
+            self.events.emit("controller_drain")
         self.draining = True
 
     @property

@@ -77,6 +77,25 @@ def write_text(handler, code: int, text: str,
     _write_body(handler, body)
 
 
+def serve_events_jsonl(handler, render) -> None:
+    """The shared ``GET /events`` plumbing (agent, controller and the
+    obs exporter all serve the same surface): parse ``?kind=``/
+    ``?limit=`` from the handler's path, 400 a non-integer limit, and
+    write *render*(kind=, limit=) as NDJSON."""
+    import urllib.parse
+
+    url = urllib.parse.urlsplit(handler.path)
+    q = urllib.parse.parse_qs(url.query)
+    try:
+        limit = int(q["limit"][0]) if "limit" in q else None
+    except ValueError:
+        write_json(handler, 400, {"error": "limit must be an integer"})
+        return
+    write_text(handler, 200,
+               render(kind=(q.get("kind") or [None])[0], limit=limit),
+               content_type="application/x-ndjson")
+
+
 def _write_body(handler, body: bytes) -> None:
     """Body write with the partial-response fault hook: when the fault
     layer marked this request (``_fault_truncate``), advertise the full

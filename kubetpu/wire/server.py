@@ -16,6 +16,8 @@ small threaded HTTP server wrapping a ``device.Device``:
                        scrapes and federates this into its fleet /metrics
     GET  /trace/<id>-> finished spans of one trace from the process tracer
                        (agent legs of a stitched controller trace)
+    GET  /events    -> this agent's structured event log (allocate /
+                       replay / drain) as JSON Lines, trace-id linked
     POST /allocate  -> {"pod": PodInfo, "container": <name>} ->
                        AllocateResult JSON (the container-start injection
                        step, run node-local where the devices live)
@@ -50,7 +52,8 @@ from kubetpu.api import utils
 from kubetpu.api.device import Device
 from kubetpu.api.types import new_node_info
 from kubetpu.obs import trace as obs_trace
-from kubetpu.obs.registry import Registry
+from kubetpu.obs.events import EventLog
+from kubetpu.obs.registry import Registry, install_process_gauges
 from kubetpu.wire.codec import (
     allocate_result_to_json,
     node_info_to_json,
@@ -62,6 +65,7 @@ from kubetpu.wire.httpcommon import (
     check_bearer,
     handle_guarded,
     run_idempotent,
+    serve_events_jsonl,
     write_json,
     write_text,
 )
@@ -98,13 +102,20 @@ class NodeAgentServer:
         # the old hand-rolled counter dict + lock are gone — /metrics
         # renders the registry, writers inc() instruments
         self.registry = Registry()
+        install_process_gauges(self.registry, self.obs_component)
         for key in ("nodeinfo_requests", "allocate_requests",
                     "allocate_replays", "errors"):
             self.registry.counter(f"kubetpu_agent_{key}_total")
+        # legacy alias (pinned by test_wire): the Round-11 standard
+        # kubetpu_process_uptime_seconds is the fleet-wide series; this
+        # one measures from server construction rather than obs import
         self.registry.gauge_fn(
             "kubetpu_agent_uptime_seconds",
             lambda: time.time() - self.started_at,
         )
+        # Round-11: bounded structured event log (allocate/replay/drain),
+        # served as JSONL at GET /events, trace-id cross-linked
+        self.events = EventLog(component=self.obs_component)
         # graceful lifecycle: while draining, mutating work is refused 503
         # but in-flight requests run to completion (tracked so a graceful
         # shutdown can wait for them)
@@ -179,6 +190,8 @@ class NodeAgentServer:
                         "trace": tid,
                         "spans": obs_trace.tracer().spans(tid),
                     })
+                elif self.path.split("?")[0] == "/events":
+                    serve_events_jsonl(self, agent.events.to_jsonl)
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -207,6 +220,8 @@ class NodeAgentServer:
                     if cont is None:
                         return 400, {"error": f"pod has no container {cname!r}"}
                     result = agent.device.allocate(pod, cont)
+                    agent.events.emit("allocate", pod=pod.name,
+                                      container=cname)
                     return 200, allocate_result_to_json(result)
                 except Exception as e:  # noqa: BLE001 — report, stay up
                     bump("errors")
@@ -221,10 +236,14 @@ class NodeAgentServer:
                 # idempotency: a keyed retry of an allocate whose response
                 # was lost replays the committed result (the shared
                 # run_idempotent contract, httpcommon)
+                def replayed():
+                    bump("allocate_replays")
+                    agent.events.emit("allocate_replay")
+
                 run_idempotent(
                     self, agent.idem, self.headers.get("Idempotency-Key"),
                     self._allocate,
-                    on_replay=lambda: bump("allocate_replays"),
+                    on_replay=replayed,
                 )
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -285,6 +304,8 @@ class NodeAgentServer:
     def drain(self) -> None:
         """Stop accepting mutating work (POST -> 503); reads and liveness
         keep answering, in-flight requests finish."""
+        if not self.draining:
+            self.events.emit("drain", node=self.node_name)
         self.draining = True
 
     def shutdown(self, graceful: bool = True, timeout: float = 5.0) -> None:

@@ -7,15 +7,22 @@ and fails (exit 1) on:
 
 - malformed Prometheus text (``obs.validate_prometheus_text``);
 - any missing REQUIRED series: scheduler latency summary, per-node agent
-  allocate counters, the breaker-state node gauge, chips/pending gauges;
+  allocate counters, the breaker-state node gauge, chips/pending gauges,
+  and (Round-11) the standard process gauges (``kubetpu_build_info`` /
+  uptime / RSS) plus the fleet ``kubetpu_slo_*`` judgment surface;
 - a submit whose trace does not stitch (no shared trace_id across
-  controller and agent spans).
+  controller and agent spans);
+- (Round-11) a ``GET /events`` body on the controller, any agent, or a
+  serving-style exporter that is not schema-valid event JSONL, a
+  controller event log missing its registration events, or a profiler-
+  carrying exporter scrape missing the ``kubetpu_profile_*`` series.
 
 Runs in a few seconds with no accelerator; wired into the chaos target so
 every fault-injection run also proves the fleet is observable.
 """
 
 import sys
+import time
 
 sys.path.insert(0, ".")
 
@@ -24,7 +31,17 @@ from kubetpu.device import (  # noqa: E402
     make_fake_tpus_info,
     new_fake_tpu_dev_manager,
 )
-from kubetpu.obs import span, validate_prometheus_text  # noqa: E402
+from kubetpu.obs import (  # noqa: E402
+    EventLog,
+    Registry,
+    ServingProfiler,
+    install_process_gauges,
+    span,
+    validate_events_jsonl,
+    validate_prometheus_text,
+)
+from kubetpu.obs.exporter import MetricsServer  # noqa: E402
+from kubetpu.obs.slo import fleet_slos  # noqa: E402
 from kubetpu.plugintypes import ResourceTPU  # noqa: E402
 from kubetpu.wire import ControllerServer, NodeAgentServer  # noqa: E402
 from kubetpu.wire.controller import pod_to_json  # noqa: E402
@@ -41,7 +58,44 @@ REQUIRED_SERIES = (
     'kubetpu_chips_held{device="kubedevice/tpu"}',
     "kubetpu_controller_submits_total 2",
     "kubetpu_agent_capacity",
+    # Round-11: replica identification + the fleet SLO surface
+    'component="controller"',
+    "kubetpu_build_info{",
+    "kubetpu_process_uptime_seconds",
+    "kubetpu_process_rss_bytes",
+    'kubetpu_slo_value{slo="node_availability"}',
+    'kubetpu_slo_ok{slo="node_availability"} 1',
+    'kubetpu_slo_burn_rate{slo="node_availability",window="fast"}',
+    'kubetpu_slo_burn_rate{slo="node_availability",window="slow"}',
+    'kubetpu_slo_firing{slo="node_availability"} 0',
 )
+
+# the serving-style exporter scrape must carry the profiler families
+REQUIRED_PROFILE_SERIES = (
+    "kubetpu_profile_sampled_steps_total",
+    "kubetpu_profile_step_seconds_total",
+    'kubetpu_profile_phase_seconds_total{phase="device"',
+    'kubetpu_jit_recompiles_total{leg="step"}',
+    'kubetpu_jit_compile_seconds_total{leg="step"}',
+    "kubetpu_build_info{",
+)
+
+
+def _get_text(url: str) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+def _check_events(name: str, body: str, failures, expect_kinds=()):
+    problems = validate_events_jsonl(body)
+    if problems:
+        failures.append(f"{name} /events not schema-valid JSONL:\n  " +
+                        "\n  ".join(problems[:5]))
+    for kind in expect_kinds:
+        if f'"kind": "{kind}"' not in body:
+            failures.append(f"{name} /events missing a {kind!r} event")
 
 
 def main() -> int:
@@ -54,7 +108,12 @@ def main() -> int:
         )
         for h in range(2)
     ]
-    controller = ControllerServer(poll_interval=3600)
+    controller = ControllerServer(
+        poll_interval=3600,
+        # the fleet judgment surface under test: with both agents
+        # healthy, availability must evaluate ok and not fire
+        slos=fleet_slos(min_healthy_fraction=0.5),
+    )
     controller.start()
     try:
         for a in agents:
@@ -100,6 +159,54 @@ def main() -> int:
             failures.append(
                 f"trace {trace_id} did not stitch across controller and "
                 f"agent spans (components: {sorted(comps)})")
+
+        # Round-11: GET /events must serve schema-valid JSONL fleet-wide
+        _check_events(
+            "controller",
+            _get_text(controller.address + "/events"),
+            failures, expect_kinds=("register",))
+        for a in agents:
+            _check_events(
+                a.node_name,
+                _get_text(a.address + "/events"),
+                failures, expect_kinds=("allocate",))
+
+        # Round-11: a serving-style exporter carrying a profiler + event
+        # log (no accelerator: the profiler is exercised host-side — the
+        # serving integration is pinned by the jax test suite)
+        sreg = Registry()
+        install_process_gauges(sreg, "serving")
+        prof = ServingProfiler(sample_every=1, registry=sreg)
+        rec = prof.begin_step()
+        time.sleep(0.001)
+        rec.mark("schedule")
+        rec.mark("device")
+        prof.end_step(rec)
+        step = prof.watch("step", lambda *a: None)
+        step(1)
+        step(1.5)          # new call signature -> one tracked recompile
+        slog = EventLog(component="serving")
+        slog.emit("admit", rid="r0", slot=0)
+        slog.emit("retire", rid="r0", slot=0)
+        exporter = MetricsServer({"replica": sreg}, events=slog)
+        exporter.start()
+        try:
+            base = exporter.address
+            stext = _get_text(base + "/metrics")
+            sproblems = validate_prometheus_text(stext)
+            if sproblems:
+                failures.append("exporter /metrics malformed:\n  " +
+                                "\n  ".join(sproblems[:5]))
+            for needle in REQUIRED_PROFILE_SERIES:
+                if needle not in stext:
+                    failures.append(
+                        f"exporter missing profiler series: {needle!r}")
+            _check_events(
+                "exporter",
+                _get_text(base + "/events"),
+                failures, expect_kinds=("admit", "retire"))
+        finally:
+            exporter.shutdown()
     finally:
         controller.shutdown()
         for a in agents:
@@ -110,7 +217,8 @@ def main() -> int:
             print(f"  - {f}", file=sys.stderr)
         return 1
     print("obs-check OK: federated /metrics valid, required series "
-          "present, submit trace stitched")
+          "(incl. slo/build-info/profiler) present, submit trace "
+          "stitched, /events schema-valid fleet-wide")
     return 0
 
 

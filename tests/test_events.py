@@ -1,0 +1,181 @@
+"""Round-11 structured event log: bounded ring semantics, JSONL schema
++ validation, trace cross-linking, the sink tee, multi-log merging, and
+the ``GET /events`` wire surface on the exporter and both wire servers."""
+
+import json
+
+import pytest
+
+from kubetpu.obs import span
+from kubetpu.obs.events import (
+    EventLog,
+    event_log,
+    merge_events,
+    validate_events_jsonl,
+)
+
+
+def test_ring_bounds_and_drop_counter():
+    log = EventLog(capacity=4)
+    for i in range(7):
+        log.emit("tick", i=i)
+    assert len(log) == 4
+    assert log.dropped == 3
+    evs = log.events()
+    assert [e["i"] for e in evs] == [3, 4, 5, 6]       # oldest-first tail
+    assert [e["seq"] for e in evs] == [3, 4, 5, 6]     # seq keeps counting
+    with pytest.raises(ValueError):
+        EventLog(capacity=0)
+
+
+def test_kind_filter_limit_and_counts():
+    log = EventLog()
+    for i in range(3):
+        log.emit("admit", rid=f"r{i}")
+    log.emit("retire", rid="r0")
+    assert [e["rid"] for e in log.events(kind="admit", limit=2)] == \
+        ["r1", "r2"]
+    assert log.events(limit=0) == []          # not "[-0:] = everything"
+    assert log.counts() == {"admit": 3, "retire": 1}
+
+
+def test_component_and_field_coercion():
+    log = EventLog(component="serving")
+    ev = log.emit("admit", rid="r0", obj=object(), none=None, flag=True)
+    assert ev["component"] == "serving"
+    assert isinstance(ev["obj"], str)       # non-JSON values coerced
+    assert ev["none"] is None and ev["flag"] is True
+    # a per-call component overrides the log's
+    assert log.emit("x", component="agent:h0")["component"] == "agent:h0"
+
+
+def test_trace_id_cross_link():
+    log = EventLog()
+    with span("unit.op") as s:
+        ev = log.emit("inside")
+    outside = log.emit("outside")
+    assert ev["trace_id"] == s.trace_id
+    assert "trace_id" not in outside
+
+
+def test_jsonl_roundtrip_and_validation():
+    log = EventLog(component="c")
+    log.emit("a", x=1)
+    log.emit("b", y="two")
+    text = log.to_jsonl()
+    assert validate_events_jsonl(text) == []
+    lines = [json.loads(line) for line in text.splitlines()]
+    assert [e["kind"] for e in lines] == ["a", "b"]
+    # the validator actually catches breakage
+    bad = 'not json\n{"ts": "late", "seq": 1.5, "kind": 3}\n[1, 2]\n'
+    problems = validate_events_jsonl(bad)
+    assert len(problems) == 5, problems     # not-JSON, ts, seq, kind, not-obj
+
+
+def test_sink_tee_and_survives_close(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog()
+    log.set_sink(str(path))
+    log.emit("a", n=1)
+    log.set_sink(None)
+    log.emit("b", n=2)              # after close: ring only
+    text = path.read_text()
+    assert validate_events_jsonl(text) == []
+    assert '"kind": "a"' in text and '"kind": "b"' not in text
+    assert len(log) == 2
+
+
+def test_merge_events_orders_and_stamps():
+    a, b = EventLog(), EventLog(component="b")
+    a.emit("first")
+    b.emit("second")
+    a.emit("third")
+    merged = merge_events({"a": a, "b": b})
+    assert [e["kind"] for e in merged] == ["first", "second", "third"]
+    assert merged[0]["component"] == "a"        # stamped by merge
+    assert merged[1]["component"] == "b"        # the log's own wins
+    assert merge_events({"a": a, "b": b}, limit=1)[0]["kind"] == "third"
+
+
+def test_process_default_log_exists():
+    assert event_log() is event_log()
+    before = len(event_log())
+    event_log().emit("unit_test_marker")
+    assert len(event_log()) == before + 1
+
+
+def test_exporter_serves_events_with_filters():
+    import urllib.request
+
+    from kubetpu.obs.exporter import MetricsServer
+    from kubetpu.obs.registry import Registry
+
+    log = EventLog(component="serving")
+    log.emit("admit", rid="r0")
+    log.emit("retire", rid="r0")
+    log.emit("admit", rid="r1")
+    srv = MetricsServer({"replica": Registry()}, events=log)
+    srv.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(srv.address + path, timeout=5) as r:
+                return r.read().decode()
+
+        body = get("/events")
+        assert validate_events_jsonl(body) == []
+        assert len(body.splitlines()) == 3
+        only_admits = get("/events?kind=admit")
+        assert len(only_admits.splitlines()) == 2
+        assert '"retire"' not in only_admits
+        tail = get("/events?kind=admit&limit=1")
+        assert json.loads(tail)["rid"] == "r1"
+    finally:
+        srv.shutdown()
+
+
+def test_agent_and_controller_serve_events():
+    """The wire servers' /events: the agent records allocates, the
+    controller records registrations — both schema-valid JSONL."""
+    import urllib.request
+
+    from kubetpu.api.types import ContainerInfo, PodInfo
+    from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+    from kubetpu.plugintypes import ResourceTPU
+    from kubetpu.wire import ControllerServer, NodeAgentServer
+    from kubetpu.wire.controller import pod_to_json
+    from kubetpu.wire.httpcommon import request_json
+
+    agent = NodeAgentServer(
+        new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-16")), "ev-h0")
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    agent.start()
+    try:
+        request_json(controller.address + "/nodes", {"url": agent.address})
+        request_json(
+            controller.address + "/pods",
+            {"pod": pod_to_json(PodInfo(
+                name="ev-p0",
+                running_containers={"main": ContainerInfo(
+                    requests={ResourceTPU: 4})},
+            ))},
+            idempotency_key="ev-p0")
+        controller.poll_once()
+
+        def get(base, path):
+            with urllib.request.urlopen(base + path, timeout=5) as r:
+                return r.read().decode()
+
+        abody = get(agent.address, "/events")
+        assert validate_events_jsonl(abody) == []
+        allocates = [json.loads(line) for line in abody.splitlines()
+                     if '"allocate"' in line]
+        assert allocates and allocates[0]["component"] == "agent:ev-h0"
+        # the allocate ran inside the wire-propagated submit span
+        assert "trace_id" in allocates[0]
+        cbody = get(controller.address, "/events")
+        assert validate_events_jsonl(cbody) == []
+        assert '"kind": "register"' in cbody
+    finally:
+        controller.shutdown()
+        agent.shutdown()

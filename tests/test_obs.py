@@ -132,6 +132,83 @@ def test_federate_relabels_and_dedups_types():
     assert 'kubetpu_agent_errors_total{node="h0"} 1' in text2
 
 
+# -- Round-11 exposition round-trip edge cases (ISSUE 6 satellite) -----------
+
+
+def test_label_value_escaping_round_trip():
+    """Backslashes, newlines and quotes in label values must survive
+    render -> validate -> parse byte-exactly — adjacent escapes are the
+    trap (``\\\\"`` must decode to ``\\"``, not ``"``), and an unescaped
+    newline would split the series line and corrupt the exposition."""
+    nasty = [
+        'C:\\tmp\\x',            # backslashes
+        'line1\nline2',          # raw newline
+        'say "hi"',              # quotes
+        'mix\\"q\\\\n',          # adjacent escape soup
+        'trail\\',               # trailing backslash
+    ]
+    reg = Registry()
+    for i, v in enumerate(nasty):
+        reg.counter("kubetpu_esc_total", path=v, i=str(i)).inc(i + 1)
+    text = reg.render()
+    assert validate_prometheus_text(text) == []
+    got = {labels["path"]: value
+           for name, labels, value in parse_prometheus_text(text)}
+    assert got == {v: float(i + 1) for i, v in enumerate(nasty)}
+    # and through federation (parse -> relabel -> re-render -> re-parse)
+    fed = federate("", {"n0": text})
+    assert validate_prometheus_text(fed) == []
+    got2 = {labels["path"]: labels["node"]
+            for _n, labels, _v in parse_prometheus_text(fed)}
+    assert set(got2) == set(nasty)
+    assert set(got2.values()) == {"n0"}
+
+
+def test_empty_reservoir_histogram_round_trips_without_nan():
+    """A histogram with count == 0 (created, never observed — every
+    serving server pre-creates its latency families) must render, parse
+    and federate as zeros: a NaN percentile would poison any fleet
+    aggregation downstream."""
+    reg = Registry()
+    reg.histogram("kubetpu_lat_seconds", op="empty")
+    text = reg.render()
+    assert validate_prometheus_text(text) == []
+    assert "nan" not in text.lower()
+    samples = parse_prometheus_text(text)
+    assert ("kubetpu_lat_seconds_count", {"op": "empty"}, 0.0) in samples
+    for _n, labels, value in samples:
+        assert value == 0.0
+    fed = federate("", {"n0": text})
+    assert validate_prometheus_text(fed) == []
+    assert "nan" not in fed.lower()
+
+
+def test_install_process_gauges():
+    """The standard identification trio (ISSUE 6 satellite): build info
+    with version+component labels, uptime, RSS — idempotent, valid, and
+    distinct per component under federation."""
+    from kubetpu.obs.registry import install_process_gauges
+
+    reg = Registry()
+    install_process_gauges(reg, "controller")
+    install_process_gauges(reg, "controller")     # idempotent
+    text = reg.render()
+    assert validate_prometheus_text(text) == []
+    assert 'component="controller"' in text
+    assert "kubetpu_build_info{" in text
+    samples = {name: value
+               for name, _l, value in parse_prometheus_text(text)}
+    assert samples["kubetpu_build_info"] == 1.0
+    assert samples["kubetpu_process_uptime_seconds"] >= 0.0
+    # RSS is best-effort (nan off-unix) but on Linux it is real bytes
+    assert samples["kubetpu_process_rss_bytes"] > 1e6
+    other = Registry()
+    install_process_gauges(other, "agent:h0")
+    fed = federate(text, {"h0": other.render()})
+    assert validate_prometheus_text(fed) == []
+    assert 'component="agent:h0"' in fed
+
+
 # -- LatencyRecorder over obs histograms -------------------------------------
 
 

@@ -90,6 +90,129 @@ def test_submit_path_records_ttft_immediately(params):
     assert stats["queue_wait"]["p50_ms"] <= stats["ttft"]["p50_ms"]
 
 
+def test_admit_event_precedes_first_token_retire(params):
+    """A request that finishes on its very first token (max_new_tokens=1)
+    must still log admit -> retire in causal (seq) order — the event
+    timeline exists to answer 'what happened in what order'."""
+    srv = DecodeServer(CFG, params, n_slots=1, max_seq=64, max_new_tokens=1)
+    srv.submit(PROMPTS[0])
+    kinds = [e["kind"] for e in srv.events.events()]
+    assert "admit" in kinds and "retire" in kinds
+    assert kinds.index("admit") < kinds.index("retire")
+
+
+# -- Round-11 sampled profiler ------------------------------------------------
+
+
+def test_step_profiler_disabled_adds_no_syncs_or_uploads(monkeypatch):
+    """The ISSUE 6 acceptance pin, alongside the Round-10 zero-upload
+    pin: with the profiler DISABLED (the default), steady-state step()
+    issues ZERO ``jax.block_until_ready`` device syncs and ZERO
+    ``jnp.asarray`` host uploads — observability must never defeat the
+    overlap double-buffer. With it ENABLED at sample rate N, exactly the
+    sampled step pays exactly one sync."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    srv = DecodeServer(CFG, params, n_slots=2, max_seq=64,
+                       max_new_tokens=40)
+    srv.submit([1, 2, 3, 4])
+    srv.step()                          # mirrors warm, decode mid-flight
+    syncs, uploads = [], []
+    real_sync, real_asarray = jax.block_until_ready, jnp.asarray
+
+    def counting_sync(x):
+        syncs.append(1)
+        return real_sync(x)
+
+    def counting_upload(x, *a, **k):
+        uploads.append(np.shape(x))
+        return real_asarray(x, *a, **k)
+
+    monkeypatch.setattr(jax, "block_until_ready", counting_sync)
+    monkeypatch.setattr(jnp, "asarray", counting_upload)
+    for _ in range(4):
+        srv.step()
+    monkeypatch.undo()
+    assert syncs == [], "disabled profiler issued a device sync"
+    assert uploads == [], f"disabled profiler uploaded host state: {uploads}"
+
+    # enabled at rate 2: the sampled step pays one sync, its neighbor none
+    srv.enable_profiler(sample_every=2)
+    monkeypatch.setattr(jax, "block_until_ready", counting_sync)
+    monkeypatch.setattr(jnp, "asarray", counting_upload)
+    srv.step()                          # step index 0: sampled
+    sampled_syncs = len(syncs)
+    srv.step()                          # step index 1: not sampled
+    monkeypatch.undo()
+    assert sampled_syncs == 1
+    assert len(syncs) == 1
+    assert uploads == [], "profiler uploaded host state"
+    srv.drain()
+
+
+def test_profiler_breakdown_covers_step_wall(params):
+    """Enabled at rate 1 under a mixed chunked-admission load, the
+    per-phase breakdown tiles the step: named phases sum to >= 90% of
+    sampled wall (the acceptance bar — a breakdown that loses a tenth of
+    the step hides the problem it exists to find), and the series render
+    on the server's own registry."""
+    srv = DecodeServer(CFG, params, n_slots=2, max_seq=64, max_new_tokens=6,
+                       prefill_budget=3)
+    prof = srv.enable_profiler(sample_every=1)
+    run_mixed(srv)
+    s = prof.summary()
+    assert s["sampled_steps"] == s["steps"] > 0
+    assert {"schedule", "dispatch", "materialize"} <= set(s["phases"])
+    assert "device" in s["phases"]           # sampled steps synced
+    assert s["coverage"] >= 0.9, s
+    assert s["coverage"] <= 1.0 + 1e-6
+    text = srv.metrics_text()
+    assert validate_prometheus_text(text) == []
+    assert "kubetpu_profile_sampled_steps_total" in text
+    assert 'kubetpu_profile_phase_seconds_total{phase="device"}' in text
+    # profile_summary() is the bench-row surface; {} while disabled
+    assert srv.profile_summary() == s
+    assert DecodeServer(CFG, params, n_slots=2, max_seq=64,
+                        max_new_tokens=4).profile_summary() == {}
+
+
+@pytest.mark.slow
+def test_gamma_walk_shows_recompile_counters(params):
+    """The recompile-storm pin (ISSUE 6 acceptance): an adaptive-gamma
+    walk onto a not-yet-compiled round leg reads as a NONZERO
+    ``kubetpu_jit_recompiles_total{leg="round[gamma=G]"}`` counter with
+    compile seconds attached — not a mystery stall. A random-init draft
+    walks gamma down from gamma_max, so the gamma-1 leg compiles only
+    AFTER the change; page_size 4 keeps these legs distinct from every
+    other test's compile cache. Slow-marked: compiles its own draft +
+    round legs."""
+    from kubetpu.jobs import ModelConfig
+    from kubetpu.jobs.spec_serving import PagedSpeculativeDecodeServer
+
+    dcfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2, d_ff=32)
+    d_params = init_params(jax.random.PRNGKey(7), dcfg)
+    srv = PagedSpeculativeDecodeServer(CFG, dcfg, params, d_params,
+                                       n_slots=1, max_seq=64,
+                                       max_new_tokens=24,
+                                       page_size=4, gamma_max=2)
+    prof = srv.enable_profiler(sample_every=1)
+    rid = srv.submit([5, 9, 3, 1, 7, 2])
+    while not srv.finished(rid):
+        srv.step()
+    gammas = srv.events.events(kind="gamma")
+    assert gammas and gammas[0]["old"] == 2 and gammas[0]["new"] == 1
+    s = prof.summary()
+    assert s["recompiles"].get("round[gamma=1]", {}).get(
+        "recompiles", 0) >= 1, s["recompiles"]
+    text = srv.metrics_text()
+    assert 'kubetpu_jit_recompiles_total{leg="round[gamma=1]"}' in text
+    assert 'kubetpu_jit_compile_seconds_total{leg="round[gamma=1]"}' in text
+    assert s["coverage"] >= 0.9, s
+    srv.check_invariants()
+
+
 @pytest.mark.slow
 def test_chunked_ttft_p50_beats_monolithic_under_storm():
     """ISSUE 3 satellite ordering, via the bench harness: under a
