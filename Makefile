@@ -33,16 +33,33 @@ test: tpuinfo gpuinfo dataio
 	python -m pytest tests/ -x -q
 
 # seeded fault-injection soaks + the resilience suite (the short soak
-# also runs in tier-1; this target adds the slow 30% one). obs-check runs
-# first (a chaos run whose faults are invisible proves nothing), then
-# prefix-check (a chaos run over a pool the prefix tree corrupted proves
-# the wrong thing), then spec-check (speculative rounds must be invisible
-# in the output stream before chaos means anything), then bench-gate in
-# smoke mode (a chaos pass that silently regressed serving throughput
-# still fails the round).
+# also runs in tier-1; this target adds the slow 30% one). lint runs
+# FIRST (a chaos run over code that violates the wire/lock invariants
+# proves the wrong thing — a raw urlopen is invisible to the very faults
+# the soak injects), then obs-check (a chaos run whose faults are
+# invisible proves nothing), then prefix-check (a chaos run over a pool
+# the prefix tree corrupted proves the wrong thing), then spec-check
+# (speculative rounds must be invisible in the output stream before
+# chaos means anything), then bench-gate in smoke mode (a chaos pass
+# that silently regressed serving throughput still fails the round).
 .PHONY: chaos
-chaos: obs-check prefix-check spec-check bench-gate-smoke
+chaos: lint obs-check prefix-check spec-check bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+
+# static invariant lint (Round-12, kubetpu/analysis): rules KTP001… over
+# kubetpu/ + scripts/, exits non-zero on any finding not covered by an
+# inline `# ktlint: disable=` or the committed lint_baseline.json ratchet
+.PHONY: lint
+lint:
+	python -m kubetpu.analysis
+
+# deliberately regenerate the ratchet from the current tree. The diff of
+# lint_baseline.json must only ever SHRINK counts — review enforces it,
+# and tests/test_analysis.py asserts the repo lints clean against the
+# committed file.
+.PHONY: lint-baseline
+lint-baseline:
+	python -m kubetpu.analysis --write-baseline
 
 # bench regression gate: compare the newest BENCH_r0*.json against its
 # predecessor and fail on a >15% regression in any shared storm metric

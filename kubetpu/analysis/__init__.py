@@ -1,0 +1,63 @@
+"""kubetpu.analysis — the project-specific lint engine (Round-12).
+
+Six rounds of PRs accumulated load-bearing invariants that were only
+enforced *dynamically* — by tests that must happen to exercise the
+offending path (the PR 5/6 zero-upload monkeypatch pins, the PR 2
+"every wire call goes through ``request_json``" contract, the obs
+registry's lock discipline, the ``kubetpu_*`` metric grammar). This
+package is the static twin: an AST-visitor rule engine that flags a
+violation at the line that introduces it, before any test runs.
+
+Surface:
+
+- ``python -m kubetpu.analysis [paths...]`` / ``scripts/lint.py`` /
+  ``make lint`` — run the full rule suite, exit non-zero on any
+  non-baselined finding;
+- findings print as ``path:line:col: KTPnnn message`` (or
+  ``--format=json`` for machine consumers like bench_gate-style
+  regression diffing);
+- ``# ktlint: disable=KTPnnn[,KTPmmm]`` suppresses a finding — trailing
+  on the finding's ANCHOR line (the line the report names; a multi-line
+  statement anchors to its FIRST line, flake8-style) or on a standalone
+  comment directly above it. Every disable in the tree should carry a
+  comment saying WHY;
+- ``lint_baseline.json`` ratchets pre-existing violations: counts per
+  (path, rule) may only shrink. Regenerate deliberately with
+  ``make lint-baseline`` after paying debt down, never to admit new
+  debt.
+
+Rule catalog (stable codes — tooling may key on them):
+
+====== ===================== =====================================
+code   name                  invariant (introduced by)
+====== ===================== =====================================
+KTP001 hot-path-sync         no host syncs/uploads reachable from
+                             serving ``step()`` (PR 5/6 pins)
+KTP002 wire-hygiene          all HTTP through ``httpcommon``;
+                             POSTs carry idempotency keys (PR 2)
+KTP003 lock-discipline       writes to ``self._lock``-guarded
+                             attributes stay under the lock (PR 3)
+KTP004 metric-hygiene        literal ``kubetpu_*`` metric names,
+                             counters end ``_total`` (PR 3/6)
+KTP005 determinism           no wall-clock / stdlib ``random`` in
+                             device-path ``jobs/`` modules (PR 1)
+KTP006 jit-leg-hygiene       ``jax.jit`` legs built once and
+                             cached, never per-call/in-loop (PR 6)
+====== ===================== =====================================
+
+Stdlib only (``ast`` + ``json``); no jax import — the linter must run
+anywhere, including CI boxes with no accelerator stack.
+"""
+
+from kubetpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    run_lint,
+)
+from kubetpu.analysis.baseline import (  # noqa: F401
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,3 @@
+from kubetpu.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,102 @@
+"""The baseline ratchet: ``lint_baseline.json``.
+
+Pre-existing violations must not block the build (that would force a
+big-bang cleanup before the gate could land), but they must never be a
+license to add more. The baseline records, per ``(path, rule-code)``,
+how many unsuppressed findings existed when it was last regenerated;
+``apply_baseline`` absorbs up to that many findings per key and lets
+anything beyond it fail.
+
+Counts — not line numbers — are the key on purpose: an unrelated edit
+above a baselined finding moves its line, and a line-keyed baseline
+would re-open it as "new" (noise that teaches people to regenerate
+reflexively, which defeats the ratchet). A count per (path, code) is
+stable under drift and still catches the only thing that matters: MORE
+violations of rule X in file Y than the debt on record.
+
+The ratchet direction is social, enforced by review + the meta-test in
+``tests/test_analysis.py``: ``make lint-baseline`` rewrites the file
+from the current tree, and the diff must only ever shrink counts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: not a v{BASELINE_VERSION} lint baseline"
+        )
+    if not isinstance(data.get("counts"), dict):
+        raise ValueError(f"{path}: missing counts map")
+    return data
+
+
+def baseline_from_findings(findings) -> dict:
+    """Build the baseline dict for the current tree: unsuppressed
+    finding counts per ``path::code`` (suppressed findings are already
+    handled at their line — recording them too would double-absorb)."""
+    counts: Dict[str, int] = {}
+    for f in findings:
+        if f.suppressed:
+            continue
+        key = f"{f.path}::{f.code}"
+        counts[key] = counts.get(key, 0) + 1
+    return {
+        "version": BASELINE_VERSION,
+        "comment": (
+            "Ratcheted pre-existing lint findings (counts per path::rule)."
+            " Regenerate ONLY to shrink: make lint-baseline."
+        ),
+        "counts": dict(sorted(counts.items())),
+    }
+
+
+def write_baseline(path: str, findings) -> dict:
+    data = baseline_from_findings(findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return data
+
+
+def apply_baseline(findings: List, baseline: dict) -> None:
+    """Mark up to ``counts[path::code]`` unsuppressed findings per key
+    as baselined, in file order (findings arrive sorted by path/line, so
+    the absorbed ones are the earliest — matching how debt was counted
+    when the baseline was written)."""
+    budget = dict(baseline.get("counts", {}))
+    for f in findings:
+        if f.suppressed:
+            continue
+        key = f"{f.path}::{f.code}"
+        left = budget.get(key, 0)
+        if left > 0:
+            f.baselined = True
+            budget[key] = left - 1
+
+
+def stale_keys(findings: List, baseline: dict) -> Dict[str, int]:
+    """Baseline entries with MORE budget than current findings — debt
+    that was paid down without regenerating. Reported so ``make lint``
+    can nudge (never fail): a shrinking baseline should be committed."""
+    current: Dict[str, int] = {}
+    for f in findings:
+        if f.suppressed:
+            continue
+        key = f"{f.path}::{f.code}"
+        current[key] = current.get(key, 0) + 1
+    out: Dict[str, int] = {}
+    for key, budget in baseline.get("counts", {}).items():
+        extra = budget - current.get(key, 0)
+        if extra > 0:
+            out[key] = extra
+    return out

@@ -1,0 +1,149 @@
+"""``python -m kubetpu.analysis`` — the lint front door.
+
+Exit codes: 0 clean (baselined/suppressed findings allowed), 1 any new
+finding, 2 usage errors. Text output is one ``path:line:col: KTPnnn
+message`` per finding (editor/CI clickable); ``--format=json`` emits the
+full structured result for tooling (finding-count regression diffing,
+bench_gate-style).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from kubetpu.analysis import baseline as baseline_mod
+from kubetpu.analysis.core import all_rules, run_lint
+
+DEFAULT_PATHS = ("kubetpu", "scripts")
+
+
+def _find_root(start: Optional[str] = None) -> str:
+    """The repo root: nearest ancestor of this package holding the
+    kubetpu/ tree (so the CLI works from any CWD inside the checkout)."""
+    here = os.path.dirname(os.path.abspath(
+        start or os.path.dirname(__file__)))
+    cur = here
+    while True:
+        if os.path.isdir(os.path.join(cur, "kubetpu")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.getcwd()
+        cur = parent
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubetpu.analysis",
+        description="kubetpu static invariant linter (rules KTP001…)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="lint_baseline.json path (default: <root>/"
+                         f"{baseline_mod.DEFAULT_BASELINE}; missing = bare)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (every finding fails)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from this run and exit 0"
+                         " — the deliberate ratchet reset (make"
+                         " lint-baseline)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule codes to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed/baselined findings")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.code} {r.name}: {r.description}")
+        return 0
+
+    if args.write_baseline and (args.rules or args.paths):
+        # a scoped run sees only a slice of the findings — writing the
+        # baseline from it would silently DROP every other rule's/file's
+        # ratchet budget and re-open that debt as "new" on the next run
+        print("--write-baseline must regenerate from the FULL default "
+              "run; drop --rules/paths", file=sys.stderr)
+        return 2
+
+    if args.rules:
+        want = {c.strip().upper() for c in args.rules.split(",")}
+        unknown = want - {r.code for r in rules}
+        if unknown:
+            print(f"unknown rule codes: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.code in want]
+
+    root = args.root or _find_root()
+    paths = args.paths or [p for p in DEFAULT_PATHS
+                           if os.path.exists(os.path.join(root, p))]
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.DEFAULT_BASELINE)
+
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        if os.path.exists(baseline_path):
+            try:
+                baseline = baseline_mod.load_baseline(baseline_path)
+            except ValueError as e:
+                print(f"bad baseline: {e}", file=sys.stderr)
+                return 2
+
+    t0 = time.monotonic()
+    result = run_lint(root, paths, rules=rules, baseline=baseline)
+    dur = time.monotonic() - t0
+
+    if args.write_baseline:
+        data = baseline_mod.write_baseline(baseline_path, result.findings)
+        n = sum(data["counts"].values())
+        print(f"wrote {baseline_path}: {len(data['counts'])} keys, "
+              f"{n} ratcheted findings")
+        return 0
+
+    if args.format == "json":
+        out = result.to_json()
+        out["duration_seconds"] = round(dur, 3)
+        print(json.dumps(out, indent=2))
+        return 1 if result.active else 0
+
+    shown = result.findings if args.show_suppressed else result.active
+    for f in shown:
+        tag = ""
+        if f.suppressed:
+            tag = "  [suppressed]"
+        elif f.baselined:
+            tag = "  [baselined]"
+        print(f.render() + tag)
+    summary = (
+        f"lint: {len(result.active)} new, {len(result.baselined)} "
+        f"baselined, {len(result.suppressed)} suppressed "
+        f"({len(rules)} rules, {dur:.1f}s)"
+    )
+    print(summary, file=sys.stderr)
+    if baseline is not None:
+        stale = baseline_mod.stale_keys(result.findings, baseline)
+        if stale:
+            paid = sum(stale.values())
+            print(
+                f"lint: baseline is stale — {paid} ratcheted finding(s) "
+                "no longer exist; commit a shrunk baseline "
+                "(make lint-baseline)",
+                file=sys.stderr,
+            )
+    return 1 if result.active else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

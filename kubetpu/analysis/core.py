@@ -1,0 +1,280 @@
+"""Engine core: file loading, the rule registry, suppression scanning,
+and the one ``run_lint`` entry point every surface (CLI, tests, make)
+calls.
+
+Design constraints, in order:
+
+- **whole-project context**: rules see every parsed file at once, not
+  one file at a time — the hot-path rule must flatten a class hierarchy
+  that spans ``serving.py`` -> ``paged.py`` -> ``spec_serving.py``, and
+  the wire rule needs to know which module it is standing in;
+- **cheap**: one ``ast.parse`` per file, shared by all rules; the full
+  tree (~150 files) lints in low single-digit seconds, well under the
+  30s budget ``make lint`` rides in ``make chaos``;
+- **suppressable at the line**: ``# ktlint: disable=KTPnnn`` on the
+  finding's line or the line directly above. Suppressed findings are
+  kept (marked) so ``--show-suppressed`` and the JSON output can audit
+  them, but they never fail the run;
+- **stdlib only**: the linter must run on machines with no jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ktlint:\s*disable=([A-Z]{3}[0-9]{3}(?:\s*,\s*[A-Z]{3}[0-9]{3})*)"
+)
+
+# directories never worth parsing (build junk, VCS internals)
+_SKIP_DIRS = {".git", "__pycache__", "_output", ".pytest_cache", "node_modules"}
+
+
+@dataclass
+class Finding:
+    """One rule violation, anchored to the line that introduces it."""
+
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    code: str          # "KTP001"
+    message: str
+    suppressed: bool = False   # an inline ktlint: disable covers it
+    baselined: bool = False    # absorbed by lint_baseline.json
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "code": self.code,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+
+
+@dataclass
+class SourceFile:
+    """One parsed module plus the per-line suppression index."""
+
+    path: str                  # repo-relative
+    source: str
+    tree: ast.Module
+    # line -> set of codes disabled on that line (trailing comment) or
+    # by a standalone comment on the line directly above
+    suppressions: Dict[int, set] = field(default_factory=dict)
+
+    def suppressed_at(self, line: int, code: str) -> bool:
+        return code in self.suppressions.get(line, set())
+
+
+class Project:
+    """Everything a rule may look at: the parsed files, keyed by
+    repo-relative path."""
+
+    def __init__(self, files: Dict[str, SourceFile]) -> None:
+        self.files = files
+
+    def get(self, path: str) -> Optional[SourceFile]:
+        return self.files.get(path)
+
+    def __iter__(self):
+        return iter(self.files.values())
+
+
+class Rule:
+    """Base class. Subclasses set ``code``/``name``/``description`` and
+    implement ``check(project) -> iterable of Finding``. Registration is
+    by subclassing — ``all_rules()`` instantiates every leaf subclass,
+    so a new rule file only needs to be imported to participate."""
+
+    code: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+def _scan_suppressions(source: str) -> Dict[int, set]:
+    """Per-line ``# ktlint: disable=`` index. A trailing comment covers
+    its own line; a comment on an otherwise code-free line covers the
+    NEXT line too (the idiom for statements too long to share a line
+    with their justification)."""
+    out: Dict[int, set] = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group(1).split(",")}
+        out.setdefault(lineno, set()).update(codes)
+        if text.lstrip().startswith("#"):    # standalone comment line
+            out.setdefault(lineno + 1, set()).update(codes)
+    return out
+
+
+def load_project(root: str, paths: Sequence[str]) -> Project:
+    """Parse every ``.py`` under *paths* (files or directories, given
+    relative to *root*). Unparseable files are skipped — syntax errors
+    are the compiler's job, not the linter's."""
+    import os
+
+    files: Dict[str, SourceFile] = {}
+
+    def add(abs_path: str) -> None:
+        rel = os.path.relpath(abs_path, root).replace(os.sep, "/")
+        try:
+            with open(abs_path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=rel)
+        except (OSError, SyntaxError, ValueError):
+            return
+        files[rel] = SourceFile(
+            path=rel, source=source, tree=tree,
+            suppressions=_scan_suppressions(source),
+        )
+
+    for p in paths:
+        abs_p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(abs_p):
+            add(abs_p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(abs_p):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    add(os.path.join(dirpath, fn))
+    return Project(files)
+
+
+def all_rules() -> List[Rule]:
+    """Every registered rule, sorted by code. Importing the rule
+    modules here keeps ``core`` import-cycle-free while making
+    ``run_lint`` self-contained."""
+    from kubetpu.analysis import rules_device, rules_plane  # noqa: F401
+
+    def leaves(cls):
+        subs = cls.__subclasses__()
+        if not subs:
+            return [cls]
+        out = []
+        for s in subs:
+            out.extend(leaves(s))
+        return out
+
+    rules = [cls() for cls in leaves(Rule) if cls is not Rule and cls.code]
+    rules.sort(key=lambda r: r.code)
+    return rules
+
+
+@dataclass
+class LintResult:
+    """The full outcome of one run: every finding (suppressed and
+    baselined ones marked, not dropped) plus the selection that should
+    fail the build."""
+
+    findings: List[Finding]
+    rules: List[Rule]
+
+    @property
+    def active(self) -> List[Finding]:
+        """Findings that fail the run: not suppressed, not baselined."""
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    @property
+    def baselined(self) -> List[Finding]:
+        return [f for f in self.findings if f.baselined]
+
+    def counts(self) -> Dict[str, int]:
+        """Unsuppressed finding count per rule code (baselined ones
+        included — this is the number the baseline ratchets on)."""
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            if not f.suppressed:
+                out[f.code] = out.get(f.code, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "findings": [f.to_json() for f in self.findings],
+            "counts": self.counts(),
+            "new": len(self.active),
+            "baselined": len(self.baselined),
+            "suppressed": len(self.suppressed),
+            "rules": [
+                {"code": r.code, "name": r.name,
+                 "description": r.description}
+                for r in self.rules
+            ],
+        }
+
+
+def run_lint(
+    root: str,
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    baseline: Optional[dict] = None,
+) -> LintResult:
+    """Parse, run every rule, mark suppressions, apply the baseline
+    ratchet. *baseline* is the parsed ``lint_baseline.json`` (or None
+    for a bare run)."""
+    from kubetpu.analysis.baseline import apply_baseline
+
+    project = load_project(root, paths)
+    ruleset = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    for rule in ruleset:
+        for f in rule.check(project):
+            sf = project.get(f.path)
+            if sf is not None and sf.suppressed_at(f.line, f.code):
+                f.suppressed = True
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    if baseline is not None:
+        apply_baseline(findings, baseline)
+    return LintResult(findings=findings, rules=ruleset)
+
+
+# -- shared AST helpers (used by both rule modules) --------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def iter_calls(node: ast.AST) -> Iterable[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None

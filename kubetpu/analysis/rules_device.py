@@ -1,0 +1,393 @@
+"""Device-path rules: the static twins of the runtime pins the serving
+PRs left behind.
+
+KTP001 (hot-path-sync) is the heart: PR 5/6 proved that steady-state
+``step()`` issues zero host uploads and zero device syncs by
+monkeypatching ``jnp.asarray`` / ``block_until_ready`` and counting —
+but that pin only fires when a test drives the exact path. Here we
+flatten the serving class hierarchy (``SlotServerBase`` ->
+``DecodeServer``/``PagedDecodeServer`` -> the speculative servers),
+compute every method reachable from ``step()`` via ``self.*`` calls,
+and flag sync/upload constructs inside that closure at the line that
+introduces them.
+
+Reachability is deliberately conservative in BOTH directions:
+
+- it only follows ``self.method(...)`` / ``super().method(...)`` /
+  same-module bare calls — a callable stored on an attribute (the jitted
+  legs in ``self._step_fn``) is compiled device code and cannot host-sync
+  mid-trace, so not following it is correct, not a gap;
+- it stops at BARRIER methods: legs that are *architecturally allowed*
+  to touch the host — admission (uploads happen at the dev-cache
+  invalidation points, by design), the one materialize/route sync, and
+  warmup. Everything else reachable from ``step()`` must stay clean;
+  surgical exceptions (the profiler's sampled-step sync) carry inline
+  ``# ktlint: disable=KTP001`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kubetpu.analysis.core import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    call_name,
+    dotted_name,
+    iter_calls,
+)
+
+# the serving hot modules: where step()/round loops live. speculative.py
+# and sampling.py only contribute jitted device code (called inside the
+# legs), so they cannot host-sync mid-step and are not closure members.
+HOT_MODULES = (
+    "kubetpu/jobs/serving.py",
+    "kubetpu/jobs/paged.py",
+    "kubetpu/jobs/spec_serving.py",
+)
+
+# traversal roots: the per-step entry points
+HOT_ROOTS = ("step",)
+
+# legs allowed to touch the host, by architecture (module docstrings in
+# serving.py spell each out): admission + prefill scheduling upload at
+# the invalidation points, route/materialize IS the one designed sync,
+# warmup runs before serving, retirement publishes pages by ownership
+# donation (and its obs writes are host-only state).
+HOT_BARRIERS = {
+    "_schedule_prefills",
+    "_drain_queue_into_slots",
+    "_route_step",
+    "_materialize_pending",
+    "warmup",
+    "_warmup_buckets",
+    "retire",
+    "_retire_if_done",
+    "enqueue",
+    "cancel",
+    "drain",
+}
+
+# host-sync / host-upload constructs (the same set the PR 5/6 runtime
+# pins count, minus float()-on-array which is untypable statically)
+_SYNC_DOTTED = {
+    "jax.block_until_ready",
+    "jax.device_get",
+    "jax.device_put",
+    "jnp.asarray",
+    "np.asarray",
+    "numpy.asarray",
+}
+_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+
+
+class _ClassInfo:
+    def __init__(self, name: str, path: str, node: ast.ClassDef) -> None:
+        self.name = name
+        self.path = path
+        self.node = node
+        self.bases: List[str] = [
+            b for b in (dotted_name(x) for x in node.bases) if b
+        ]
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+
+
+def _collect_classes(project: Project) -> Dict[str, _ClassInfo]:
+    """name -> class info across the hot modules. Names are unique there
+    today; last-write-wins would only matter for a duplicate class name,
+    which the serving modules do not have."""
+    out: Dict[str, _ClassInfo] = {}
+    for path in HOT_MODULES:
+        sf = project.get(path)
+        if sf is None:
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                out[node.name] = _ClassInfo(node.name, path, node)
+    return out
+
+
+def _module_functions(sf: SourceFile) -> Dict[str, ast.FunctionDef]:
+    return {
+        n.name: n for n in sf.tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _resolve_method(
+    classes: Dict[str, _ClassInfo], cls: str, method: str
+) -> Optional[Tuple[str, ast.FunctionDef]]:
+    """(path, node) for *method* resolved through *cls*'s hierarchy
+    (depth-first over base names known to the hot modules)."""
+    seen: Set[str] = set()
+    stack = [cls]
+    while stack:
+        name = stack.pop(0)
+        if name in seen:
+            continue
+        seen.add(name)
+        info = classes.get(name)
+        if info is None:
+            continue
+        if method in info.methods:
+            return info.path, info.methods[method]
+        stack.extend(info.bases)
+    return None
+
+
+def hot_closure(project: Project) -> Dict[Tuple[str, int], Tuple[str, str, ast.FunctionDef]]:
+    """Every function reachable from a hot root, keyed by
+    (path, lineno) -> (path, qualified name, node). Traverses per
+    concrete class so inherited methods resolve against the class that
+    actually serves."""
+    classes = _collect_classes(project)
+    mod_funcs = {
+        path: _module_functions(project.get(path))
+        for path in HOT_MODULES if project.get(path) is not None
+    }
+    out: Dict[Tuple[str, int], Tuple[str, str, ast.FunctionDef]] = {}
+    for cls_name, info in classes.items():
+        root = _resolve_method(classes, cls_name, HOT_ROOTS[0])
+        if root is None:
+            continue
+        # BFS over self./super()./bare calls from this class's step
+        queue: List[Tuple[str, str, ast.FunctionDef]] = []
+        visited: Set[Tuple[str, int]] = set()
+        for r in HOT_ROOTS:
+            hit = _resolve_method(classes, cls_name, r)
+            if hit is not None:
+                queue.append((hit[0], f"{cls_name}.{r}", hit[1]))
+        while queue:
+            path, qual, node = queue.pop(0)
+            key = (path, node.lineno)
+            if key in visited:
+                continue
+            visited.add(key)
+            out.setdefault(key, (path, qual, node))
+            for call in iter_calls(node):
+                callee = _callee_method(call)
+                if callee is not None:
+                    if callee in HOT_BARRIERS:
+                        continue
+                    hit = _resolve_method(classes, cls_name, callee)
+                    if hit is not None:
+                        queue.append((hit[0], f"{cls_name}.{callee}", hit[1]))
+                    continue
+                bare = call_name(call)
+                if bare and "." not in bare and bare not in HOT_BARRIERS:
+                    fn = mod_funcs.get(path, {}).get(bare)
+                    if fn is not None:
+                        queue.append((path, f"{path}:{bare}", fn))
+    return out
+
+
+def _callee_method(call: ast.Call) -> Optional[str]:
+    """Method name for ``self.X(...)`` / ``super().X(...)`` calls."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    v = f.value
+    if isinstance(v, ast.Name) and v.id == "self":
+        return f.attr
+    if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+            and v.func.id == "super"):
+        return f.attr
+    return None
+
+
+class HotPathSyncRule(Rule):
+    code = "KTP001"
+    name = "hot-path-sync"
+    description = (
+        "no host syncs/uploads (jnp.asarray, np.asarray, "
+        ".block_until_ready(), .item(), .tolist(), jax.device_get/put) "
+        "in functions reachable from serving step() — the static twin "
+        "of the PR 5/6 zero-upload pins"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        emitted: Set[Tuple[str, int, int]] = set()
+        for (path, _), (_, qual, node) in sorted(hot_closure(project).items()):
+            for call in iter_calls(node):
+                label = self._sync_label(call)
+                if label is None:
+                    continue
+                key = (path, call.lineno, call.col_offset)
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                yield Finding(
+                    path=path, line=call.lineno, col=call.col_offset,
+                    code=self.code,
+                    message=(
+                        f"host sync/upload `{label}` in `{qual.split('.')[-1]}`"
+                        f" (reachable from step() via {qual})"
+                    ),
+                )
+
+    @staticmethod
+    def _sync_label(call: ast.Call) -> Optional[str]:
+        d = call_name(call)
+        if d in _SYNC_DOTTED:
+            return d
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+            # method-style sync on an expression: x.item(), arr.tolist(),
+            # handle.block_until_ready(). A direct `self.item(...)` would
+            # be a server METHOD, not an array sync — but `self._x.item()`
+            # (stored array) is one, so only bare `self` is exempt.
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                return None
+            return f".{f.attr}()"
+        return None
+
+
+class DeterminismRule(Rule):
+    code = "KTP005"
+    name = "determinism"
+    description = (
+        "no wall-clock (time.time/time_ns) or stdlib random in "
+        "device-path jobs/ modules — serving sampling is "
+        "request-deterministic (fold_in(seed, rid, pos)); timing shims "
+        "use monotonic/perf_counter"
+    )
+
+    _JOBS_PREFIX = "kubetpu/jobs/"
+    _CLOCK = {"time.time", "time.time_ns"}
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project:
+            if not sf.path.startswith(self._JOBS_PREFIX):
+                continue
+            random_aliases = self._stdlib_random_aliases(sf.tree)
+            for call in iter_calls(sf.tree):
+                d = call_name(call)
+                if d in self._CLOCK:
+                    yield Finding(
+                        path=sf.path, line=call.lineno,
+                        col=call.col_offset, code=self.code,
+                        message=(
+                            f"wall-clock `{d}()` in a device-path module "
+                            "(use time.monotonic/perf_counter for "
+                            "intervals; wall time belongs to obs)"
+                        ),
+                    )
+                elif d and "." in d and d.split(".")[0] in random_aliases:
+                    yield Finding(
+                        path=sf.path, line=call.lineno,
+                        col=call.col_offset, code=self.code,
+                        message=(
+                            f"stdlib `{d}()` in a device-path module — "
+                            "randomness must flow from seeded keys "
+                            "(jax.random.fold_in) or seeded np.random "
+                            "generators"
+                        ),
+                    )
+
+    @staticmethod
+    def _stdlib_random_aliases(tree: ast.Module) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "random":
+                        out.add(alias.asname or "random")
+        return out
+
+
+class JitLegRule(Rule):
+    code = "KTP006"
+    name = "jit-leg-hygiene"
+    description = (
+        "jax.jit/jax.pmap legs must be built once and cached (leg "
+        "factories at init/warmup), never constructed inside a loop or "
+        "in the step() closure — a per-call jit recompiles every call"
+    )
+
+    _JIT = {"jax.jit", "jax.pmap"}
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        closure_lines: Dict[str, Set[Tuple[int, int]]] = {}
+        for (path, _), (_, _, node) in hot_closure(project).items():
+            span = closure_lines.setdefault(path, set())
+            span.add((node.lineno, getattr(node, "end_lineno", node.lineno)))
+        for sf in project:
+            if not sf.path.startswith("kubetpu/"):
+                continue
+            for call, in_loop in self._calls_with_loop_flag(sf.tree):
+                if not self._is_jit_construction(call):
+                    continue
+                if in_loop:
+                    yield Finding(
+                        path=sf.path, line=call.lineno,
+                        col=call.col_offset, code=self.code,
+                        message=(
+                            "jax.jit constructed inside a loop — each "
+                            "iteration builds a fresh leg; hoist and "
+                            "cache it (see the shared-leg cache)"
+                        ),
+                    )
+                elif any(lo <= call.lineno <= hi
+                         for lo, hi in closure_lines.get(sf.path, ())):
+                    yield Finding(
+                        path=sf.path, line=call.lineno,
+                        col=call.col_offset, code=self.code,
+                        message=(
+                            "jax.jit constructed in the step() closure — "
+                            "legs are compiled at init/warmup and cached, "
+                            "never per step"
+                        ),
+                    )
+
+    def _calls_with_loop_flag(self, tree: ast.Module):
+        out: List[Tuple[ast.Call, bool]] = []
+        loops = (ast.For, ast.While, ast.AsyncFor,
+                 # comprehensions ARE loops: [jax.jit(f) for f in fns]
+                 # builds a leg per element
+                 ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+        def visit(node: ast.AST, in_loop: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # decorators + argument defaults evaluate at DEF time
+                    # — inside the loop if the def is; the body only runs
+                    # when called
+                    defaults = [d for d in child.args.kw_defaults if d]
+                    defaults += child.args.defaults
+                    for expr in list(child.decorator_list) + defaults:
+                        for call in iter_calls(expr):
+                            out.append((call, in_loop))
+                    for stmt in child.body:
+                        # the stmt may itself BE a loop — its loop-ness is
+                        # normally computed when recursing into a child,
+                        # which this direct visit bypasses
+                        visit(stmt, isinstance(stmt, loops))
+                    continue
+                child_in_loop = in_loop
+                if isinstance(child, loops):
+                    child_in_loop = True
+                elif isinstance(child, ast.Lambda):
+                    # a lambda body runs later, like a def's
+                    child_in_loop = False
+                if isinstance(child, ast.Call):
+                    out.append((child, in_loop))
+                visit(child, child_in_loop)
+
+        visit(tree, False)
+        return out
+
+    def _is_jit_construction(self, call: ast.Call) -> bool:
+        d = call_name(call)
+        if d in self._JIT:
+            return True
+        # functools.partial(jax.jit, ...) — the decorator idiom
+        if d in ("partial", "functools.partial") and call.args:
+            return dotted_name(call.args[0]) in self._JIT
+        return False

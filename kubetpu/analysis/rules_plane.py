@@ -1,0 +1,280 @@
+"""Control-plane rules: wire hygiene, lock discipline, metric grammar.
+
+These guard the PR 2/3 contracts that make the chaos suite meaningful:
+every HTTP call rides the one retrying client (so fault injection,
+idempotency keys and trace propagation apply to it), shared state
+mutates under its lock, and metric names stay a bounded, greppable
+grammar.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kubetpu.analysis.core import (
+    Finding,
+    Project,
+    Rule,
+    call_name,
+    dotted_name,
+    iter_calls,
+    keyword_arg,
+)
+
+
+class WireHygieneRule(Rule):
+    code = "KTP002"
+    name = "wire-hygiene"
+    description = (
+        "all HTTP through wire/httpcommon (request_json/request_text — "
+        "retries, idempotency keys, trace propagation, fault injection); "
+        "no raw urllib.request.urlopen elsewhere, and POSTs must carry "
+        "an idempotency path"
+    )
+
+    # the ONE module allowed to open sockets directly: the shared client
+    _URLOPEN_HOME = {"kubetpu/wire/httpcommon.py"}
+    _URLOPEN = {"urllib.request.urlopen", "request.urlopen", "urlopen"}
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project:
+            for call in iter_calls(sf.tree):
+                d = call_name(call)
+                if d in self._URLOPEN and sf.path not in self._URLOPEN_HOME:
+                    yield Finding(
+                        path=sf.path, line=call.lineno, col=call.col_offset,
+                        code=self.code,
+                        message=(
+                            "raw urllib.request.urlopen bypasses the "
+                            "retrying client (no retries, no trace "
+                            "propagation, no fault injection) — use "
+                            "httpcommon.request_json/request_text"
+                        ),
+                    )
+                elif d and d.split(".")[-1] == "request_json":
+                    miss = self._post_without_key(call)
+                    if miss:
+                        yield Finding(
+                            path=sf.path, line=call.lineno,
+                            col=call.col_offset, code=self.code,
+                            message=miss,
+                        )
+
+    @staticmethod
+    def _post_without_key(call: ast.Call) -> Optional[str]:
+        """A request_json call that will issue a POST (payload present or
+        method='POST') without an idempotency_key= argument: the client
+        gives such a POST exactly one attempt, so a dropped response is
+        an outage instead of a retry. Calls that merely FORWARD an outer
+        idempotency_key parameter pass (the key expression is whatever
+        the caller supplied)."""
+        if keyword_arg(call, "idempotency_key") is not None:
+            return None
+        method = keyword_arg(call, "method")
+        is_post = False
+        if (isinstance(method, ast.Constant)
+                and isinstance(method.value, str)):
+            if method.value.upper() in ("GET", "HEAD", "DELETE"):
+                return None
+            is_post = method.value.upper() == "POST"
+        if not is_post:
+            payload = None
+            if len(call.args) >= 2:
+                payload = call.args[1]
+            elif keyword_arg(call, "payload") is not None:
+                payload = keyword_arg(call, "payload")
+            if payload is None or (isinstance(payload, ast.Constant)
+                                   and payload.value is None):
+                return None
+        return (
+            "request_json POST without idempotency_key= — the client "
+            "gives non-keyed POSTs a single attempt (PR 2 retry-safety "
+            "contract); pass a key or make the call a GET"
+        )
+
+
+class LockDisciplineRule(Rule):
+    code = "KTP003"
+    name = "lock-discipline"
+    description = (
+        "attributes a class mutates under `with self._lock:` are "
+        "lock-guarded shared state — every other write to them must "
+        "also hold the lock (obs registry, controller, treecache)"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(sf.path, node)
+
+    def _check_class(self, path: str, cls: ast.ClassDef) -> Iterable[Finding]:
+        methods = [
+            m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # pass 1: attributes written while holding self._lock, anywhere
+        # outside __init__ (constructors initialize before the lock has
+        # any contenders — flagging them would just breed disables)
+        guarded: Set[str] = set()
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            # a `*_locked` method runs with the caller holding the lock
+            # (project convention) — its writes are lock-guarded evidence
+            body_locked = m.name.endswith("_locked")
+            for write, under in self._writes(m):
+                if under or body_locked:
+                    guarded.add(write[0])
+        if not guarded:
+            return
+        # pass 2: writes to guarded attributes outside a lock block.
+        # `*_locked` methods are skipped — the caller holds the lock.
+        for m in methods:
+            if m.name == "__init__" or m.name.endswith("_locked"):
+                continue
+            for (attr, node), under in self._writes(m):
+                if under or attr not in guarded:
+                    continue
+                yield Finding(
+                    path=path, line=node.lineno, col=node.col_offset,
+                    code=self.code,
+                    message=(
+                        f"write to `self.{attr}` outside `with "
+                        f"self._lock:` — `{cls.name}` mutates this "
+                        "attribute under the lock elsewhere, so this "
+                        "write races those readers/writers"
+                    ),
+                )
+
+    def _writes(self, func: ast.AST) -> List[Tuple[Tuple[str, ast.AST], bool]]:
+        """[((attr, node), under_lock)] for every `self.X = ...`,
+        `self.X op= ...`, `self.X[k] = ...`, `del self.X[...]` in
+        *func*, tracking `with self._lock:` nesting."""
+        out: List[Tuple[Tuple[str, ast.AST], bool]] = []
+
+        def self_attr(target: ast.AST) -> Optional[str]:
+            # unwrap subscripts: self.X[k] mutates the object behind
+            # self.X just like assignment replaces it
+            while isinstance(target, ast.Subscript):
+                target = target.value
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                return target.attr
+            return None
+
+        def is_lock_with(w: ast.With) -> bool:
+            for item in w.items:
+                d = dotted_name(item.context_expr)
+                if d in ("self._lock", "self._cv"):
+                    return True
+                # self._lock() / self._cv-style helper calls
+                if isinstance(item.context_expr, ast.Call):
+                    dc = dotted_name(item.context_expr.func)
+                    if dc in ("self._lock", "self._cv"):
+                        return True
+            return False
+
+        def visit(node: ast.AST, under: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_under = under
+                if isinstance(child, ast.With) and is_lock_with(child):
+                    child_under = True
+                if isinstance(child, ast.Assign):
+                    for t in child.targets:
+                        a = self_attr(t)
+                        if a is not None:
+                            out.append(((a, child), under))
+                elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                    a = self_attr(child.target)
+                    if a is not None:
+                        out.append(((a, child), under))
+                elif isinstance(child, ast.Delete):
+                    for t in child.targets:
+                        a = self_attr(t)
+                        if a is not None:
+                            out.append(((a, child), under))
+                visit(child, child_under)
+
+        visit(func, False)
+        return out
+
+
+# metric names: the project grammar (PR 3), counters end _total
+_METRIC_NAME_RE = re.compile(r"^kubetpu_[a-z0-9_]+$")
+
+
+class MetricHygieneRule(Rule):
+    code = "KTP004"
+    name = "metric-hygiene"
+    description = (
+        "metric names are string literals matching kubetpu_[a-z0-9_]+ "
+        "(counters end _total); an f-string metric/label name is "
+        "unbounded cardinality waiting for traffic"
+    )
+
+    _REGISTERING = {"counter", "gauge", "gauge_fn", "histogram",
+                    "attach_histogram"}
+    # the framework itself + this package (rule fixtures embed names)
+    _EXEMPT = ("kubetpu/obs/registry.py", "kubetpu/analysis/")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project:
+            if sf.path.startswith(self._EXEMPT):
+                continue
+            for call in iter_calls(sf.tree):
+                f = call.func
+                if (not isinstance(f, ast.Attribute)
+                        or f.attr not in self._REGISTERING
+                        or not call.args):
+                    continue
+                kind = f.attr
+                name_arg = call.args[0]
+                if isinstance(name_arg, ast.JoinedStr):
+                    yield Finding(
+                        path=sf.path, line=call.lineno,
+                        col=call.col_offset, code=self.code,
+                        message=(
+                            f"f-string metric name in .{kind}() — "
+                            "interpolated names are unbounded series "
+                            "cardinality; use literals (a fixed set of "
+                            "keys gets a justified ktlint disable)"
+                        ),
+                    )
+                elif (isinstance(name_arg, ast.Constant)
+                        and isinstance(name_arg.value, str)):
+                    name = name_arg.value
+                    if not _METRIC_NAME_RE.match(name):
+                        yield Finding(
+                            path=sf.path, line=call.lineno,
+                            col=call.col_offset, code=self.code,
+                            message=(
+                                f"metric name `{name}` does not match "
+                                "kubetpu_[a-z0-9_]+ — one prefix keeps "
+                                "the fleet exposition greppable"
+                            ),
+                        )
+                    elif kind == "counter" and not name.endswith("_total"):
+                        yield Finding(
+                            path=sf.path, line=call.lineno,
+                            col=call.col_offset, code=self.code,
+                            message=(
+                                f"counter `{name}` must end `_total` "
+                                "(Prometheus counter convention the "
+                                "SLO engine keys on)"
+                            ),
+                        )
+                else:
+                    yield Finding(
+                        path=sf.path, line=call.lineno,
+                        col=call.col_offset, code=self.code,
+                        message=(
+                            f"non-literal metric name in .{kind}() — "
+                            "names must be auditable at the call site "
+                            "(facades that forward caller-validated "
+                            "names get a justified ktlint disable)"
+                        ),
+                    )

@@ -36,17 +36,17 @@ import os
 import sys
 import time
 import urllib.parse
-import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from kubetpu.obs.registry import parse_prometheus_text
+from kubetpu.wire.httpcommon import NO_RETRY, request_text
 
 
-def _fetch(url: str, token: Optional[str], timeout: float = 10.0) -> bytes:
-    headers = {"Authorization": f"Bearer {token}"} if token else {}
-    req = urllib.request.Request(url, headers=headers)
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return r.read()
+def _fetch(url: str, token: Optional[str], timeout: float = 10.0) -> str:
+    """One read-only scrape via the shared wire client (Round-12 — raw
+    ``urlopen`` is lint-rejected, KTP002). ``NO_RETRY``: a CLI refresh
+    beats stale backoff; ``--watch`` will be back in N seconds anyway."""
+    return request_text(url, token=token, timeout=timeout, retry=NO_RETRY)
 
 
 def _index(samples) -> Dict[str, List[Tuple[dict, float]]]:
@@ -335,10 +335,10 @@ def main(argv=None) -> int:
                         q["limit"] = args.limit
                     url = base + "/events" + (
                         "?" + urllib.parse.urlencode(q) if q else "")
-                    body = _fetch(url, args.token).decode()
+                    body = _fetch(url, args.token)
                     blocks.append(render_events(body, f"{kind} {base}"))
                 else:
-                    text = _fetch(base + "/metrics", args.token).decode()
+                    text = _fetch(base + "/metrics", args.token)
                     blocks.append(
                         renderers[args.view](text, f"{kind} {base}"))
             except Exception as e:  # noqa: BLE001 — show the gap, keep going

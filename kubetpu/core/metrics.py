@@ -53,6 +53,9 @@ class LatencyRecorder:
             self._registry = registry
             self._metric = metric
             for op, hist in self._hists.items():
+                # facade: the name is the literal bind() callers pass,
+                # validated by the registry at registration
+                # ktlint: disable=KTP004
                 registry.attach_histogram(metric, hist, op=op)
         return self
 
@@ -61,6 +64,8 @@ class LatencyRecorder:
             hist = self._hists.get(op)
             if hist is None:
                 if self._registry is not None:
+                    # facade: forwards the bind()-time literal name
+                    # ktlint: disable=KTP004
                     hist = self._registry.histogram(
                         self._metric, cap=self._cap, op=op)
                 else:

@@ -42,7 +42,8 @@ class NvidiaGPUManager(Device):
     # -- Device lifecycle ---------------------------------------------------
 
     def new(self) -> None:
-        self.gpus = {}
+        with self._lock:
+            self.gpus = {}
 
     def start(self) -> None:
         try:
@@ -118,7 +119,9 @@ class NvidiaGPUManager(Device):
             self.update_gpu_info()
         except Exception as e:  # noqa: BLE001
             utils.logf(0, "update_gpu_info error %s, setting GPUs to zero", e)
-            self.num_gpus = 0
+            # update_gpu_info released the lock when it raised
+            with self._lock:
+                self.num_gpus = 0
             raise
         utils.logf(4, "NumGPUs found = %d", self.num_gpus)
         # Count only found GPUs (deliberate divergence from the reference's

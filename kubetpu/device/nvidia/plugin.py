@@ -47,6 +47,12 @@ class NvidiaDockerPlugin(NvidiaPlugin):
         )
 
     def _get(self, path: str) -> bytes:
+        # Read-only GET against the LOCAL nvidia-docker daemon — a foreign
+        # REST protocol, not the kubetpu wire: no trace headers to
+        # propagate, no idempotency contract, and chaos fault injection
+        # targets our own control plane, not the vendor daemon. The shared
+        # client would add nothing but a decode round-trip.
+        # ktlint: disable=KTP002
         with urllib.request.urlopen(self.base_url + path, timeout=10) as resp:
             return resp.read()
 

@@ -83,7 +83,8 @@ class TpuDevManager(Device):
     # -- Device lifecycle ---------------------------------------------------
 
     def new(self) -> None:
-        self.tpus = {}
+        with self._lock:
+            self.tpus = {}
 
     def start(self) -> None:
         """Probe errors are deliberately swallowed: the node degrades to zero
@@ -156,7 +157,9 @@ class TpuDevManager(Device):
             self.update_tpu_info()
         except Exception as e:  # noqa: BLE001
             utils.logf(0, "update_tpu_info error %s, setting TPUs to zero", e)
-            self.num_tpus = 0
+            # update_tpu_info released the lock when it raised
+            with self._lock:
+                self.num_tpus = 0
             raise
         utils.logf(4, "NumTPUs found = %d", self.num_tpus)
         # Count only currently-found chips: the map retains disappeared chips

@@ -261,6 +261,9 @@ class SpeculativeDecodeServer(_SpecRoundsMixin, SlotServerBase):
             self.last, self.pos,
             self._dev("active", lambda: self.active),
         )
+        # the round's ONE designed materialize: acceptance decides what
+        # the host emits, so the round loop must read these — the exact
+        # analogue of _route_step's sync # ktlint: disable=KTP001
         return np.asarray(toks), np.asarray(n_emit), np.asarray(lps)
 
     def _device_step(self):  # pragma: no cover — step() is overridden
@@ -344,6 +347,9 @@ def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos):
     query can ever attend. The target side needs no redirect: inactive
     slots' pool writes are dropped via ``write_enable``."""
 
+    # built lazily per gamma on first use, then cached (and warmup()
+    # pre-compiles every gamma); the profiler's round[gamma=G] watch
+    # counts any recompile this misses # ktlint: disable=KTP006
     @partial(jax.jit, donate_argnums=(2, 3, 4))
     def round_all(t_params, d_params, k_pages, v_pages, dcache,
                   table, last, pos, active, slot_gamma):
@@ -599,11 +605,15 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
         )
         if rec is not None:
             rec.mark("dispatch")
+            # sampled-step profiler sync only (same shape as the base
+            # step's device mark) # ktlint: disable=KTP001
             jax.block_until_ready((toks_d, n_emit_d, lps_d))
             rec.mark("device")
-        toks = np.asarray(toks_d)
-        n_emit = np.asarray(n_emit_d)
-        lps = np.asarray(lps_d)
+        # the round's ONE designed materialize — rounds emit variable
+        # bursts, so there is no overlap double-buffer to hide behind
+        toks = np.asarray(toks_d)      # ktlint: disable=KTP001
+        n_emit = np.asarray(n_emit_d)  # ktlint: disable=KTP001
+        lps = np.asarray(lps_d)        # ktlint: disable=KTP001
         out = self._materialize_pending()
         self._metrics.record("step", time.perf_counter() - t0)
         out = _route_round(self, toks, n_emit, lps, out)

@@ -73,7 +73,6 @@ from __future__ import annotations
 
 import json
 import threading
-import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -93,10 +92,12 @@ from kubetpu.wire.codec import (
     pod_info_to_json,
 )
 from kubetpu.wire.httpcommon import (
+    NO_RETRY,
     IdempotencyCache,
     InflightTracker,
     check_bearer,
     handle_guarded,
+    request_text,
     run_idempotent,
     serve_events_jsonl,
     write_json,
@@ -168,6 +169,8 @@ class ControllerServer:
             self.registry, "kubetpu_schedule_latency_seconds")
         for key in ("submits", "reconcile_passes",
                     "federation_scrape_errors"):
+            # key ranges over the fixed literal tuple above — bounded
+            # cardinality by construction # ktlint: disable=KTP004
             self.registry.counter(f"kubetpu_controller_{key}_total")
         for state in (HEALTHY, SUSPECT, PROBATION):
             self.registry.gauge_fn(
@@ -925,14 +928,14 @@ class ControllerServer:
         return token or self.token
 
     def _scrape_agent_text(self, url: str, token: Optional[str]) -> str:
-        """One raw-text scrape of an agent endpoint (no retry — a missed
-        scrape is a gap in a graph, not an outage worth backoff)."""
-        headers = {}
-        if token:
-            headers["Authorization"] = f"Bearer {token}"
-        req = urllib.request.Request(url, headers=headers)
-        with urllib.request.urlopen(req, timeout=5.0) as r:
-            return r.read().decode()
+        """One text scrape of an agent endpoint through the shared
+        retrying client (Round-12: the raw ``urlopen`` here bypassed
+        retry/trace/fault injection — a chaos soak could never drop a
+        federation scrape). ``NO_RETRY`` keeps the original semantics: a
+        missed scrape is a gap in a graph, not an outage worth backoff,
+        and the per-reconcile SLO evaluation must not stall failover
+        behind a dark agent's backoff."""
+        return request_text(url, token=token, timeout=5.0, retry=NO_RETRY)
 
     def _metrics_text(self) -> str:
         """The federated fleet exposition: this registry (scheduler

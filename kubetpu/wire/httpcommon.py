@@ -4,9 +4,11 @@ Server side (agent + controller): JSON / text replies and the bearer-token
 check. One implementation so security hardening (constant-time compare,
 latin-1 header handling) can never drift between the two surfaces.
 
-Client side: ``request_json`` — THE one urllib call every wire client
-routes through (``RemoteDevice``, ``gang_launch``, ``schedsim``), carrying
-the chaos-hardening contract in one place:
+Client side: ``request_json`` / ``request_text`` — THE one urllib call
+every wire client routes through (``RemoteDevice``, ``gang_launch``,
+``schedsim``, the controller's federation scrapes, the obs CLI), carrying
+the chaos-hardening contract in one place (lint rule KTP002 statically
+rejects raw ``urlopen`` anywhere else):
 
 - jittered exponential retry with a per-call wall-clock deadline
   (``RetryPolicy``): transient connection failures, timeouts, truncated
@@ -191,6 +193,52 @@ def request_json(
     per attempt, so a server span parents under the exact attempt that
     reached it. ``kubetpu_wire_requests_total`` / ``_retried_total``
     count on the process-default registry."""
+    body = _request_raw(
+        url, payload=payload, method=method, token=token, timeout=timeout,
+        retry=retry, idempotency_key=idempotency_key, headers=headers,
+        faults=faults,
+    )
+    return json.loads(body)
+
+
+def request_text(
+    url: str,
+    *,
+    token: Optional[str] = None,
+    timeout: float = 5.0,
+    retry: Optional[RetryPolicy] = None,
+    headers: Optional[dict] = None,
+    faults=None,
+) -> str:
+    """One text GET through the SAME retry/trace/fault machinery as
+    ``request_json`` — for the non-JSON wire surfaces (Prometheus
+    ``/metrics`` federation scrapes, ``/events`` NDJSON). Before
+    Round-12 these were raw ``urlopen`` calls, invisible to fault
+    injection and trace stitching; now a scrape rides the one client
+    (lint rule KTP002 keeps it that way). Pass ``retry=NO_RETRY`` when
+    a miss should stay a gap in a graph instead of a backoff."""
+    return _request_raw(
+        url, payload=None, method="GET", token=token, timeout=timeout,
+        retry=retry, idempotency_key=None, headers=headers, faults=faults,
+    ).decode()
+
+
+def _request_raw(
+    url: str,
+    payload: Optional[dict],
+    *,
+    method: Optional[str],
+    token: Optional[str],
+    timeout: float,
+    retry: Optional[RetryPolicy],
+    idempotency_key: Optional[str],
+    headers: Optional[dict],
+    faults,
+) -> bytes:
+    """The shared client workhorse: retry loop, idempotency gating,
+    trace spans + header propagation, fault injection, wire counters.
+    Returns the response body bytes; the public wrappers decide how to
+    decode them."""
     from kubetpu.wire import faults as faults_mod
 
     reg = obs_registry.default_registry()
@@ -244,7 +292,7 @@ def request_json(
                     with urllib.request.urlopen(
                         req, timeout=min(timeout, remaining)
                     ) as resp:
-                        return json.loads(resp.read())
+                        return resp.read()
             except urllib.error.HTTPError as e:
                 if not (retry.retry_5xx and e.code in (502, 503, 504)
                         and retriable) or attempt + 1 >= attempts:

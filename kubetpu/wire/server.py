@@ -105,6 +105,8 @@ class NodeAgentServer:
         install_process_gauges(self.registry, self.obs_component)
         for key in ("nodeinfo_requests", "allocate_requests",
                     "allocate_replays", "errors"):
+            # key ranges over the fixed literal tuple above — bounded
+            # cardinality by construction # ktlint: disable=KTP004
             self.registry.counter(f"kubetpu_agent_{key}_total")
         # legacy alias (pinned by test_wire): the Round-11 standard
         # kubetpu_process_uptime_seconds is the fleet-wide series; this
@@ -129,6 +131,8 @@ class NodeAgentServer:
         agent = self
 
         def bump(key: str) -> None:
+            # callers pass literals from the pre-registered set above
+            # ktlint: disable=KTP004
             agent.registry.counter(f"kubetpu_agent_{key}_total").inc()
 
         class Handler(BaseHTTPRequestHandler):

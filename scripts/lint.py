@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""``scripts/lint.py`` — thin wrapper over ``python -m kubetpu.analysis``
+so CI and operators have one obvious entry point next to the other
+check scripts (obs_check, prefix_check, spec_check)."""
+
+import os
+import sys
+
+# run from the repo root like the sibling check scripts; also resolve
+# the root from this file so `python scripts/lint.py` works anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubetpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -82,10 +82,9 @@ REQUIRED_PROFILE_SERIES = (
 
 
 def _get_text(url: str) -> str:
-    import urllib.request
+    from kubetpu.wire.httpcommon import request_text
 
-    with urllib.request.urlopen(url, timeout=10) as r:
-        return r.read().decode()
+    return request_text(url, timeout=10)
 
 
 def _check_events(name: str, body: str, failures, expect_kinds=()):
@@ -118,7 +117,11 @@ def main() -> int:
     try:
         for a in agents:
             a.start()
-            request_json(controller.address + "/nodes", {"url": a.address})
+            # keyed so the registration POST is retry-safe under the
+            # shared client (register_agent is idempotent server-side
+            # too, but the key keeps KTP002's contract uniform)
+            request_json(controller.address + "/nodes", {"url": a.address},
+                         idempotency_key=f"obs-check-reg-{a.node_name}")
         # one single-pod submit + one gang submit so both schedule ops and
         # both agents' allocate paths record
         with span("obs-check.submit") as root:

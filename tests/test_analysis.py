@@ -1,0 +1,495 @@
+"""Round-12: the static invariant linter (`kubetpu.analysis`).
+
+Fixture-driven per rule (one violating + one clean snippet each),
+suppression + baseline-ratchet mechanics, the CLI's JSON surface, the
+new `httpcommon.request_text` wire path the migrations ride, and the
+meta-test: the repo itself lints clean against the committed baseline.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from kubetpu.analysis import baseline as baseline_mod
+from kubetpu.analysis.cli import main as lint_main
+from kubetpu.analysis.core import all_rules, run_lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def lint(tmp_path, files, rules=None, baseline=None):
+    root = make_tree(tmp_path, files)
+    picked = None
+    if rules is not None:
+        want = set(rules)
+        picked = [r for r in all_rules() if r.code in want]
+        assert {r.code for r in picked} == want
+    return run_lint(root, ["."], rules=picked, baseline=baseline)
+
+
+def codes(result):
+    return [f.code for f in result.active]
+
+
+# -- KTP001 hot-path-sync ----------------------------------------------------
+
+HOT_VIOLATING = """
+    class Server:
+        def step(self):
+            return self._advance()
+
+        def _advance(self):
+            vals = jnp.asarray(self.host_buf)      # upload in the hot loop
+            return vals.tolist()                   # and a sync
+    """
+
+HOT_CLEAN = """
+    class Server:
+        def step(self):
+            return self._advance()
+
+        def _advance(self):
+            return self._step_fn(self.cache)
+
+        def warmup(self):
+            # barrier leg: uploads here are by design
+            jnp.asarray([0])
+    """
+
+
+def test_hotpath_flags_sync_reachable_from_step(tmp_path):
+    res = lint(tmp_path, {"kubetpu/jobs/serving.py": HOT_VIOLATING},
+               rules=["KTP001"])
+    assert codes(res) == ["KTP001", "KTP001"]
+    msgs = [f.message for f in res.active]
+    assert any("jnp.asarray" in m for m in msgs)
+    assert any(".tolist()" in m for m in msgs)
+
+
+def test_hotpath_clean_and_barriers_exempt(tmp_path):
+    res = lint(tmp_path, {"kubetpu/jobs/serving.py": HOT_CLEAN},
+               rules=["KTP001"])
+    assert res.active == []
+
+
+def test_hotpath_follows_inheritance_across_modules(tmp_path):
+    # base step() in serving.py, the offending override lives in paged.py
+    # — the closure must flatten the hierarchy across files
+    res = lint(tmp_path, {
+        "kubetpu/jobs/serving.py": """
+            class SlotServerBase:
+                def step(self):
+                    return self._device_step()
+
+                def _device_step(self):
+                    raise NotImplementedError
+            """,
+        "kubetpu/jobs/paged.py": """
+            from kubetpu.jobs.serving import SlotServerBase
+
+            class PagedDecodeServer(SlotServerBase):
+                def _device_step(self):
+                    return self.tokens.item()
+            """,
+    }, rules=["KTP001"])
+    assert [(f.path, f.code) for f in res.active] == [
+        ("kubetpu/jobs/paged.py", "KTP001")]
+
+
+def test_hotpath_ignores_cold_modules(tmp_path):
+    # same code outside the hot modules: not serving's step, no finding
+    res = lint(tmp_path, {"kubetpu/jobs/train.py": HOT_VIOLATING},
+               rules=["KTP001"])
+    assert res.active == []
+
+
+# -- KTP002 wire-hygiene -----------------------------------------------------
+
+
+def test_wire_flags_raw_urlopen_and_naked_post(tmp_path):
+    res = lint(tmp_path, {"kubetpu/cli/thing.py": """
+        import urllib.request
+        from kubetpu.wire.httpcommon import request_json
+
+        def scrape(url):
+            with urllib.request.urlopen(url) as r:   # raw socket
+                return r.read()
+
+        def submit(url, pod):
+            return request_json(url + "/pods", {"pod": pod})  # naked POST
+        """}, rules=["KTP002"])
+    assert codes(res) == ["KTP002", "KTP002"]
+    assert "urlopen" in res.active[0].message
+    assert "idempotency_key" in res.active[1].message
+
+
+def test_wire_clean_sites_pass(tmp_path):
+    res = lint(tmp_path, {
+        # the one module allowed to urlopen: the shared client itself
+        "kubetpu/wire/httpcommon.py": """
+            import urllib.request
+
+            def request_json(url):
+                with urllib.request.urlopen(url) as r:
+                    return r.read()
+            """,
+        "kubetpu/cli/thing.py": """
+            from kubetpu.wire.httpcommon import request_json
+
+            def ok(url, pod, key):
+                request_json(url, {"pod": pod}, idempotency_key=key)
+                request_json(url + "/pods/p0")            # GET
+                request_json(url, method="DELETE")        # idempotent verb
+            """,
+    }, rules=["KTP002"])
+    assert res.active == []
+
+
+# -- KTP003 lock-discipline --------------------------------------------------
+
+LOCK_VIOLATING = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = {}
+
+        def add(self, k):
+            with self._lock:
+                self.items[k] = 1
+
+        def clear(self):
+            self.items = {}          # unguarded write to guarded state
+    """
+
+
+def test_lock_flags_unguarded_write(tmp_path):
+    res = lint(tmp_path, {"kubetpu/obs/reg2.py": LOCK_VIOLATING},
+               rules=["KTP003"])
+    assert codes(res) == ["KTP003"]
+    assert "self.items" in res.active[0].message
+
+
+def test_lock_clean_under_lock_and_locked_convention(tmp_path):
+    res = lint(tmp_path, {"kubetpu/obs/reg2.py": """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+
+            def add(self, k):
+                with self._lock:
+                    self.items[k] = 1
+
+            def clear(self):
+                with self._lock:
+                    self.items = {}
+
+            def _evict_locked(self, k):
+                # caller holds the lock (project convention)
+                del self.items[k]
+        """}, rules=["KTP003"])
+    assert res.active == []
+
+
+# -- KTP004 metric-hygiene ---------------------------------------------------
+
+
+def test_metric_flags_fstring_grammar_and_counter_suffix(tmp_path):
+    res = lint(tmp_path, {"kubetpu/obs/thing.py": """
+        def setup(reg, name):
+            reg.counter(f"kubetpu_{name}_total").inc()   # unbounded
+            reg.counter("kubetpu_requests")              # not *_total
+            reg.gauge("badprefix_depth")                 # wrong grammar
+            reg.histogram(name)                          # non-literal
+        """}, rules=["KTP004"])
+    assert codes(res) == ["KTP004"] * 4
+
+
+def test_metric_clean_names_pass(tmp_path):
+    res = lint(tmp_path, {"kubetpu/obs/thing.py": """
+        def setup(reg):
+            reg.counter("kubetpu_requests_total").inc()
+            reg.gauge("kubetpu_queue_depth").set(0)
+            reg.histogram("kubetpu_ttft_seconds", op="serve")
+        """}, rules=["KTP004"])
+    assert res.active == []
+
+
+# -- KTP005 determinism ------------------------------------------------------
+
+
+def test_determinism_flags_wall_clock_and_stdlib_random(tmp_path):
+    res = lint(tmp_path, {"kubetpu/jobs/widget.py": """
+        import random
+        import time
+
+        def pick(xs):
+            t = time.time()
+            return random.choice(xs), t
+        """}, rules=["KTP005"])
+    assert codes(res) == ["KTP005", "KTP005"]
+
+
+def test_determinism_allows_seeded_and_monotonic(tmp_path):
+    res = lint(tmp_path, {"kubetpu/jobs/widget.py": """
+        import time
+
+        def pick(xs, rng, key):
+            t0 = time.perf_counter()
+            a = np.random.RandomState(0).permutation(len(xs))
+            b = jax.random.fold_in(key, 3)
+            return a, b, time.monotonic() - t0
+        """}, rules=["KTP005"])
+    assert res.active == []
+
+
+def test_determinism_scoped_to_jobs(tmp_path):
+    # obs/wire legitimately read wall clock (timestamps, TTLs)
+    res = lint(tmp_path, {"kubetpu/obs/clock.py": """
+        import time
+
+        def now():
+            return time.time()
+        """}, rules=["KTP005"])
+    assert res.active == []
+
+
+# -- KTP006 jit-leg-hygiene --------------------------------------------------
+
+
+def test_jit_flags_in_loop_and_step_closure(tmp_path):
+    res = lint(tmp_path, {
+        "kubetpu/jobs/legs.py": """
+            def compile_all(fns):
+                legs = []
+                for fn in fns:
+                    legs.append(jax.jit(fn))      # fresh leg per iteration
+                return legs
+            """,
+        "kubetpu/jobs/serving.py": """
+            class Server:
+                def step(self):
+                    return self._advance()
+
+                def _advance(self):
+                    return jax.jit(self._fn)(self.cache)   # per-step jit
+            """,
+    }, rules=["KTP006"])
+    got = sorted((f.path, f.code) for f in res.active)
+    assert got == [("kubetpu/jobs/legs.py", "KTP006"),
+                   ("kubetpu/jobs/serving.py", "KTP006")]
+
+
+def test_jit_flags_decorator_and_comprehension_in_loop(tmp_path):
+    # the def's body runs later, but its DECORATORS evaluate per loop
+    # iteration — a fresh leg each time; comprehensions are loops too
+    res = lint(tmp_path, {"kubetpu/jobs/legs.py": """
+        from functools import partial
+
+        def per_gamma(fns, gammas):
+            legs = []
+            for g in gammas:
+                @partial(jax.jit, static_argnums=(0,))
+                def leg(cache):
+                    return cache
+                legs.append(leg)
+            return legs
+
+        def all_at_once(fns):
+            return [jax.jit(f) for f in fns]
+        """}, rules=["KTP006"])
+    assert codes(res) == ["KTP006", "KTP006"]
+    assert all("inside a loop" in f.message for f in res.active)
+
+
+def test_jit_clean_factory_passes(tmp_path):
+    res = lint(tmp_path, {"kubetpu/jobs/legs.py": """
+        from functools import partial
+
+        def make_leg(fn):
+            @partial(jax.jit, donate_argnums=(0,))
+            def leg(cache, tok):
+                return fn(cache, tok)
+            return leg
+        """}, rules=["KTP006"])
+    assert res.active == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+def test_inline_suppression_trailing_and_line_above(tmp_path):
+    res = lint(tmp_path, {"kubetpu/cli/thing.py": """
+        import urllib.request
+
+        def a(url):
+            return urllib.request.urlopen(url)  # ktlint: disable=KTP002
+
+        def b(url):
+            # local read-only scrape — justified
+            # ktlint: disable=KTP002
+            return urllib.request.urlopen(url)
+
+        def c(url):
+            return urllib.request.urlopen(url)  # ktlint: disable=KTP001
+        """}, rules=["KTP002"])
+    # a + b suppressed; c's disable names the WRONG code, so it fails
+    assert len(res.suppressed) == 2
+    assert [f.line for f in res.active] == [13]
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+TWO_URLOPEN = """
+    import urllib.request
+
+    def a(url):
+        return urllib.request.urlopen(url)
+
+    def b(url):
+        return urllib.request.urlopen(url)
+    """
+
+
+def test_baseline_absorbs_up_to_budget_and_ratchets(tmp_path):
+    files = {"kubetpu/cli/thing.py": TWO_URLOPEN}
+    bare = lint(tmp_path, files, rules=["KTP002"])
+    assert len(bare.active) == 2
+
+    # write the baseline from the bare run: both findings become debt
+    bl_path = str(tmp_path / "lint_baseline.json")
+    data = baseline_mod.write_baseline(bl_path, bare.findings)
+    assert data["counts"] == {"kubetpu/cli/thing.py::KTP002": 2}
+
+    # same tree + baseline: clean (ratcheted, not blocking)
+    again = lint(tmp_path, files, rules=["KTP002"],
+                 baseline=baseline_mod.load_baseline(bl_path))
+    assert again.active == [] and len(again.baselined) == 2
+
+    # a THIRD violation exceeds the budget: exactly one new finding
+    files3 = {"kubetpu/cli/thing.py": textwrap.dedent(TWO_URLOPEN)
+              + "\ndef c(url):\n    return urllib.request.urlopen(url)\n"}
+    worse = lint(tmp_path, files3, rules=["KTP002"],
+                 baseline=baseline_mod.load_baseline(bl_path))
+    assert len(worse.active) == 1 and len(worse.baselined) == 2
+
+
+def test_baseline_reports_paid_down_debt_as_stale(tmp_path):
+    baseline = {"version": 1, "counts": {"kubetpu/cli/thing.py::KTP002": 5}}
+    res = lint(tmp_path, {"kubetpu/cli/thing.py": TWO_URLOPEN},
+               rules=["KTP002"], baseline=baseline)
+    assert res.active == []
+    stale = baseline_mod.stale_keys(res.findings, baseline)
+    assert stale == {"kubetpu/cli/thing.py::KTP002": 3}
+
+
+def test_baseline_rejects_wrong_version(tmp_path):
+    p = tmp_path / "bl.json"
+    p.write_text(json.dumps({"version": 99, "counts": {}}))
+    with pytest.raises(ValueError):
+        baseline_mod.load_baseline(str(p))
+
+
+# -- CLI surface -------------------------------------------------------------
+
+
+def test_cli_json_format_and_exit_codes(tmp_path, capsys):
+    root = make_tree(tmp_path, {"kubetpu/cli/thing.py": TWO_URLOPEN})
+    rc = lint_main(["--root", root, "--no-baseline", "--format", "json",
+                    "--rules", "KTP002", "kubetpu"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["new"] == 2 and out["counts"] == {"KTP002": 2}
+    assert {f["code"] for f in out["findings"]} == {"KTP002"}
+    assert any(r["code"] == "KTP002" for r in out["rules"])
+
+    clean_root = make_tree(tmp_path / "clean",
+                           {"kubetpu/cli/ok.py": "x = 1\n"})
+    rc = lint_main(["--root", clean_root, "--no-baseline",
+                    "--format", "json", "kubetpu"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["new"] == 0
+
+
+def test_cli_write_baseline_round_trip(tmp_path, capsys):
+    root = make_tree(tmp_path, {"kubetpu/cli/thing.py": TWO_URLOPEN})
+    bl = os.path.join(root, "lint_baseline.json")
+    # a SCOPED write-baseline would silently drop out-of-scope budget:
+    # refused outright
+    assert lint_main(["--root", root, "--baseline", bl,
+                      "--write-baseline", "kubetpu"]) == 2
+    assert lint_main(["--root", root, "--baseline", bl,
+                      "--write-baseline", "--rules", "KTP002"]) == 2
+    # the full default run regenerates
+    assert lint_main(["--root", root, "--baseline", bl,
+                      "--write-baseline"]) == 0
+    capsys.readouterr()
+    # with the ratchet in place the same tree now exits 0
+    assert lint_main(["--root", root, "--baseline", bl, "kubetpu"]) == 0
+    # but ignoring it fails
+    assert lint_main(["--root", root, "--no-baseline", "kubetpu"]) == 1
+
+
+def test_cli_list_rules_covers_catalog(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in ("KTP001", "KTP002", "KTP003", "KTP004", "KTP005",
+                 "KTP006"):
+        assert code in out
+
+
+# -- request_text (the migration the lint forced) ----------------------------
+
+
+def test_request_text_rides_the_shared_client():
+    from kubetpu.obs.exporter import MetricsServer
+    from kubetpu.obs.registry import Registry, default_registry
+    from kubetpu.wire.httpcommon import NO_RETRY, request_text
+
+    reg = Registry()
+    reg.counter("kubetpu_widget_total").inc(3)
+    server = MetricsServer({"replica0": reg})
+    server.start()
+    try:
+        before = default_registry().counter(
+            "kubetpu_wire_requests_total").value
+        text = request_text(server.address + "/metrics", timeout=5,
+                            retry=NO_RETRY)
+        assert 'kubetpu_widget_total' in text
+        # the scrape rode the shared client: the wire counter moved
+        after = default_registry().counter(
+            "kubetpu_wire_requests_total").value
+        assert after == before + 1
+    finally:
+        server.shutdown()
+
+
+# -- the meta-test: this repo lints clean ------------------------------------
+
+
+def test_repo_lints_clean_against_committed_baseline():
+    """`make lint` green is a merge gate; this pins it in tier-1. Any
+    new violation of KTP001–KTP006 in kubetpu/ or scripts/ fails here
+    at the offending path:line unless it carries a justified inline
+    disable or the (shrink-only) baseline covers it."""
+    bl_path = os.path.join(REPO_ROOT, baseline_mod.DEFAULT_BASELINE)
+    baseline = baseline_mod.load_baseline(bl_path)
+    res = run_lint(REPO_ROOT, ["kubetpu", "scripts"], baseline=baseline)
+    assert [f.render() for f in res.active] == []
+    # the ratchet only ever shrinks: every budgeted finding must still
+    # exist, otherwise the baseline is stale and must be regenerated
+    assert baseline_mod.stale_keys(res.findings, baseline) == {}
