@@ -44,14 +44,25 @@ test: tpuinfo gpuinfo dataio
 # that silently regressed serving throughput still fails the round).
 .PHONY: chaos
 chaos: lint obs-check prefix-check spec-check bench-gate-smoke
-	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+	python -m pytest tests/test_chaos.py tests/test_resilience.py \
+		tests/test_race_soak.py -q
 
-# static invariant lint (Round-12, kubetpu/analysis): rules KTP001… over
-# kubetpu/ + scripts/, exits non-zero on any finding not covered by an
-# inline `# ktlint: disable=` or the committed lint_baseline.json ratchet
+# static invariant lint (Rounds 12–13, kubetpu/analysis): rules
+# KTP001–KTP010 over kubetpu/ + scripts/, exits non-zero on any finding
+# not covered by an inline `# ktlint: disable=` or the committed
+# lint_baseline.json ratchet — and (CI mode, scripts/lint.py) on a
+# STALE baseline whose budget outlived its findings
 .PHONY: lint
 lint:
-	python -m kubetpu.analysis
+	python scripts/lint.py
+
+# diff-scoped lint for the inner loop: the whole tree is still parsed
+# (the flow rules need global context) but only findings in files git
+# sees as changed fail — the gate's failure surface scales with the
+# diff as the repo grows
+.PHONY: lint-changed
+lint-changed:
+	python -m kubetpu.analysis --changed-only
 
 # deliberately regenerate the ratchet from the current tree. The diff of
 # lint_baseline.json must only ever SHRINK counts — review enforces it,

@@ -1,10 +1,20 @@
 """``python -m kubetpu.analysis`` — the lint front door.
 
 Exit codes: 0 clean (baselined/suppressed findings allowed), 1 any new
-finding, 2 usage errors. Text output is one ``path:line:col: KTPnnn
-message`` per finding (editor/CI clickable); ``--format=json`` emits the
-full structured result for tooling (finding-count regression diffing,
-bench_gate-style).
+finding (or, with ``--fail-stale``, a stale baseline), 2 usage errors.
+Text output is one ``path:line:col: KTPnnn message`` per finding
+(editor/CI clickable); ``--format=json`` emits the full structured
+result for tooling (finding-count regression diffing, bench_gate-style);
+``--format=github`` emits workflow-command annotations so CI findings
+land inline on the PR diff.
+
+``--changed-only`` scopes the REPORT to files git sees as changed
+(working tree + index vs ``--diff-base``, default HEAD). The whole
+project is still parsed — the flow-aware rules (hot-path closure, lock
+graph, thread roles) need global context, and a finding in an unchanged
+file can be CAUSED by a changed one — but only findings in changed files
+fail the run, so the gate's failure surface scales with the diff, not
+the repo.
 """
 
 from __future__ import annotations
@@ -12,9 +22,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from kubetpu.analysis import baseline as baseline_mod
 from kubetpu.analysis.core import all_rules, run_lint
@@ -46,7 +57,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
     ap.add_argument("--root", default=None,
                     help="repo root (default: auto-detected)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only findings in files git sees as "
+                         "changed (the full tree is still parsed for "
+                         "whole-project context)")
+    ap.add_argument("--diff-base", default="HEAD",
+                    help="git ref --changed-only diffs against "
+                         "(default: HEAD; untracked files always count)")
+    ap.add_argument("--fail-stale", action="store_true",
+                    help="exit 1 when the baseline holds budget for "
+                         "findings that no longer exist (CI mode — a "
+                         "paid-down ratchet must be committed)")
     ap.add_argument("--baseline", default=None,
                     help="lint_baseline.json path (default: <root>/"
                          f"{baseline_mod.DEFAULT_BASELINE}; missing = bare)")
@@ -69,12 +92,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{r.code} {r.name}: {r.description}")
         return 0
 
-    if args.write_baseline and (args.rules or args.paths):
+    if args.write_baseline and (args.rules or args.paths
+                                or args.changed_only):
         # a scoped run sees only a slice of the findings — writing the
         # baseline from it would silently DROP every other rule's/file's
         # ratchet budget and re-open that debt as "new" on the next run
         print("--write-baseline must regenerate from the FULL default "
-              "run; drop --rules/paths", file=sys.stderr)
+              "run; drop --rules/paths/--changed-only", file=sys.stderr)
         return 2
 
     if args.rules:
@@ -101,6 +125,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"bad baseline: {e}", file=sys.stderr)
                 return 2
 
+    changed: Optional[Set[str]] = None
+    if args.changed_only:
+        changed = _changed_files(root, args.diff_base)
+        if changed is None:
+            print("--changed-only: git diff failed (not a checkout?); "
+                  "reporting the full run", file=sys.stderr)
+
     t0 = time.monotonic()
     result = run_lint(root, paths, rules=rules, baseline=baseline)
     dur = time.monotonic() - t0
@@ -112,37 +143,140 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{n} ratcheted findings")
         return 0
 
+    failing = [f for f in result.active
+               if changed is None or f.path in changed]
+    # staleness is only decidable when the FULL finding set was
+    # computed: a --rules/paths scope sees a slice, so every
+    # out-of-scope baseline key would read as "paid down" and a clean
+    # tree would fail (the same hazard --write-baseline refuses).
+    # --changed-only is NOT scoped here — it filters the report, but
+    # run_lint still linted the full default paths, so stale_keys over
+    # result.findings stays exact.
+    scoped = bool(args.rules or args.paths)
+    stale = (baseline_mod.stale_keys(result.findings, baseline)
+             if baseline is not None and not scoped else {})
+    rc = 1 if failing or (args.fail_stale and stale) else 0
+
     if args.format == "json":
         out = result.to_json()
         out["duration_seconds"] = round(dur, 3)
+        out["failing"] = len(failing)
+        if changed is not None:
+            out["changed_only"] = sorted(changed)
+        if stale:
+            out["stale_baseline_keys"] = stale
         print(json.dumps(out, indent=2))
-        return 1 if result.active else 0
+        return rc
 
-    shown = result.findings if args.show_suppressed else result.active
+    if args.format == "github":
+        # GitHub workflow commands: CI surfaces each finding inline on
+        # the PR diff. Active findings are errors; with
+        # --show-suppressed, absorbed/disabled ones annotate as notices.
+        for f in failing:
+            print(f"::error file={f.path},line={f.line},col={f.col},"
+                  f"title={f.code}::{_gh_escape(f.message)}")
+        if args.show_suppressed:
+            for f in result.findings:
+                if not (f.suppressed or f.baselined):
+                    continue
+                if changed is not None and f.path not in changed:
+                    continue
+                kind = "suppressed" if f.suppressed else "baselined"
+                print(f"::notice file={f.path},line={f.line},col={f.col},"
+                      f"title={f.code} {kind}::{_gh_escape(f.message)}")
+        if args.fail_stale and stale:
+            print("::error title=stale lint baseline::"
+                  + _gh_escape(f"{sum(stale.values())} ratcheted "
+                               "finding(s) no longer exist; run make "
+                               "lint-baseline and commit the shrink"))
+        return rc
+
+    shown = result.findings if args.show_suppressed else failing
     for f in shown:
+        if changed is not None and f.path not in changed:
+            continue
         tag = ""
         if f.suppressed:
             tag = "  [suppressed]"
         elif f.baselined:
             tag = "  [baselined]"
         print(f.render() + tag)
+    scope = (f" [{len(changed)} changed files]"
+             if changed is not None else "")
     summary = (
-        f"lint: {len(result.active)} new, {len(result.baselined)} "
+        f"lint: {len(failing)} new, {len(result.baselined)} "
         f"baselined, {len(result.suppressed)} suppressed "
-        f"({len(rules)} rules, {dur:.1f}s)"
+        f"({len(rules)} rules, {dur:.1f}s){scope}"
     )
     print(summary, file=sys.stderr)
-    if baseline is not None:
-        stale = baseline_mod.stale_keys(result.findings, baseline)
-        if stale:
-            paid = sum(stale.values())
-            print(
-                f"lint: baseline is stale — {paid} ratcheted finding(s) "
-                "no longer exist; commit a shrunk baseline "
-                "(make lint-baseline)",
-                file=sys.stderr,
-            )
-    return 1 if result.active else 0
+    if stale:
+        paid = sum(stale.values())
+        fatal = " (--fail-stale: failing the run)" if args.fail_stale else ""
+        print(
+            f"lint: baseline is stale — {paid} ratcheted finding(s) "
+            "no longer exist; commit a shrunk baseline "
+            f"(make lint-baseline){fatal}",
+            file=sys.stderr,
+        )
+    return rc
+
+
+def _gh_escape(msg: str) -> str:
+    """GitHub workflow-command data escaping (the documented set)."""
+    return (msg.replace("%", "%25").replace("\r", "%0D")
+            .replace("\n", "%0A"))
+
+
+def _changed_files(root: str, base: str) -> Optional[Set[str]]:
+    """LINT-ROOT-relative paths git sees as changed: committed-vs-*base*
+    + working tree + index + untracked. None when git is unusable here.
+
+    git prints paths relative to the repo TOPLEVEL; when the lint root
+    is a subdirectory of the checkout (a vendored project), findings are
+    root-relative — so toplevel paths are re-rooted via ``--show-prefix``
+    (changes outside the lint root are dropped: they cannot host a
+    finding)."""
+    out: Set[str] = set()
+    try:
+        prefix_run = subprocess.run(
+            ["git", "rev-parse", "--show-prefix"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if prefix_run.returncode != 0:
+            return None
+        prefix = prefix_run.stdout.strip()
+
+        def add(p: str) -> None:
+            p = p.strip().strip('"')
+            if not p:
+                return
+            if prefix:
+                if not p.startswith(prefix):
+                    return
+                p = p[len(prefix):]
+            out.add(p)
+
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode != 0:
+            return None
+        for p in diff.stdout.splitlines():
+            add(p)
+        status = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=all"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if status.returncode == 0:
+            for line in status.stdout.splitlines():
+                p = line[3:]
+                if " -> " in p:          # rename: new side is the live file
+                    p = p.split(" -> ", 1)[1]
+                add(p)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return out
 
 
 if __name__ == "__main__":

@@ -158,7 +158,7 @@ def all_rules() -> List[Rule]:
     """Every registered rule, sorted by code. Importing the rule
     modules here keeps ``core`` import-cycle-free while making
     ``run_lint`` self-contained."""
-    from kubetpu.analysis import rules_device, rules_plane  # noqa: F401
+    from kubetpu.analysis import rules_device, rules_flow, rules_plane  # noqa: F401
 
     def leaves(cls):
         subs = cls.__subclasses__()

@@ -1,0 +1,1054 @@
+"""Dataflow layer under the Round-13 rules (KTP007–KTP010).
+
+PR 7's engine pins invariants by *name matching* single lines; this
+module is the step up to *flow*: a per-function CFG with a forward
+may-taint analysis (KTP007's implicit-sync tracking), a whole-project
+lock-acquisition graph (KTP008's deadlock cycles), and a thread-role
+model separating wire-handler threads from the step/reconcile loops
+(KTP009's escape analysis). Everything here is rule-agnostic machinery;
+the rules in ``rules_flow.py`` supply the sources/sinks/policies.
+
+Design constraints, matching ``core``:
+
+- **stdlib only**, one ``ast`` pass per consumer over already-parsed
+  trees — no jax, no imports of the linted code;
+- **conservative over clever**: the taint engine is a may-analysis
+  (union at joins, monotone transfer — it always converges), the lock
+  graph resolves only receivers it can type (``self``, attributes whose
+  class is assigned in ``__init__``, the wire servers' ``alias = self``
+  closure idiom). A receiver we cannot type contributes nothing — rules
+  built on this model miss, they do not spray false positives;
+- **shared shape**: the class-index/inheritance walk mirrors
+  ``rules_device.hot_closure`` so the hot-path closure and the thread
+  model agree about who overrides what.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from kubetpu.analysis.core import Project, SourceFile, call_name, dotted_name
+
+def walk_skip_nested(node: ast.AST) -> Iterable[ast.AST]:
+    """``ast.walk`` that does not descend into nested function/lambda
+    bodies — a nested def is a BINDING at this level; its body runs on
+    some later call, not under the enclosing statement's locks or taint
+    environment."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.append(c)
+
+
+# ---------------------------------------------------------------------------
+# control-flow graph
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Block:
+    """One basic block: straight-line statements + successor indices.
+    Compound statements (If/While/For/With/Try) appear as their OWN
+    entry — the "header" — so an analysis sees their test/iter with the
+    environment that reaches it; their bodies live in successor blocks."""
+
+    idx: int
+    stmts: List[ast.stmt] = field(default_factory=list)
+    succs: Set[int] = field(default_factory=set)
+
+
+class CFG:
+    """Statement-level control-flow graph of one function body."""
+
+    def __init__(self) -> None:
+        self.blocks: List[Block] = []
+        # try-body -> handler edges: control may leave MID-block (any
+        # statement can raise), so a flow analysis must propagate the
+        # union of the block's intermediate states, not its final one
+        self.exceptional: Set[Tuple[int, int]] = set()
+        self.entry = self._new()
+        self.exit = self._new()
+
+    def _new(self) -> int:
+        b = Block(idx=len(self.blocks))
+        self.blocks.append(b)
+        return b.idx
+
+    def preds(self) -> Dict[int, Set[int]]:
+        out: Dict[int, Set[int]] = {b.idx: set() for b in self.blocks}
+        for b in self.blocks:
+            for s in b.succs:
+                out[s].add(b.idx)
+        return out
+
+
+class _CfgBuilder:
+    """Builds a CFG from a function body. Loops get back edges, breaks
+    and continues resolve against a loop stack, every statement of a
+    ``try`` body may jump to every handler (exceptions are unpredictable
+    — the conservative over-approximation a may-analysis wants), and
+    ``return``/``raise`` edge to the synthetic exit."""
+
+    def __init__(self) -> None:
+        self.cfg = CFG()
+        self.cur = self.cfg.entry
+        # (continue_target, break_target) innermost-last
+        self.loops: List[Tuple[int, int]] = []
+
+    def _edge(self, a: int, b: int) -> None:
+        self.cfg.blocks[a].succs.add(b)
+
+    def _start(self, pred: Optional[int] = None) -> int:
+        b = self.cfg._new()
+        if pred is not None:
+            self._edge(pred, b)
+        return b
+
+    def build(self, body: Sequence[ast.stmt]) -> CFG:
+        self.visit_body(body)
+        self._edge(self.cur, self.cfg.exit)
+        return self.cfg
+
+    def visit_body(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self.visit_stmt(stmt)
+
+    def visit_stmt(self, stmt: ast.stmt) -> None:
+        blocks = self.cfg.blocks
+        if isinstance(stmt, ast.If):
+            blocks[self.cur].stmts.append(stmt)   # header: test sees env
+            head = self.cur
+            join = self.cfg._new()
+            self.cur = self._start(head)
+            self.visit_body(stmt.body)
+            self._edge(self.cur, join)
+            if stmt.orelse:
+                self.cur = self._start(head)
+                self.visit_body(stmt.orelse)
+                self._edge(self.cur, join)
+            else:
+                self._edge(head, join)
+            self.cur = join
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = self._start(self.cur)
+            blocks[head].stmts.append(stmt)       # header: test/iter + bind
+            after = self.cfg._new()
+            self._edge(head, after)               # zero-iteration path
+            self.loops.append((head, after))
+            self.cur = self._start(head)
+            self.visit_body(stmt.body)
+            self._edge(self.cur, head)            # back edge
+            self.loops.pop()
+            if stmt.orelse:
+                self.cur = self._start(after)
+                self.visit_body(stmt.orelse)
+                self._edge(self.cur, after)
+            self.cur = after
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            blocks[self.cur].stmts.append(stmt)   # header: binds as-names
+            self.visit_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            # the try body starts its OWN block: its leading simple
+            # statements must be inside the exceptional-edge range, not
+            # merged into the preceding block (which would carry only
+            # post-body state into the handlers)
+            body_entry = self._start(self.cur)
+            self.cur = body_entry
+            self.visit_body(stmt.body)
+            body_blocks = list(range(body_entry, len(blocks)))
+            body_end = self.cur
+            ends = []
+            for handler in stmt.handlers:
+                h = self.cfg._new()
+                if handler.name:
+                    # `except E as name:` binds — represent with the
+                    # handler node so transfer fns can see it
+                    blocks[h].stmts.append(handler)
+                # any try-body statement may raise into this handler
+                for b in body_blocks:
+                    self._edge(b, h)
+                    self.cfg.exceptional.add((b, h))
+                self.cur = h
+                self.visit_body(handler.body)
+                ends.append(self.cur)
+            if stmt.orelse:
+                self.cur = body_end
+                self.visit_body(stmt.orelse)
+                body_end = self.cur
+            join = self._start(body_end)
+            for e in ends:
+                self._edge(e, join)
+            self.cur = join
+            if stmt.finalbody:
+                self.visit_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.Return, ast.Raise)):
+            blocks[self.cur].stmts.append(stmt)
+            self._edge(self.cur, self.cfg.exit)
+            self.cur = self.cfg._new()            # unreachable continuation
+        elif isinstance(stmt, ast.Break):
+            if self.loops:
+                self._edge(self.cur, self.loops[-1][1])
+            self.cur = self.cfg._new()
+        elif isinstance(stmt, ast.Continue):
+            if self.loops:
+                self._edge(self.cur, self.loops[-1][0])
+            self.cur = self.cfg._new()
+        else:
+            # simple statement (incl. nested def/class: a binding, not a
+            # call — nested bodies are analyzed as their own functions)
+            blocks[self.cur].stmts.append(stmt)
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """CFG of *func*'s body (FunctionDef/AsyncFunctionDef)."""
+    return _CfgBuilder().build(func.body)
+
+
+# ---------------------------------------------------------------------------
+# taint (forward may-analysis over the CFG)
+# ---------------------------------------------------------------------------
+
+# value-preserving wrappers: taint flows THROUGH them unchanged
+_TRANSPARENT_CALLS = {"list", "tuple", "sorted", "reversed", "abs", "min",
+                      "max", "sum"}
+
+
+class TaintEngine:
+    """Forward may-taint over one function.
+
+    *is_source(call) -> bool* marks producing expressions;
+    *sanitizers* is a set of dotted call names whose RESULT is clean
+    (e.g. ``np.asarray`` — it syncs, which is KTP001's finding to make,
+    and hands back a host array). Tracked variables are plain names and
+    ``self.attr`` pseudo-names (strong updates on both: an assignment of
+    a clean value kills the taint — the transfer stays monotone in the
+    input environment, so the fixpoint converges)."""
+
+    def __init__(self, is_source: Callable[[ast.Call], bool],
+                 sanitizers: Optional[Set[str]] = None) -> None:
+        self.is_source = is_source
+        self.sanitizers = sanitizers or set()
+
+    # -- expression taint ----------------------------------------------------
+
+    def expr_tainted(self, node: ast.AST, env: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in env
+        if isinstance(node, ast.Attribute):
+            d = dotted_name(node)
+            if d is not None and d in env:
+                return True
+            return self.expr_tainted(node.value, env)
+        if isinstance(node, ast.Call):
+            d = call_name(node)
+            if d is not None and d in self.sanitizers:
+                return False
+            if self.is_source(node):
+                return True
+            parts: List[ast.AST] = list(node.args)
+            parts += [kw.value for kw in node.keywords]
+            # a method on a tainted receiver stays tainted (mask.any())
+            if isinstance(node.func, ast.Attribute):
+                parts.append(node.func.value)
+            return any(self.expr_tainted(p, env) for p in parts)
+        if isinstance(node, ast.Lambda):
+            return False                      # body runs later, elsewhere
+        # generic: any tainted sub-expression taints the whole
+        return any(self.expr_tainted(c, env)
+                   for c in ast.iter_child_nodes(node)
+                   if isinstance(c, (ast.expr, ast.comprehension,
+                                     ast.FormattedValue)))
+
+    # -- statement transfer --------------------------------------------------
+
+    @staticmethod
+    def _target_keys(target: ast.AST) -> List[str]:
+        """Variable keys a target binds: names, self.attr pseudo-names,
+        elements of tuple targets; subscript targets key their base (a
+        tainted store into a container taints the container)."""
+        out: List[str] = []
+        stack = [target]
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+            elif isinstance(t, ast.Subscript):
+                stack.append(t.value)
+            elif isinstance(t, ast.Name):
+                out.append(t.id)
+            elif isinstance(t, ast.Attribute):
+                d = dotted_name(t)
+                if d is not None:
+                    out.append(d)
+        return out
+
+    def transfer(self, stmt: ast.stmt, env: Set[str]) -> Set[str]:
+        env = set(env)
+        if isinstance(stmt, ast.Assign):
+            t = self.expr_tainted(stmt.value, env)
+            for target in stmt.targets:
+                sub = isinstance(target, ast.Subscript)
+                for key in self._target_keys(target):
+                    if t:
+                        env.add(key)
+                    elif not sub:     # container base survives clean store
+                        env.discard(key)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            t = self.expr_tainted(stmt.value, env)
+            for key in self._target_keys(stmt.target):
+                env.add(key) if t else env.discard(key)
+        elif isinstance(stmt, ast.AugAssign):
+            t = (self.expr_tainted(stmt.value, env)
+                 or self.expr_tainted(stmt.target, env))
+            for key in self._target_keys(stmt.target):
+                env.add(key) if t else env.discard(key)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            t = self.expr_tainted(stmt.iter, env)
+            for key in self._target_keys(stmt.target):
+                env.add(key) if t else env.discard(key)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is None:
+                    continue
+                t = self.expr_tainted(item.context_expr, env)
+                for key in self._target_keys(item.optional_vars):
+                    env.add(key) if t else env.discard(key)
+        elif isinstance(stmt, ast.ExceptHandler):
+            if stmt.name:
+                env.discard(stmt.name)
+        elif isinstance(stmt, ast.Delete):
+            for t_ in stmt.targets:
+                for key in self._target_keys(t_):
+                    env.discard(key)
+        return env
+
+    # -- fixpoint ------------------------------------------------------------
+
+    def run(self, func: ast.AST) -> Dict[int, Set[str]]:
+        """{id(stmt): tainted-variable set BEFORE that statement} for
+        every statement placed in the CFG (compound headers included)."""
+        cfg = build_cfg(func)
+        preds = cfg.preds()
+        block_in: Dict[int, Set[str]] = {b.idx: set() for b in cfg.blocks}
+
+        def block_out(p: int, exceptional: bool) -> Set[str]:
+            """State leaving block *p*. A NORMAL edge carries the state
+            after every statement ran; an EXCEPTIONAL edge (try-body ->
+            handler) may fire mid-block, so it carries the UNION of
+            every intermediate state — taint killed later in the try
+            body must still reach the handler."""
+            acc = set(block_in[p])
+            union = set(acc)
+            for s in cfg.blocks[p].stmts:
+                acc = self.transfer(s, acc)
+                union |= acc
+            return union if exceptional else acc
+
+        changed = True
+        while changed:
+            changed = False
+            for b in cfg.blocks:
+                env: Set[str] = set()
+                for p in preds[b.idx]:
+                    env |= block_out(p, (p, b.idx) in cfg.exceptional)
+                if env != block_in[b.idx]:
+                    # joins only ever union and transfer is monotone, so
+                    # envs grow toward the fixpoint
+                    block_in[b.idx] = env
+                    changed = True
+        before: Dict[int, Set[str]] = {}
+        for b in cfg.blocks:
+            env = block_in[b.idx]
+            for s in b.stmts:
+                before[id(s)] = env
+                env = self.transfer(s, env)
+        return before
+
+
+# ---------------------------------------------------------------------------
+# whole-project class index (shared by the lock graph + thread model)
+# ---------------------------------------------------------------------------
+
+
+class ClassIndex:
+    """Every class in the project by name, with inheritance-aware method
+    resolution and a light attribute-type map (``self.X = ClassName(...)``
+    anywhere in the class body types X as ClassName). Names are assumed
+    project-unique — true today, and a duplicate would only blur the lock
+    graph toward MORE edges, never fewer findings silently."""
+
+    def __init__(self, project: Project) -> None:
+        self.classes: Dict[str, Tuple[str, ast.ClassDef]] = {}
+        for sf in project:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes.setdefault(node.name, (sf.path, node))
+        self._methods: Dict[str, Dict[str, ast.AST]] = {}
+        self._attr_types: Dict[str, Dict[str, str]] = {}
+
+    def methods(self, cls: str) -> Dict[str, ast.AST]:
+        if cls not in self._methods:
+            out: Dict[str, ast.AST] = {}
+            hit = self.classes.get(cls)
+            if hit is not None:
+                for item in hit[1].body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        out[item.name] = item
+            self._methods[cls] = out
+        return self._methods[cls]
+
+    def mro(self, cls: str) -> List[str]:
+        """Breadth-first linearization over base-class NAMES known to the
+        project (external bases contribute nothing)."""
+        seen: List[str] = []
+        queue = [cls]
+        while queue:
+            name = queue.pop(0)
+            if name in seen:
+                continue
+            seen.append(name)
+            hit = self.classes.get(name)
+            if hit is not None:
+                for b in hit[1].bases:
+                    d = dotted_name(b)
+                    if d is not None:
+                        queue.append(d.split(".")[-1])
+        return seen
+
+    def resolve(self, cls: str, method: str) -> Optional[Tuple[str, str, ast.AST]]:
+        """(defining class, path, node) for *method* through *cls*'s MRO."""
+        for name in self.mro(cls):
+            node = self.methods(name).get(method)
+            if node is not None:
+                return name, self.classes[name][0], node
+        return None
+
+    def attr_type(self, cls: str, attr: str) -> Optional[str]:
+        """Class name of ``self.<attr>`` when some method of *cls* (or a
+        base) assigns it ``ClassName(...)`` for a project class."""
+        for name in self.mro(cls):
+            types = self._class_attr_types(name)
+            if attr in types:
+                return types[attr]
+        return None
+
+    def _class_attr_types(self, cls: str) -> Dict[str, str]:
+        if cls not in self._attr_types:
+            out: Dict[str, str] = {}
+            for node in self.methods(cls).values():
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    if not isinstance(sub.value, ast.Call):
+                        continue
+                    ctor = call_name(sub.value)
+                    if ctor is None:
+                        continue
+                    ctor = ctor.split(".")[-1]
+                    if ctor not in self.classes:
+                        continue
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            out.setdefault(t.attr, ctor)
+            self._attr_types[cls] = out
+        return self._attr_types[cls]
+
+
+# ---------------------------------------------------------------------------
+# lock model (KTP008)
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+# Condition() defaults to an RLock; re-acquiring on the same thread is fine
+_REENTRANT_CTORS = {"RLock", "Condition"}
+
+
+@dataclass
+class LockSite:
+    path: str
+    line: int
+    col: int
+    where: str          # "Class.method" holding the edge
+
+
+class LockModel:
+    """Project-wide lock inventory + ordering graph.
+
+    Nodes are ``Class.attr`` lock ids. An edge ``a -> b`` means some
+    code path acquires *b* while holding *a* (nested ``with`` or a call
+    chain the class index can type). ``reentrant`` marks RLock/Condition
+    ids; re-acquiring those on one thread is legal."""
+
+    def __init__(self, index: ClassIndex) -> None:
+        self.index = index
+        self.locks: Dict[str, bool] = {}        # id -> reentrant?
+        self.edges: Dict[Tuple[str, str], LockSite] = {}
+        self.self_cycles: List[Tuple[str, LockSite]] = []
+        self._acquires_memo: Dict[Tuple[str, str], Set[str]] = {}
+
+    # -- inventory -----------------------------------------------------------
+
+    def _collect_locks(self) -> None:
+        for cls, (_, node) in self.index.classes.items():
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                if not isinstance(sub.value, ast.Call):
+                    continue
+                ctor = call_name(sub.value)
+                if ctor is None:
+                    continue
+                short = ctor.split(".")[-1]
+                if short not in _LOCK_CTORS:
+                    continue
+                for t in sub.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        self.locks[f"{cls}.{t.attr}"] = (
+                            short in _REENTRANT_CTORS)
+
+    def lock_id(self, cls: str, attr: str) -> Optional[str]:
+        """The lock id ``self.<attr>`` names inside *cls* (inheritance-
+        aware: the id belongs to the DEFINING class so every subclass
+        shares one node)."""
+        for name in self.index.mro(cls):
+            lid = f"{name}.{attr}"
+            if lid in self.locks:
+                return lid
+        return None
+
+    # -- acquisition summaries ----------------------------------------------
+
+    def _with_lock_ids(self, cls: str, stmt: ast.AST) -> List[str]:
+        out = []
+        for item in stmt.items:
+            d = dotted_name(item.context_expr)
+            if d is None and isinstance(item.context_expr, ast.Call):
+                d = dotted_name(item.context_expr.func)
+            if d is None or "." not in d:
+                continue
+            base, attr = d.split(".", 1)
+            if base != "self" or "." in attr:
+                continue
+            lid = self.lock_id(cls, attr)
+            if lid is not None:
+                out.append(lid)
+        return out
+
+    def acquires(self, cls: str, method: str,
+                 _stack: Optional[Set[Tuple[str, str]]] = None) -> Set[str]:
+        """Lock ids calling ``cls.method`` may acquire, transitively
+        through self-calls and typed-attribute calls. ``*_locked``
+        methods run with the caller already holding the lock — their own
+        ``with`` acquisitions (if any) still count."""
+        key = (cls, method)
+        if key in self._acquires_memo:
+            return self._acquires_memo[key]
+        stack = _stack or set()
+        if key in stack:
+            return set()
+        stack = stack | {key}
+        hit = self.index.resolve(cls, method)
+        out: Set[str] = set()
+        if hit is not None:
+            _, _, node = hit
+            # skip nested defs: a callback defined here runs later, on
+            # some other call path — charging its acquisitions to THIS
+            # method would fabricate edges (and deadlocks) that cannot
+            # happen
+            for sub in walk_skip_nested(node):
+                if sub is node:
+                    continue
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    out.update(self._with_lock_ids(cls, sub))
+                elif isinstance(sub, ast.Call):
+                    callee = self._typed_callee(cls, sub)
+                    if callee is not None:
+                        out |= self.acquires(*callee, _stack=stack)
+        self._acquires_memo[key] = out
+        return out
+
+    def _typed_callee(self, cls: str,
+                      call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(class, method) for calls the index can type: ``self.m()``,
+        ``super().m()``, ``self.attr.m()`` with a typed attr."""
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        v = f.value
+        if isinstance(v, ast.Name) and v.id == "self":
+            return (cls, f.attr) if self.index.resolve(cls, f.attr) else None
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id == "super"):
+            return (cls, f.attr) if self.index.resolve(cls, f.attr) else None
+        if (isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name)
+                and v.value.id == "self"):
+            t = self.index.attr_type(cls, v.attr)
+            if t is not None and self.index.resolve(t, f.attr):
+                return (t, f.attr)
+        return None
+
+    # -- edge walk -----------------------------------------------------------
+
+    def build(self, project: Project) -> "LockModel":
+        self._collect_locks()
+        for cls, (path, node) in self.index.classes.items():
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._walk(cls, path, f"{cls}.{item.name}",
+                               item.body, held=())
+        return self
+
+    def _walk(self, cls: str, path: str, where: str,
+              body: Sequence[ast.stmt], held: Tuple[str, ...]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = self._with_lock_ids(cls, stmt)
+                inner = held
+                for lid in acquired:
+                    site = LockSite(path, stmt.lineno, stmt.col_offset, where)
+                    if lid in inner and not self.locks.get(lid, False):
+                        self.self_cycles.append((lid, site))
+                    for h in inner:
+                        if h != lid:
+                            self.edges.setdefault((h, lid), site)
+                    inner = inner + (lid,)
+                self._walk(cls, path, where, stmt.body, inner)
+                continue
+            # calls made while holding locks: their transitive
+            # acquisitions order after every held lock (nested defs are
+            # bindings — their bodies run on some later call path, not
+            # under these locks)
+            if held:
+                for sub in walk_skip_nested(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    callee = self._typed_callee(cls, sub)
+                    if callee is None:
+                        continue
+                    site = LockSite(path, sub.lineno, sub.col_offset, where)
+                    for lid in self.acquires(*callee):
+                        if lid in held and not self.locks.get(lid, False):
+                            self.self_cycles.append((lid, site))
+                        for h in held:
+                            if h != lid:
+                                self.edges.setdefault((h, lid), site)
+            for sub_body in self._nested_bodies(stmt):
+                self._walk(cls, path, where, sub_body, held)
+
+    @staticmethod
+    def _nested_bodies(stmt: ast.stmt) -> Iterable[Sequence[ast.stmt]]:
+        for attr in ("body", "orelse", "finalbody"):
+            b = getattr(stmt, attr, None)
+            if b and not isinstance(stmt, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.ClassDef)):
+                yield b
+        for h in getattr(stmt, "handlers", ()):
+            yield h.body
+
+    # -- cycles --------------------------------------------------------------
+
+    def cycles(self) -> List[Tuple[List[str], LockSite]]:
+        """Ordering cycles: [(lock-id path a -> b -> ... -> a, site of one
+        participating edge)]. Each cycle reports once, keyed by its node
+        set. Single-lock re-acquisition lands in ``self_cycles``."""
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+        seen_sets: Set[frozenset] = set()
+        out: List[Tuple[List[str], LockSite]] = []
+
+        def dfs(start: str, node: str, path: List[str],
+                on_path: Set[str]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        out.append((path + [start],
+                                    self.edges[(node, start)]))
+                elif nxt not in on_path and nxt > start:
+                    # only walk ids lexically above the start: each cycle
+                    # is found from its smallest node exactly once
+                    dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(graph):
+            dfs(start, start, [start], {start})
+        return out
+
+
+def build_lock_model(project: Project,
+                     index: Optional[ClassIndex] = None) -> LockModel:
+    return LockModel(index or ClassIndex(project)).build(project)
+
+
+# ---------------------------------------------------------------------------
+# thread-role model (KTP009)
+# ---------------------------------------------------------------------------
+
+# entry points of the wire-handler role: stdlib http.server dispatch
+HANDLER_ROOTS = ("do_GET", "do_POST", "do_DELETE", "do_PUT", "do_PATCH")
+# entry points of the step/reconcile-loop role on a server class
+LOOP_ROOTS = ("step", "poll_once", "_poll_once", "_poll_loop", "reconcile",
+              "run")
+
+
+@dataclass
+class Access:
+    attr: str
+    path: str
+    line: int
+    col: int
+    locked: bool
+    where: str
+
+
+@dataclass
+class ServerThreads:
+    """One server class with an embedded wire handler: who writes what
+    from handler threads, who reads what from the loop role."""
+
+    server: str                       # server class name
+    handler_writes: List[Access] = field(default_factory=list)
+    loop_reads: List[Access] = field(default_factory=list)
+
+
+class ThreadModel:
+    """Finds the wire-server idiom both stdlib servers use:
+
+        class Server:
+            def __init__(self):
+                alias = self
+                class Handler(BaseHTTPRequestHandler):
+                    def do_GET(self):           # handler THREAD role
+                        alias.attr = ...        # mutates server state
+                        alias.method(...)       # or via server methods
+            def step/_poll_loop(self):          # loop THREAD role
+                read self.attr
+
+    Every method of the nested handler class is handler-role (do_* are
+    just the dispatch entries; ``run_idempotent(self._leg)`` style
+    indirection reaches the rest). Server methods invoked from handler
+    code join the role transitively. Lock tracking recognizes both
+    ``with alias._lock:`` in handler code and ``with self._lock:``
+    inside server methods; ``*_locked`` methods count as locked."""
+
+    def __init__(self, project: Project, index: Optional[ClassIndex] = None,
+                 lock_model: Optional[LockModel] = None) -> None:
+        self.index = index or ClassIndex(project)
+        self.locks = lock_model or build_lock_model(project, self.index)
+        self.servers: List[ServerThreads] = []
+        self._writes_memo: Dict[Tuple[str, str], List[Tuple[str, ast.AST, bool, str]]] = {}
+        self._build(project)
+
+    # -- discovery -----------------------------------------------------------
+
+    def _build(self, project: Project) -> None:
+        for sf in project:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for meth in node.body:
+                    if not isinstance(meth, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    for inner in ast.walk(meth):
+                        if (isinstance(inner, ast.ClassDef)
+                                and any(m in HANDLER_ROOTS
+                                        for m in (i.name for i in inner.body
+                                                  if isinstance(i, ast.FunctionDef)))):
+                            alias = self._self_alias(meth, inner)
+                            self.servers.append(self._analyze(
+                                sf, node.name, meth, inner, alias))
+
+    @staticmethod
+    def _self_alias(enclosing: ast.AST, handler: ast.ClassDef) -> Optional[str]:
+        """The ``alias = self`` name handler code reaches the server by."""
+        for stmt in ast.walk(enclosing):
+            if (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Name)
+                    and stmt.value.id == "self"):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        return t.id
+        return None
+
+    # -- role analyses -------------------------------------------------------
+
+    def _analyze(self, sf: SourceFile, server: str, enclosing: ast.AST,
+                 handler: ast.ClassDef, alias: Optional[str]) -> ServerThreads:
+        st = ServerThreads(server=server)
+        if alias is not None:
+            for meth in handler.body:
+                if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._handler_walk(
+                        sf, server, alias, f"{server}.Handler.{meth.name}",
+                        meth.body, held=False, out=st.handler_writes)
+        # loop role: methods reachable from the loop roots via self-calls
+        # — resolved per CONCRETE class, across the server's subclasses
+        # too (a subclass inherits the handler, and its step/reconcile
+        # override reads the same shared attributes — the cross-module
+        # escape KTP009 exists to catch)
+        for concrete in self._subclasses_of(server):
+            for acc in self._loop_reads(concrete):
+                st.loop_reads.append(acc)
+        return st
+
+    def _subclasses_of(self, cls: str) -> List[str]:
+        return [name for name in self.index.classes
+                if cls in self.index.mro(name)]
+
+    def _is_server_lock_with(self, server: str, alias: Optional[str],
+                             stmt: ast.AST) -> bool:
+        for item in stmt.items:
+            d = dotted_name(item.context_expr)
+            if d is None and isinstance(item.context_expr, ast.Call):
+                d = dotted_name(item.context_expr.func)
+            if d is None or "." not in d:
+                continue
+            base, attr = d.split(".", 1)
+            if "." in attr:
+                continue
+            if base in ("self", alias) and self.locks.lock_id(server, attr):
+                return True
+        return False
+
+    def _handler_walk(self, sf: SourceFile, server: str, alias: str,
+                      where: str, body: Sequence[ast.stmt], held: bool,
+                      out: List[Access]) -> None:
+        """Collect server-state writes made by handler-role code: direct
+        ``alias.attr = ...`` stores and, transitively, the self-attribute
+        writes of every server method the handler invokes (or merely
+        references — ``run_idempotent(self, ..., self._leg)`` passes the
+        leg as a value; any referenced handler method joins the role)."""
+        for stmt in body:
+            inner_held = held
+            if (isinstance(stmt, (ast.With, ast.AsyncWith))
+                    and self._is_server_lock_with(server, alias, stmt)):
+                inner_held = True
+            for t, node, aug in self._alias_writes(stmt, alias):
+                out.append(Access(attr=t, path=sf.path, line=node.lineno,
+                                  col=node.col_offset, locked=held,
+                                  where=where))
+            for call in self._direct_calls(stmt):
+                f = call.func
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == alias):
+                    for (attr, wnode, wlocked, wwhere) in self._method_writes(
+                            server, f.attr):
+                        out.append(Access(
+                            attr=attr, path=self._method_path(server, f.attr),
+                            line=wnode.lineno, col=wnode.col_offset,
+                            locked=wlocked or held, where=wwhere))
+            for sub_body in LockModel._nested_bodies(stmt):
+                self._handler_walk(sf, server, alias, where, sub_body,
+                                   inner_held, out)
+
+    @staticmethod
+    def _direct_calls(stmt: ast.stmt) -> Iterable[ast.Call]:
+        """Calls in *stmt* outside nested with/if bodies (those recurse
+        via _nested_bodies with the right held flag)."""
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            roots: List[ast.AST] = [i.context_expr for i in stmt.items]
+        elif isinstance(stmt, (ast.If, ast.While)):
+            roots = [stmt.test]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            roots = [stmt.iter]
+        elif isinstance(stmt, ast.Try):
+            roots = []
+        else:
+            roots = [stmt]
+        for r in roots:
+            for sub in ast.walk(r):
+                if isinstance(sub, ast.Call):
+                    yield sub
+
+    @staticmethod
+    def _alias_writes(stmt: ast.stmt,
+                      alias: str) -> List[Tuple[str, ast.AST, bool]]:
+        out = []
+        if isinstance(stmt, (ast.With, ast.AsyncWith, ast.If, ast.While,
+                             ast.For, ast.AsyncFor, ast.Try)):
+            return out      # bodies recurse separately with held tracking
+        for sub in ast.walk(stmt):
+            targets: List[ast.AST] = []
+            if isinstance(sub, ast.Assign):
+                targets = list(sub.targets)
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            elif isinstance(sub, ast.Delete):
+                targets = list(sub.targets)
+            for t in targets:
+                while isinstance(t, ast.Subscript):
+                    t = t.value
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == alias):
+                    out.append((t.attr, sub, isinstance(sub, ast.AugAssign)))
+        return out
+
+    def _method_path(self, cls: str, method: str) -> str:
+        hit = self.index.resolve(cls, method)
+        return hit[1] if hit is not None else ""
+
+    def _method_writes(self, cls: str, method: str,
+                       _stack: Optional[Set[Tuple[str, str]]] = None
+                       ) -> List[Tuple[str, ast.AST, bool, str]]:
+        """[(attr, node, locked, where)] self-attribute writes performed
+        by ``cls.method`` and its transitive self-calls. ``*_locked``
+        methods' writes count as locked (caller-holds convention)."""
+        key = (cls, method)
+        if key in self._writes_memo:
+            return self._writes_memo[key]
+        stack = _stack or set()
+        if key in stack:
+            return []
+        stack = stack | {key}
+        hit = self.index.resolve(cls, method)
+        out: List[Tuple[str, ast.AST, bool, str]] = []
+        if hit is not None:
+            owner, path, node = hit
+            body_locked = method.endswith("_locked")
+            where = f"{owner}.{method}"
+
+            def walk(body: Sequence[ast.stmt], held: bool) -> None:
+                for stmt in body:
+                    inner = held
+                    if (isinstance(stmt, (ast.With, ast.AsyncWith))
+                            and self._is_server_lock_with(cls, None, stmt)):
+                        inner = True
+                    for (attr, wnode, _aug) in self._alias_writes(stmt, "self"):
+                        out.append((attr, wnode, held or body_locked, where))
+                    for call in self._direct_calls(stmt):
+                        f = call.func
+                        if (isinstance(f, ast.Attribute)
+                                and isinstance(f.value, ast.Name)
+                                and f.value.id == "self"
+                                and self.index.resolve(cls, f.attr)):
+                            for (attr, wnode, wlocked, wwhere) in \
+                                    self._method_writes(cls, f.attr,
+                                                        _stack=stack):
+                                out.append((attr, wnode,
+                                            wlocked or held or body_locked,
+                                            wwhere))
+                    for sub_body in LockModel._nested_bodies(stmt):
+                        walk(sub_body, inner)
+
+            walk(node.body, False)
+        self._writes_memo[key] = out
+        return out
+
+    def _loop_reads(self, server: str) -> List[Access]:
+        """self-attribute LOADS in methods reachable from the server's
+        loop roots via self-calls, with lock tracking."""
+        out: List[Access] = []
+        visited: Set[Tuple[str, str]] = set()
+        queue = [r for r in LOOP_ROOTS
+                 if self.index.resolve(server, r) is not None]
+
+        def scan(roots: Sequence[ast.AST], path: str, where: str,
+                 held: bool) -> None:
+            """ONE implementation of the read/call harvest, fed either a
+            whole simple statement or just a compound header's exprs —
+            the two positions must never drift apart."""
+            for root in roots:
+                for sub in ast.walk(root):
+                    if (isinstance(sub, ast.Attribute)
+                            and isinstance(sub.ctx, ast.Load)
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == "self"):
+                        out.append(Access(
+                            attr=sub.attr, path=path, line=sub.lineno,
+                            col=sub.col_offset, locked=held, where=where))
+                    if isinstance(sub, ast.Call):
+                        f = sub.func
+                        if (isinstance(f, ast.Attribute)
+                                and isinstance(f.value, ast.Name)
+                                and f.value.id == "self"
+                                and (server, f.attr) not in visited
+                                and self.index.resolve(server, f.attr)):
+                            visited.add((server, f.attr))
+                            queue.append(f.attr)
+
+        def walk(method: str, body: Sequence[ast.stmt], path: str,
+                 where: str, held: bool) -> None:
+            for stmt in body:
+                inner = held
+                if (isinstance(stmt, (ast.With, ast.AsyncWith))
+                        and self._is_server_lock_with(server, None, stmt)):
+                    inner = True
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    scan([i.context_expr for i in stmt.items],
+                         path, where, held)
+                elif isinstance(stmt, (ast.If, ast.While)):
+                    scan([stmt.test], path, where, held)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan([stmt.iter], path, where, held)
+                elif not isinstance(stmt, ast.Try):
+                    scan([stmt], path, where, held)
+                for sub_body in LockModel._nested_bodies(stmt):
+                    walk(method, sub_body, path, where, inner)
+
+        while queue:
+            m = queue.pop(0)
+            hit = self.index.resolve(server, m)
+            if hit is None:
+                continue
+            owner, path, node = hit
+            if (owner, f"__body__{m}") in visited:
+                continue
+            visited.add((owner, f"__body__{m}"))
+            walk(m, node.body, path, f"{owner}.{m}",
+                 held=m.endswith("_locked"))
+        return out
+
+
+def build_thread_model(project: Project,
+                       index: Optional[ClassIndex] = None,
+                       lock_model: Optional[LockModel] = None) -> ThreadModel:
+    return ThreadModel(project, index=index, lock_model=lock_model)
+
+
+# ---------------------------------------------------------------------------
+# per-Project model cache (rules share one index/lock model per run)
+# ---------------------------------------------------------------------------
+
+
+def get_class_index(project: Project) -> ClassIndex:
+    idx = getattr(project, "_flow_class_index", None)
+    if idx is None:
+        idx = ClassIndex(project)
+        project._flow_class_index = idx
+    return idx
+
+
+def get_lock_model(project: Project) -> LockModel:
+    model = getattr(project, "_flow_lock_model", None)
+    if model is None:
+        model = build_lock_model(project, get_class_index(project))
+        project._flow_lock_model = model
+    return model
+
+
+def get_thread_model(project: Project) -> ThreadModel:
+    model = getattr(project, "_flow_thread_model", None)
+    if model is None:
+        model = build_thread_model(project, index=get_class_index(project),
+                                   lock_model=get_lock_model(project))
+        project._flow_thread_model = model
+    return model

@@ -1,0 +1,442 @@
+"""Flow-aware rules (Round-13): the KTP007–KTP010 set built on
+``analysis.flow``'s CFG/taint engine, lock graph and thread-role model.
+
+Where the PR 7 rules pin single lines by name, these four follow VALUES
+and ORDER:
+
+- KTP007 catches the syncs KTP001's explicit-call list can never name —
+  a device-produced value (``jnp.*``/``lax.*`` result, a ``self._dev``
+  mirror) flowing into ``bool()``/``int()``/``float()``/``len()``, an
+  ``if``/``while`` condition, iteration, or an f-string inside the
+  serving step() closure. Each of those implicitly blocks on the device.
+- KTP008 builds the global lock-ordering graph (nested ``with`` blocks
+  plus call chains the class index can type) and flags cycles — and the
+  sharper special case, re-acquiring a non-reentrant ``threading.Lock``
+  already held on the same call path (instant single-thread deadlock).
+- KTP009 is the interprocedural generalization of KTP003: state written
+  from wire-handler threads (the ``handle_guarded`` routes) and read in
+  the step/reconcile loop must hold the owning lock on the WRITE side.
+- KTP010 guards the unglamorous leak: files/sockets opened in ``wire/``
+  and ``obs/`` outside a ``with``/try-finally, where an early return or
+  raise walks the handle out of scope still open.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from kubetpu.analysis.core import (
+    Finding,
+    Project,
+    Rule,
+    call_name,
+    dotted_name,
+)
+from kubetpu.analysis.flow import (
+    TaintEngine,
+    get_lock_model,
+    get_thread_model,
+    walk_skip_nested,
+)
+from kubetpu.analysis.rules_device import hot_closure
+
+# ---------------------------------------------------------------------------
+# KTP007 — implicit-device-sync taint
+# ---------------------------------------------------------------------------
+
+# device-value producers: jax-namespace array ops + the _dev mirror cache
+_SOURCE_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+# calls that hand back HOST data (they sync too — but by an explicit,
+# greppable name KTP001 already rejects; KTP007 must not double-report)
+_SANITIZERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+               "jax.device_get"}
+# host coercions that force the implicit sync when fed a device value
+_COERCION_SINKS = {"bool", "int", "float", "len"}
+
+
+def _is_device_source(call: ast.Call) -> bool:
+    d = call_name(call)
+    if d is None:
+        return False
+    if any(d.startswith(p) for p in _SOURCE_PREFIXES):
+        return True
+    return d in ("self._dev",)
+
+
+# the engine's skip-nested walker under the name this module grew up
+# with; for KTP007 the skip has extra meaning — a nested def inside the
+# step closure is a jitted leg (traced code cannot host-sync mid-trace)
+_walk_skip_nested = walk_skip_nested
+
+
+def _stmt_own_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions a statement evaluates ITSELF (not its nested
+    block bodies — those are separate CFG statements with their own
+    taint environments)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value] + list(stmt.targets)
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return [v for v in (stmt.value, stmt.target) if v is not None]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Assert):
+        return [v for v in (stmt.test, stmt.msg) if v is not None]
+    if isinstance(stmt, ast.Raise):
+        return [v for v in (stmt.exc, stmt.cause) if v is not None]
+    return []
+
+
+class ImplicitSyncRule(Rule):
+    code = "KTP007"
+    name = "implicit-sync-taint"
+    description = (
+        "device-produced values (jnp./lax. results, self._dev mirrors) "
+        "must not flow into bool()/int()/float()/len(), if/while "
+        "conditions, iteration, or f-strings inside the serving step() "
+        "closure — each implicitly blocks on the device (the syncs "
+        "KTP001's explicit-call list cannot name)"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        engine = TaintEngine(_is_device_source, sanitizers=_SANITIZERS)
+        emitted: Set[Tuple[str, int, int]] = set()
+        for (path, _), (_, qual, node) in sorted(hot_closure(project).items()):
+            before = engine.run(node)
+            for stmt in self._cfg_stmts(node, before):
+                env = before[id(stmt)]
+                for f in self._sinks_in_stmt(stmt, env, engine, path, qual):
+                    key = (f.path, f.line, f.col)
+                    if key not in emitted:
+                        emitted.add(key)
+                        yield f
+
+    @staticmethod
+    def _cfg_stmts(func: ast.AST, before: Dict[int, Set[str]]):
+        return [s for s in _walk_skip_nested(func)
+                if isinstance(s, ast.stmt) and id(s) in before]
+
+    def _sinks_in_stmt(self, stmt: ast.stmt, env: Set[str],
+                       engine: TaintEngine, path: str,
+                       qual: str) -> Iterable[Finding]:
+        in_condition = isinstance(stmt, (ast.If, ast.While, ast.Assert))
+
+        def finding(node: ast.AST, what: str) -> Finding:
+            return Finding(
+                path=path, line=node.lineno, col=node.col_offset,
+                code=self.code,
+                message=(
+                    f"implicit device sync: {what} on a device-produced "
+                    f"value in `{qual.split('.')[-1]}` (reachable from "
+                    f"step() via {qual}) — materialize once via the "
+                    "designed route/materialize leg instead"
+                ),
+            )
+
+        for root in _stmt_own_exprs(stmt):
+            if in_condition and engine.expr_tainted(root, env):
+                yield finding(root, "branch condition")
+                continue
+            for sub in _walk_skip_nested(root):
+                if isinstance(sub, ast.Call):
+                    fn = sub.func
+                    if (isinstance(fn, ast.Name)
+                            and fn.id in _COERCION_SINKS
+                            and any(engine.expr_tainted(a, env)
+                                    for a in sub.args)):
+                        yield finding(sub, f"`{fn.id}()`")
+                elif isinstance(sub, ast.IfExp):
+                    if engine.expr_tainted(sub.test, env):
+                        yield finding(sub.test, "conditional-expression test")
+                elif isinstance(sub, ast.FormattedValue):
+                    if engine.expr_tainted(sub.value, env):
+                        yield finding(sub, "f-string interpolation")
+                elif isinstance(sub, (ast.ListComp, ast.SetComp,
+                                      ast.DictComp, ast.GeneratorExp)):
+                    for gen in sub.generators:
+                        if engine.expr_tainted(gen.iter, env):
+                            yield finding(gen.iter, "iteration")
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            if engine.expr_tainted(stmt.iter, env):
+                yield finding(stmt.iter, "iteration")
+
+
+# ---------------------------------------------------------------------------
+# KTP008 — lock-order deadlock graph
+# ---------------------------------------------------------------------------
+
+
+class LockOrderRule(Rule):
+    code = "KTP008"
+    name = "lock-order-deadlock"
+    description = (
+        "the whole-project lock-acquisition graph (nested `with "
+        "self._lock:` blocks + call chains) must stay acyclic, and a "
+        "non-reentrant threading.Lock must never be re-acquired on a "
+        "call path that already holds it (single-thread deadlock)"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        model = get_lock_model(project)
+        emitted: Set[Tuple[str, int, int, str]] = set()
+        for lid, site in model.self_cycles:
+            key = (site.path, site.line, site.col, lid)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            yield Finding(
+                path=site.path, line=site.line, col=site.col,
+                code=self.code,
+                message=(
+                    f"re-acquisition of non-reentrant lock `{lid}` on a "
+                    f"path that already holds it (via {site.where}) — "
+                    "this thread deadlocks itself; split a *_locked "
+                    "variant or switch to RLock with a comment on why"
+                ),
+            )
+        for cycle, site in model.cycles():
+            key = (site.path, site.line, site.col, "->".join(cycle))
+            if key in emitted:
+                continue
+            emitted.add(key)
+            yield Finding(
+                path=site.path, line=site.line, col=site.col,
+                code=self.code,
+                message=(
+                    "lock-order cycle "
+                    + " -> ".join(f"`{c}`" for c in cycle)
+                    + f" (one edge acquired via {site.where}) — two "
+                    "threads taking these locks in opposite orders "
+                    "deadlock; pick one global order and restructure"
+                ),
+            )
+
+
+# ---------------------------------------------------------------------------
+# KTP009 — thread-escape (handler-thread writes racing the loop role)
+# ---------------------------------------------------------------------------
+
+
+class ThreadEscapeRule(Rule):
+    code = "KTP009"
+    name = "thread-escape"
+    description = (
+        "server attributes written from wire-handler threads (the "
+        "handle_guarded do_GET/do_POST routes, directly or via server "
+        "methods) and read in the step/reconcile loop must hold the "
+        "server's lock at the write — the interprocedural "
+        "generalization of KTP003"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        model = get_thread_model(project)
+        emitted: Set[Tuple[str, int, int, str]] = set()
+        for st in model.servers:
+            read_attrs = {a.attr for a in st.loop_reads}
+            read_at = {}
+            for a in st.loop_reads:
+                read_at.setdefault(a.attr, a)
+            for w in st.handler_writes:
+                if w.locked or w.attr not in read_attrs:
+                    continue
+                key = (w.path, w.line, w.col, w.attr)
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                r = read_at[w.attr]
+                yield Finding(
+                    path=w.path, line=w.line, col=w.col, code=self.code,
+                    message=(
+                        f"`{st.server}.{w.attr}` is written from a wire-"
+                        f"handler thread ({w.where}) without the server "
+                        f"lock, and read by the loop role at "
+                        f"{r.path}:{r.line} ({r.where}) — handler "
+                        "threads race the loop; take the lock or route "
+                        "the mutation through a locked method"
+                    ),
+                )
+
+
+# ---------------------------------------------------------------------------
+# KTP010 — resource/exception safety in wire/ and obs/
+# ---------------------------------------------------------------------------
+
+_OPENERS = {"open", "os.open", "os.fdopen", "socket.socket",
+            "socket.create_connection"}
+_RESOURCE_SCOPES = ("kubetpu/wire/", "kubetpu/obs/")
+
+
+class ResourceSafetyRule(Rule):
+    code = "KTP010"
+    name = "resource-safety"
+    description = (
+        "files/sockets in wire/ and obs/ must be opened in a `with`, "
+        "closed in a try/finally, or handed off (stored on self / "
+        "returned) before any early return or raise can leak the handle"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project:
+            if not sf.path.startswith(_RESOURCE_SCOPES):
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_function(sf.path, node)
+
+    def _check_function(self, path: str,
+                        func: ast.AST) -> Iterable[Finding]:
+        with_exprs: Set[int] = set()
+        # finally blocks run on EVERY path; except handlers only on the
+        # raising one — a close that lives only in a handler does not
+        # close the normal path, so the two spans are tracked apart
+        finally_ranges: List[Tuple[int, int]] = []
+        except_ranges: List[Tuple[int, int]] = []
+        for sub in _walk_skip_nested(func):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    for c in ast.walk(item.context_expr):
+                        with_exprs.add(id(c))
+            elif isinstance(sub, ast.Try):
+                for blk in sub.finalbody:
+                    finally_ranges.append(
+                        (blk.lineno, getattr(blk, "end_lineno", blk.lineno)))
+                for blk in (s for h in sub.handlers for s in h.body):
+                    except_ranges.append(
+                        (blk.lineno, getattr(blk, "end_lineno", blk.lineno)))
+
+        def span(ranges):
+            return lambda line: any(lo <= line <= hi for lo, hi in ranges)
+
+        # gather per-statement events once, in source order
+        stmts = [s for s in _walk_skip_nested(func)
+                 if isinstance(s, ast.stmt)]
+        for sub in _walk_skip_nested(func):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = call_name(sub)
+            if d not in _OPENERS or id(sub) in with_exprs:
+                continue
+            yield from self._check_open(path, func, sub, stmts,
+                                        span(finally_ranges),
+                                        span(except_ranges))
+
+    def _check_open(self, path: str, func: ast.AST, call: ast.Call,
+                    stmts: Sequence[ast.stmt],
+                    in_finally, in_except) -> Iterable[Finding]:
+        owner = self._owner_stmt(stmts, call)
+        if owner is None:
+            return
+        name = self._bound_name(owner, call)
+        if name is None:
+            # inline use: `return open(...)` / `f(open(...))` hands the
+            # handle off; a bare `open(...)` expression drops it on the
+            # floor with no way to ever close it
+            if isinstance(owner, ast.Expr) and owner.value is call:
+                yield self._finding(
+                    path, call,
+                    "handle opened and immediately dropped — nothing can "
+                    "ever close it")
+            elif (isinstance(owner, ast.Assign)
+                  and len(owner.targets) == 1
+                  and isinstance(owner.targets[0], ast.Attribute)):
+                pass  # self.x = open(...): escapes to the object
+            return
+        closes: List[int] = []
+        escapes = False
+        exits: List[int] = []
+        for stmt in stmts:
+            if stmt.lineno < owner.lineno or stmt is owner:
+                continue
+            for sub in _walk_skip_nested(stmt):
+                if isinstance(sub, (ast.With, ast.AsyncWith)):
+                    # `with fh:` (possibly `as g`) delegates the close to
+                    # __exit__ — the handle is managed from here on
+                    for item in sub.items:
+                        if (isinstance(item.context_expr, ast.Name)
+                                and item.context_expr.id == name):
+                            closes.append(sub.lineno)
+                if isinstance(sub, ast.Call):
+                    f = sub.func
+                    if (isinstance(f, ast.Attribute) and f.attr == "close"
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id == name):
+                        closes.append(sub.lineno)
+                    elif any(isinstance(a, ast.Name) and a.id == name
+                             for a in list(sub.args)
+                             + [k.value for k in sub.keywords]):
+                        escapes = True       # handed to another owner
+                elif isinstance(sub, ast.Assign):
+                    if (isinstance(sub.value, ast.Name)
+                            and sub.value.id == name):
+                        escapes = True       # stored (self.x = handle, ...)
+                elif isinstance(sub, ast.Return):
+                    # only returning the HANDLE itself (bare, or as a
+                    # tuple/list element) transfers ownership — `return
+                    # fh.read()` returns data and leaves fh open
+                    v = sub.value
+                    elts = ([v] if isinstance(v, ast.Name)
+                            else list(getattr(v, "elts", ())))
+                    if any(isinstance(n, ast.Name) and n.id == name
+                           for n in elts):
+                        escapes = True
+                    else:
+                        exits.append(sub.lineno)
+                elif isinstance(sub, ast.Raise):
+                    exits.append(sub.lineno)
+        if escapes:
+            return
+        # a close in a finally runs on every path: fully protected
+        normal_closes = [c for c in closes if not in_except(c)]
+        if any(in_finally(c) for c in closes):
+            return
+        if not normal_closes:
+            where = (" (only the exception path closes it)"
+                     if closes else "")
+            yield self._finding(
+                path, call,
+                f"`{name}` is never closed, stored, or returned on the "
+                f"normal path out of this function{where}")
+            return
+        first_close = min(normal_closes)
+        leaks = [e for e in exits if owner.lineno < e < first_close]
+        if leaks:
+            yield self._finding(
+                path, call,
+                f"`{name}` leaks across the early exit at line "
+                f"{leaks[0]} — the close at line {first_close} is not "
+                "in a finally; use `with` or try/finally")
+
+    @staticmethod
+    def _owner_stmt(stmts: Sequence[ast.stmt],
+                    call: ast.Call) -> Optional[ast.stmt]:
+        """The innermost simple statement containing *call*."""
+        best = None
+        for s in stmts:
+            if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                              ast.Expr, ast.Return)):
+                if any(c is call for c in ast.walk(s)):
+                    best = s
+        return best
+
+    @staticmethod
+    def _bound_name(owner: ast.stmt, call: ast.Call) -> Optional[str]:
+        if (isinstance(owner, ast.Assign) and owner.value is call
+                and len(owner.targets) == 1
+                and isinstance(owner.targets[0], ast.Name)):
+            return owner.targets[0].id
+        if (isinstance(owner, ast.AnnAssign) and owner.value is call
+                and isinstance(owner.target, ast.Name)):
+            return owner.target.id
+        return None
+
+    def _finding(self, path: str, call: ast.Call, msg: str) -> Finding:
+        return Finding(path=path, line=call.lineno, col=call.col_offset,
+                       code=self.code, message=msg)

@@ -213,18 +213,22 @@ class MetricHygieneRule(Rule):
     description = (
         "metric names are string literals matching kubetpu_[a-z0-9_]+ "
         "(counters end _total); an f-string metric/label name is "
-        "unbounded cardinality waiting for traffic"
+        "unbounded cardinality waiting for traffic — unless every "
+        "interpolation is a loop variable over a literal tuple (then "
+        "each expansion is validated like a literal)"
     )
 
     _REGISTERING = {"counter", "gauge", "gauge_fn", "histogram",
                     "attach_histogram"}
     # the framework itself + this package (rule fixtures embed names)
     _EXEMPT = ("kubetpu/obs/registry.py", "kubetpu/analysis/")
+    _MAX_EXPANSIONS = 64
 
     def check(self, project: Project) -> Iterable[Finding]:
         for sf in project:
             if sf.path.startswith(self._EXEMPT):
                 continue
+            bindings = self._literal_loop_bindings(sf.tree)
             for call in iter_calls(sf.tree):
                 f = call.func
                 if (not isinstance(f, ast.Attribute)
@@ -234,39 +238,33 @@ class MetricHygieneRule(Rule):
                 kind = f.attr
                 name_arg = call.args[0]
                 if isinstance(name_arg, ast.JoinedStr):
+                    expansions = self._bounded_expansions(
+                        name_arg, bindings.get(id(call), {}))
+                    if expansions is not None:
+                        # Round-13 flow refinement: every interpolation
+                        # is a loop variable over a literal tuple — the
+                        # name set is closed; validate each member like
+                        # a literal instead of demanding a disable
+                        for name in expansions:
+                            yield from self._check_literal(
+                                sf, call, kind, name)
+                        continue
                     yield Finding(
                         path=sf.path, line=call.lineno,
                         col=call.col_offset, code=self.code,
                         message=(
                             f"f-string metric name in .{kind}() — "
                             "interpolated names are unbounded series "
-                            "cardinality; use literals (a fixed set of "
-                            "keys gets a justified ktlint disable)"
+                            "cardinality; use literals, or interpolate "
+                            "only loop variables bound to a literal "
+                            "tuple (a fixed set the engine cannot see "
+                            "gets a justified ktlint disable)"
                         ),
                     )
                 elif (isinstance(name_arg, ast.Constant)
                         and isinstance(name_arg.value, str)):
-                    name = name_arg.value
-                    if not _METRIC_NAME_RE.match(name):
-                        yield Finding(
-                            path=sf.path, line=call.lineno,
-                            col=call.col_offset, code=self.code,
-                            message=(
-                                f"metric name `{name}` does not match "
-                                "kubetpu_[a-z0-9_]+ — one prefix keeps "
-                                "the fleet exposition greppable"
-                            ),
-                        )
-                    elif kind == "counter" and not name.endswith("_total"):
-                        yield Finding(
-                            path=sf.path, line=call.lineno,
-                            col=call.col_offset, code=self.code,
-                            message=(
-                                f"counter `{name}` must end `_total` "
-                                "(Prometheus counter convention the "
-                                "SLO engine keys on)"
-                            ),
-                        )
+                    yield from self._check_literal(
+                        sf, call, kind, name_arg.value)
                 else:
                     yield Finding(
                         path=sf.path, line=call.lineno,
@@ -278,3 +276,131 @@ class MetricHygieneRule(Rule):
                             "names get a justified ktlint disable)"
                         ),
                     )
+
+    def _check_literal(self, sf, call: ast.Call, kind: str,
+                       name: str) -> Iterable[Finding]:
+        """Validate one concrete metric name (a string literal, or one
+        expansion of a bounded f-string)."""
+        if not _METRIC_NAME_RE.match(name):
+            yield Finding(
+                path=sf.path, line=call.lineno,
+                col=call.col_offset, code=self.code,
+                message=(
+                    f"metric name `{name}` does not match "
+                    "kubetpu_[a-z0-9_]+ — one prefix keeps "
+                    "the fleet exposition greppable"
+                ),
+            )
+        elif kind == "counter" and not name.endswith("_total"):
+            yield Finding(
+                path=sf.path, line=call.lineno,
+                col=call.col_offset, code=self.code,
+                message=(
+                    f"counter `{name}` must end `_total` "
+                    "(Prometheus counter convention the "
+                    "SLO engine keys on)"
+                ),
+            )
+
+    # -- bounded f-string proof (Round-13) -----------------------------------
+
+    @staticmethod
+    def _literal_loop_bindings(tree: ast.Module) -> Dict[int, Dict[str, List[str]]]:
+        """{id(call): {loop var: [literal strings]}} for every call,
+        carrying the innermost enclosing ``for NAME in (<str literals>)``
+        bindings — the scope the bounded-f-string proof may expand."""
+        out: Dict[int, Dict[str, List[str]]] = {}
+
+        def literal_items(node: ast.AST) -> Optional[List[str]]:
+            if not isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+                return None
+            vals = []
+            for e in node.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)):
+                    return None
+                vals.append(e.value)
+            return vals
+
+        def rebinds(body: List[ast.stmt], var: str) -> bool:
+            """True when *var* is bound again anywhere in *body* — an
+            intervening `key = dyn[key]`, an inner `for key in runtime()`,
+            a `with ... as key`, walrus or except-as. Any rebind voids
+            the proof for the WHOLE loop (order-insensitive on purpose:
+            conservative in the direction of demanding a disable, never
+            of accepting an unbounded name)."""
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        continue       # a nested def's locals are theirs
+                    targets: List[ast.AST] = []
+                    if isinstance(sub, ast.Assign):
+                        targets = list(sub.targets)
+                    elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [sub.target]
+                    elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                        targets = [sub.target]
+                    elif isinstance(sub, ast.NamedExpr):
+                        targets = [sub.target]
+                    elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                        targets = [i.optional_vars for i in sub.items
+                                   if i.optional_vars is not None]
+                    elif isinstance(sub, ast.ExceptHandler):
+                        if sub.name == var:
+                            return True
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name) and n.id == var:
+                                return True
+            return False
+
+        def visit(node: ast.AST, env: Dict[str, List[str]]) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_env = env
+                if isinstance(child, ast.For) and isinstance(
+                        child.target, ast.Name):
+                    items = literal_items(child.iter)
+                    var = child.target.id
+                    child_env = dict(env)
+                    if items is not None and not rebinds(
+                            list(child.body) + list(child.orelse), var):
+                        child_env[var] = items
+                    else:
+                        # non-literal iter (or a rebind in the body)
+                        # SHADOWS any outer binding of the same name —
+                        # the stale outer tuple must not vouch for it
+                        child_env.pop(var, None)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda)):
+                    # a nested def's body runs OUTSIDE the loop binding
+                    child_env = {}
+                if isinstance(child, ast.Call):
+                    out[id(child)] = child_env
+                visit(child, child_env)
+
+        visit(tree, {})
+        return out
+
+    def _bounded_expansions(self, js: ast.JoinedStr,
+                            env: Dict[str, List[str]]) -> Optional[List[str]]:
+        """All concrete strings *js* can produce when every interpolated
+        value is a loop variable bound to a literal tuple — None when any
+        part is unprovable (or the product explodes past the cap)."""
+        parts: List[List[str]] = []
+        for v in js.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append([v.value])
+            elif (isinstance(v, ast.FormattedValue)
+                    and v.format_spec is None and v.conversion == -1
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id in env):
+                parts.append(env[v.value.id])
+            else:
+                return None
+        out = [""]
+        for choices in parts:
+            out = [a + c for a in out for c in choices]
+            if len(out) > self._MAX_EXPANSIONS:
+                return None
+        return out

@@ -1,7 +1,13 @@
 #!/usr/bin/env python
 """``scripts/lint.py`` — thin wrapper over ``python -m kubetpu.analysis``
 so CI and operators have one obvious entry point next to the other
-check scripts (obs_check, prefix_check, spec_check)."""
+check scripts (obs_check, prefix_check, spec_check).
+
+CI mode by default: unless the invocation is a ``--write-baseline``
+regeneration, ``--fail-stale`` is injected so a baseline holding budget
+for findings that no longer exist FAILS the run (the interactive CLI
+only nudges) — paid-down ratchet debt must be committed, or the next
+regression hides inside the stale budget."""
 
 import os
 import sys
@@ -13,4 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from kubetpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    raise SystemExit(main(sys.argv[1:]))
+    args = sys.argv[1:]
+    if "--write-baseline" not in args and "--fail-stale" not in args:
+        args = ["--fail-stale"] + args
+    raise SystemExit(main(args))

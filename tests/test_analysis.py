@@ -329,6 +329,592 @@ def test_jit_clean_factory_passes(tmp_path):
     assert res.active == []
 
 
+# -- KTP007 implicit-sync taint (Round-13) -----------------------------------
+
+TAINT_VIOLATING = """
+    class Server:
+        def step(self):
+            return self._advance()
+
+        def _advance(self):
+            mask = jnp.greater(self.pos, 0)
+            if mask.any():                    # branch on a device value
+                n = int(jnp.sum(mask))        # int() on a device value
+            vals = self._dev("active", lambda: self.active)
+            for v in vals:                    # iterating a device mirror
+                pass
+            return f"active={vals}"           # f-string materializes
+    """
+
+TAINT_CLEAN = """
+    class Server:
+        def step(self):
+            return self._advance()
+
+        def _advance(self):
+            mask = jnp.greater(self.pos, 0)
+            host = np.asarray(mask)           # KTP001's finding, not 007's
+            if host.any():                    # host array: no implicit sync
+                n = int(host.sum())
+            if self.active.any():             # plain host state
+                pass
+            k = len(self.host_list)
+            return k
+    """
+
+
+def test_taint_flags_implicit_syncs_in_step_closure(tmp_path):
+    res = lint(tmp_path, {"kubetpu/jobs/serving.py": TAINT_VIOLATING},
+               rules=["KTP007"])
+    whats = [f.message.split(":")[1].split(" on ")[0].strip()
+             for f in res.active]
+    assert codes(res) == ["KTP007"] * 4
+    assert whats == ["branch condition", "`int()`", "iteration",
+                     "f-string interpolation"]
+
+
+def test_taint_clean_after_sanitizer_and_host_state(tmp_path):
+    res = lint(tmp_path, {"kubetpu/jobs/serving.py": TAINT_CLEAN},
+               rules=["KTP007"])
+    assert res.active == []
+
+
+def test_taint_survives_branch_join_and_loop_back_edge(tmp_path):
+    # taint assigned in ONE branch must survive the join (may-analysis);
+    # taint created in a loop body must reach the loop HEADER via the
+    # back edge — both are flow facts a per-line matcher cannot see
+    res = lint(tmp_path, {"kubetpu/jobs/serving.py": """
+        class Server:
+            def step(self):
+                x = self.host
+                if self.flag:
+                    x = jnp.ones(3)
+                if x.any():                  # tainted via one branch only
+                    pass
+                y = self.host
+                while y.any():               # tainted via the back edge
+                    y = jnp.cumsum(y)
+        """}, rules=["KTP007"])
+    assert [f.line for f in res.active] == [7, 10]
+
+
+def test_taint_cleared_by_reassignment(tmp_path):
+    res = lint(tmp_path, {"kubetpu/jobs/serving.py": """
+        class Server:
+            def step(self):
+                x = jnp.ones(3)
+                x = self.host_list
+                if x:                        # strong update killed the taint
+                    pass
+        """}, rules=["KTP007"])
+    assert res.active == []
+
+
+def test_taint_ignores_jitted_inner_defs(tmp_path):
+    # a nested def in the closure is a traced leg: its body cannot
+    # host-sync mid-trace, so device-value branches there are legal
+    res = lint(tmp_path, {"kubetpu/jobs/serving.py": """
+        class Server:
+            def step(self):
+                def leg(cache):
+                    m = jnp.greater(cache, 0)
+                    return jnp.where(m, cache, 0)
+                return self._legs["step"](self.cache)
+        """}, rules=["KTP007"])
+    assert res.active == []
+
+
+# -- KTP008 lock-order deadlock graph (Round-13) ------------------------------
+
+THREE_LOCK_CYCLE = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.b = B()
+
+        def fwd(self):
+            with self._lock:
+                self.b.fwd()
+
+    class B:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.c = C()
+
+        def fwd(self):
+            with self._lock:
+                self.c.poke()
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.a = A()
+
+        def poke(self):
+            with self._lock:
+                pass
+
+        def back(self):
+            with self._lock:
+                self.a.fwd()
+    """
+
+
+def test_lock_order_flags_three_lock_cycle(tmp_path):
+    res = lint(tmp_path, {"kubetpu/wire/locks.py": THREE_LOCK_CYCLE},
+               rules=["KTP008"])
+    cycles = [f for f in res.active if "lock-order cycle" in f.message]
+    assert any("`A._lock`" in f.message and "`B._lock`" in f.message
+               and "`C._lock`" in f.message for f in cycles)
+
+
+def test_lock_order_flags_self_reacquisition_but_not_rlock(tmp_path):
+    res = lint(tmp_path, {"kubetpu/wire/locks.py": """
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                with self._lock:
+                    pass
+
+        class Reentrant:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                with self._lock:
+                    pass
+        """}, rules=["KTP008"])
+    assert codes(res) == ["KTP008"]
+    assert "Plain._lock" in res.active[0].message
+
+
+def test_lock_order_clean_consistent_order_passes(tmp_path):
+    # A -> B everywhere: a DAG, no finding (and *_locked callees that
+    # take nothing themselves add no edges)
+    res = lint(tmp_path, {"kubetpu/wire/locks.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def one(self):
+                with self._lock:
+                    self.b.poke()
+
+            def two(self):
+                with self._lock:
+                    self._apply_locked()
+                    self.b.poke()
+
+            def _apply_locked(self):
+                self.x = 1
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    pass
+        """}, rules=["KTP008"])
+    assert res.active == []
+
+
+# -- KTP009 thread-escape (Round-13) ------------------------------------------
+
+# the cross-module shape: the wire module embeds the handler and writes
+# through the `srv = self` closure alias; the LOOP half (step) lives in
+# a subclass in another module — the model must flatten the hierarchy
+ESCAPE_WIRE = """
+    import threading
+    from http.server import BaseHTTPRequestHandler
+
+    class ExporterBase:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.paused = False
+            self.limit = 0
+            srv = self
+
+            class Handler(BaseHTTPRequestHandler):
+                def do_POST(self):
+                    srv.paused = True          # unguarded handler write
+                    with srv._lock:
+                        srv.limit = 10         # guarded: clean
+
+                def do_GET(self):
+                    srv._bump()                # escapes via a server method
+            srv.handler_cls = Handler
+
+        def _bump(self):
+            self.hits = self.hits + 1          # unguarded, handler-reached
+    """
+
+ESCAPE_JOBS = """
+    from kubetpu.wire.exp import ExporterBase
+
+    class StepExporter(ExporterBase):
+        def step(self):
+            if self.paused:                    # loop role reads the flag
+                return None
+            return self.hits + self.limit
+    """
+
+
+def test_thread_escape_flags_cross_module_handler_write(tmp_path):
+    res = lint(tmp_path, {"kubetpu/wire/exp.py": ESCAPE_WIRE,
+                          "kubetpu/jobs/stepper.py": ESCAPE_JOBS},
+               rules=["KTP009"])
+    attrs = sorted(f.message.split("`")[1] for f in res.active)
+    # paused (direct write) + hits (via _bump); limit is lock-guarded
+    assert codes(res) == ["KTP009", "KTP009"]
+    assert attrs == ["ExporterBase.hits", "ExporterBase.paused"]
+    assert all("wire-handler thread" in f.message for f in res.active)
+
+
+def test_thread_escape_clean_when_locked_or_unread(tmp_path):
+    res = lint(tmp_path, {"kubetpu/wire/exp.py": """
+        import threading
+        from http.server import BaseHTTPRequestHandler
+
+        class Exporter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.limit = 0
+                srv = self
+
+                class Handler(BaseHTTPRequestHandler):
+                    def do_POST(self):
+                        with srv._lock:
+                            srv.limit = 10     # guarded
+                        srv.stats = {}         # never read by the loop
+
+            def step(self):
+                return self.limit
+        """}, rules=["KTP009"])
+    assert res.active == []
+
+
+# -- KTP010 resource safety (Round-13) ----------------------------------------
+
+
+def test_resource_flags_early_return_leak_and_never_closed(tmp_path):
+    res = lint(tmp_path, {"kubetpu/obs/sink2.py": """
+        def leak(path, cond):
+            fh = open(path)
+            if cond:
+                return None              # fh leaks out of scope open
+            fh.close()
+
+        def never(path):
+            fh = open(path)
+            fh.write("x")
+
+        def dropped(path):
+            open(path)                   # no handle at all
+        """}, rules=["KTP010"])
+    assert codes(res) == ["KTP010"] * 3
+    assert "leaks across the early exit" in res.active[0].message
+    assert "never closed" in res.active[1].message
+    assert "immediately dropped" in res.active[2].message
+
+
+def test_resource_close_only_in_except_does_not_cover_normal_path(tmp_path):
+    # an except handler runs only on the raising path; a close that
+    # lives nowhere else leaves the handle open on every normal exit
+    # (a finally-close, by contrast, covers every path)
+    res = lint(tmp_path, {"kubetpu/obs/sink3.py": """
+        def except_only(path):
+            fh = open(path)
+            try:
+                risky()
+            except ValueError:
+                fh.close()
+            return fh.read()
+
+        def ok_finally(path):
+            fh = open(path)
+            try:
+                risky()
+            finally:
+                fh.close()
+            return 0
+        """}, rules=["KTP010"])
+    assert codes(res) == ["KTP010"]
+    assert "only the exception path closes it" in res.active[0].message
+
+
+def test_resource_bind_then_with_is_managed(tmp_path):
+    # `f = open(...)` then `with f:` delegates the close to __exit__ —
+    # managed, not a leak; but an early exit BEFORE the with still is
+    res = lint(tmp_path, {"kubetpu/obs/sink5.py": """
+        def ok_bind_then_with(path):
+            f = open(path)
+            with f:
+                return f.read()
+
+        def leak_before_with(path, cond):
+            f = open(path)
+            if cond:
+                return None
+            with f:
+                return f.read()
+        """}, rules=["KTP010"])
+    assert codes(res) == ["KTP010"]
+    assert res.active[0].line == 8
+    assert "leaks across the early exit" in res.active[0].message
+
+
+def test_resource_clean_with_finally_escape_and_scope(tmp_path):
+    res = lint(tmp_path, {
+        "kubetpu/obs/sink2.py": """
+            def ok_with(path):
+                with open(path) as fh:
+                    return fh.read()
+
+            def ok_finally(path, cond):
+                fh = open(path)
+                try:
+                    if cond:
+                        return None
+                finally:
+                    fh.close()
+
+            def ok_escape_self(self, path):
+                new_sink = open(path, "a")
+                self._sink = new_sink        # ownership moves to the object
+
+            def ok_return(path):
+                return open(path)
+            """,
+        # jobs/ is out of scope for KTP010 (checkpoint IO has its own
+        # atomic-rename discipline)
+        "kubetpu/jobs/ckpt2.py": """
+            def raw(path):
+                fh = open(path)
+                fh.write("x")
+            """,
+    }, rules=["KTP010"])
+    assert res.active == []
+
+
+# -- KTP004 bounded-f-string proof (Round-13 refinement) ----------------------
+
+
+def test_metric_fstring_over_literal_tuple_is_proven(tmp_path):
+    res = lint(tmp_path, {"kubetpu/obs/thing2.py": """
+        def setup(reg):
+            for key in ("a", "b"):
+                reg.counter(f"kubetpu_agent_{key}_total")    # provable
+
+        def bad(reg):
+            for key in ("a", "B!"):
+                reg.counter(f"kubetpu_agent_{key}_total")    # bad expansion
+
+        def unbounded(reg, key):
+            reg.counter(f"kubetpu_agent_{key}_total")        # parameter
+        """}, rules=["KTP004"])
+    msgs = [f.message for f in res.active]
+    assert len(msgs) == 2
+    assert "kubetpu_agent_B!_total" in msgs[0]    # the expansion, by name
+    assert "unbounded series cardinality" in msgs[1]
+
+
+def test_metric_fstring_proof_voided_by_rebound_loop_var(tmp_path):
+    # a rebind inside the loop (assignment, or an inner non-literal for
+    # shadowing the name) means the literal tuple no longer vouches for
+    # the interpolated value — the proof must refuse, not validate the
+    # wrong name set
+    res = lint(tmp_path, {"kubetpu/obs/thing3.py": """
+        def reassigned(reg, dyn):
+            for key in ("a", "b"):
+                key = dyn[key]
+                reg.counter(f"kubetpu_agent_{key}_total")
+
+        def shadowed(reg, runtime_list):
+            for key in ("a", "b"):
+                for key in runtime_list():
+                    reg.counter(f"kubetpu_agent_{key}_total")
+        """}, rules=["KTP004"])
+    assert codes(res) == ["KTP004", "KTP004"]
+    assert all("unbounded series cardinality" in f.message
+               for f in res.active)
+
+
+# -- CFG/taint engine unit tests (synthetic functions) ------------------------
+
+
+def _taint_envs(src, source_names=("taint",)):
+    import ast as ast_mod
+
+    from kubetpu.analysis.core import call_name
+    from kubetpu.analysis.flow import TaintEngine
+
+    tree = ast_mod.parse(textwrap.dedent(src))
+    func = tree.body[0]
+    eng = TaintEngine(lambda c: call_name(c) in source_names)
+    return func, eng, eng.run(func)
+
+
+def test_cfg_branches_union_at_join():
+    func, eng, before = _taint_envs("""
+        def f(cond):
+            x = 1
+            if cond:
+                x = taint()
+            else:
+                y = 2
+            return x
+        """)
+    ret = func.body[-1]
+    assert "x" in before[id(ret)]
+
+
+def test_cfg_loop_back_edge_propagates():
+    func, eng, before = _taint_envs("""
+        def f(n):
+            x = 1
+            while n:
+                use(x)
+                x = taint()
+            return x
+        """)
+    use_stmt = func.body[1].body[0]
+    # on the second iteration `x` arrives tainted at the loop body head
+    assert "x" in before[id(use_stmt)]
+    assert "x" in before[id(func.body[-1])]
+
+
+def test_cfg_try_except_reaches_handler_mid_body():
+    func, eng, before = _taint_envs("""
+        def f():
+            try:
+                x = taint()
+                risky()
+            except ValueError:
+                use(x)
+            return 0
+        """)
+    handler_use = func.body[0].handlers[0].body[0]
+    assert "x" in before[id(handler_use)]
+
+
+def test_cfg_break_skips_loop_tail():
+    func, eng, before = _taint_envs("""
+        def f(n):
+            x = 1
+            for i in range(n):
+                if i:
+                    break
+                x = taint()
+            return x
+        """)
+    assert "x" in before[id(func.body[-1])]
+
+
+def test_taint_strong_update_kills():
+    func, eng, before = _taint_envs("""
+        def f():
+            x = taint()
+            x = 1
+            return x
+        """)
+    assert "x" not in before[id(func.body[-1])]
+
+
+def test_cfg_handler_sees_taint_killed_later_in_try_body():
+    # risky() can raise while x is still the device value; the kill on
+    # the NEXT line must not launder the handler's view (exceptional
+    # edges carry the union of the try body's intermediate states)
+    func, eng, before = _taint_envs("""
+        def f():
+            try:
+                x = taint()
+                risky()
+                x = 1
+            except ValueError:
+                use(x)
+            return x
+        """)
+    handler_use = func.body[0].handlers[0].body[0]
+    assert "x" in before[id(handler_use)]
+    # and the may-analysis unions at the post-try join: the handler
+    # path reaches the return with x still tainted
+    assert "x" in before[id(func.body[-1])]
+
+
+def test_cfg_handler_edge_covers_try_bodys_leading_statements():
+    # with a COMPOUND statement in the try body, the leading simple
+    # statements live in the body's entry block — the exceptional edge
+    # must include that block too, or the kill there launders the
+    # handler's view of the leading taint
+    func, eng, before = _taint_envs("""
+        def f(c):
+            try:
+                x = taint()
+                risky()
+                x = 1
+                if c:
+                    pass
+            except ValueError:
+                use(x)
+            return 0
+        """)
+    handler_use = func.body[0].handlers[0].body[0]
+    assert "x" in before[id(handler_use)]
+
+
+def test_lock_order_ignores_nested_defs_under_lock(tmp_path):
+    # a callback DEFINED under the lock runs later, on another call
+    # path — charging its acquisitions to the enclosing method would
+    # fabricate an A->B edge (and, with B->A elsewhere, a phantom
+    # deadlock cycle) that cannot happen
+    res = lint(tmp_path, {"kubetpu/wire/locks.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def register(self):
+                with self._lock:
+                    def cb():
+                        self.b.poke()
+                    self.cbs.append(cb)
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = A()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+            def back(self):
+                with self._lock:
+                    self.a.noop()
+        """}, rules=["KTP008"])
+    assert res.active == []
+
+
 # -- suppressions ------------------------------------------------------------
 
 
@@ -448,8 +1034,98 @@ def test_cli_list_rules_covers_catalog(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for code in ("KTP001", "KTP002", "KTP003", "KTP004", "KTP005",
-                 "KTP006"):
+                 "KTP006", "KTP007", "KTP008", "KTP009", "KTP010"):
         assert code in out
+
+
+def test_cli_github_format_emits_annotations(tmp_path, capsys):
+    root = make_tree(tmp_path, {"kubetpu/cli/thing.py": TWO_URLOPEN})
+    rc = lint_main(["--root", root, "--no-baseline", "--format", "github",
+                    "--rules", "KTP002", "kubetpu"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [ln for ln in out.splitlines() if ln.startswith("::error")]
+    assert len(lines) == 2
+    assert "file=kubetpu/cli/thing.py" in lines[0]
+    assert "title=KTP002" in lines[0]
+
+
+def test_cli_fail_stale_turns_nudge_into_failure(tmp_path, capsys):
+    root = make_tree(tmp_path, {"kubetpu/cli/ok.py": "x = 1\n"})
+    bl = tmp_path / "lint_baseline.json"
+    bl.write_text(json.dumps({
+        "version": 1,
+        "counts": {"kubetpu/cli/gone.py::KTP002": 3},
+    }))
+    # default: stale baseline only nudges (full default-path run)
+    assert lint_main(["--root", root, "--baseline", str(bl)]) == 0
+    # CI mode (what scripts/lint.py injects): stale FAILS
+    assert lint_main(["--root", root, "--baseline", str(bl),
+                      "--fail-stale"]) == 1
+    assert "stale" in capsys.readouterr().err
+    # an explicitly-pathed run is SCOPED — staleness is undecidable
+    # there, so it must not fail (mirrors the --write-baseline refusal)
+    assert lint_main(["--root", root, "--baseline", str(bl),
+                      "--fail-stale", "kubetpu"]) == 0
+    # ...but staleness is only decidable over the FULL finding set: a
+    # --rules scope sees a slice, so every out-of-scope key would read
+    # as paid down and a clean tree would spuriously fail
+    assert lint_main(["--root", root, "--baseline", str(bl),
+                      "--fail-stale", "--rules", "KTP004"]) == 0
+    # --changed-only still LINTS the full default paths (it filters the
+    # report), so staleness stays exact and must still fail
+    assert lint_main(["--root", root, "--baseline", str(bl),
+                      "--fail-stale", "--changed-only"]) == 1
+
+
+def test_cli_changed_only_scopes_the_report(tmp_path, capsys):
+    import subprocess
+
+    root = make_tree(tmp_path, {
+        "kubetpu/cli/old.py": textwrap.dedent(TWO_URLOPEN),
+        "kubetpu/cli/clean.py": "x = 1\n",
+    })
+    env_git = ["git", "-C", root, "-c", "user.email=t@t", "-c",
+               "user.name=t"]
+    subprocess.run(["git", "-C", root, "init", "-q"], check=True)
+    subprocess.run(env_git + ["add", "-A"], check=True)
+    subprocess.run(env_git + ["commit", "-qm", "seed"], check=True)
+    # untouched tree: the committed violations exist but nothing changed,
+    # so --changed-only passes (the full run still fails)
+    assert lint_main(["--root", root, "--no-baseline", "--rules", "KTP002",
+                      "kubetpu"]) == 1
+    capsys.readouterr()
+    assert lint_main(["--root", root, "--no-baseline", "--changed-only",
+                      "--rules", "KTP002", "kubetpu"]) == 0
+    capsys.readouterr()
+    # a NEW (untracked) violating file is in the changed set and fails
+    (tmp_path / "kubetpu/cli/fresh.py").write_text(
+        textwrap.dedent(TWO_URLOPEN))
+    assert lint_main(["--root", root, "--no-baseline", "--changed-only",
+                      "--rules", "KTP002", "kubetpu"]) == 1
+    out = capsys.readouterr().out
+    assert "fresh.py" in out and "old.py" not in out
+
+
+def test_cli_changed_only_reroots_when_project_is_a_git_subdir(tmp_path,
+                                                               capsys):
+    # git prints toplevel-relative paths; findings are lint-root-relative
+    # — when the project is vendored a level below the checkout root the
+    # changed set must be re-rooted or the gate silently passes
+    import subprocess
+
+    subprocess.run(["git", "-C", str(tmp_path), "init", "-q"], check=True)
+    root = make_tree(tmp_path / "vendor" / "proj",
+                     {"kubetpu/cli/clean.py": "x = 1\n"})
+    env_git = ["git", "-C", str(tmp_path), "-c", "user.email=t@t", "-c",
+               "user.name=t"]
+    subprocess.run(env_git + ["add", "-A"], check=True)
+    subprocess.run(env_git + ["commit", "-qm", "seed"], check=True)
+    (tmp_path / "vendor/proj/kubetpu/cli/fresh.py").write_text(
+        textwrap.dedent(TWO_URLOPEN))
+    assert lint_main(["--root", root, "--no-baseline", "--changed-only",
+                      "--rules", "KTP002", "kubetpu"]) == 1
+    assert "fresh.py" in capsys.readouterr().out
 
 
 # -- request_text (the migration the lint forced) ----------------------------

@@ -1,0 +1,227 @@
+"""Round-13 threaded race soak — the runtime twin of KTP008/KTP009.
+
+The static rules claim the thread contract holds: wire-handler threads
+touch only lock-guarded surfaces (the obs Registry, the EventLog ring,
+the tracer), the step loop owns all serving state, and no lock order
+cycles exist. This soak is the dynamic oracle for that claim: one
+thread drives ``step()`` on a ``PagedDecodeServer`` (admissions, decode,
+drains, checkpoint saves) while wire-handler threads hammer the
+``MetricsServer`` exposing that SAME server's registry and event log —
+through the fault-injected retrying client, so handlers see drops,
+delays and retries (>= 10% injected) exactly like the chaos suite's
+control plane.
+
+Oracles, in order of strength:
+
+- **token exactness**: the concurrently-scraped run must emit byte-for-
+  byte the tokens a quiet serial replay emits — scraping is read-only
+  or it isn't, there is no "mostly";
+- **pool accounting**: ``check_invariants()`` (free + slot-private +
+  tree-owned == n_pages, refcounts == pins) after every drain and at
+  the end;
+- **metric-counter consistency**: the final exposition parses clean,
+  TTFT samples == finished requests, admit events == retire events ==
+  requests, and the scrape responses themselves were well-formed under
+  fault injection;
+- **liveness**: no thread died, every scraper made progress, faults
+  actually fired.
+
+The short soak rides tier-1; the 30s+ one is ``slow`` and runs under
+``make chaos`` next to the control-plane soak.
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.obs.events import validate_events_jsonl
+from kubetpu.obs.exporter import MetricsServer
+from kubetpu.obs.registry import validate_prometheus_text
+from kubetpu.wire.faults import FaultInjector, RoutePolicy
+from kubetpu.wire.httpcommon import RetryPolicy, request_text
+
+pytestmark = pytest.mark.chaos
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+
+# generous attempts: the soak asserts convergence THROUGH faults, so a
+# scraper must practically never exhaust its budget at a ~10-30% rate
+SOAK_RETRY = RetryPolicy(attempts=6, base_delay=0.01, max_delay=0.05,
+                         deadline=10.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _mk_server(params):
+    return PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=6, page_size=8,
+                             prefill_budget=8)
+
+
+def _prompt(i):
+    return [(i * 11 + j * 3) % 60 + 1 for j in range(4 + (i * 5) % 9)]
+
+
+def _serial_reference(params, n_requests):
+    """The quiet replay: same prompts, same server config, no scrapers —
+    what the soaked run must reproduce token-for-token."""
+    server = _mk_server(params)
+    out = {}
+    for i in range(n_requests):
+        rid = server.enqueue(_prompt(i))
+        server.step()
+        out[i] = rid
+    server.drain()
+    return {i: server.result(rid) for i, rid in out.items()}
+
+
+def _scraper(address, stop, injector, errors, stats, validate_every=7):
+    n = 0
+    while not stop.is_set():
+        n += 1
+        try:
+            text = request_text(address + "/metrics", timeout=5,
+                                retry=SOAK_RETRY, faults=injector)
+            stats["scrapes"] += 1
+            if n % validate_every == 0:
+                problems = validate_prometheus_text(text)
+                if problems:
+                    errors.append(f"malformed exposition: {problems[:3]}")
+            ev = request_text(address + "/events?limit=64", timeout=5,
+                              retry=SOAK_RETRY, faults=injector)
+            stats["scrapes"] += 1
+            if n % validate_every == 0:
+                problems = validate_events_jsonl(ev)
+                if problems:
+                    errors.append(f"malformed events: {problems[:3]}")
+        except Exception as e:  # noqa: BLE001 — a scraper death is a FAIL
+            errors.append(f"scraper died: {type(e).__name__}: {e}")
+            return
+
+
+def _run_race_soak(params, tmp_path, seconds, fault_rate, seed,
+                   n_scrapers=3):
+    from kubetpu.jobs.checkpoint import save_checkpoint
+    from kubetpu.jobs.train import TrainState
+
+    reference_n = 6
+    reference = _serial_reference(params, reference_n)
+
+    server = _mk_server(params)
+    exporter = MetricsServer({"serving": server.obs}, events=server.events)
+    exporter.start()
+    stop = threading.Event()
+    errors: list = []
+    stats = {"scrapes": 0}
+    per = fault_rate / 2.0
+    injectors = [
+        FaultInjector(seed=seed + i,
+                      default=RoutePolicy(drop=per, delay=per, delay_s=0.002))
+        for i in range(n_scrapers)
+    ]
+    threads = [
+        threading.Thread(target=_scraper,
+                         args=(exporter.address, stop, inj, errors, stats),
+                         daemon=True)
+        for inj in injectors
+    ]
+    ck_state = TrainState(params=params, opt_state=(),
+                          step=jax.numpy.zeros((), jax.numpy.int32))
+    try:
+        server.warmup()
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + seconds
+        results = {}
+        pending = {}
+        i = 0
+        rounds = 0
+        while (time.monotonic() < deadline or pending
+               or len(results) < reference_n):
+            # keep a couple of requests in flight, FIFO-collect finishes;
+            # past the deadline, top up only until the reference set (the
+            # exactness oracle's prompts) has all been admitted
+            while len(pending) < 3 and (time.monotonic() < deadline
+                                        or i < reference_n):
+                pending[i] = server.enqueue(_prompt(i))
+                i += 1
+            server.step()
+            for key in list(pending):
+                rid = pending[key]
+                if server.finished(rid):
+                    results[key] = server.result(rid)
+                    del pending[key]
+            rounds += 1
+            if rounds % 16 == 0:
+                # drain + pool oracle mid-flight, on the step thread (the
+                # serving object is loop-owned state — that is the thread
+                # contract KTP009 pins)
+                server.drain()
+                for key in list(pending):
+                    results[key] = server.result(pending[key])
+                    del pending[key]
+                server.check_invariants()
+            if rounds % 8 == 0:
+                save_checkpoint(str(tmp_path / "soak_ck"), ck_state)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        exporter.shutdown()
+
+    assert errors == [], f"wire-thread failures: {errors[:5]}"
+    server.drain()
+    server.check_invariants()
+
+    # -- token exactness vs the quiet serial replay ------------------------
+    assert len(results) >= reference_n, "soak produced too few requests"
+    for key in range(reference_n):
+        assert results[key] == reference[key], (
+            f"request {key} diverged under concurrent scraping: "
+            f"{results[key]} != {reference[key]}"
+        )
+
+    # -- metric-counter consistency ----------------------------------------
+    text = server.metrics_text()
+    assert validate_prometheus_text(text) == []
+    stats_summary = server.metrics_summary()
+    n_done = len(results)
+    assert stats_summary["ttft"]["count"] == n_done
+    ev_counts = server.events.counts()
+    admits = sum(v for k, v in ev_counts.items() if k.startswith("admit"))
+    assert admits == n_done, f"admit events {admits} != requests {n_done}"
+    assert ev_counts.get("retire", 0) == n_done
+    total_tokens = sum(len(v) for v in results.values())
+    assert total_tokens >= n_done  # every request emitted
+
+    # -- liveness: the soak actually soaked --------------------------------
+    injected = sum(sum(inj.counts.values()) for inj in injectors)
+    assert injected > 0, "no faults injected — dead knob?"
+    assert stats["scrapes"] >= n_scrapers * 2, "scrapers made no progress"
+    return stats, injected
+
+
+def test_race_soak_short(params, tmp_path):
+    """Tier-1 soak: ~2.5s of concurrent step+scrape at >= 10% injected
+    faults, token-exact vs serial, clean pool + counters."""
+    _run_race_soak(params, tmp_path, seconds=2.5, fault_rate=0.12,
+                   seed=4242)
+
+
+@pytest.mark.slow
+def test_race_soak_long(params, tmp_path):
+    """The full soak (make chaos): 30+ seconds at ~25% injected faults —
+    the acceptance oracle for KTP008/KTP009's static claims."""
+    stats, injected = _run_race_soak(params, tmp_path, seconds=32,
+                                     fault_rate=0.25, seed=987,
+                                     n_scrapers=4)
+    # a 30s soak must accumulate real coverage on both sides
+    assert stats["scrapes"] > 50
+    assert injected > 10
