@@ -40,10 +40,12 @@ test: tpuinfo gpuinfo dataio
 # invisible proves nothing), then prefix-check (a chaos run over a pool
 # the prefix tree corrupted proves the wrong thing), then spec-check
 # (speculative rounds must be invisible in the output stream before
-# chaos means anything), then bench-gate in smoke mode (a chaos pass
-# that silently regressed serving throughput still fails the round).
+# chaos means anything), then router-check (the data plane must route
+# token-exactly and never double-admit under the same faults), then
+# bench-gate in smoke mode (a chaos pass that silently regressed
+# serving throughput still fails the round).
 .PHONY: chaos
-chaos: lint obs-check prefix-check spec-check bench-gate-smoke
+chaos: lint obs-check prefix-check spec-check router-check bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py \
 		tests/test_race_soak.py -q
 
@@ -109,6 +111,14 @@ spec-check:
 .PHONY: prefix-check
 prefix-check:
 	python scripts/prefix_check.py
+
+# data-plane routing oracle (Round-14): router + 2 paged replicas under
+# >=10% injected wire faults — greedy token parity vs direct serving,
+# zero double-admissions through the idempotency replay window, a
+# stitched router->replica trace, and the pool invariant per replica
+.PHONY: router-check
+router-check:
+	python scripts/router_check.py
 
 # observability smoke oracle: controller + 2 fake agents, scrape the
 # federated /metrics, fail on malformed Prometheus text / missing

@@ -433,8 +433,9 @@ def _result_key(r: dict) -> tuple:
             r.get("kv_cache", "bf16"), r.get("block_q", 128),
             r.get("block_k", 128), r.get("variant"),
             # prefix_reuse_storm rows: one line per reuse arm, re-runs
-            # with the same arm replace cleanly across rounds
-            r.get("reuse"))
+            # with the same arm replace cleanly across rounds; ditto
+            # router_storm's routing-policy arms
+            r.get("reuse"), r.get("policy"))
 
 
 def _merge_out(path: str, new: list) -> None:
@@ -731,6 +732,130 @@ def prefix_reuse_storm(cfg, n_slots=4, sys_len=192, tail_len=8,
     return run(0), run(cache_pages)
 
 
+def router_storm(cfg, n_replicas=2, n_families=3, sys_len=96, tail_len=8,
+                 requests_per_family=4, max_new=6, page_size=16,
+                 prefill_budget=32, cache_pages=32, concurrency=4,
+                 n_slots=2, policies=("random", "affinity")):
+    """N-replica storm through the Round-14 data plane: *n_families*
+    shared-prefix prompt families interleaved through a router in front
+    of *n_replicas* paged replicas (prefix cache on), AFFINITY routing
+    vs the seeded RANDOM baseline. Affinity consistent-hashes each
+    family's prefix head onto one replica, so every family member after
+    the first hits a warm radix tree; random routing gives each replica
+    per-replica luck. Reports the CLUSTER-wide prefix hit rate plus
+    TTFT p50 / ITL p99 pooled over every replica's raw reservoir (exact
+    below cap) — the numbers the bench gate rides. *policies* selects
+    the arms (the gate runs only "affinity"; the comparison row runs
+    both)."""
+    import dataclasses
+    import random as _random
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.paged import PagedDecodeServer
+    from kubetpu.router import ReplicaServer, RouterServer
+    from kubetpu.wire.httpcommon import request_json
+
+    dcfg = dataclasses.replace(cfg, remat=False)
+    params = init_params(jax.random.PRNGKey(0), dcfg)
+    rng = _random.Random(0)
+    families = [[rng.randrange(1, dcfg.vocab) for _ in range(sys_len)]
+                for _ in range(n_families)]
+    # interleave families so the random baseline's first-landing luck is
+    # realistic (a family-sorted order would gift it warm trees)
+    prompts = []
+    for _ in range(requests_per_family):
+        for fam in families:
+            prompts.append(fam + [rng.randrange(1, dcfg.vocab)
+                                  for _ in range(tail_len)])
+    max_seq = -(-(sys_len + tail_len + max_new + 2)
+                // page_size) * page_size
+    n_pages = (n_slots * ((max_seq + page_size - 1) // page_size)
+               + cache_pages)
+
+    def make_server():
+        return PagedDecodeServer(
+            dcfg, params, n_slots=n_slots, max_seq=max_seq,
+            max_new_tokens=max_new, page_size=page_size, n_pages=n_pages,
+            prefill_budget=prefill_budget,
+            prefix_cache_pages=cache_pages)
+
+    # pre-compile the storm's leg shapes once (shared _LEG_CACHE), so
+    # neither arm's TTFT carries the other's compile bill
+    pre = make_server()
+    for p in (prompts[0], prompts[-1]):
+        rid = pre.enqueue(p)
+        pre.drain()
+        pre.pop_result(rid)
+
+    def pooled_ms(servers, op, pct):
+        vals = []
+        for srv in servers:
+            for name, labels, kind, inst in srv.obs.snapshot():
+                if (name == "kubetpu_serving_latency_seconds"
+                        and kind == "summary"
+                        and dict(labels).get("op") == op):
+                    vals.extend(inst.tail()[1])
+        if not vals:
+            return 0.0
+        return float(np.percentile(np.asarray(vals), pct)) * 1e3
+
+    def run(policy):
+        servers = [make_server() for _ in range(n_replicas)]
+        replicas = [ReplicaServer(srv, f"bench{i}", idle_wait=0.002)
+                    for i, srv in enumerate(servers)]
+        router = RouterServer(policy=policy, load_refresh_s=0.1)
+        try:
+            router.start()
+            for rep in replicas:
+                rep.start()
+                router.register_replica(rep.address)
+
+            def one(item):
+                i, prompt = item
+                return request_json(
+                    router.address + "/generate",
+                    {"prompt": prompt, "timeout": 120.0},
+                    idempotency_key=f"router-storm-{policy}-{i}",
+                    timeout=120.0)
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=concurrency) as ex:
+                bodies = list(ex.map(one, enumerate(prompts)))
+            wall = time.perf_counter() - t0
+            emitted = sum(len(b["emitted"]) for b in bodies)
+            reuse = [srv.prefix_cache_stats() for srv in servers]
+            hits = sum(r["requests_hit"] for r in reuse)
+            total = hits + sum(r["requests_miss"] for r in reuse)
+            for srv in servers:
+                srv.check_invariants()   # the pool oracle rides the bench
+            return {
+                "metric": "router_storm",
+                "policy": policy,
+                "value": round(hits / total, 3) if total else 0.0,
+                "unit": "cluster-wide prefix hit rate",
+                "ttft_p50_ms": round(pooled_ms(servers, "ttft", 50), 3),
+                "itl_p99_ms": round(pooled_ms(servers, "itl", 99), 3),
+                "decode_tok_s": round(emitted / wall, 1) if wall else 0.0,
+                "prefill_tokens_saved": sum(
+                    r["prefill_tokens_saved"] for r in reuse),
+                "fallbacks": int(router._c_fallback.value),
+                "requests": len(prompts),
+                "n_replicas": n_replicas,
+                "n_families": n_families,
+                "concurrency": concurrency,
+            }
+        finally:
+            router.shutdown()
+            for rep in replicas:
+                rep.shutdown(graceful=False)
+
+    return tuple(run(p) for p in policies)
+
+
 def spec_serving_throughput(cfg, n_slots, prompt_len, rounds):
     """Continuous batching WITH speculation: tokens per round under churn
     (the round replaces the one-token step; acceptance sets the speedup
@@ -1014,6 +1139,20 @@ def main() -> int:
                 page_size=16,
                 prefill_budget=32 if args.smoke else 256,
                 cache_pages=16 if args.smoke else 128):
+            emit(row)
+        # Round-14 data plane: affinity vs random routing across a
+        # replica fleet — cluster-wide hit rate and pooled TTFT/ITL
+        for row in router_storm(
+                cfg,
+                n_replicas=2,
+                n_families=3,
+                sys_len=64 if args.smoke else 512,
+                tail_len=8 if args.smoke else 32,
+                requests_per_family=3 if args.smoke else 6,
+                max_new=4 if args.smoke else 16,
+                page_size=16,
+                prefill_budget=32 if args.smoke else 256,
+                cache_pages=32 if args.smoke else 128):
             emit(row)
         emit(spec_serving_throughput(cfg, n_slots=2 if args.smoke else 4,
                                      prompt_len=16 if args.smoke else 128,

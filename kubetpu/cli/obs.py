@@ -5,8 +5,12 @@ endpoints — agents directly, or serving replicas behind an
 ``obs.exporter.MetricsServer``) and renders the numbers an operator
 actually pages on: nodes by breaker state, free/held chips, pending pods,
 scheduler latency percentiles, per-node agent counters, and serving
-TTFT/ITL/queue when a serving endpoint is scraped. ``--trace ID`` renders
-one stitched trace as an indented timeline instead.
+TTFT/ITL/queue when a serving endpoint is scraped. Scraping a Round-14
+``RouterServer`` adds the data-plane section: routed/shed/queued counts,
+replica breaker states, last autoscaler action, and per-replica load +
+prefix hit rate from the federated ``replica="<name>"`` series.
+``--trace ID`` renders one stitched trace as an indented timeline — for
+a routed generate that includes the router hop above its replica leg.
 
     python -m kubetpu.cli.obs [VIEW] --controller URL [--token T]
                               [--scrape URL ...] [--watch SECONDS]
@@ -147,6 +151,60 @@ def render_summary(metrics_text: str, source: str) -> str:
             lines.append(
                 f"serving   active_slots={int(act or 0)} "
                 f"queue_depth={int(depth or 0)}")
+
+    # Round-14 router data plane (present when scraping a RouterServer:
+    # its own counters plus every replica's series federated under
+    # replica="<name>")
+    outcomes = {labels.get("outcome"): int(v) for labels, v in
+                idx.get("kubetpu_router_requests_total", [])}
+    rep_states = {labels.get("state"): int(v) for labels, v in
+                  idx.get("kubetpu_router_replicas", [])}
+    if outcomes or rep_states:
+        burning = _pick(idx, "kubetpu_router_burning")
+        lines.append(
+            f"router    routed={outcomes.get('routed', 0)} "
+            f"shed={outcomes.get('shed', 0)} "
+            f"queue_timeout={outcomes.get('queue_timeout', 0)} "
+            f"fallbacks={int(_pick(idx, 'kubetpu_router_fallback_total') or 0)} "
+            f"queued={int(_pick(idx, 'kubetpu_router_queued_total') or 0)}"
+            + ("  BURNING" if burning else ""))
+        lines.append("router    replicas " + "  ".join(
+            f"{s}={rep_states.get(s, 0)}"
+            for s in ("healthy", "suspect", "probation", "dead")))
+        ups = _pick(idx, "kubetpu_autoscaler_scale_ups_total")
+        downs = _pick(idx, "kubetpu_autoscaler_scale_downs_total")
+        if ups is not None or downs is not None:
+            last = _pick(idx, "kubetpu_autoscaler_last_scale_ts") or 0.0
+            ago = (f" last={time.time() - last:.0f}s ago" if last else "")
+            lines.append(f"scale     ups={int(ups or 0)} "
+                         f"downs={int(downs or 0)}{ago}")
+        # per-replica load + prefix hit rate from the federated series
+        per_rep: Dict[str, Dict[str, float]] = {}
+
+        def by_replica(metric, key, **want):
+            for labels, v in idx.get(metric, []):
+                rep = labels.get("replica")
+                if rep and all(labels.get(k) == v2
+                               for k, v2 in want.items()):
+                    per_rep.setdefault(rep, {})[key] = v
+
+        by_replica("kubetpu_serving_active_slots", "active")
+        by_replica("kubetpu_serving_queue_depth", "queue")
+        by_replica("kubetpu_serving_pages_free", "pages_free")
+        by_replica("kubetpu_prefix_requests_total", "hits", result="hit")
+        by_replica("kubetpu_prefix_requests_total", "misses",
+                   result="miss")
+        for rep in sorted(per_rep):
+            c = per_rep[rep]
+            total = c.get("hits", 0) + c.get("misses", 0)
+            hit_s = (f" hit_rate={c.get('hits', 0) / total:.2f}"
+                     if total else "")
+            pages = c.get("pages_free")
+            pages_s = (f" pages_free={int(pages)}"
+                       if pages is not None else "")
+            lines.append(
+                f"replica   {rep}: active={int(c.get('active', 0))} "
+                f"queue={int(c.get('queue', 0))}{pages_s}{hit_s}")
     return "\n".join(lines)
 
 

@@ -87,6 +87,30 @@ class LatencyRecorder:
             hist = self._hists.get(op)
         return hist.percentile(p) if hist is not None else 0.0
 
+    def recent_percentile(self, op: str, p: float,
+                          window: int = 128) -> float:
+        """Percentile over the LAST *window* observations (seconds; 0.0
+        with no samples) — the recovery-capable read a live control
+        signal needs. The lifetime reservoir never forgets an incident
+        (a burst's p99 stays elevated for hours after traffic
+        normalizes — the windowed-percentile lesson the SLO engine
+        bakes in), so anything that FEEDS BACK into decisions (the
+        Round-14 autoscaler's hot signal via ``load_info``) must read a
+        recent window. Exact while the reservoir is below cap (the
+        buffer is an append-only log there); past cap it degrades to
+        the full-reservoir estimate — slow-moving, never latched."""
+        with self._lock:
+            hist = self._hists.get(op)
+        if hist is None:
+            return 0.0
+        count, buf = hist.tail()
+        if not buf:
+            return 0.0
+        recent = sorted(buf[-window:] if count <= len(buf) else buf)
+        idx = min(len(recent) - 1,
+                  max(0, int(round(p / 100.0 * (len(recent) - 1)))))
+        return recent[idx]
+
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             ops = list(self._hists)

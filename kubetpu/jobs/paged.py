@@ -837,6 +837,22 @@ class PagedDecodeServer(SlotServerBase):
             "inserted_pages": int(self._c_inserted.value),
         }
 
+    def load_info(self) -> dict:
+        """Base snapshot + the paged pressure signals (Round-14 router
+        food): pool size / free pages, and — with the prefix cache on —
+        the hit rate and tree size, so the data plane can see which
+        replica is page-starved or cache-warm without a /metrics
+        parse. Host counters only; no device work."""
+        info = super().load_info()
+        info["pool_pages"] = self.pool_pages
+        info["pages_free"] = len(self._free)
+        info["pages_in_use"] = self.pages_in_use()
+        if self._prefix_cache is not None:
+            stats = self.prefix_cache_stats()
+            info["prefix_hit_rate"] = stats["hit_rate"]
+            info["prefix_tree_pages"] = stats["tree_pages"]
+        return info
+
     def check_invariants(self) -> None:
         """The pool accounting ORACLE (``Cluster.check_invariants``'s
         serving sibling): every physical page is owned by exactly one of

@@ -501,6 +501,29 @@ class SlotServerBase:
         ``obs.exporter.MetricsServer`` serves at ``/metrics``."""
         return self.obs.render()
 
+    def load_info(self) -> dict:
+        """The CHEAP load snapshot the data plane routes on (Round-14:
+        ``kubetpu.router`` polls this as ``GET /load`` instead of
+        parsing a full /metrics render per decision): host-side
+        occupancy counters plus two bounded-reservoir percentile reads
+        — no device sync, no exposition render. The percentiles are
+        RECENT-window reads (``recent_percentile``), not lifetime: the
+        autoscaler's hot signal feeds back into scaling decisions, and
+        a lifetime p99 that never forgets one burst would latch "hot"
+        forever (the SLO engine's windowed-percentile lesson).
+        Subclasses extend with their pressure signals (the paged
+        server adds pool pages and prefix-cache hit rate)."""
+        return {
+            "n_slots": self.n_slots,
+            "active_slots": int(self.active.sum()),
+            "queue_depth": len(self._queue),
+            "inflight_prefills": len(self._prefills),
+            "queue_wait_p99_ms": self._metrics.recent_percentile(
+                "queue_wait", 99) * 1e3,
+            "ttft_p50_ms": self._metrics.recent_percentile(
+                "ttft", 50) * 1e3,
+        }
+
     # -- Round-11 signal layer ------------------------------------------------
 
     def enable_profiler(self, sample_every: int = 16) -> ServingProfiler:

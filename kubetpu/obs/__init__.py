@@ -75,6 +75,7 @@ from kubetpu.obs.slo import (
     Objective,
     SloEngine,
     fleet_slos,
+    router_slos,
     serving_slos,
 )
 from kubetpu.obs.profile import ServingProfiler
@@ -96,6 +97,7 @@ __all__ = [
     "event_log",
     "federate",
     "fleet_slos",
+    "router_slos",
     "install_process_gauges",
     "merge_events",
     "parse_prometheus_text",

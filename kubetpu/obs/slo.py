@@ -1,6 +1,9 @@
 """Declarative SLOs with multi-window burn rates — the judgment layer
-over the Round-8 metrics spine, and the decision surface the
-prefix-affinity router / autoscaler (ROADMAP) will consume.
+over the Round-8 metrics spine, and (Round-14) the decision surface
+the prefix-affinity router / autoscaler consumes: ``router_slos()``
+is the canned set ``kubetpu.router.RouterServer`` evaluates over its
+federated scrape to shed/queue by SLO class, and whose fast-window
+burn the autoscaler folds into its hot signal.
 
 The registry records *what happened*; an SLO says *whether that is
 acceptable* and *how fast the error budget is burning*. One
@@ -437,6 +440,56 @@ def serving_slos(
             "pool_free_pages", metric="kubetpu_serving_pages_free",
             threshold=float(min_free_pages), op=">=", reduce="min",
             target=target, description="paged-pool free-pages floor"))
+    return out
+
+
+def router_slos(
+    route_p99_s: Optional[float] = None,
+    ttft_p50_s: Optional[float] = None,
+    queue_wait_p99_s: Optional[float] = None,
+    min_free_pages: Optional[int] = None,
+    max_queue_depth: Optional[int] = None,
+    target: float = 0.99,
+) -> List[Objective]:
+    """The data-plane objective set (Round-14): what the
+    ``kubetpu.router.RouterServer`` evaluates over its FEDERATED
+    ``/metrics`` each refresh — the router's own end-to-end route
+    latency plus the WORST replica's serving SLIs (federated percentile
+    resolution already judges max-for-ceilings / min-for-floors, so one
+    page-starved replica fires the set). The router sheds/queues by SLO
+    class while any fast window burns; the autoscaler reads the same
+    verdicts to scale."""
+    out: List[Objective] = []
+    if route_p99_s is not None:
+        out.append(Objective(
+            "route_p99", metric="kubetpu_router_latency_seconds",
+            labels={"op": "route"}, percentile=99, threshold=route_p99_s,
+            target=target, description="router end-to-end route, p99"))
+    if ttft_p50_s is not None:
+        out.append(Objective(
+            "fleet_ttft_p50", metric="kubetpu_serving_latency_seconds",
+            labels={"op": "ttft"}, percentile=50, threshold=ttft_p50_s,
+            target=target,
+            description="worst replica time to first token, p50"))
+    if queue_wait_p99_s is not None:
+        out.append(Objective(
+            "fleet_queue_wait_p99",
+            metric="kubetpu_serving_latency_seconds",
+            labels={"op": "queue_wait"}, percentile=99,
+            threshold=queue_wait_p99_s, target=target,
+            description="worst replica admission-queue wait, p99"))
+    if min_free_pages is not None:
+        out.append(Objective(
+            "fleet_free_pages", metric="kubetpu_serving_pages_free",
+            threshold=float(min_free_pages), op=">=", reduce="min",
+            target=target,
+            description="tightest replica paged-pool free pages"))
+    if max_queue_depth is not None:
+        out.append(Objective(
+            "fleet_queue_depth", metric="kubetpu_serving_queue_depth",
+            threshold=float(max_queue_depth), op="<=", reduce="max",
+            target=target,
+            description="deepest replica admission queue"))
     return out
 
 

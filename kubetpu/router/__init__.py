@@ -1,0 +1,37 @@
+"""``kubetpu.router`` — the prefix-affinity data plane (Round-14).
+
+Three cooperating parts in front of N serving replicas:
+
+- :mod:`kubetpu.router.server` — ``RouterServer``, the HTTP request
+  router: consistent-hash on the tokenized prefix head
+  (:mod:`kubetpu.router.hashring`), load-based fallback from each
+  replica's ``/load`` snapshot, SLO-class admission (shed / queue while
+  the fast window burns);
+- :mod:`kubetpu.router.replica` / :mod:`kubetpu.router.pool` —
+  ``ReplicaServer`` (a slot server's wire surface: idempotent
+  ``POST /generate``, graceful drain) and ``ReplicaPool``
+  (registration, breaker health, snapshots, federation);
+- :mod:`kubetpu.router.autoscaler` — ``ReplicaAutoscaler``, the
+  reconcile loop scaling the replica set from the federated signals
+  with hysteresis and scale-down-only-after-drain.
+
+Deliberately light: stdlib + ``kubetpu.obs`` + ``kubetpu.wire`` only —
+importing the router NEVER imports jax (the router process holds no
+model state and routes for accelerator fleets it doesn't run on).
+"""
+
+from kubetpu.router.autoscaler import ReplicaAutoscaler, ScalePolicy
+from kubetpu.router.hashring import HashRing, prefix_head_key
+from kubetpu.router.pool import ReplicaPool
+from kubetpu.router.replica import ReplicaServer
+from kubetpu.router.server import RouterServer
+
+__all__ = [
+    "HashRing",
+    "ReplicaAutoscaler",
+    "ReplicaPool",
+    "ReplicaServer",
+    "RouterServer",
+    "ScalePolicy",
+    "prefix_head_key",
+]

@@ -1,0 +1,311 @@
+"""``ReplicaAutoscaler`` — SLO-driven replica scaling behind the router.
+
+The Round-11 signal layer computes the judgment (burn rates, federated
+percentiles, pool pressure); this loop ACTS on it. One reconcile pass
+(``poll_once``) reads the federated signals the ISSUE names — worst
+replica queue-wait p99 and TTFT p50, pool free-page fraction, the
+router's SLO fast-window burn — and folds them into a hot/cold verdict
+with HYSTERESIS:
+
+- **scale up** after ``up_after`` CONSECUTIVE hot passes (a single
+  slow scrape must not buy hardware): ``launcher()`` is called (the
+  operator's replica factory — boots a server, returns its URL) and
+  the newcomer registers with the router, earning its ring arcs (which
+  remaps only ~1/N prefix buckets — the hashring contract);
+- **scale down** only AFTER GRACEFUL DRAIN: ``down_after`` consecutive
+  cold passes pick the least-loaded routable victim and ask the pool
+  to drain it (routing stops immediately, in-flight requests finish).
+  Only when the victim's ``/load`` reads drained-and-idle is it
+  removed from the ring and handed to ``terminator`` — a scale-down
+  can never drop a live stream (ROADMAP's live-KV-migration item is
+  the future upgrade; drain-first is the safe spelling today);
+- **cooldown** after any action (``cooldown_s``) so a scale event's
+  own disruption (warmup, cache cold start) can't trigger the next.
+
+Every decision is an event (``scale_up`` -> ... -> ``drain`` ->
+``scale_down``) in the router's event log — the ordering the
+acceptance test pins — plus counters/gauges on the router registry.
+
+The loop runs wherever the operator wants: call ``poll_once()`` from
+your own scheduler, or ``start(interval)`` for the built-in daemon
+thread. Stdlib only; no model state, no device work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from kubetpu.router.pool import DEAD
+from kubetpu.router.server import RouterServer
+
+
+@dataclass(frozen=True)
+class ScalePolicy:
+    """The autoscaler's knobs. Thresholds compare against the WORST
+    replica (ceilings) / the fleet aggregate (floors) — one degraded
+    replica is a capacity problem even when the mean looks fine."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    up_after: int = 3            # consecutive hot passes before scale-up
+    down_after: int = 6          # consecutive cold passes before drain
+    cooldown_s: float = 10.0     # quiet time after any scale action
+    # hot when ANY of these trips (or the router's SLO fast window burns)
+    queue_wait_p99_ms: float = 500.0
+    ttft_p50_ms: float = 1000.0
+    min_free_page_frac: float = 0.1
+    queue_depth: int = 4         # fleet-total queued requests
+    # cold when ALL of: queues empty, occupancy under this, not burning
+    cold_active_frac: float = 0.25
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError("need 1 <= min_replicas <= max_replicas")
+        if self.up_after < 1 or self.down_after < 1:
+            raise ValueError("up_after/down_after must be >= 1")
+
+
+class ReplicaAutoscaler:
+    """Reconcile the replica count against the federated signals."""
+
+    def __init__(
+        self,
+        router: RouterServer,
+        launcher: Callable[[], str],
+        policy: ScalePolicy = ScalePolicy(),
+        terminator: Optional[Callable[[str, str], None]] = None,
+    ) -> None:
+        """*launcher*: boots one replica, returns its URL (raises on
+        failure — the pass records the error and retries next time).
+        *terminator*: called with (name, url) AFTER a drained victim is
+        removed, so the operator can reclaim the process/chips."""
+        self.router = router
+        self.launcher = launcher
+        self.terminator = terminator
+        self.policy = policy
+        self.events = router.events
+        self._lock = threading.Lock()
+        self._hot = 0
+        self._cold = 0
+        self._victim: Optional[str] = None     # name mid-drain
+        self._victim_url: Optional[str] = None
+        self._cooldown_until = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        reg = router.registry
+        self._c_ups = reg.counter(
+            "kubetpu_autoscaler_scale_ups_total")
+        self._c_downs = reg.counter(
+            "kubetpu_autoscaler_scale_downs_total")
+        self._c_errors = reg.counter(
+            "kubetpu_autoscaler_launch_errors_total")
+        self._g_last = reg.gauge(
+            "kubetpu_autoscaler_last_scale_ts",
+            "wall-clock time of the last completed scale action")
+        reg.gauge_fn("kubetpu_autoscaler_replicas",
+                     lambda: len(router.pool.names()))
+        reg.gauge_fn("kubetpu_autoscaler_hot_passes",
+                     lambda: self._hot)
+        reg.gauge_fn("kubetpu_autoscaler_cold_passes",
+                     lambda: self._cold)
+
+    # -- signals -------------------------------------------------------------
+
+    def signals(self) -> dict:
+        """The federated decision inputs, from the pool's ``/load``
+        snapshots + the router's SLO engine: worst-replica queue-wait
+        p99 and TTFT p50, fleet queue depth, occupancy, the tightest
+        pool free-page fraction, and the burn bit."""
+        loads = [self.router.pool.snapshot(n)
+                 for n in self.router.pool.routable()]
+        loads = [ld for ld in loads if ld]
+        out = {
+            # ALIVE capacity, not registrations: a dead handle must not
+            # hold the max_replicas gate shut while the fleet burns
+            "replicas": len(self.router.pool.alive()),
+            "routable": len(self.router.pool.routable()),
+            "burning": self.router._burning(),
+            "queue_depth": sum(int(ld.get("queue_depth", 0))
+                               for ld in loads),
+            "queue_wait_p99_ms": max(
+                (float(ld.get("queue_wait_p99_ms", 0.0)) for ld in loads),
+                default=0.0),
+            "ttft_p50_ms": max(
+                (float(ld.get("ttft_p50_ms", 0.0)) for ld in loads),
+                default=0.0),
+        }
+        active = sum(int(ld.get("active_slots", 0)) for ld in loads)
+        slots = sum(int(ld.get("n_slots", 0)) for ld in loads)
+        out["active_frac"] = (active / slots) if slots else 0.0
+        fracs = [int(ld["pages_free"]) / max(1, int(ld["pool_pages"]))
+                 for ld in loads
+                 if ld.get("pages_free") is not None
+                 and ld.get("pool_pages")]
+        out["free_page_frac"] = min(fracs) if fracs else 1.0
+        return out
+
+    def _hot_cold(self, sig: dict):
+        p = self.policy
+        hot = (sig["burning"]
+               or sig["queue_wait_p99_ms"] > p.queue_wait_p99_ms
+               or sig["ttft_p50_ms"] > p.ttft_p50_ms
+               or sig["free_page_frac"] < p.min_free_page_frac
+               or sig["queue_depth"] >= p.queue_depth)
+        cold = (not hot
+                and sig["queue_depth"] == 0
+                and sig["active_frac"] < p.cold_active_frac)
+        return hot, cold
+
+    # -- one reconcile pass --------------------------------------------------
+
+    def poll_once(self) -> dict:
+        """One reconcile pass: refresh signals, advance the hysteresis
+        counters, maybe act. Returns {signals, hot, cold, action} for
+        operators/tests."""
+        self.router.pool.refresh(0.0)
+        self.router.evaluate_slos(0.0)
+        with self._lock:
+            cur_victim = self._victim
+        # reap DEAD replicas (breaker-confirmed gone): their streams
+        # are lost either way, and a dead registration would otherwise
+        # pin ring arcs and the max_replicas gate forever. The current
+        # drain victim is left for _finish_scale_down, which owns its
+        # scale_down event and terminator call.
+        for name in self.router.pool.names():
+            if name != cur_victim and self.router.pool.state(name) == DEAD:
+                self.router.remove_replica(name)
+                self.events.emit("reap", replica=name)
+        sig = self.signals()
+        hot, cold = self._hot_cold(sig)
+        p = self.policy
+        now = time.monotonic()
+        with self._lock:
+            self._hot = self._hot + 1 if hot else 0
+            self._cold = self._cold + 1 if cold else 0
+            hot_n, cold_n = self._hot, self._cold
+            victim = self._victim
+            in_cooldown = now < self._cooldown_until
+        action = None
+        if victim is not None:
+            # a drain in flight FINISHES regardless of temperature: the
+            # victim is already cordoned, leaving it half-drained helps
+            # no one. (A fleet gone hot mid-drain scales back up next
+            # pass — the counters keep counting.)
+            action = self._finish_scale_down(victim)
+        elif sig["replicas"] < p.min_replicas:
+            # FLOOR healing, before cooldown and without hysteresis: a
+            # reaped/crashed fleet below min_replicas produces no hot
+            # signals (no traffic -> no latency samples, SLIs absent),
+            # so waiting for heat would leave "no routable replica"
+            # outages standing forever. A failed launch counts an error
+            # and retries next pass.
+            action = self._scale_up(sig)
+        elif in_cooldown:
+            pass
+        elif (hot_n >= p.up_after
+                and sig["replicas"] < p.max_replicas):
+            action = self._scale_up(sig)
+        elif (cold_n >= p.down_after
+                and sig["routable"] > p.min_replicas):
+            action = self._begin_scale_down(sig)
+        return {"signals": sig, "hot": hot, "cold": cold,
+                "action": action}
+
+    def _scale_up(self, sig: dict) -> Optional[str]:
+        try:
+            url = self.launcher()
+            name = self.router.register_replica(url)
+        except Exception as e:  # noqa: BLE001 — record, retry next pass
+            self._c_errors.inc()
+            self.events.emit("scale_error", error=str(e))
+            return None
+        self._c_ups.inc()
+        self._g_last.set(time.time())
+        self.events.emit("scale_up", replica=name, url=url,
+                         replicas=sig["replicas"] + 1,
+                         reason=self._reason(sig))
+        with self._lock:
+            self._hot = 0
+            self._cooldown_until = time.monotonic() + self.policy.cooldown_s
+        return f"scale_up:{name}"
+
+    def _begin_scale_down(self, sig: dict) -> Optional[str]:
+        # least-loaded routable victim: fewest active slots, then
+        # shallowest queue — the cheapest drain
+        names = self.router.pool.routable()
+        if len(names) <= self.policy.min_replicas:
+            return None
+
+        def load_key(n):
+            ld = self.router.pool.snapshot(n) or {}
+            return (int(ld.get("active_slots", 0)),
+                    int(ld.get("queue_depth", 0)), n)
+
+        victim = min(names, key=load_key)
+        url = self.router.pool.url(victim)
+        self.router.pool.drain(victim)
+        self.events.emit("drain", replica=victim, reason="scale_down")
+        with self._lock:
+            self._cold = 0
+            self._victim = victim
+            self._victim_url = url
+        return f"drain:{victim}"
+
+    def _finish_scale_down(self, victim: str) -> Optional[str]:
+        if not self.router.pool.drained(victim):
+            return None            # still finishing in-flight work
+        with self._lock:
+            url = self._victim_url
+            self._victim = None
+            self._victim_url = None
+        self.router.remove_replica(victim)
+        self._c_downs.inc()
+        self._g_last.set(time.time())
+        self.events.emit("scale_down", replica=victim,
+                         replicas=len(self.router.pool.names()))
+        if self.terminator is not None and url is not None:
+            try:
+                self.terminator(victim, url)
+            except Exception as e:  # noqa: BLE001 — reclaim best-effort
+                self.events.emit("scale_error", error=str(e))
+        with self._lock:
+            self._cooldown_until = time.monotonic() + self.policy.cooldown_s
+        return f"scale_down:{victim}"
+
+    @staticmethod
+    def _reason(sig: dict) -> str:
+        if sig["burning"]:
+            return "slo_burn"
+        if sig["queue_depth"]:
+            return "queue_depth"
+        if sig["free_page_frac"] < 1.0:
+            return "pool_pressure"
+        return "latency"
+
+    # -- daemon loop ---------------------------------------------------------
+
+    def start(self, interval: float = 1.0) -> None:
+        """Run ``poll_once`` every *interval* seconds on a daemon
+        thread until ``shutdown``."""
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(interval):
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001 — the loop survives
+                    self._c_errors.inc()
+                    self.events.emit("scale_error", error=str(e))
+
+        self._thread = threading.Thread(
+            target=run, name="kubetpu-autoscaler", daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

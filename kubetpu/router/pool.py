@@ -1,0 +1,359 @@
+"""``ReplicaPool`` — the router's replica registry, health breaker and
+load snapshot cache.
+
+The control plane already knows how to keep a fleet honest (Round-7:
+circuit-breaker node health, graceful drain); this module applies the
+same discipline to serving replicas behind the data plane:
+
+- **registration**: ``add(url)`` probes ``/healthz`` to learn the
+  replica's name (idempotent at the same URL — re-registering is a
+  no-op), ``remove(name)`` forgets it;
+- **breaker health**: every ``refresh()`` probes ``/load``; the states
+  and transitions mirror the controller's breaker
+  (healthy -> suspect -> probation -> dead): ``suspect_after``
+  consecutive misses cordons the replica out of routing WITHOUT
+  forgetting it (a transient blackout costs zero remaps — ring
+  membership only changes on register/remove), ``dead_after`` misses
+  marks it dead, a success moves suspect to probation and
+  ``probation_passes`` consecutive successes restore healthy. Every
+  transition lands in the event log;
+- **load snapshots**: the ``/load`` body (queue depth, active slots,
+  pool free pages, prefix hit rate — ``SlotServerBase.load_info``) is
+  cached per replica; ``refresh(min_interval)`` is throttled so the
+  per-request routing path reads a fresh-enough snapshot without
+  scraping per request. Scrapes run CONCURRENTLY (the controller's
+  federation shape): N dark replicas cost one timeout, not N;
+- **drain tracking**: ``drain(name)`` POSTs the replica's ``/drain``
+  (idempotency-keyed) and marks the handle; ``drained(name)`` reads
+  the last snapshot — draining AND idle — which is the autoscaler's
+  scale-down-only-after-drain gate;
+- **federation**: ``federate_text(own)`` merges every replica's
+  ``/metrics`` into one exposition (series relabeled
+  ``replica="<name>"``) and ``trace(id)`` stitches replica trace legs
+  — the router's ``/metrics`` and ``/trace/<id>`` surfaces.
+
+All scrapes ride the shared retrying client (``request_text`` /
+``request_json`` — KTP002), ``NO_RETRY`` for probes (a missed probe is
+breaker evidence, not an outage worth backoff).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from kubetpu.obs.events import EventLog
+from kubetpu.obs.registry import Registry, federate
+from kubetpu.wire.httpcommon import NO_RETRY, request_json, request_text
+
+# breaker states — the controller's strings (wire.controller), repeated
+# here so the router package never imports the control plane
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+PROBATION = "probation"
+DEAD = "dead"
+
+
+class ReplicaHandle:
+    """One replica's registration + breaker + last load snapshot."""
+
+    def __init__(self, name: str, url: str) -> None:
+        self.name = name
+        self.url = url.rstrip("/")
+        self.state = HEALTHY
+        self.misses = 0
+        self.passes = 0
+        self.draining = False
+        self.load: Optional[dict] = None
+        self.last_seen = 0.0
+
+    def routable(self) -> bool:
+        return self.state in (HEALTHY, PROBATION) and not self.draining
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "url": self.url,
+            "state": self.state,
+            "draining": self.draining,
+            "load": self.load,
+        }
+
+
+class ReplicaPool:
+    """Thread-safe replica registry + breaker + snapshot cache."""
+
+    def __init__(
+        self,
+        token: Optional[str] = None,
+        suspect_after: int = 2,
+        dead_after: int = 5,
+        probation_passes: int = 2,
+        scrape_timeout: float = 2.0,
+        registry: Optional[Registry] = None,
+        events: Optional[EventLog] = None,
+    ) -> None:
+        if not 1 <= suspect_after <= dead_after:
+            raise ValueError("need 1 <= suspect_after <= dead_after")
+        self.token = token
+        self.suspect_after = suspect_after
+        self.dead_after = dead_after
+        self.probation_passes = probation_passes
+        self.scrape_timeout = scrape_timeout
+        self.registry = registry if registry is not None else Registry()
+        self.events = events if events is not None else EventLog(
+            component="router")
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaHandle] = {}
+        self._last_refresh = 0.0
+        for state in (HEALTHY, SUSPECT, PROBATION, DEAD):
+            # state ranges over the fixed literal tuple above (KTP004's
+            # bounded proof); closure binds the loop variable by default
+            self.registry.gauge_fn(
+                "kubetpu_router_replicas",
+                lambda s=state: self._count_state(s), state=state)
+
+    def _count_state(self, state: str) -> int:
+        with self._lock:
+            return sum(1 for h in self._replicas.values()
+                       if h.state == state)
+
+    # -- membership ----------------------------------------------------------
+
+    def add(self, url: str, name: Optional[str] = None) -> str:
+        """Register a replica by URL; probes ``/healthz`` for its name
+        unless given. Idempotent: the same URL re-registers as the same
+        handle (breaker state kept). A DIFFERENT url under an existing
+        name is refused — silently swapping the handle would orphan the
+        first replica (running, unobserved, undrained) and repoint its
+        ring arcs; remove the old one first."""
+        url = url.rstrip("/")
+        if name is None:
+            body = request_json(url + "/healthz",
+                                timeout=self.scrape_timeout)
+            name = body.get("replica") or url
+        with self._lock:
+            existing = self._replicas.get(name)
+            if existing is not None:
+                if existing.url == url:
+                    return name
+                raise ValueError(
+                    f"replica name {name!r} is already registered at "
+                    f"{existing.url}; remove it before registering "
+                    f"{url}")
+            self._replicas[name] = ReplicaHandle(name, url)
+        self.events.emit("replica_register", replica=name, url=url)
+        return name
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            gone = self._replicas.pop(name, None)
+        if gone is not None:
+            self.events.emit("replica_remove", replica=name)
+        return gone is not None
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def routable(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, h in self._replicas.items()
+                          if h.routable())
+
+    def url(self, name: str) -> Optional[str]:
+        with self._lock:
+            h = self._replicas.get(name)
+            return h.url if h is not None else None
+
+    def snapshot(self, name: str) -> Optional[dict]:
+        """The last ``/load`` body for *name* (None before the first
+        successful refresh)."""
+        with self._lock:
+            h = self._replicas.get(name)
+            return dict(h.load) if h is not None and h.load else None
+
+    def to_json(self) -> List[dict]:
+        with self._lock:
+            return [h.to_json() for _n, h in sorted(self._replicas.items())]
+
+    # -- health + load refresh -----------------------------------------------
+
+    def refresh(self, min_interval: float = 0.0) -> bool:
+        """Scrape every replica's ``/load`` (concurrently), update
+        snapshots and breaker states. Throttled: returns False without
+        scraping when the last refresh is younger than *min_interval*
+        (the per-request routing path passes its staleness budget; the
+        autoscaler passes 0 for a fresh view)."""
+        with self._lock:
+            now = time.monotonic()
+            if min_interval > 0 and now - self._last_refresh < min_interval:
+                return False
+            self._last_refresh = now
+            targets = [(h.name, h.url) for h in self._replicas.values()]
+        if not targets:
+            return True
+
+        def scrape(item):
+            name, url = item
+            try:
+                return name, request_json(
+                    url + "/load", token=self.token,
+                    timeout=self.scrape_timeout, retry=NO_RETRY)
+            except Exception:  # noqa: BLE001 — a miss is breaker evidence
+                return name, None
+
+        with ThreadPoolExecutor(max_workers=min(16, len(targets))) as pool:
+            results = list(pool.map(scrape, sorted(targets)))
+        for name, load in results:
+            if load is None:
+                self._record_miss(name)
+            else:
+                self._record_ok(name, load)
+        return True
+
+    def _record_miss(self, name: str) -> None:
+        with self._lock:
+            h = self._replicas.get(name)
+            if h is None or h.state == DEAD:
+                return
+            h.misses += 1
+            h.passes = 0
+            misses, transition = h.misses, None
+            if h.misses >= self.dead_after:
+                h.state, transition = DEAD, "replica_dead"
+            elif h.state != SUSPECT and h.misses >= self.suspect_after:
+                h.state, transition = SUSPECT, "replica_suspect"
+        if transition:
+            self.events.emit(transition, replica=name, misses=misses)
+
+    def _record_ok(self, name: str, load: dict) -> None:
+        with self._lock:
+            h = self._replicas.get(name)
+            if h is None:
+                return
+            h.load = dict(load)
+            # the LOCAL cordon is sticky: pool.drain() promises the
+            # router stops routing even when the /drain POST was lost,
+            # so a replica still reporting draining=False must not
+            # un-cordon the handle (replicas have no un-drain path —
+            # only remove/re-add resets it)
+            h.draining = h.draining or bool(load.get("draining"))
+            h.last_seen = time.time()
+            h.misses = 0
+            transition = None
+            if h.state in (DEAD, SUSPECT):
+                # a dead/suspect replica answering again re-earns
+                # routing the slow way, like the controller's breaker:
+                # through probation, never straight to healthy
+                h.state, h.passes = PROBATION, 1
+                transition = "replica_probation"
+            elif h.state == PROBATION:
+                h.passes += 1
+                if h.passes >= self.probation_passes:
+                    h.state, transition = HEALTHY, "replica_recovered"
+        if transition:
+            self.events.emit(transition, replica=name)
+
+    # -- drain ---------------------------------------------------------------
+
+    def drain(self, name: str) -> bool:
+        """Ask *name* to drain (idempotency-keyed POST) and stop routing
+        to it. Returns False for unknown replicas; a failed POST still
+        cordons the handle (the router stops sending work either way —
+        the replica-side refusal is belt on top of braces)."""
+        with self._lock:
+            h = self._replicas.get(name)
+            if h is None:
+                return False
+            h.draining = True
+            url = h.url
+        try:
+            request_json(url + "/drain", {}, token=self.token,
+                         timeout=self.scrape_timeout,
+                         idempotency_key=f"router-drain-{uuid.uuid4().hex}")
+        except Exception:  # noqa: BLE001 — cordon held locally regardless
+            pass
+        return True
+
+    def drained(self, name: str) -> bool:
+        """True once the replica's LAST snapshot shows it draining and
+        idle — no active slots, nothing queued, no in-flight prefills.
+        The autoscaler's remove gate: scale-down completes only here.
+        A DEAD victim counts as drained: its streams are already gone,
+        and waiting on a snapshot a dead replica can never refresh
+        would wedge the scale-down forever."""
+        with self._lock:
+            h = self._replicas.get(name)
+            if h is None:
+                return True          # already gone
+            if h.state == DEAD:
+                return True
+            load = h.load
+            if not h.draining or load is None:
+                return False
+        return (int(load.get("active_slots", 1)) == 0
+                and int(load.get("queue_depth", 1)) == 0
+                and int(load.get("inflight_prefills", 0)) == 0
+                and bool(load.get("draining")))
+
+    def alive(self) -> List[str]:
+        """Names whose breaker state is not DEAD — what capacity
+        decisions (the autoscaler's max_replicas gate) count; a dead
+        handle is evidence, not capacity."""
+        with self._lock:
+            return sorted(n for n, h in self._replicas.items()
+                          if h.state != DEAD)
+
+    def state(self, name: str) -> Optional[str]:
+        with self._lock:
+            h = self._replicas.get(name)
+            return h.state if h is not None else None
+
+    # -- federation ----------------------------------------------------------
+
+    def federate_text(self, own: str) -> str:
+        """*own* exposition merged with every replica's ``/metrics``,
+        replica series relabeled ``replica="<name>"``. Failures skip
+        that replica and count — federation degrades, never 500s."""
+        with self._lock:
+            targets = [(h.name, h.url) for h in self._replicas.values()]
+        scraped: Dict[str, str] = {}
+
+        def scrape(item):
+            name, url = item
+            try:
+                return name, request_text(
+                    url + "/metrics", token=self.token,
+                    timeout=self.scrape_timeout, retry=NO_RETRY)
+            except Exception:  # noqa: BLE001 — degrade per replica
+                self.registry.counter(
+                    "kubetpu_router_federation_scrape_errors_total").inc()
+                return name, None
+
+        if targets:
+            with ThreadPoolExecutor(
+                    max_workers=min(16, len(targets))) as pool:
+                for name, text in pool.map(scrape, sorted(targets)):
+                    if text is not None:
+                        scraped[name] = text
+        return federate(own, scraped, label="replica")
+
+    def trace(self, trace_id: str, spans: Dict[str, dict]) -> None:
+        """Merge every replica's ``/trace/<id>`` leg into *spans*
+        (span_id-keyed, first writer wins — in-process fleets share the
+        tracer, cross-process ones don't)."""
+        with self._lock:
+            targets = [(h.name, h.url) for h in self._replicas.values()]
+        for _name, url in sorted(targets):
+            try:
+                body = request_json(
+                    f"{url}/trace/{trace_id}", token=self.token,
+                    timeout=self.scrape_timeout, retry=NO_RETRY)
+                for s in body.get("spans", []):
+                    spans.setdefault(s["span_id"], s)
+            except Exception:  # noqa: BLE001 — a dark replica loses its
+                pass           # leg, not the whole trace

@@ -1,0 +1,355 @@
+"""``ReplicaServer`` — one serving replica's wire surface for the data
+plane.
+
+The slot servers (``DecodeServer`` / ``PagedDecodeServer`` and friends)
+are in-process objects; ``obs.exporter.MetricsServer`` gave them a
+read-only scrape surface, but nothing could *send them work* over the
+wire. This server is that missing half — the leg the affinity router
+(``kubetpu.router.server``) POSTs to:
+
+    GET  /healthz    -> {"ok": true, "replica": <name>,
+                         "draining": <bool>}  (open, liveness)
+    GET  /load       -> ``server.load_info()`` + draining flag: the
+                        CHEAP routing signal (queue depth, active
+                        slots, pool free pages, prefix-cache hit rate)
+                        the router polls instead of parsing /metrics
+    GET  /metrics    -> Prometheus text of the serving registry
+                        (latency summaries, pool gauges, prefix
+                        counters, and this server's replica counters)
+    GET  /slo        -> the replica's declared-SLO verdicts (JSON)
+    GET  /events     -> replica + serving event logs, merged JSONL
+    GET  /trace/<id> -> finished spans of one trace (the replica leg of
+                        a stitched router trace)
+    POST /generate   -> {"prompt": [ids], "sampling": {...}?,
+                        "timeout": s?} -> {"rid", "tokens", "emitted"}
+                        — synchronous generate: enqueue, wait for the
+                        step loop to finish the request, return
+                        prompt + emitted tokens
+    POST /drain      -> stop accepting generates (503); in-flight
+                        requests run to completion
+
+Robustness (the Round-7 contract, uniformly):
+
+- **idempotent generate**: a ``Idempotency-Key``-carrying POST is
+  deduped through a bounded replay window (``run_idempotent``). A
+  router retry whose first response was truncated mid-write gets the
+  committed tokens REPLAYED — never a second admission, so a lost
+  response can never double-allocate slots/pool pages (pinned by
+  ``make router-check`` under injected partial faults);
+- **graceful drain**: ``drain()`` refuses NEW generates with 503 while
+  requests already admitted (or waiting on the handler) complete —
+  the autoscaler's scale-down path depends on this (drain first,
+  remove only once ``/load`` reads idle);
+- **fault injection**: ``faults=FaultInjector(...)`` chaos-tests the
+  surface like every other wire server.
+
+Threading: the slot servers are NOT thread-safe, so one condition
+variable serializes everything that touches the serving object — the
+background step loop (``_poll_loop``: step while work exists, sleep
+while idle) and the handler-side enqueue/result reads. Handlers block
+on the condition between polls, so a finishing request wakes its waiter
+within one step. This is the honest single-replica spelling: the
+serving hot loop already runs one step at a time; the lock adds a
+handler's enqueue (host-side bookkeeping, microseconds) to that serial
+order, never a device wait.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kubetpu.api import utils
+from kubetpu.obs import trace as obs_trace
+from kubetpu.obs.events import EventLog, merge_events
+from kubetpu.wire.httpcommon import (
+    IdempotencyCache,
+    InflightTracker,
+    check_bearer,
+    handle_guarded,
+    run_idempotent,
+    serve_events_jsonl,
+    write_json,
+    write_text,
+)
+
+DEFAULT_GENERATE_TIMEOUT = 30.0
+
+
+class ReplicaServer:
+    """Serve one slot server (``SlotServerBase`` contract) to the
+    router data plane."""
+
+    def __init__(
+        self,
+        server,
+        name: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: "str | None" = None,
+        faults=None,
+        idem_window: float = 300.0,
+        idle_wait: float = 0.005,
+    ) -> None:
+        """*server*: the serving object (enqueue/step/finished/
+        pop_result/load_info — ``SlotServerBase`` and every subclass).
+        *idle_wait*: step-loop sleep while the server is idle (bounds
+        enqueue-to-first-step latency when work arrives)."""
+        self.server = server
+        self.name = name
+        self.token = token or None
+        self.faults = faults
+        self.idem = IdempotencyCache(ttl=idem_window)
+        self.obs_component = f"replica:{name}"
+        self.events = EventLog(component=self.obs_component)
+        self.draining = False
+        self._inflight = InflightTracker()
+        self._cv = threading.Condition()
+        self._running = False
+        self._idle_wait = float(idle_wait)
+        # replica wire counters land on the SERVING registry so one
+        # /metrics scrape carries both (the router federates it whole)
+        for key in ("requests", "replays", "errors"):
+            # key ranges over the fixed literal tuple above — KTP004's
+            # bounded-f-string proof expands and validates every name
+            self.server.obs.counter(f"kubetpu_replica_generate_{key}_total")
+        replica = self
+
+        def bump(key: str) -> None:
+            # callers pass literals from the pre-registered set above
+            # ktlint: disable=KTP004
+            replica.server.obs.counter(
+                f"kubetpu_replica_generate_{key}_total").inc()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
+                utils.logf(5, "replica %s: " + fmt, replica.name, *args)
+
+            def _authorized(self) -> bool:
+                if check_bearer(self.headers, replica.token):
+                    return True
+                write_json(self, 401,
+                           {"error": "missing or invalid bearer token"})
+                return False
+
+            def do_GET(self):  # noqa: N802
+                handle_guarded(replica, self, self._do_get)
+
+            def _do_get(self):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    write_json(self, 200, {
+                        "ok": True,
+                        "replica": replica.name,
+                        "draining": replica.draining,
+                    })
+                elif not self._authorized():
+                    pass  # 401 already sent
+                elif path == "/load":
+                    write_json(self, 200, replica.load())
+                elif path == "/metrics":
+                    write_text(self, 200, replica.server.metrics_text())
+                elif path == "/slo":
+                    slo = getattr(replica.server, "slo", None)
+                    write_json(self, 200, {
+                        "replica": replica.name,
+                        "results": slo.results() if slo is not None else {},
+                    })
+                elif path == "/events":
+                    serve_events_jsonl(self, replica.render_events)
+                elif path.startswith("/trace/"):
+                    tid = path[len("/trace/"):]
+                    write_json(self, 200, {
+                        "trace": tid,
+                        "spans": obs_trace.tracer().spans(tid),
+                    })
+                else:
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):  # noqa: N802
+                handle_guarded(replica, self, self._do_post)
+
+            def _do_post(self):
+                if not self._authorized():
+                    return
+                if self.path == "/drain":
+                    replica.drain()
+                    write_json(self, 200, {"draining": True})
+                    return
+                if self.path != "/generate":
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError:
+                    write_json(self, 400, {"error": "body is not JSON"})
+                    return
+
+                def replayed():
+                    bump("replays")
+                    replica.events.emit("generate_replay")
+
+                run_idempotent(
+                    self, replica.idem,
+                    self.headers.get("Idempotency-Key"),
+                    lambda: replica._generate(req),
+                    on_replay=replayed,
+                )
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+        self._loop_thread: Optional[threading.Thread] = None
+
+    # -- the generate leg ----------------------------------------------------
+
+    def _generate(self, req: dict):
+        """One generate execution -> (code, obj); runs on the handler
+        thread under ``run_idempotent`` (200 commits into the replay
+        window, anything else aborts so a retry re-executes). The
+        draining refusal lives HERE, after the replay lookup: a keyed
+        retry of an already-committed generate must get its replay even
+        mid-drain (replaying mutates nothing)."""
+        deadline = time.monotonic() + float(
+            req.get("timeout") or DEFAULT_GENERATE_TIMEOUT)
+        prompt = req.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            return 400, {"error": "prompt must be a non-empty list of "
+                                  "token ids"}
+        with self._cv:
+            if self.draining:
+                return 503, {"error": "replica is draining"}
+            if not self._running:
+                return 503, {"error": "replica step loop is not running"}
+            self.events.emit("generate", prompt_tokens=len(prompt))
+            try:
+                rid = self.server.enqueue(prompt,
+                                          sampling=req.get("sampling"))
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            except Exception as e:  # noqa: BLE001 — report, stay up
+                self.server.obs.counter(
+                    "kubetpu_replica_generate_errors_total").inc()
+                return 500, {"error": str(e)}
+            self.server.obs.counter(
+                "kubetpu_replica_generate_requests_total").inc()
+            self._cv.notify_all()
+            while not self.server.finished(rid):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._running:
+                    self.server.cancel(rid)
+                    if self.server.finished(rid):
+                        self.server.pop_result(rid)
+                    return 503, {"error": "generate deadline exceeded"
+                                 if self._running else "replica stopping"}
+                self._cv.wait(timeout=min(remaining, 0.25))
+            reason = self.server.expire_reason(rid)
+            tokens = self.server.pop_result(rid)
+        if reason is not None:
+            return 503, {"error": f"request expired: {reason}"}
+        return 200, {
+            "rid": rid,
+            "replica": self.name,
+            "tokens": tokens,
+            "emitted": tokens[len(prompt):],
+        }
+
+    # -- observability -------------------------------------------------------
+
+    def load(self) -> dict:
+        """The routing-signal snapshot: ``server.load_info()`` (host
+        counters only — no device sync, no reservoir sort beyond the
+        bounded percentile reads) plus this wire layer's flags."""
+        info = dict(self.server.load_info())
+        info["replica"] = self.name
+        info["draining"] = self.draining
+        return info
+
+    def render_events(self, kind: Optional[str] = None,
+                      limit: Optional[int] = None) -> str:
+        evs = merge_events({
+            self.obs_component: self.events,
+            "serving": self.server.events,
+        }, limit=None)
+        if kind is not None:
+            evs = [e for e in evs if e.get("kind") == kind]
+        if limit is not None and limit >= 0:
+            evs = evs[-limit:] if limit else []
+        return "".join(json.dumps(e) + "\n" for e in evs)
+
+    # -- step loop -----------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        """Drive the serving object: step while any request is in
+        flight, sleep (bounded) while idle. Every touch of the serving
+        object happens under the condition — the handlers' enqueue and
+        result reads interleave between steps, never during one."""
+        while True:
+            with self._cv:
+                if not self._running:
+                    return
+                if self.server._idle():
+                    self._cv.wait(timeout=self._idle_wait)
+                    continue
+                self.server.step()
+                self._cv.notify_all()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> str:
+        """Serve + start the step loop (both daemon threads); returns
+        the bound address."""
+        with self._cv:
+            self._running = True
+        self._loop_thread = threading.Thread(
+            target=self._poll_loop, name=f"kubetpu-replica-{self.name}",
+            daemon=True)
+        self._loop_thread.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"kubetpu-replica-http-{self.name}", daemon=True)
+        self._thread.start()
+        return self.address
+
+    def drain(self) -> None:
+        """Refuse NEW generates (503); admitted and handler-waiting
+        requests run to completion — the step loop keeps stepping until
+        the server goes idle."""
+        with self._cv:
+            if not self.draining:
+                self.events.emit("drain", replica=self.name)
+            self.draining = True
+            self._cv.notify_all()
+
+    def shutdown(self, graceful: bool = True, timeout: float = 10.0) -> None:
+        """Stop the server. ``graceful`` drains, waits (bounded) for the
+        serving object to go idle and for in-flight HTTP requests to
+        finish; False simulates abrupt death (chaos tests)."""
+        if graceful:
+            self.drain()
+            deadline = time.monotonic() + timeout
+            with self._cv:
+                while (not self.server._idle()
+                       and time.monotonic() < deadline):
+                    self._cv.wait(timeout=0.05)
+            self._inflight.wait_idle(timeout)
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5.0)
+            self._loop_thread = None
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

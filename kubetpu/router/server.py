@@ -1,0 +1,555 @@
+"""``RouterServer`` — the prefix-affinity data plane in front of N
+serving replicas.
+
+ROADMAP's millions-of-users story: every ``PagedDecodeServer`` replica
+has a Round-9 radix tree, but without a router each replica gets
+per-replica cache luck and load that ignores capacity. This server
+makes cluster-wide decisions per request:
+
+1. **affinity routing**: the tokenized prefix HEAD (first
+   ``head_tokens`` ids) consistent-hashes onto the replica ring
+   (``hashring``), so requests sharing a system prompt / few-shot
+   preamble land where that prefix's KV pages are already warm —
+   cluster-wide hit rate instead of luck. ``policy="random"`` is the
+   seeded baseline the router storm benches against;
+2. **load fallback**: the affinity target is skipped when its last
+   ``/load`` snapshot reads overloaded (queue depth at/over
+   ``overload_queue_depth``, or paged free pages under
+   ``min_free_pages``) — the walk continues down the key's
+   deterministic preference order, ending at the least-queued routable
+   replica when everyone is busy. Snapshots come from the pool's
+   throttled concurrent refresh, never a per-request scrape;
+3. **SLO-class admission**: with objectives declared
+   (``obs.slo.router_slos`` over the router's FEDERATED /metrics —
+   worst-replica percentiles, exactly what the controller does), a
+   burning fast window sheds ``shed_classes`` requests (503, counted)
+   and parks ``queue_classes`` requests (bounded wait for the burn to
+   clear, then 503) while interactive traffic keeps flowing.
+
+Surfaces::
+
+    POST   /generate         {"prompt": [ids], "slo_class"?, "sampling"?,
+                              "timeout"?} -> routed reply + "replica"
+    POST   /replicas         {"url": ...} -> register (idempotent by URL)
+    DELETE /replicas/<name>  forget a replica (drain first — see
+                              ``ReplicaAutoscaler`` for the safe order)
+    GET    /replicas         pool listing (state, draining, last load)
+    GET    /healthz /metrics /slo /events /trace/<id>
+
+``/metrics`` federates every replica's exposition under
+``replica="<name>"``; ``/trace/<id>`` stitches the router span with the
+replica legs, so one generate renders router -> replica -> serving in
+``kubetpu.cli.obs --trace``.
+
+Robustness is the uniform Round-7 contract: the router -> replica leg is
+a keyed ``request_json`` POST (retries can never double-admit — the
+replica replays its committed tokens), the router's own ``/generate``
+honors client ``Idempotency-Key`` headers through the same
+``run_idempotent`` dance, and ``faults=`` injects chaos on the router
+surface itself. The router holds NO model state — it can restart (or
+run replicated) with zero warmup; two routers agree on every routing
+key because the ring is seedless ``hashlib``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from kubetpu.api import utils
+from kubetpu.core.metrics import LatencyRecorder
+from kubetpu.obs import trace as obs_trace
+from kubetpu.obs.events import EventLog
+from kubetpu.obs.registry import Registry, install_process_gauges
+from kubetpu.obs.slo import Objective, SloEngine
+from kubetpu.router.hashring import DEFAULT_HEAD_QUANTUM, \
+    DEFAULT_HEAD_TOKENS, HashRing, prefix_head_key
+from kubetpu.router.pool import ReplicaPool
+from kubetpu.wire.httpcommon import (
+    IdempotencyCache,
+    InflightTracker,
+    TRANSIENT_ERRORS,
+    check_bearer,
+    handle_guarded,
+    request_json,
+    run_idempotent,
+    serve_events_jsonl,
+    write_json,
+    write_text,
+)
+
+DEFAULT_ROUTE_TIMEOUT = 30.0
+
+
+class RouterServer:
+    """Prefix-affinity request router + replica pool owner."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: "str | None" = None,
+        faults=None,
+        policy: str = "affinity",
+        head_tokens: int = DEFAULT_HEAD_TOKENS,
+        head_quantum: int = DEFAULT_HEAD_QUANTUM,
+        vnodes: int = 64,
+        overload_queue_depth: int = 4,
+        min_free_pages: int = 0,
+        load_refresh_s: float = 0.25,
+        slos: Optional[List[Objective]] = None,
+        slo_interval_s: float = 0.5,
+        shed_classes: Tuple[str, ...] = ("batch",),
+        queue_classes: Tuple[str, ...] = ("standard",),
+        queue_timeout_s: float = 2.0,
+        idem_window: float = 300.0,
+        suspect_after: int = 2,
+        dead_after: int = 5,
+        probation_passes: int = 2,
+        seed: int = 0,
+        **engine_kw,
+    ) -> None:
+        if policy not in ("affinity", "random"):
+            raise ValueError("policy must be 'affinity' or 'random'")
+        self.policy = policy
+        self.token = token or None
+        self.faults = faults
+        self.head_tokens = int(head_tokens)
+        self.head_quantum = int(head_quantum)
+        self.overload_queue_depth = int(overload_queue_depth)
+        self.min_free_pages = int(min_free_pages)
+        self.load_refresh_s = float(load_refresh_s)
+        self.shed_classes = tuple(shed_classes)
+        self.queue_classes = tuple(queue_classes)
+        self.queue_timeout_s = float(queue_timeout_s)
+        self.obs_component = "router"
+        self.registry = Registry()
+        install_process_gauges(self.registry, "router")
+        self.events = EventLog(component="router")
+        self.pool = ReplicaPool(
+            token=token, suspect_after=suspect_after, dead_after=dead_after,
+            probation_passes=probation_passes, registry=self.registry,
+            events=self.events)
+        self.ring = HashRing(vnodes=vnodes)
+        self.idem = IdempotencyCache(ttl=idem_window)
+        self._inflight = InflightTracker()
+        self._lock = threading.Lock()       # ring membership + throttles
+        self._rng = random.Random(seed)     # the "random" baseline policy
+        self._last_slo_eval = 0.0
+        self._metrics = LatencyRecorder(
+            registry=self.registry, metric="kubetpu_router_latency_seconds")
+        self._c_routed = self.registry.counter(
+            "kubetpu_router_requests_total", outcome="routed")
+        self._c_shed = self.registry.counter(
+            "kubetpu_router_requests_total", outcome="shed")
+        self._c_qtimeout = self.registry.counter(
+            "kubetpu_router_requests_total", outcome="queue_timeout")
+        self._c_norep = self.registry.counter(
+            "kubetpu_router_requests_total", outcome="no_replica")
+        self._c_uperr = self.registry.counter(
+            "kubetpu_router_requests_total", outcome="upstream_error")
+        self._c_fallback = self.registry.counter(
+            "kubetpu_router_fallback_total",
+            "requests whose affinity target was skipped for load/health")
+        self._c_queued = self.registry.counter(
+            "kubetpu_router_queued_total",
+            "requests parked by SLO-class admission while burning")
+        self.registry.gauge_fn("kubetpu_router_burning",
+                               lambda: 1.0 if self._burning() else 0.0)
+        # SLO engine over the FEDERATED scrape (worst replica judged) —
+        # evaluated on the background signals loop (throttled to
+        # slo_interval_s) and per autoscaler pass; handlers only read
+        self.slo: Optional[SloEngine] = (
+            SloEngine(slos, registry=self.registry, **engine_kw)
+            if slos else None)
+        self._slo_interval = float(slo_interval_s)
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
+                utils.logf(5, "router: " + fmt, *args)
+
+            def _authorized(self) -> bool:
+                if check_bearer(self.headers, router.token):
+                    return True
+                write_json(self, 401,
+                           {"error": "missing or invalid bearer token"})
+                return False
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_GET(self):  # noqa: N802
+                handle_guarded(router, self, self._do_get)
+
+            def _do_get(self):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    write_json(self, 200, {
+                        "ok": True,
+                        "component": "router",
+                        "replicas": len(router.pool.names()),
+                    })
+                elif not self._authorized():
+                    pass  # 401 already sent
+                elif path == "/metrics":
+                    write_text(self, 200, router.metrics_text())
+                elif path == "/slo":
+                    write_json(self, 200, {
+                        "results": (router.slo.results()
+                                    if router.slo is not None else {}),
+                        "burning": router._burning(),
+                    })
+                elif path == "/events":
+                    serve_events_jsonl(self, router.events.to_jsonl)
+                elif path == "/replicas":
+                    write_json(self, 200,
+                               {"replicas": router.pool.to_json()})
+                elif path.startswith("/trace/"):
+                    write_json(self, 200,
+                               router.trace(path[len("/trace/"):]))
+                else:
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):  # noqa: N802
+                handle_guarded(router, self, self._do_post)
+
+            def _do_post(self):
+                if not self._authorized():
+                    return
+                if self.path == "/replicas":
+                    try:
+                        req = self._body()
+                        name = router.register_replica(
+                            req["url"], name=req.get("name"))
+                        write_json(self, 200, {"replica": name})
+                    except ValueError as e:
+                        # name conflict: the caller's mistake, not an
+                        # unreachable replica — 409, never a silent swap
+                        write_json(self, 409, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — report
+                        write_json(self, 502,
+                                   {"error": f"registration failed: {e}"})
+                    return
+                if self.path != "/generate":
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    req = self._body()
+                except ValueError:
+                    write_json(self, 400, {"error": "body is not JSON"})
+                    return
+                key = self.headers.get("Idempotency-Key")
+                run_idempotent(
+                    self, router.idem, key,
+                    lambda: router._route_request(req, client_key=key),
+                )
+
+            def do_DELETE(self):  # noqa: N802
+                handle_guarded(router, self, self._do_delete)
+
+            def _do_delete(self):
+                if not self._authorized():
+                    return
+                if self.path.startswith("/replicas/"):
+                    name = self.path[len("/replicas/"):]
+                    if router.remove_replica(name):
+                        write_json(self, 200, {"removed": name})
+                    else:
+                        write_json(self, 404,
+                                   {"error": f"no replica {name!r}"})
+                else:
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- membership ----------------------------------------------------------
+
+    def register_replica(self, url: str, name: Optional[str] = None) -> str:
+        """Register a replica and give it ring arcs. Idempotent at the
+        same URL. Ring membership changes ONLY here and in
+        ``remove_replica`` — transient health blips cordon via the
+        breaker without remapping anyone's prefix buckets."""
+        name = self.pool.add(url, name=name)
+        with self._lock:
+            self.ring.add(name)
+        # seed a load snapshot so the first routed request after a scale
+        # event doesn't see the newcomer as "unknown load"
+        self.pool.refresh(0.0)
+        return name
+
+    def remove_replica(self, name: str) -> bool:
+        with self._lock:
+            self.ring.remove(name)
+        return self.pool.remove(name)
+
+    # -- routing -------------------------------------------------------------
+
+    def _overloaded(self, load: Optional[dict]) -> bool:
+        if load is None:
+            return False             # no snapshot yet: don't exile it
+        if int(load.get("queue_depth", 0)) >= self.overload_queue_depth:
+            return True
+        free = load.get("pages_free")
+        return free is not None and int(free) < self.min_free_pages
+
+    def _pick(self, prompt: List[int]) -> Tuple[Optional[str], bool]:
+        """(replica name, was_affinity_target) — the routing decision.
+        Affinity: walk the key's preference order, skipping unroutable
+        and overloaded replicas; everyone overloaded -> least-queued
+        routable. Random policy: seeded uniform choice (the bench
+        baseline)."""
+        routable = set(self.pool.routable())
+        if not routable:
+            return None, False
+        with self._lock:
+            if self.policy == "random":
+                return self._rng.choice(sorted(routable)), False
+            prefs = self.ring.preference(prefix_head_key(
+                prompt, self.head_tokens, self.head_quantum))
+        if not prefs:
+            return None, False
+        # the TRUE affinity target is the unfiltered ring head: landing
+        # anywhere else — because the target is cordoned, draining OR
+        # overloaded — is a fallback, and the metric must say so
+        target = prefs[0]
+        prefs = [n for n in prefs if n in routable]
+        if not prefs:
+            return None, False
+        for name in prefs:
+            if not self._overloaded(self.pool.snapshot(name)):
+                if name != target:
+                    self._c_fallback.inc()
+                return name, name == target
+        # everyone overloaded: least-queued routable still gets the work
+        # (the SLO-class gate, not the picker, is the shed decision)
+        def depth(n):
+            load = self.pool.snapshot(n) or {}
+            return int(load.get("queue_depth", 0))
+
+        name = min(prefs, key=depth)
+        if name != target:
+            self._c_fallback.inc()
+        return name, name == target
+
+    def _route_request(self, req: dict, client_key: Optional[str] = None):
+        """One routed generate -> (code, obj); runs under
+        ``run_idempotent`` on the handler thread."""
+        prompt = req.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            return 400, {"error": "prompt must be a non-empty list of "
+                                  "token ids"}
+        slo_class = str(req.get("slo_class") or "interactive")
+        deadline = time.monotonic() + float(
+            req.get("timeout") or DEFAULT_ROUTE_TIMEOUT)
+        code, obj = self._admit(slo_class)
+        if code is not None:
+            return code, obj
+        # route timing starts AFTER the admission gate: a queue-parked
+        # request's park time is already recorded as queue_wait, and
+        # folding it into op=route would make route_p99 judge the
+        # gate's own delay — the gate re-triggering itself after the
+        # original burn cleared
+        t0 = time.perf_counter()
+        # up to two candidates: the picked replica, and — when the POST
+        # itself fails (it started draining / died between the snapshot
+        # and now) — one fresh pick with the pool's updated view. ONE
+        # idempotency key covers the whole logical request — derived
+        # from the CLIENT's key when it sent one, so even a client-level
+        # retry of a keyed request reuses the same downstream key: any
+        # re-execution that lands the same replica after an ambiguous
+        # failure (admitted, response lost past the retry budget)
+        # REPLAYS the committed tokens instead of admitting twice. The
+        # residual window is a re-pick landing a DIFFERENT replica —
+        # transient double compute that retires and frees its pages,
+        # bounded by per-replica dedup being the only state a jax-free
+        # router can carry.
+        leg_key = ("router-gen-" + (client_key or uuid.uuid4().hex))
+        last_err: Optional[str] = None
+        for attempt in range(2):
+            name, affinity = self._pick(prompt)
+            if name is None:
+                self._c_norep.inc()
+                return 503, {"error": "no routable replica"}
+            url = self.pool.url(name)
+            if url is None:
+                continue
+            payload = {"prompt": prompt,
+                       "timeout": max(0.1, deadline - time.monotonic())}
+            if req.get("sampling") is not None:
+                payload["sampling"] = req["sampling"]
+            try:
+                tup = time.perf_counter()
+                body = request_json(
+                    url + "/generate", payload, token=self.token,
+                    idempotency_key=leg_key,
+                    timeout=max(0.1, deadline - time.monotonic()))
+                self._metrics.record("upstream",
+                                     time.perf_counter() - tup)
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    # a deterministic CLIENT error (bad sampling params,
+                    # oversized prompt) — failing over would just repeat
+                    # it and mis-file it as infrastructure trouble;
+                    # surface the replica's verdict as-is
+                    try:
+                        detail = json.loads(e.read()).get("error", "")
+                    except Exception:  # noqa: BLE001 — body unreadable
+                        detail = ""
+                    return e.code, {"error": f"replica {name}: "
+                                             f"{detail or f'HTTP {e.code}'}"}
+                last_err = f"{name}: HTTP {e.code}"
+                self.pool.refresh(0.0)
+                continue
+            except TRANSIENT_ERRORS as e:
+                last_err = f"{name}: {e}"
+                self.pool.refresh(0.0)
+                continue
+            self._c_routed.inc()
+            self._metrics.record("route", time.perf_counter() - t0)
+            self.events.emit("route", replica=name, slo_class=slo_class,
+                             affinity=affinity,
+                             prompt_tokens=len(prompt))
+            body = dict(body)
+            body["replica"] = name
+            body["affinity"] = affinity
+            return 200, body
+        self._c_uperr.inc()
+        return 502, {"error": f"upstream generate failed: {last_err}"}
+
+    def _admit(self, slo_class: str):
+        """The SLO-class gate: (None, None) to proceed; a (code, obj)
+        refusal otherwise. Burning = any declared objective's FAST
+        window at/over the engine's burn threshold — the early
+        multiwindow signal, deliberately more trigger-happy than
+        ``firing`` (which also needs the slow window: paging wants
+        proof, load-shedding wants reflexes)."""
+        if not self._burning():
+            return None, None
+        if slo_class in self.shed_classes:
+            self._c_shed.inc()
+            self.events.emit("shed", slo_class=slo_class)
+            return 503, {"error": "shed: SLO fast window burning",
+                         "slo_class": slo_class}
+        if slo_class in self.queue_classes:
+            self._c_queued.inc()
+            self.events.emit("queue", slo_class=slo_class)
+            tq = time.perf_counter()
+            q_deadline = time.monotonic() + self.queue_timeout_s
+            while time.monotonic() < q_deadline:
+                # the signals loop keeps re-evaluating in the background;
+                # a parked request only polls the verdict
+                time.sleep(0.02)
+                if not self._burning():
+                    self._metrics.record("queue_wait",
+                                         time.perf_counter() - tq)
+                    return None, None
+            self._c_qtimeout.inc()
+            return 503, {"error": "queue timeout: SLO fast window still "
+                                  "burning", "slo_class": slo_class}
+        return None, None
+
+    # -- SLO evaluation ------------------------------------------------------
+
+    def evaluate_slos(self, min_interval: float = 0.0) -> Dict[str, dict]:
+        """Evaluate the declared objectives over the federated fleet
+        scrape (throttled by *min_interval*). The router's evaluation
+        window is its traffic plus the autoscaler's reconcile cadence —
+        both call here."""
+        if self.slo is None:
+            return {}
+        with self._lock:
+            now = time.monotonic()
+            if min_interval > 0 and now - self._last_slo_eval < min_interval:
+                return self.slo.results()
+            self._last_slo_eval = now
+        return self.slo.evaluate(self.metrics_text())
+
+    def _burning(self) -> bool:
+        if self.slo is None:
+            return False
+        return any(r.get("burn_fast", 0.0) >= self.slo.burn_threshold
+                   for r in self.slo.results().values())
+
+    # -- observability -------------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """Router registry federated with every replica's ``/metrics``
+        (series relabeled ``replica="<name>"``) — what ``GET /metrics``
+        serves and what the SLO engine judges."""
+        return self.pool.federate_text(self.registry.render())
+
+    def trace(self, trace_id: str) -> dict:
+        """One stitched trace: router spans + every replica's leg."""
+        spans = {s["span_id"]: s
+                 for s in obs_trace.tracer().spans(trace_id)}
+        self.pool.trace(trace_id, spans)
+        ordered = sorted(spans.values(), key=lambda s: s["start"])
+        return {"trace": trace_id, "spans": ordered}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def _poll_loop(self) -> None:
+        """The background signals loop: fleet /load refresh + SLO
+        evaluation over the federated scrape, OFF the request path — a
+        dark replica's scrape timeout must inflate a background tick,
+        never a routed request's latency (the controller's concurrent-
+        scrape lesson, PR 6: observability overhead that trips the very
+        latency objective it feeds is self-inflicted load shedding).
+        Handlers only read the cached snapshots and the engine's last
+        verdicts."""
+        interval = max(0.05, min(self.load_refresh_s or 0.25,
+                                 self._slo_interval or 0.25))
+        while not self._stop.wait(interval):
+            try:
+                # both halves keep their OWN configured cadence — the
+                # tick rate is just the scheduler granularity
+                self.pool.refresh(self.load_refresh_s)
+                # SLO evaluation keeps its OWN cadence: the federation
+                # scrape + parse is the dear half, so a fast load tick
+                # must not drag it along (the throttle returns cached
+                # verdicts inside slo_interval_s)
+                self.evaluate_slos(self._slo_interval)
+            except Exception:  # noqa: BLE001 — the loop survives a bad
+                pass           # scrape; next tick retries
+
+    def start(self) -> str:
+        self._stop.clear()
+        self._loop_thread = threading.Thread(
+            target=self._poll_loop, name="kubetpu-router-signals",
+            daemon=True)
+        self._loop_thread.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="kubetpu-router",
+            daemon=True)
+        self._thread.start()
+        return self.address
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        self._inflight.wait_idle(timeout)
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5.0)
+            self._loop_thread = None
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+

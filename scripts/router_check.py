@@ -1,0 +1,182 @@
+#!/usr/bin/env python3
+"""``make router-check`` — the data-plane routing oracle.
+
+Boots a router + 2 paged serving replicas (prefix cache on) IN-PROCESS
+on the CPU backend, injects >=10% wire faults (drop / injected 5xx /
+truncated response) on BOTH the router surface and every replica's
+``/generate``, drives a 3-family shared-prefix storm through keyed,
+retrying client POSTs, and fails (exit 1) on:
+
+- PARITY: any routed request's greedy tokens differing from a quiet
+  direct serial run on one replica (routing must be semantics-free —
+  affinity placement, prefix-cache hits, retries and replays
+  notwithstanding);
+- DOUBLE ALLOCATION: total generate EXECUTIONS (and serving ``admit``
+  events) across the fleet differing from the number of logical
+  requests — a retried POST whose first response was lost must be
+  REPLAYED by the idempotency window, never re-admitted;
+- an UNSTITCHED trace: the storm's traced request must render router
+  and replica spans under one trace id (the router hop
+  ``kubetpu.cli.obs --trace`` draws);
+- the POOL ORACLE (``check_invariants``) on any replica after the
+  storm, and faults that never actually fired (a chaos run that
+  injected nothing proves nothing).
+
+Runs in well under a minute with no accelerator; wired into
+``make chaos`` so every fault-injection run also proves the data plane
+routes exactly and never double-admits.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.obs import span  # noqa: E402
+from kubetpu.router import ReplicaServer, RouterServer  # noqa: E402
+from kubetpu.wire.faults import FaultInjector, RoutePolicy  # noqa: E402
+from kubetpu.wire.httpcommon import request_json  # noqa: E402
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+MAX_NEW = 5
+# >=10% total injection on the generate legs: 4% drop + 4% injected 503
+# + 4% truncated response (the double-allocation manufacturing fault)
+GEN_FAULTS = RoutePolicy(drop=0.04, error=0.04, partial=0.04)
+
+
+def fail(msg: str) -> None:
+    print(f"router-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def make_server(params):
+    return PagedDecodeServer(
+        CFG, params, n_slots=2, max_seq=64, max_new_tokens=MAX_NEW,
+        page_size=PS, prefill_budget=PS, prefix_cache_pages=16)
+
+
+def storm_prompts():
+    """Three shared-prefix families x tails + a sub-page loner."""
+    prompts = []
+    for f, seed in enumerate((5, 7, 11)):
+        fam = [(i * seed) % 60 + 1 for i in range(2 * PS)]
+        for tail in range(3):
+            prompts.append(fam + [f * 10 + tail + 1])
+    prompts.append([63] * 3)
+    return prompts
+
+
+def main() -> int:
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = storm_prompts()
+
+    # the quiet oracle: one replica, serial, no wire, no faults
+    direct = make_server(params)
+    expected = []
+    for p in prompts:
+        rid = direct.enqueue(p)
+        direct.drain()
+        expected.append(direct.pop_result(rid))
+
+    injector = FaultInjector(seed=11, routes={"/generate": GEN_FAULTS})
+    replicas = []
+    for i in range(2):
+        rep = ReplicaServer(make_server(params), f"chk{i}",
+                            faults=injector, idle_wait=0.002)
+        rep.start()
+        replicas.append(rep)
+    router = RouterServer(load_refresh_s=0.1, faults=injector)
+    router.start()
+    try:
+        for rep in replicas:
+            router.register_replica(rep.address)
+
+        results = []
+        trace_id = None
+        for i, p in enumerate(prompts):
+            if i == len(prompts) // 2 and trace_id is None:
+                with span("router-check.generate") as root:
+                    body = request_json(
+                        router.address + "/generate",
+                        {"prompt": p, "timeout": 30.0},
+                        idempotency_key=f"router-check-{i}", timeout=30.0)
+                    trace_id = root.trace_id
+            else:
+                body = request_json(
+                    router.address + "/generate",
+                    {"prompt": p, "timeout": 30.0},
+                    idempotency_key=f"router-check-{i}", timeout=30.0)
+            results.append(body)
+
+        # 1) parity: routed greedy tokens == the quiet direct run
+        for i, (body, want) in enumerate(zip(results, expected)):
+            if body["tokens"] != want:
+                fail(f"request {i}: routed tokens {body['tokens']} != "
+                     f"direct {want} (replica {body['replica']})")
+
+        # 2) no double allocation: executions + admits == logical requests
+        execs = sum(
+            int(rep.server.obs.counter(
+                "kubetpu_replica_generate_requests_total").value)
+            for rep in replicas)
+        admits = sum(len(rep.server.events.events(kind="admit"))
+                     for rep in replicas)
+        if execs != len(prompts):
+            fail(f"{execs} generate executions for {len(prompts)} logical "
+                 f"requests — an idempotency-keyed retry re-executed")
+        if admits != len(prompts):
+            fail(f"{admits} admit events for {len(prompts)} requests — "
+                 f"a lost response double-admitted")
+
+        # 3) the faults actually fired, and a replay actually happened
+        # when a partial fault hit a generate leg
+        fired = dict(injector.counts)
+        if sum(fired.values()) == 0:
+            fail("no faults fired — the soak proved nothing; raise rates")
+        replays = sum(
+            int(rep.server.obs.counter(
+                "kubetpu_replica_generate_replays_total").value)
+            for rep in replicas)
+        print(f"router-check: faults fired {fired}, {replays} replays, "
+              f"{execs} executions / {len(prompts)} requests")
+
+        # 4) stitched router -> replica trace
+        trace = router.trace(trace_id)
+        comps = {s.get("component", "") for s in trace["spans"]}
+        if "router" not in comps or not any(
+                c.startswith("replica:") for c in comps):
+            fail(f"trace {trace_id} did not stitch router and replica "
+                 f"spans (components: {sorted(comps)})")
+
+        # 5) the routed storm left every pool honest
+        for rep in replicas:
+            rep.server.check_invariants()
+        hits = sum(rep.server.prefix_cache_stats()["requests_hit"]
+                   for rep in replicas)
+        if hits == 0:
+            fail("zero prefix-cache hits through the router — affinity "
+                 "routing is not engaging the radix trees")
+    finally:
+        router.shutdown()
+        for rep in replicas:
+            rep.shutdown(graceful=False)
+
+    print("router-check OK: token-exact routing under injected faults, "
+          f"no double allocation ({execs}/{len(prompts)}), "
+          f"{hits} prefix hits, trace stitched")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

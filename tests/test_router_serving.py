@@ -1,0 +1,112 @@
+"""Round-14 data plane over REAL paged serving replicas (CPU backend):
+routing must be semantics-free — greedy tokens through the router, with
+prefix-affinity placement and cache hits, byte-identical to a direct
+serial run on one replica — and affinity must actually warm the trees
+(cluster-wide hits, each prompt family pinned to one replica).
+
+The wire/admission/scaling logic is unit-covered in test_router.py;
+``make router-check`` runs this contract under injected faults."""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.router import ReplicaServer, RouterServer  # noqa: E402
+from kubetpu.wire.httpcommon import request_json  # noqa: E402
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+MAX_NEW = 4
+
+
+def _params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _server(params):
+    return PagedDecodeServer(
+        CFG, params, n_slots=2, max_seq=64, max_new_tokens=MAX_NEW,
+        page_size=PS, prefill_budget=PS, prefix_cache_pages=16)
+
+
+def _family_prompts():
+    """Three shared-prefix families (two full pages each) x two tails —
+    the fleet workload affinity routing exists for."""
+    prompts = []
+    for f, seed in enumerate((5, 7, 11)):
+        fam = [(i * seed) % 60 + 1 for i in range(2 * PS)]
+        for tail in range(2):
+            prompts.append(fam + [f * 10 + tail + 1])
+    return prompts
+
+
+@pytest.fixture(scope="module")
+def routed_fleet():
+    """Router + 2 paged replicas (shared compiled legs) + the routed
+    storm's results, torn down after the module."""
+    params = _params()
+    replicas = []
+    for i in range(2):
+        rep = ReplicaServer(_server(params), f"paged{i}", idle_wait=0.002)
+        rep.start()
+        replicas.append(rep)
+    router = RouterServer(load_refresh_s=0.05)
+    router.start()
+    for rep in replicas:
+        router.register_replica(rep.address)
+    results = []
+    for i, prompt in enumerate(_family_prompts()):
+        body = request_json(router.address + "/generate",
+                            {"prompt": prompt, "timeout": 60.0},
+                            idempotency_key=f"t-serve-{i}", timeout=60.0)
+        results.append((prompt, body))
+    yield router, replicas, results
+    router.shutdown()
+    for rep in replicas:
+        rep.shutdown(graceful=False)
+
+
+def test_router_tokens_match_direct_serving(routed_fleet):
+    """Semantics-free routing: greedy tokens through the router ==
+    a quiet direct serial run (same params), prefix-cache hits and
+    replica placement notwithstanding."""
+    _router, _replicas, results = routed_fleet
+    direct = _server(_params())
+    for prompt, body in results:
+        rid = direct.enqueue(prompt)
+        direct.drain()
+        assert body["tokens"] == direct.pop_result(rid), (
+            f"router tokens diverged for prompt {prompt[:4]}...")
+
+
+def test_affinity_pins_families_and_warms_trees(routed_fleet):
+    """Each shared-prefix family lands on ONE replica, and the second
+    member of every family hits that replica's warm radix tree —
+    cluster-wide reuse instead of per-replica luck."""
+    router, replicas, results = routed_fleet
+    prompts = _family_prompts()
+    by_family = {}
+    for (prompt, body) in results:
+        by_family.setdefault(tuple(prompt[:2 * PS]), set()).add(
+            body["replica"])
+    assert len(by_family) == 3
+    for members in by_family.values():
+        assert len(members) == 1
+    hits = sum(rep.server.prefix_cache_stats()["requests_hit"]
+               for rep in replicas)
+    # one cold miss per family; every later family member hits
+    assert hits >= len(prompts) - len(by_family)
+    direct_cells = [rep.server for rep in replicas]
+    for srv in direct_cells:
+        srv.check_invariants()     # routed storm left the pools honest
+
+
+def test_load_info_reports_pool_pressure(routed_fleet):
+    _router, replicas, _results = routed_fleet
+    info = replicas[0].server.load_info()
+    assert info["pool_pages"] > 0
+    assert 0 <= info["pages_free"] <= info["pool_pages"]
+    assert "prefix_hit_rate" in info
+    assert info["queue_depth"] == 0
