@@ -434,8 +434,10 @@ def _result_key(r: dict) -> tuple:
             r.get("block_k", 128), r.get("variant"),
             # prefix_reuse_storm rows: one line per reuse arm, re-runs
             # with the same arm replace cleanly across rounds; ditto
-            # router_storm's routing-policy arms
-            r.get("reuse"), r.get("policy"))
+            # router_storm's routing-policy arms and pagedtune's
+            # (pool dtype, pages_per_block) sweep points
+            r.get("reuse"), r.get("policy"),
+            r.get("pool"), r.get("pages_per_block"))
 
 
 def _merge_out(path: str, new: list) -> None:
@@ -985,6 +987,63 @@ def speculative_paged_storm(n_slots=4, long_len=48, short_len=12, n_shorts=3,
     return plain, spec
 
 
+def paged_kernel_tune(cfg, n_slots, seq_len, variant, kv_int8,
+                      pages_per_block, page_size=16, chunk_t=5):
+    """One ``pagedtune`` point: raw fused paged-attention kernel
+    throughput (Round-15) on a synthetic full pool — every slot holds
+    *seq_len* tokens, the table walks ``pages_per_block`` pages per grid
+    step (the VMEM tile knob). *variant* ``decode`` is the one-token
+    step (T == 1), ``chunk`` the speculative-verify leg (T = chunk_t);
+    *kv_int8* swaps the pool for (values int8, scales f32) pairs with
+    in-kernel dequant. Parity is tier-1's job; this measures query
+    tokens/s by the two-point marginal method."""
+    import numpy as np
+
+    from kubetpu.jobs.profiling import marginal_ms
+    from kubetpu.jobs.quant import quantize_kv_chunk
+    from kubetpu.ops.paged_attention import paged_attention_chunk
+
+    h, h_kv, d = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    max_pages = (seq_len + page_size - 1) // page_size
+    n_pool = n_slots * max_pages
+    kk, kv_, kq = jax.random.split(jax.random.PRNGKey(0), 3)
+    kp = jax.random.normal(kk, (n_pool, page_size, h_kv, d), jnp.float32)
+    vp = jax.random.normal(kv_, (n_pool, page_size, h_kv, d), jnp.float32)
+    if kv_int8:
+        kp, vp = quantize_kv_chunk(kp), quantize_kv_chunk(vp)
+    table = jnp.asarray(
+        np.arange(n_pool, dtype=np.int32).reshape(n_slots, max_pages))
+    pos = jnp.full((n_slots,), seq_len - 1, jnp.int32)
+    t = 1 if variant == "decode" else chunk_t
+    q0 = jax.random.normal(kq, (n_slots, t, h, d), jnp.float32)
+
+    def make_run(n):
+        @jax.jit
+        def run():
+            def body(_, q):
+                out = paged_attention_chunk(
+                    q, kp, vp, table, pos,
+                    pages_per_block=pages_per_block)
+                # live data dependency: the next query reads this output,
+                # so XLA cannot CSE/dead-code the iterations
+                return q + 1e-6 * out
+            return jnp.sum(jax.lax.fori_loop(0, n, body, q0))
+        return run
+
+    step_ms = marginal_ms(make_run, 2, 10, reps=2)
+    return {
+        "metric": f"paged_kernel_{variant}_toks_s",
+        "value": round(n_slots * t / (step_ms / 1e3), 1),
+        "unit": "query tokens/s",
+        "pool": "int8" if kv_int8 else "f32",
+        "pages_per_block": pages_per_block,
+        "n_slots": n_slots,
+        "seq_len": seq_len,
+        "page_size": page_size,
+        "chunk_t": t,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -992,9 +1051,10 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--out", default=None, help="also merge JSON lines into FILE")
     ap.add_argument("--only", default=None,
-                    help="comma list of sections: train,flash,decode,spec,flashtune,"
-                         "serving (big compiles over the tunneled backend "
-                         "make a full run slow; sections merge into --out)")
+                    help="comma list of sections: train,flash,decode,spec,"
+                         "flashtune,pagedtune,serving (big compiles over the "
+                         "tunneled backend make a full run slow; sections "
+                         "merge into --out)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -1006,7 +1066,8 @@ def main() -> int:
             pass
 
     cfg = flagship_cfg(args.smoke)
-    sections = {"train", "flash", "decode", "spec", "serving", "flashtune"}
+    sections = {"train", "flash", "decode", "spec", "serving", "flashtune",
+                "pagedtune"}
     only = (
         {s.strip() for s in args.only.split(",")} if args.only else set(sections)
     )
@@ -1065,6 +1126,47 @@ def main() -> int:
                                   "block_q": best["block_q"],
                                   "block_k": best["block_k"],
                                   "mfu": best["mfu"]}), flush=True)
+
+    if "pagedtune" in only:
+        # Round-15 raw-speed push: sweep the fused paged-attention
+        # kernels' pages_per_block VMEM tile over the decode (T=1) and
+        # speculative-verify chunk legs, f32 + int8 pools. TPU-only —
+        # like flashtune, the Pallas kernels don't run compiled on the
+        # CPU backend and tile choice is a hardware question.
+        if jax.default_backend() == "cpu":
+            print(json.dumps({"metric": "pagedtune", "skipped": "cpu backend"}))
+        else:
+            pt_slots = 4 if args.smoke else 16
+            pt_seq = 256 if args.smoke else 2048
+            for kv_int8 in (False, True):
+                for variant in ("decode", "chunk"):
+                    best = None
+                    # ALWAYS sweep the shipped default tile (1) too:
+                    # pagedtune_best only ranks rows from THIS sweep, so
+                    # omitting the default could crown a "best" tile
+                    # slower than what the code ships with
+                    for ppb in (1, 2, 4, 8):
+                        try:
+                            r = paged_kernel_tune(
+                                cfg, pt_slots, pt_seq, variant, kv_int8, ppb)
+                        except Exception as e:  # noqa: BLE001 — a tile may not fit VMEM
+                            print(json.dumps({
+                                "metric": "pagedtune_point",
+                                "variant": variant,
+                                "pool": "int8" if kv_int8 else "f32",
+                                "pages_per_block": ppb,
+                                "error": str(e)[:120]}), flush=True)
+                            continue
+                        emit(r)
+                        if best is None or r["value"] > best["value"]:
+                            best = r
+                    if best is not None:
+                        print(json.dumps({
+                            "metric": "pagedtune_best",
+                            "variant": variant,
+                            "pool": best["pool"],
+                            "pages_per_block": best["pages_per_block"],
+                            "toks_s": best["value"]}), flush=True)
 
     if "train" in only:
         attn = "flash" if jax.default_backend() != "cpu" else "dense"

@@ -16,10 +16,15 @@ shared pool:
 Attention gathers a slot's pages on the fly (XLA gather; the score math is
 bit-identical to the dense `_attend_cached`, so greedy decode through
 pages matches the dense server EXACTLY — the parity test pins this).
-An optional Pallas paged-attention kernel (kubetpu.ops.paged_attention)
-streams pages through VMEM without materializing the gathered cache;
-interpret-mode tests pin its parity, compiled validation runs on real TPU
-via scripts/tpu_smoke.py.
+An optional Pallas paged-attention kernel family
+(kubetpu.ops.paged_attention, Round-15) streams pages through VMEM
+without materializing the gathered cache — or, for kv_int8 pools, the
+host-side dequantized f32 copy (the dequant happens per-tile in VMEM):
+``use_kernel=True`` now covers f32 AND int8 pools, the banded
+(window > 0) decode step, the chunked-prefill chunk, and the
+speculative verify chunk. Interpret-mode tests and the ``make
+spec-check``/``prefix-check`` kernel arms pin its parity; compiled
+validation runs on real TPU via scripts/tpu_smoke.py.
 
 Memory math: a slot costs ``ceil(live_tokens / page_size)`` pages instead
 of ``max_seq`` rows — a server provisions the pool for the EXPECTED total
@@ -227,7 +232,7 @@ def _attend_paged_chunk(q, k_pages_l, v_pages_l, table, pos):
 
 def paged_forward_chunk(
     cfg: ModelConfig, params: Params, tokens, k_pages, v_pages, table, pos,
-    write_enable=None,
+    write_enable=None, attend_chunk=None,
 ):
     """T-token chunk forward per slot through the page pool at PER-SLOT
     positions ``pos..pos+T-1`` — the speculative VERIFY leg (T = gamma+1;
@@ -245,7 +250,12 @@ def paged_forward_chunk(
     re-fed and overwritten (jobs.speculative's argument, through pages).
     *write_enable* (B,) bool drops an inactive slot's writes entirely
     (phys -> out-of-bounds sentinel), protecting mid-prefill neighbors'
-    pages like the decode step does."""
+    pages like the decode step does. *attend_chunk* swaps the chunk
+    attention core (``ops.paged_attention_chunk`` plugs in here — same
+    write-then-read order, so the kernel reads the committed in-chunk
+    entries exactly as the gather core does)."""
+    if attend_chunk is None:
+        attend_chunk = _attend_paged_chunk
     vals = k_pages[0] if isinstance(k_pages, tuple) else k_pages
     ps = vals.shape[2]
     n_pool = vals.shape[1]
@@ -270,7 +280,7 @@ def paged_forward_chunk(
         k = model_lib.rope(k, tpos, cfg.rope_theta, cfg.rope_llama3_scaling)
         k_l = _write_token_kv(k_l, k, phys, offset)   # (B, T) scatter
         v_l = _write_token_kv(v_l, v, phys, offset)
-        attn = _attend_paged_chunk(q, k_l, v_l, table, pos)
+        attn = attend_chunk(q, k_l, v_l, table, pos)
         x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
         h2 = model_lib.rms_norm(x, layer["ln2"])
         delta, _aux = model_lib._mlp(cfg, h2, layer)
@@ -285,7 +295,8 @@ def paged_forward_chunk(
     return logits, k_pages, v_pages
 
 
-def _paged_prefill_io(write_phys, gather_row, ps: int, window: int):
+def _paged_prefill_io(write_phys, gather_row, ps: int, window: int,
+                      attend_chunk=None):
     """The PAGE-POOL cache strategy for a prefill chunk: scatter the
     chunk's K/V into its (page-aligned) physical pages, then attend the
     chunk's queries through the slot's gathered logical pages — so
@@ -319,7 +330,16 @@ def _paged_prefill_io(write_phys, gather_row, ps: int, window: int):
     the same per-token per-head scales as ``_int8_cache_io`` — and the
     patched in-chunk view is the DEQUANTIZED quantized chunk, exactly
     what the int8 dense server's attention reads — so the pool receives
-    bit-identical entries and emits bit-identical attention."""
+    bit-identical entries and emits bit-identical attention.
+
+    *attend_chunk* (non-windowed configs only): the fused Pallas chunk
+    kernel. The scatter COMMITS first and the chunk's queries attend
+    THROUGH the gathered-prefix table — sound off a ring because the
+    chunk's pages are disjoint from every earlier page, so the committed
+    view at every position a real query can see is exactly the patched
+    contiguous view (int8: the kernel's in-VMEM dequant of the committed
+    chunk IS the dequantized-quantized patch). Windowed (ring) configs
+    keep the gather-before-write order and never take this path."""
     from kubetpu.jobs.decode import _attend_cached
 
     n_write = write_phys.shape[0]
@@ -344,6 +364,19 @@ def _paged_prefill_io(write_phys, gather_row, ps: int, window: int):
         return pages_l.at[write_phys].set(
             payload[0].reshape(n_write, ps, *payload.shape[2:]), mode="drop")
 
+    if attend_chunk is not None:
+        def io(q, k, v, cache, pos):
+            k_l, v_l = cache
+            k_pool, _k_att = split(k_l, k)
+            v_pool, _v_att = split(v_l, v)
+            k_l = scatter(k_l, k_pool)
+            v_l = scatter(v_l, v_pool)
+            attn = attend_chunk(q, k_l, v_l, gather_row[None],
+                                jnp.reshape(pos, (1,)).astype(jnp.int32))
+            return attn, (k_l, v_l)
+
+        return io
+
     def io(q, k, v, cache, pos):
         k_l, v_l = cache
         k_pool, k_att = split(k_l, k)
@@ -363,10 +396,12 @@ def _paged_prefill_io(write_phys, gather_row, ps: int, window: int):
     return io
 
 
-def _build_paged_legs(cfg_, page_size, attend):
+def _build_paged_legs(cfg_, page_size, attend, attend_chunk=None):
     """(prefill_chunk, step_all) jits for the page-pool server — shared
     across same-key servers via ``serving._cached_legs`` (the legs are
-    pure functions of their arguments)."""
+    pure functions of their arguments). *attend_chunk* (use_kernel,
+    non-windowed) fuses the prefill chunk's attention through the page
+    table too."""
     from kubetpu.jobs.sampling import make_slot_sampler
 
     sampler = make_slot_sampler()
@@ -393,7 +428,8 @@ def _build_paged_legs(cfg_, page_size, attend):
         # the chunk forward THROUGH the pool: forward_chunk_io over
         # the paged cache strategy (module docstring) — one compile
         # per chunk length serves every offset and every slot
-        io = _paged_prefill_io(write_phys, row, ps_, window_)
+        io = _paged_prefill_io(write_phys, row, ps_, window_,
+                               attend_chunk=attend_chunk)
         logits, (k_pages, v_pages) = forward_chunk_io(
             cfg_, params, chunk[None], (k_pages, v_pages), pos, io
         )
@@ -460,6 +496,7 @@ class PagedDecodeServer(SlotServerBase):
         eos_id: Optional[int] = None,
         use_kernel: bool = False,
         interpret: bool = False,
+        pages_per_block: int = 1,
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
@@ -478,17 +515,6 @@ class PagedDecodeServer(SlotServerBase):
                 "prefix_cache_pages is incompatible with windowed serving: "
                 "the ring table aliases logical pages onto a per-slot "
                 "physical ring, which cannot be shared across slots"
-            )
-        if cfg.window > 0 and use_kernel:
-            raise NotImplementedError(
-                "the Pallas paged-attention kernel does not implement the "
-                "banded mask yet; windowed paged serving uses the gather "
-                "core (use_kernel=False)"
-            )
-        if kv_int8 and use_kernel:
-            raise NotImplementedError(
-                "the Pallas paged-attention kernel reads dense-dtype pages; "
-                "int8 pools use the gather core (use_kernel=False)"
             )
         super().__init__(cfg, params, n_slots, max_seq, max_new_tokens,
                          eos_id, temperature=temperature, top_k=top_k,
@@ -590,15 +616,57 @@ class PagedDecodeServer(SlotServerBase):
             self.obs.gauge_fn("kubetpu_prefix_tree_nodes",
                               lambda: self._prefix_cache.n_nodes())
 
+        # -- attention cores (Round-15): under use_kernel the decode step
+        # AND the chunk paths (prefill, speculative verify) walk the page
+        # table in one fused Pallas kernel — f32 or int8 pools, banded
+        # (window > 0) decode included. Windowed chunked prefill keeps
+        # the gather core: its gather-before-write order is what makes
+        # the ring sound, and prefill is not the per-token hot path.
+        if pages_per_block < 1:
+            raise ValueError("pages_per_block must be >= 1")
+        self.use_kernel = bool(use_kernel)
+        self.interpret = bool(interpret)
+        # the pagedtune-swept VMEM tile: pages walked per kernel grid
+        # step (applies only under use_kernel; 1 is the shipped default)
+        self.pages_per_block = int(pages_per_block)
         attend = partial(_attend_paged, window=cfg.window)
+        attend_chunk = None
         if use_kernel:
-            from kubetpu.ops.paged_attention import paged_attention
+            from kubetpu.ops.paged_attention import (
+                paged_attention,
+                paged_attention_chunk,
+            )
 
-            attend = partial(paged_attention, interpret=interpret)
+            attend = partial(paged_attention, window=cfg.window,
+                             pages_per_block=self.pages_per_block,
+                             interpret=interpret)
+            if cfg.window == 0:
+                attend_chunk = partial(paged_attention_chunk,
+                                       pages_per_block=self.pages_per_block,
+                                       interpret=interpret)
+        self._attend_chunk = attend_chunk
+        if use_kernel:
+            # kernel adoption + the HBM win, on the serving registry: the
+            # gather core materializes (B, max_pages*ps, H_kv, D) f32 x2
+            # (K, V) x L per attention call; the kernel streams pages
+            # through VMEM instead — count that buffer as saved per leg
+            self._kernel_bytes_saved = (
+                2 * cfg.n_layers * n_slots * self.max_pages_per_slot
+                * page_size * cfg.kv_heads * cfg.head_dim * 4
+            )
+            self._c_kernel_steps = self.obs.counter(
+                "kubetpu_paged_kernel_steps_total",
+                "decode/verify legs served by the fused paged-attention "
+                "kernel")
+            self._c_kernel_bytes = self.obs.counter(
+                "kubetpu_paged_kernel_hbm_bytes_saved_total",
+                "gathered-KV materialization bytes the kernel did not "
+                "write+read (f32 gather buffer per attention leg)")
 
         self._prefill_chunk, self._step_all = _cached_legs(
-            ("paged", cfg, page_size, kv_int8, use_kernel, interpret),
-            lambda: _build_paged_legs(cfg, page_size, attend),
+            ("paged", cfg, page_size, kv_int8, use_kernel, interpret,
+             self.pages_per_block),
+            lambda: _build_paged_legs(cfg, page_size, attend, attend_chunk),
         )
 
     # -- page accounting -----------------------------------------------------
@@ -1034,12 +1102,22 @@ class PagedDecodeServer(SlotServerBase):
         )
         return (first, first_lp) if final else True
 
+    def _note_kernel_step(self) -> None:
+        """Kernel-adoption bookkeeping on the hot path (KTP001-clean:
+        host counter writes only, no device sync): one fused decode/
+        verify leg ran instead of the gather core's materialized
+        (B, max_pages*ps, H_kv, D) buffer."""
+        if self.use_kernel:
+            self._c_kernel_steps.inc()
+            self._c_kernel_bytes.inc(self._kernel_bytes_saved)
+
     def _device_step(self):
         # worst-case pages were reserved by admission / the final prefill
         # chunk, so boundary crossings never fail; the REAL table (with
         # -1 sentinels) flows to the device — the attention core masks
         # unmapped pages. Table and slot state ride the device-resident
         # upload cache: a steady-state step re-uploads nothing.
+        self._note_kernel_step()
         self.k_pages, self.v_pages, nxt, self.pos, lp = self._step_all(
             self.params, self.k_pages, self.v_pages,
             self._dev("table", lambda: self._table),

@@ -16,7 +16,9 @@ reads them (``jobs.speculative``'s argument, per slot).
 pool — the target's (gamma+1)-token verify chunk reads and writes THROUGH
 the slot page table (``paged.paged_forward_chunk``), so speculation
 composes with everything the pool already carries: chunked prefill,
-kv_int8 pools, and shared-prefix radix-cache hits (a matched prefix skips
+kv_int8 pools, the fused Pallas paged-attention kernel
+(``use_kernel=True`` — the verify chunk is the Round-15 chunk kernel,
+in-kernel int8 dequant included), and shared-prefix radix-cache hits (a matched prefix skips
 the DRAFT's prefill too — draft staleness there can only lower
 acceptance, never change output, because verification is greedy-exact).
 Copy-on-write boundary rules are untouched: every speculative write lands
@@ -329,7 +331,7 @@ class SpeculativeDecodeServer(_SpecRoundsMixin, SlotServerBase):
         jax.block_until_ready((self.k_cache, self.v_cache))
 
 
-def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos):
+def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos, attend_chunk=None):
     """The jitted paged speculative ROUND for one static *gamma*: draft
     ``gamma`` greedy tokens through the (dense, per-slot) draft cache at
     per-slot positions (``speculative.draft_propose`` — the same
@@ -345,7 +347,12 @@ def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos):
     would corrupt them (the same hazard the dense step's ``pos_w``
     redirect covers); row ``dead_pos`` is past every position a real
     query can ever attend. The target side needs no redirect: inactive
-    slots' pool writes are dropped via ``write_enable``."""
+    slots' pool writes are dropped via ``write_enable``.
+
+    *attend_chunk* (``use_kernel``): the fused Pallas chunk kernel
+    (``ops.paged_attention_chunk``) replaces the verify chunk's gather
+    core — one compiled round per (gamma, kernel) signature, all warmed
+    by ``warmup()`` through the profiler's per-gamma watch."""
 
     # built lazily per gamma on first use, then cached (and warmup()
     # pre-compiles every gamma); the profiler's round[gamma=G] watch
@@ -360,7 +367,7 @@ def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos):
         chunk = jnp.concatenate([last[:, None], drafts], axis=1)
         t_logits, k_pages, v_pages = paged_forward_chunk(
             tcfg, t_params, chunk, k_pages, v_pages, table, pos,
-            write_enable=active,
+            write_enable=active, attend_chunk=attend_chunk,
         )
         target_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
         agree = (drafts == target_tok[:, :gamma]).astype(jnp.int32)
@@ -414,9 +421,17 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
     - windowed (``cfg.window > 0``) configs are refused: the ring table
       aliases logical pages, and an overshoot write past the accepted
       position could evict a band entry a REWOUND position still needs;
-    - greedy only (sampling overrides rejected), no ``overlap`` (a round
-      emits a variable burst; the one-step pipeline doesn't apply) and
-      no Pallas kernel (the verify chunk uses the gather core).
+    - ``use_kernel=True`` (Round-15) runs the verify chunk through the
+      fused Pallas chunk kernel (``ops.paged_attention_chunk``): the
+      (gamma+1)-token target read walks the page table in VMEM with
+      in-kernel int8 dequant instead of materializing the gathered
+      (and, for kv_int8, dequantized) cache — one compiled kernel round
+      per gamma, all warmed by ``warmup()`` through the Round-11
+      profiler watch, greedy token-exact vs the gather core (the
+      interpret-mode storm and ``make spec-check`` kernel arms pin it);
+    - greedy only (sampling overrides rejected) and no ``overlap`` (a
+      round emits a variable burst; the one-step pipeline doesn't
+      apply).
     """
 
     def __init__(
@@ -439,6 +454,9 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
         prefix_cache_pages: int = 0,
         gamma_max: int = 4,
         adaptive_gamma: bool = True,
+        use_kernel: bool = False,
+        interpret: bool = False,
+        pages_per_block: int = 1,
     ) -> None:
         if target_cfg.vocab != draft_cfg.vocab:
             raise ValueError("target and draft must share a vocabulary")
@@ -461,6 +479,8 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
             n_pages=n_pages, eos_id=eos_id, seed=seed, mesh=mesh,
             kv_int8=kv_int8, prefill_budget=prefill_budget,
             queue_ttl=queue_ttl, prefix_cache_pages=prefix_cache_pages,
+            use_kernel=use_kernel, interpret=interpret,
+            pages_per_block=pages_per_block,
         )
         self.draft_cfg = draft_cfg
         self.draft_params = draft_params
@@ -496,9 +516,11 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
     def _round_leg(self, gamma: int):
         return _cached_legs(
             ("paged_spec", self.cfg, self.draft_cfg, self.page_size,
-             self.kv_int8, gamma, self._draft_len - 1),
+             self.kv_int8, gamma, self._draft_len - 1, self.use_kernel,
+             self.interpret, self.pages_per_block),
             lambda: _build_paged_spec_round(
-                self.cfg, self.draft_cfg, gamma, self._draft_len - 1),
+                self.cfg, self.draft_cfg, gamma, self._draft_len - 1,
+                attend_chunk=self._attend_chunk),
         )
 
     def _note_admitted(self, slot: int, prompt: List[int]) -> None:
@@ -587,6 +609,7 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
                 prof.end_step(rec)
             return out
         t0 = time.perf_counter()
+        self._note_kernel_step()   # the verify chunk is a kernel leg too
         g = max(int(self._gamma[s]) for s in range(self.n_slots)
                 if self.active[s])
         round_all = self._round_leg(g)

@@ -1,5 +1,6 @@
 """TPU kernels (Pallas) for the hot ops."""
 
 from kubetpu.ops.flash_attention import flash_attention
+from kubetpu.ops.paged_attention import paged_attention, paged_attention_chunk
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "paged_attention", "paged_attention_chunk"]

@@ -1,25 +1,50 @@
-"""Paged attention as a Pallas TPU kernel.
+"""Paged attention as a Pallas TPU kernel family (Round-15).
 
-The decode-time hot op of the paged KV cache (kubetpu.jobs.paged): one
-query token per slot attends its sequence scattered across pool pages.
-The XLA reference (`_attend_paged`) GATHERS the slot's pages into a
-contiguous (B, max_pages*ps, H_kv, D) buffer every step — materialized
-HBM traffic proportional to the cache size. This kernel streams pages
-through VMEM instead:
+The decode-time hot ops of the paged KV cache (kubetpu.jobs.paged): one
+(or T) query token(s) per slot attend the slot's sequence scattered
+across pool pages. The XLA reference (`_attend_paged` /
+`_attend_paged_chunk`) GATHERS the slot's pages into a contiguous
+(B, max_pages*ps, H_kv, D) buffer every step — materialized HBM traffic
+proportional to the cache size, and for kv_int8 pools an additional
+materialized f32 dequant copy. This kernel family streams pages through
+VMEM instead:
 
-- grid (B, max_pages), sequential on TPU: for each slot, each logical
-  page is one grid step whose K/V block is selected by the PREFETCHED
-  page table (``PrefetchScalarGridSpec`` — the index map reads
-  ``table[b, p]``, so the gather happens in the block loader, not in HBM);
-- flash-style online softmax across pages: running (max, normalizer) and
-  the output accumulator live in VMEM scratch, carried across the page
-  grid steps; pages past the slot's position (or unmapped) are skipped
-  via ``pl.when`` — their block load is clamped to page 0 and ignored;
-- grouped-query aware: H query heads attend H_kv cached heads in groups
-  without expanding the cache (same layout contract as the XLA path).
+- grid (B, ceil(max_pages / pages_per_block)), sequential on TPU: for
+  each slot, each block of ``pages_per_block`` logical pages is one grid
+  step whose K/V blocks are selected by the PREFETCHED page table
+  (``PrefetchScalarGridSpec`` — each page's index map reads
+  ``table[b, blk*ppb + i]``, so the gather happens in the block loader,
+  not in HBM). ``pages_per_block`` is the VMEM tile knob the
+  ``pagedtune`` bench sweeps: a wider block gives the loader more DMA to
+  overlap per step at the cost of VMEM residency. 1 is the shipped
+  default;
+- IN-KERNEL INT8 DEQUANT: an int8 pool hands the kernel (values int8,
+  scales f32) page pairs; each tile dequantizes inside VMEM as
+  ``values.astype(f32) * scales`` — elementwise-identical to the gather
+  core's ``_gather_pages`` math, so dequantize-then-attend is preserved
+  bit-for-bit at the point scores are formed and greedy decode through
+  the kernel stays token-exact against the gather core. The materialized
+  f32 copy of the gathered cache is gone entirely;
+- MULTI-TOKEN CHUNK: ``paged_attention_chunk`` computes the causal
+  T-query-per-slot attention of ``_attend_paged_chunk`` (query t at
+  position pos+t sees keys <= pos+t) — the speculative (gamma+1)-token
+  verify leg and chunked prefill's gathered-logical-pages attention run
+  through the same page walk; the one-token decode kernel is its T == 1
+  special case (one implementation, one soundness argument);
+- BANDED MASK: ``window > 0`` adds the repo-wide band (key visible iff
+  ``0 <= q_pos - k_pos < window``) and skips pages entirely below the
+  band, which makes the RING page table sound through the kernel for
+  plain paged decode: aliased stale copies sit outside every band and
+  are masked exactly as in ``_attend_paged``;
+- flash-style online softmax across page blocks: running (max,
+  normalizer) and the output accumulator live in VMEM scratch, carried
+  across the grid; pages past the visible range (or unmapped) are
+  skipped via ``pl.when`` — their block load is clamped to page 0 and
+  ignored.
 
-Interpret mode (CPU tests) pins exact agreement with `_attend_paged`;
-compiled validation runs in scripts/tpu_smoke.py on real hardware.
+Interpret mode (CPU tests + `make spec-check`/`prefix-check` kernel
+arms) pins exact agreement with the gather core; compiled validation
+runs in scripts/tpu_smoke.py on real hardware.
 
 Reference: none in /root/reference (no inference stack, SURVEY.md §2);
 the paged layout follows the public vLLM pattern, re-shaped for TPU.
@@ -39,97 +64,200 @@ NEG_INF = -1e30
 
 def _paged_attn_kernel(
     table_ref, pos_ref,            # scalar-prefetch operands (SMEM)
-    q_ref, k_ref, v_ref,           # blocks (VMEM)
-    o_ref,                         # output block (VMEM)
-    stats_ref, acc_ref,            # scratch: (2, H) running max/norm, (H, D)
-    *, ps: int, max_pages: int, scale: float,
+    q_ref, *refs,                  # kv blocks (VMEM), o_ref, scratch
+    ps: int, max_pages: int, scale: float, t: int, window: int,
+    int8: bool, ppb: int,
 ):
-    b = pl.program_id(0)
-    p = pl.program_id(1)
+    per = 4 if int8 else 2
+    kv_refs = refs[: per * ppb]
+    o_ref = refs[per * ppb]
+    stats_ref = refs[per * ppb + 1]     # (2, T, H) running max / norm
+    acc_ref = refs[per * ppb + 2]       # (T, H, D)
 
-    @pl.when(p == 0)
+    b = pl.program_id(0)
+    blk = pl.program_id(1)
+
+    @pl.when(blk == 0)
     def _init():
-        stats_ref[0, :] = jnp.full_like(stats_ref[0, :], NEG_INF)  # m
-        stats_ref[1, :] = jnp.zeros_like(stats_ref[1, :])          # l
+        stats_ref[0, :, :] = jnp.full_like(stats_ref[0, :, :], NEG_INF)
+        stats_ref[1, :, :] = jnp.zeros_like(stats_ref[1, :, :])
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     pos = pos_ref[b]
-    valid = jnp.logical_and(p * ps <= pos, table_ref[b, p] >= 0)
+    q = q_ref[0].astype(jnp.float32) * scale              # (T, H, D)
+    h, d = q.shape[1], q.shape[2]
 
-    @pl.when(valid)
-    def _page():
-        q = q_ref[0].astype(jnp.float32) * scale          # (H, D)
-        k = k_ref[0].astype(jnp.float32)                  # (ps, Hkv, D)
-        v = v_ref[0].astype(jnp.float32)
-        h, d = q.shape
-        h_kv = k.shape[1]
-        g = h // h_kv
+    for i in range(ppb):
+        lp = blk * ppb + i
+        page_lo = lp * ps
+        valid = jnp.logical_and(
+            page_lo <= pos + (t - 1),
+            table_ref[b, jnp.minimum(lp, max_pages - 1)] >= 0,
+        )
+        valid = jnp.logical_and(valid, lp < max_pages)
+        if window > 0:
+            # the page's last key must reach the lowest band's floor
+            # (smallest q_pos = pos): pages entirely below every band
+            # are skipped, the kernel-side twin of the ring soundness
+            valid = jnp.logical_and(valid, page_lo + ps - 1 > pos - window)
 
-        qg = q.reshape(h_kv, g, d)
-        kt = k.transpose(1, 0, 2)                         # (Hkv, ps, D)
-        s = jax.lax.dot_general(
-            qg, kt, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ).reshape(h, ps)                                  # (H, ps)
-        k_pos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (h, ps), 1)
-        s = jnp.where(k_pos <= pos, s, NEG_INF)
+        @pl.when(valid)
+        def _page(i=i, lp=lp):
+            if int8:
+                k8, ksc, v8, vsc = kv_refs[4 * i: 4 * i + 4]
+                # bit-matches _gather_pages: convert THEN scale, f32 —
+                # the dequantize-then-attend order the parity pins rely on
+                k = k8[0].astype(jnp.float32) * ksc[0]
+                v = v8[0].astype(jnp.float32) * vsc[0]
+            else:
+                k_r, v_r = kv_refs[2 * i: 2 * i + 2]
+                k = k_r[0].astype(jnp.float32)            # (ps, Hkv, D)
+                v = v_r[0].astype(jnp.float32)
+            h_kv = k.shape[1]
+            g = h // h_kv
 
-        m_prev = stats_ref[0, :]
-        l_prev = stats_ref[1, :]
-        m_new = jnp.maximum(m_prev, s.max(axis=1))
-        alpha = jnp.exp(m_prev - m_new)
-        # exp(min(s - m, 0)): s <= m by construction, the guard keeps a
-        # +inf out of the accumulator if a NaN/overflow sneaks into s
-        pexp = jnp.exp(jnp.minimum(s - m_new[:, None], 0.0))
-        l_new = l_prev * alpha + pexp.sum(axis=1)
-        vt = v.transpose(1, 0, 2)                         # (Hkv, ps, D)
-        pg = pexp.reshape(h_kv, g, ps)
-        o = jax.lax.dot_general(
-            pg, vt, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ).reshape(h, d)
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + o
-        stats_ref[0, :] = m_new
-        stats_ref[1, :] = l_new
+            # grouped-query: H = (Hkv, g) major order, the gather core's
+            # reshape convention
+            qg = q.reshape(t, h_kv, g, d).transpose(1, 0, 2, 3)
+            qg = qg.reshape(h_kv, t * g, d)
+            kt = k.transpose(1, 0, 2)                     # (Hkv, ps, D)
+            s = jax.lax.dot_general(
+                qg, kt, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )                                             # (Hkv, T*g, ps)
+            s = s.reshape(h_kv, t, g, ps).transpose(1, 0, 2, 3)
+            s = s.reshape(t, h, ps)
+            k_pos = page_lo + jax.lax.broadcasted_iota(
+                jnp.int32, (t, h, ps), 2)
+            q_pos = pos + jax.lax.broadcasted_iota(
+                jnp.int32, (t, h, ps), 0)
+            mask = k_pos <= q_pos
+            if window > 0:
+                mask = jnp.logical_and(mask, q_pos - k_pos < window)
+            s = jnp.where(mask, s, NEG_INF)
 
-    @pl.when(p == max_pages - 1)
+            m_prev = stats_ref[0, :, :]                   # (T, H)
+            l_prev = stats_ref[1, :, :]
+            m_new = jnp.maximum(m_prev, s.max(axis=2))
+            alpha = jnp.exp(m_prev - m_new)
+            # exp(min(s - m, 0)): s <= m by construction, the guard keeps
+            # a +inf out of the accumulator if a NaN/overflow sneaks in
+            pexp = jnp.exp(jnp.minimum(s - m_new[:, :, None], 0.0))
+            l_new = l_prev * alpha + pexp.sum(axis=2)
+            vt = v.transpose(1, 0, 2)                     # (Hkv, ps, D)
+            pg = pexp.reshape(t, h_kv, g, ps).transpose(1, 0, 2, 3)
+            pg = pg.reshape(h_kv, t * g, ps)
+            o = jax.lax.dot_general(
+                pg, vt, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )                                             # (Hkv, T*g, D)
+            o = o.reshape(h_kv, t, g, d).transpose(1, 0, 2, 3)
+            o = o.reshape(t, h, d)
+            acc_ref[...] = acc_ref[...] * alpha[:, :, None] + o
+            stats_ref[0, :, :] = m_new
+            stats_ref[1, :, :] = l_new
+
+    @pl.when(blk == pl.num_programs(1) - 1)
     def _finalize():
-        l = jnp.maximum(stats_ref[1, :], 1e-30)
-        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        l = jnp.maximum(stats_ref[1, :, :], 1e-30)
+        o_ref[0] = (acc_ref[...] / l[:, :, None]).astype(o_ref.dtype)
 
 
-def paged_attention(q, k_pages_l, v_pages_l, table, pos, interpret: bool = False):
-    """Drop-in for ``kubetpu.jobs.paged._attend_paged``:
-    q (B, H, D); pages (P, ps, H_kv, D); table (B, max_pages) int32 with
-    -1 for unmapped; pos (B,) query positions. Returns (B, H, D)."""
-    b, h, d = q.shape
-    n_pool, ps, h_kv, _ = k_pages_l.shape
+def _paged_attention_call(q, k_pages_l, v_pages_l, table, pos, *,
+                          window: int, pages_per_block: int,
+                          interpret: bool):
+    """Shared pallas_call builder: q (B, T, H, D); pools dense arrays or
+    int8 (values, scales) pairs; table (B, max_pages) int32 (-1 =
+    unmapped); pos (B,) position of q[:, 0]. Returns (B, T, H, D)."""
+    b, t, h, d = q.shape
+    int8 = isinstance(k_pages_l, tuple)
+    if int8:
+        k8, ksc = k_pages_l
+        v8, vsc = v_pages_l
+        ps, h_kv = k8.shape[1], k8.shape[2]
+    else:
+        ps, h_kv = k_pages_l.shape[1], k_pages_l.shape[2]
     max_pages = table.shape[1]
+    ppb = max(1, min(int(pages_per_block), max_pages))
+    n_blocks = (max_pages + ppb - 1) // ppb
     scale = d ** -0.5
 
-    def page_index(b_i, p_i, table_ref, pos_ref):
-        return (jnp.maximum(table_ref[b_i, p_i], 0), 0, 0, 0)
+    def page_index(i):
+        def idx(b_i, blk, table_ref, pos_ref):
+            # past-the-end pages of a ragged final block clamp to the
+            # last table column; the kernel's `lp < max_pages` guard
+            # ignores whatever loads
+            lp = jnp.minimum(blk * ppb + i, max_pages - 1)
+            return (jnp.maximum(table_ref[b_i, lp], 0), 0, 0, 0)
+        return idx
+
+    def fixed(b_i, blk, table_ref, pos_ref):
+        return (b_i, 0, 0, 0)
+
+    kv_specs, kv_ops = [], []
+    for i in range(ppb):
+        if int8:
+            kv_specs += [
+                pl.BlockSpec((1, ps, h_kv, d), page_index(i)),
+                pl.BlockSpec((1, ps, h_kv, 1), page_index(i)),
+                pl.BlockSpec((1, ps, h_kv, d), page_index(i)),
+                pl.BlockSpec((1, ps, h_kv, 1), page_index(i)),
+            ]
+            kv_ops += [k8, ksc, v8, vsc]
+        else:
+            kv_specs += [
+                pl.BlockSpec((1, ps, h_kv, d), page_index(i)),
+                pl.BlockSpec((1, ps, h_kv, d), page_index(i)),
+            ]
+            kv_ops += [k_pages_l, v_pages_l]
 
     kernel = functools.partial(
-        _paged_attn_kernel, ps=ps, max_pages=max_pages, scale=scale
+        _paged_attn_kernel, ps=ps, max_pages=max_pages, scale=scale,
+        t=t, window=int(window), int8=int8, ppb=ppb,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda b_i, p_i, t, s: (b_i, 0, 0)),
-            pl.BlockSpec((1, ps, h_kv, d), page_index),
-            pl.BlockSpec((1, ps, h_kv, d), page_index),
-        ],
-        out_specs=pl.BlockSpec((1, h, d), lambda b_i, p_i, t, s: (b_i, 0, 0)),
+        grid=(b, n_blocks),
+        in_specs=[pl.BlockSpec((1, t, h, d), fixed)] + kv_specs,
+        out_specs=pl.BlockSpec((1, t, h, d), fixed),
         scratch_shapes=[
-            pltpu.VMEM((2, h), jnp.float32),
-            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((2, t, h), jnp.float32),
+            pltpu.VMEM((t, h, d), jnp.float32),
         ],
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, t, h, d), q.dtype),
         interpret=interpret,
-    )(table, pos, q, k_pages_l, v_pages_l)
+    )(table, pos, q, *kv_ops)
+
+
+def paged_attention(q, k_pages_l, v_pages_l, table, pos, window: int = 0,
+                    pages_per_block: int = 1, interpret: bool = False):
+    """Drop-in for ``kubetpu.jobs.paged._attend_paged`` (its ``attend=``
+    plug point): q (B, H, D); pages (P, ps, H_kv, D) dense or int8
+    (values, scales (..., H_kv, 1)) pairs; table (B, max_pages) int32
+    with -1 for unmapped; pos (B,) query positions; ``window > 0`` = the
+    banded mask. Returns (B, H, D) — the T == 1 case of the chunk
+    kernel."""
+    out = _paged_attention_call(
+        q[:, None], k_pages_l, v_pages_l, table, pos,
+        window=window, pages_per_block=pages_per_block, interpret=interpret,
+    )
+    return out[:, 0]
+
+
+def paged_attention_chunk(q, k_pages_l, v_pages_l, table, pos,
+                          pages_per_block: int = 1,
+                          interpret: bool = False):
+    """Drop-in for ``kubetpu.jobs.paged._attend_paged_chunk``: causal
+    T-query-per-slot attention through the page table — q (B, T, H, D)
+    at per-slot positions ``pos..pos+T-1``; same pool layouts as
+    ``paged_attention``. No ``window``: the speculative server refuses
+    windowed configs (ring aliasing vs overshoot writes) and windowed
+    chunked prefill needs the gather core's gather-before-write order."""
+    return _paged_attention_call(
+        q, k_pages_l, v_pages_l, table, pos,
+        window=0, pages_per_block=pages_per_block, interpret=interpret,
+    )

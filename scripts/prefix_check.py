@@ -105,10 +105,34 @@ def main() -> int:
     except AssertionError as e:
         fail(f"pool oracle violated after the storm: {e}")
 
+    # Round-15 KERNEL arm (interpret): the same chunked + prefix-hit
+    # storm through the fused paged-attention kernel — chunked prefill
+    # AND the decode step walk the page table in the kernel, and the
+    # tokens must still match the cold gather-core reference exactly
+    warm_k = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                               max_new_tokens=6, page_size=PS,
+                               prefill_budget=PS,
+                               prefix_cache_pages=BUDGET,
+                               use_kernel=True, interpret=True)
+    try:
+        got_k = run(warm_k, prompts, check=True)
+    except AssertionError as e:
+        fail(f"KERNEL arm: pool oracle violated mid-storm: {e}")
+    if got_k != ref:
+        bad = [i for i, (g, r) in enumerate(zip(got_k, ref)) if g != r]
+        fail(f"KERNEL arm parity: requests {bad} diverged")
+    stats_k = warm_k.prefix_cache_stats()
+    if stats_k["requests_hit"] == 0:
+        fail(f"KERNEL arm reuse never engaged: {stats_k}")
+    if warm_k._c_kernel_steps.value <= 0:
+        fail("KERNEL arm never ran a kernel step — parity was vacuous")
+
     print(f"prefix-check: OK — {len(prompts)} requests, "
           f"hits {stats['requests_hit']}, "
           f"saved {stats['prefill_tokens_saved']} prefill tokens, "
-          f"evicted {stats['evicted_pages']} pages, oracle clean")
+          f"evicted {stats['evicted_pages']} pages, oracle clean; "
+          f"kernel arm hits {stats_k['requests_hit']}, "
+          f"{int(warm_k._c_kernel_steps.value)} kernel steps")
     return 0
 
 

@@ -101,10 +101,35 @@ def main() -> int:
         if any(g != 1 for g in spec2.slot_gammas()):
             fail(f"{tag}: disagreeing draft did not converge to gamma 1 "
                  f"({spec2.slot_gammas()})")
+        # Round-15 KERNEL arms (interpret): the fused paged-attention
+        # chunk kernel replaces the verify leg's gather core — parity
+        # must hold monolithic AND chunked+prefix-hit, per pool dtype
+        spec_k = PagedSpeculativeDecodeServer(
+            CFG, DCFG, t_params, d_params, n_slots=2, max_seq=64,
+            max_new_tokens=8, page_size=PS, kv_int8=kv_int8, gamma_max=3,
+            use_kernel=True, interpret=True)
+        if run(spec_k, prompts, check=True) != ref:
+            fail(f"{tag} KERNEL monolithic speculative tokens != plain paged")
+        if spec_k._c_kernel_steps.value <= 0:
+            fail(f"{tag}: kernel arm never ran a kernel round — parity "
+                 f"was vacuous")
+        spec_k2 = PagedSpeculativeDecodeServer(
+            CFG, DCFG, t_params, d_params, n_slots=2, max_seq=64,
+            max_new_tokens=8, page_size=PS, kv_int8=kv_int8,
+            prefill_budget=PS, prefix_cache_pages=8, gamma_max=3,
+            use_kernel=True, interpret=True)
+        if run(spec_k2, prompts, check=True) != ref:
+            fail(f"{tag} KERNEL chunked+prefix speculative tokens != "
+                 f"plain paged")
+        if spec_k2.prefix_cache_stats()["requests_hit"] < 1:
+            fail(f"{tag}: kernel arm prefix cache never hit — hit parity "
+                 f"was vacuous")
         print(f"spec-check: {tag}: parity ok over {len(ref)} requests, "
               f"{int(spec2._c_spec_rounds.value)} rounds, "
               f"{spec2.prefix_cache_stats()['requests_hit']} prefix hits, "
-              f"gammas {spec2.slot_gammas()}")
+              f"gammas {spec2.slot_gammas()}, kernel rounds "
+              f"{int(spec_k._c_kernel_steps.value)}"
+              f"+{int(spec_k2._c_kernel_steps.value)}")
 
     # self-draft ceiling: full agreement must pin gamma at gamma_max and
     # tokens/round at the gamma+1 ceiling (the rounds-not-tokens win)

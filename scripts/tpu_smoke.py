@@ -110,6 +110,49 @@ def main():
     print(f"paged attention (compiled) max |diff| = {pdiff:.4g}")
     assert pdiff < 3e-2
 
+    # Round-15 variants on real silicon: in-kernel int8 dequant, the
+    # banded decode mask, a wider pages_per_block tile, and the
+    # multi-token chunk kernel (interpret parity rides tier-1; this is
+    # the compiled leg)
+    from functools import partial as _partial
+
+    from kubetpu.jobs.paged import _attend_paged_chunk
+    from kubetpu.jobs.quant import quantize_kv_chunk
+    from kubetpu.ops.paged_attention import paged_attention_chunk
+
+    k8 = quantize_kv_chunk(kp.astype(jnp.float32))
+    v8 = quantize_kv_chunk(vp.astype(jnp.float32))
+    qf = qq.astype(jnp.float32)
+    out8 = jax.jit(lambda *a: paged_attention(*a))(qf, k8, v8, table, pos)
+    ref8 = jax.jit(_attend_paged)(qf, k8, v8, table, pos)
+    d8 = np.max(np.abs(np.asarray(out8) - np.asarray(ref8)))
+    print(f"paged attention int8 (compiled) max |diff| = {d8:.4g}")
+    assert d8 < 3e-2
+    out_w2 = jax.jit(_partial(paged_attention, window=200))(
+        qq, kp, vp, table, pos)
+    ref_w2 = jax.jit(_partial(_attend_paged, window=200))(
+        qq, kp, vp, table, pos)
+    dw = np.max(np.abs(np.asarray(out_w2, np.float32)
+                       - np.asarray(ref_w2, np.float32)))
+    print(f"paged attention banded (compiled) max |diff| = {dw:.4g}")
+    assert dw < 3e-2
+    out_p2 = jax.jit(_partial(paged_attention, pages_per_block=2))(
+        qq, kp, vp, table, pos)
+    dp2 = np.max(np.abs(np.asarray(out_p2, np.float32)
+                        - np.asarray(ref_k, np.float32)))
+    print(f"paged attention ppb=2 (compiled) max |diff| = {dp2:.4g}")
+    assert dp2 < 3e-2
+    qc = jax.random.normal(jax.random.PRNGKey(13), (bq, 5, hq, dq),
+                           jnp.bfloat16)
+    pos_c = jnp.asarray([296, 136, 500, 56], jnp.int32)
+    out_c = jax.jit(lambda *a: paged_attention_chunk(*a))(
+        qc, kp, vp, table, pos_c)
+    ref_c = jax.jit(_attend_paged_chunk)(qc, kp, vp, table, pos_c)
+    dc = np.max(np.abs(np.asarray(out_c, np.float32)
+                       - np.asarray(ref_c, np.float32)))
+    print(f"paged chunk kernel (compiled) max |diff| = {dc:.4g}")
+    assert dc < 3e-2
+
     # sliding-window flash (round 4): compiled block-skip bounds vs the
     # dense band reference, forward AND gradient (interpret parity is
     # pinned in tests/test_ops.py; this is the real-silicon leg)

@@ -1157,6 +1157,30 @@ def test_request_text_rides_the_shared_client():
 # -- the meta-test: this repo lints clean ------------------------------------
 
 
+def test_hot_closure_covers_kernel_dispatch_and_ops_lints_clean():
+    """Round-15 pins: (a) the KTP001 barrier-leg closure reaches the new
+    kernel dispatch fns — the paged server's per-step kernel bookkeeping
+    and the speculative server's per-gamma round-leg fetch both run
+    inside step(), so a host sync sneaking into either fails lint at the
+    line; (b) `kubetpu/ops/` (the Pallas kernel family the dispatch
+    hands off to) lints clean with ZERO baseline entries — new kernel
+    code may never ride in on a ratchet budget."""
+    from kubetpu.analysis.core import load_project
+    from kubetpu.analysis.rules_device import hot_closure
+
+    project = load_project(REPO_ROOT, ["kubetpu"])
+    quals = {qual.split(".")[-1] if "." in qual else qual
+             for _, qual, _ in hot_closure(project).values()}
+    assert "_note_kernel_step" in quals, sorted(quals)
+    assert "_round_leg" in quals, sorted(quals)
+    res = run_lint(REPO_ROOT, ["kubetpu/ops"])
+    assert [f.render() for f in res.active] == []
+    baseline = baseline_mod.load_baseline(
+        os.path.join(REPO_ROOT, baseline_mod.DEFAULT_BASELINE))
+    assert not [k for k in baseline["counts"]
+                if k.startswith("kubetpu/ops/")], baseline["counts"]
+
+
 def test_repo_lints_clean_against_committed_baseline():
     """`make lint` green is a merge gate; this pins it in tier-1. Any
     new violation of KTP001–KTP006 in kubetpu/ or scripts/ fails here

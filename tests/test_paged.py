@@ -158,20 +158,154 @@ def test_pallas_kernel_matches_xla_attend(params):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def _kernel_fixture(seed=1, b=3, h=4, h_kv=2, d=8, ps=4, n_pool=10,
+                    max_pages=4):
+    """Random pages + a ragged table/pos set covering mid-page,
+    first-page and table-full geometries (the decode-kernel test's
+    shapes, shared by the Round-15 variant tests)."""
+    import jax.numpy as jnp
+
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(k1, (b, h, d), jnp.float32)
+    kp = jax.random.normal(k2, (n_pool, ps, h_kv, d), jnp.float32)
+    vp = jax.random.normal(k3, (n_pool, ps, h_kv, d), jnp.float32)
+    table = jnp.asarray(np.array([
+        [5, 2, 7, -1],
+        [0, -1, -1, -1],
+        [9, 8, 1, 3],
+    ], np.int32))
+    pos = jnp.asarray(np.array([9, 2, 15], np.int32))
+    return q, kp, vp, table, pos, k4
+
+
+def test_pallas_kernel_int8_matches_gather_core():
+    """Round-15 in-kernel int8 dequant: (values, scales) page pairs
+    dequantized per-tile in VMEM must match the gather core's
+    dequantize-then-attend math on the same quantized pool."""
+    from kubetpu.jobs.paged import _attend_paged
+    from kubetpu.jobs.quant import quantize_kv_chunk
+    from kubetpu.ops.paged_attention import paged_attention
+
+    q, kp, vp, table, pos, _ = _kernel_fixture()
+    k8 = quantize_kv_chunk(kp)
+    v8 = quantize_kv_chunk(vp)
+    ref = _attend_paged(q, k8, v8, table, pos)
+    out = paged_attention(q, k8, v8, table, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [3, 6])
+def test_pallas_kernel_banded_matches_gather_core(window):
+    """Round-15 banded mask: window > 0 through the kernel == the gather
+    core's band, including pages skipped entirely below the band."""
+    from kubetpu.jobs.paged import _attend_paged
+    from kubetpu.ops.paged_attention import paged_attention
+
+    q, kp, vp, table, pos, _ = _kernel_fixture()
+    ref = _attend_paged(q, kp, vp, table, pos, window=window)
+    out = paged_attention(q, kp, vp, table, pos, window=window,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("pages_per_block", [2, 3])
+def test_pallas_kernel_pages_per_block_parity(pages_per_block):
+    """The pagedtune VMEM tile knob: any pages_per_block (including one
+    that does not divide max_pages — the ragged final block clamps) is
+    numerically the shipped default."""
+    from kubetpu.jobs.paged import _attend_paged
+    from kubetpu.ops.paged_attention import paged_attention
+
+    q, kp, vp, table, pos, _ = _kernel_fixture()
+    ref = _attend_paged(q, kp, vp, table, pos)
+    out = paged_attention(q, kp, vp, table, pos,
+                          pages_per_block=pages_per_block, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_pallas_chunk_kernel_matches_gather_core():
+    """Round-15 multi-token chunk kernel: causal T-query-per-slot
+    attention through the page table == _attend_paged_chunk, f32 and
+    int8 pools, one-page-per-step and wider tiles."""
+    import jax.numpy as jnp
+
+    from kubetpu.jobs.paged import _attend_paged_chunk
+    from kubetpu.jobs.quant import quantize_kv_chunk
+    from kubetpu.ops.paged_attention import paged_attention_chunk
+
+    _, kp, vp, table, _, kq = _kernel_fixture()
+    t = 3
+    qt = jax.random.normal(kq, (3, t, 4, 8), jnp.float32)
+    pos = jnp.asarray(np.array([8, 0, 12], np.int32))
+    ref = _attend_paged_chunk(qt, kp, vp, table, pos)
+    for ppb in (1, 2):
+        out = paged_attention_chunk(qt, kp, vp, table, pos,
+                                    pages_per_block=ppb, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+    k8 = quantize_kv_chunk(kp)
+    v8 = quantize_kv_chunk(vp)
+    ref8 = _attend_paged_chunk(qt, k8, v8, table, pos)
+    out8 = paged_attention_chunk(qt, k8, v8, table, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(out8), np.asarray(ref8),
+                               atol=2e-5)
+
+
 def test_paged_server_with_pallas_kernel_parity(params):
     """End-to-end: the paged server running the Pallas kernel (interpret)
-    produces exactly the dense server's greedy tokens."""
+    produces exactly the dense server's greedy tokens — at the shipped
+    tile AND a tuned pages_per_block (the pagedtune knob plumbs through
+    the constructor)."""
     prompts = [[3, 14, 15, 9], [26, 5, 1]]
     dense = DecodeServer(CFG, params, n_slots=2, max_seq=32, max_new_tokens=6)
     paged = PagedDecodeServer(CFG, params, n_slots=2, max_seq=32,
                               max_new_tokens=6, page_size=8,
                               use_kernel=True, interpret=True)
+    tiled = PagedDecodeServer(CFG, params, n_slots=2, max_seq=32,
+                              max_new_tokens=6, page_size=8,
+                              use_kernel=True, interpret=True,
+                              pages_per_block=2)
     outs = {}
-    for server, tag in ((dense, "dense"), (paged, "paged")):
+    for server, tag in ((dense, "dense"), (paged, "paged"),
+                        (tiled, "tiled")):
         rids = [server.submit(p) for p in prompts]
         server.drain()
         outs[tag] = [server.result(r) for r in rids]
     assert outs["paged"] == outs["dense"]
+    assert outs["tiled"] == outs["dense"]
+
+
+def test_kernel_chunked_prefix_storm_parity_and_counters(params):
+    """Round-15 composition storm: use_kernel x chunked prefill x
+    prefix-cache hits — greedy token-exact vs the cold gather-core
+    server, pool oracle clean per drain, and the kernel adoption
+    counters (`kubetpu_paged_kernel_steps_total` + HBM-bytes-saved) on
+    the serving registry actually move."""
+    fam = [(i * 5) % 60 + 1 for i in range(16)]
+    prompts = [fam + [t] for t in (1, 2, 3)] + [[26, 5], [63] * 3]
+
+    def run(server):
+        outs = []
+        for wave in (prompts[:3], prompts[3:]):
+            rids = [server.enqueue(p) for p in wave]
+            server.drain()
+            outs.extend(server.pop_result(r) for r in rids)
+            server.check_invariants()
+        return outs
+
+    ref = run(PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                                max_new_tokens=8, page_size=8,
+                                prefill_budget=8))
+    ker = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                            max_new_tokens=8, page_size=8,
+                            prefill_budget=8, prefix_cache_pages=8,
+                            use_kernel=True, interpret=True)
+    assert run(ker) == ref
+    assert ker.prefix_cache_stats()["requests_hit"] >= 1
+    steps = int(ker._c_kernel_steps.value)
+    saved = int(ker._c_kernel_bytes.value)
+    assert steps > 0 and saved == steps * ker._kernel_bytes_saved
+    assert "kubetpu_paged_kernel_steps_total" in ker.metrics_text()
 
 
 def test_mesh_sharded_paged_server_matches_unsharded(params):
@@ -276,13 +410,29 @@ def test_windowed_pages_bounded_by_window_not_seq(params):
         plain.submit(list(range(1, 12)))
 
 
-def test_windowed_paged_kernel_refuses(params):
+def test_windowed_paged_kernel_parity(params):
+    """Round-15: the banded-mask kernel lifts the old windowed refusal —
+    a windowed paged server under ``use_kernel`` emits exactly the
+    gather core's greedy tokens, across ring wraps (prompt longer than
+    ring * page_size)."""
     import dataclasses
 
     wcfg = dataclasses.replace(CFG, window=8)
-    with pytest.raises(NotImplementedError):
-        PagedDecodeServer(wcfg, params, n_slots=2, max_seq=64,
-                          max_new_tokens=8, use_kernel=True)
+    prompts = [[3, 14, 15, 9, 2, 6], [26, 5],
+               [(i * 7) % 60 + 1 for i in range(40)]]
+
+    def run(server):
+        rids = [server.enqueue(p) for p in prompts]
+        server.drain()
+        return [server.pop_result(r) for r in rids]
+
+    ref = run(PagedDecodeServer(wcfg, params, n_slots=2, max_seq=96,
+                                max_new_tokens=12, page_size=4))
+    ker = PagedDecodeServer(wcfg, params, n_slots=2, max_seq=96,
+                            max_new_tokens=12, page_size=4,
+                            use_kernel=True, interpret=True)
+    assert run(ker) == ref
+    assert ker._c_kernel_steps.value > 0
 
 
 def test_int8_page_pool_parity_and_bytes(trained_small):
@@ -324,8 +474,14 @@ def test_int8_page_pool_parity_and_bytes(trained_small):
         (dense.k_pages, dense.v_pages)))
     q8_b = sum(x.nbytes for x in _jax.tree.leaves((q8.k_pages, q8.v_pages)))
     assert q8_b < 0.6 * dense_b  # f32 pool -> int8 + thin scales
-    with pytest.raises(NotImplementedError):
-        PagedDecodeServer(tcfg, params, use_kernel=True, kv_int8=True)
+    # Round-15: use_kernel now composes with kv_int8 — the in-kernel
+    # dequant bit-matches the gather core's, so the trained-model greedy
+    # stream is identical to the int8 gather server's
+    q8k = PagedDecodeServer(tcfg, params, n_slots=2, max_seq=64,
+                            max_new_tokens=12, page_size=8, kv_int8=True,
+                            use_kernel=True, interpret=True)
+    assert run(q8k) == got
+    assert q8k._c_kernel_steps.value > 0
 
 
 def test_int8_windowed_paged_triple_composition(trained_small):

@@ -201,6 +201,40 @@ def test_paged_spec_unaligned_max_seq_chunked_parity(params):
     spec.check_invariants()
 
 
+@pytest.mark.parametrize("kv_int8", [False, True])
+def test_paged_spec_kernel_storm_parity(params, kv_int8):
+    """Round-15: PagedSpeculativeDecodeServer(use_kernel=True) — the
+    verify chunk runs the fused Pallas chunk kernel (in-kernel int8
+    dequant included) — is greedy token-exact vs the plain gather-core
+    PagedDecodeServer across a chunked + prefix-cache-hit storm, with
+    the pool oracle clean after every drain and kernel rounds actually
+    counted."""
+    t, d = params
+    fam = [(i * 5) % 60 + 1 for i in range(16)]
+    prompts = [fam + [x] for x in (1, 2, 3)] + [[26, 5], [63] * 3]
+
+    def run(server, check=False):
+        outs = []
+        for wave in (prompts[:3], prompts[3:]):
+            rids = [server.enqueue(p) for p in wave]
+            server.drain()
+            outs.extend(server.pop_result(r) for r in rids)
+            if check:
+                server.check_invariants()
+        return outs
+
+    ref = run(PagedDecodeServer(CFG, t, n_slots=2, max_seq=64,
+                                max_new_tokens=8, page_size=8,
+                                kv_int8=kv_int8))
+    spec = _spec(params, n_slots=2, max_new_tokens=8, gamma_max=3,
+                 kv_int8=kv_int8, prefill_budget=8, prefix_cache_pages=8,
+                 use_kernel=True, interpret=True)
+    assert run(spec, check=True) == ref
+    assert spec._c_spec_rounds.value > 0
+    assert spec._c_kernel_steps.value > 0
+    assert spec.prefix_cache_stats()["requests_hit"] >= 1
+
+
 def test_paged_spec_rejects_sampling_window_and_bad_gamma(params):
     import dataclasses
 
@@ -211,9 +245,17 @@ def test_paged_spec_rejects_sampling_window_and_bad_gamma(params):
     with pytest.raises(ValueError):
         PagedSpeculativeDecodeServer(
             CFG, dataclasses.replace(DCFG, vocab=32), t, d)
-    with pytest.raises(NotImplementedError):
+    # the windowed refusal SURVIVES Round-15 (the kernel lifts the plain
+    # paged window refusal, not this one) and must say exactly why:
+    # ring aliasing vs the verify chunk's overshoot writes
+    with pytest.raises(NotImplementedError,
+                       match="ring table aliases logical pages"):
         PagedSpeculativeDecodeServer(
             dataclasses.replace(CFG, window=8), DCFG, t, d)
+    with pytest.raises(NotImplementedError, match="overshoot"):
+        PagedSpeculativeDecodeServer(
+            dataclasses.replace(CFG, window=8), DCFG, t, d,
+            use_kernel=True, interpret=True)
     with pytest.raises(ValueError):
         PagedSpeculativeDecodeServer(CFG, DCFG, t, d, gamma_max=0)
 
