@@ -42,10 +42,13 @@ test: tpuinfo gpuinfo dataio
 # (speculative rounds must be invisible in the output stream before
 # chaos means anything), then router-check (the data plane must route
 # token-exactly and never double-admit under the same faults), then
-# bench-gate in smoke mode (a chaos pass that silently regressed
-# serving throughput still fails the round).
+# migrate-check (a live slot handoff must resume token-exactly and
+# at-most-once under faults on the transfer leg), then bench-gate in
+# smoke mode (a chaos pass that silently regressed serving throughput
+# still fails the round).
 .PHONY: chaos
-chaos: lint obs-check prefix-check spec-check router-check bench-gate-smoke
+chaos: lint obs-check prefix-check spec-check router-check migrate-check \
+		bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py \
 		tests/test_race_soak.py -q
 
@@ -119,6 +122,17 @@ prefix-check:
 .PHONY: router-check
 router-check:
 	python scripts/router_check.py
+
+# live-KV-migration oracle (Round-16): router + 2 paged replicas,
+# rolling /migrate_out sweeps under >=10% injected faults on the
+# /migrate_in leg — migrated tokens byte-equal to a quiet unmigrated
+# run, committed handoffs == committed restores (zero double-restores;
+# a forged stale epoch must fence 409), admissions == logical requests,
+# a stitched source->target handoff trace, pool invariants on BOTH
+# replicas
+.PHONY: migrate-check
+migrate-check:
+	python scripts/migrate_check.py
 
 # observability smoke oracle: controller + 2 fake agents, scrape the
 # federated /metrics, fail on malformed Prometheus text / missing

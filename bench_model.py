@@ -734,6 +734,24 @@ def prefix_reuse_storm(cfg, n_slots=4, sys_len=192, tail_len=8,
     return run(0), run(cache_pages)
 
 
+def _pooled_latency_ms(servers, op, pct):
+    """Percentile over EVERY server's raw latency reservoir for *op*
+    (exact below cap) — the fleet-wide number the router and migration
+    storms both report."""
+    import numpy as np
+
+    vals = []
+    for srv in servers:
+        for name, labels, kind, inst in srv.obs.snapshot():
+            if (name == "kubetpu_serving_latency_seconds"
+                    and kind == "summary"
+                    and dict(labels).get("op") == op):
+                vals.extend(inst.tail()[1])
+    if not vals:
+        return 0.0
+    return float(np.percentile(np.asarray(vals), pct)) * 1e3
+
+
 def router_storm(cfg, n_replicas=2, n_families=3, sys_len=96, tail_len=8,
                  requests_per_family=4, max_new=6, page_size=16,
                  prefill_budget=32, cache_pages=32, concurrency=4,
@@ -793,18 +811,6 @@ def router_storm(cfg, n_replicas=2, n_families=3, sys_len=96, tail_len=8,
         pre.drain()
         pre.pop_result(rid)
 
-    def pooled_ms(servers, op, pct):
-        vals = []
-        for srv in servers:
-            for name, labels, kind, inst in srv.obs.snapshot():
-                if (name == "kubetpu_serving_latency_seconds"
-                        and kind == "summary"
-                        and dict(labels).get("op") == op):
-                    vals.extend(inst.tail()[1])
-        if not vals:
-            return 0.0
-        return float(np.percentile(np.asarray(vals), pct)) * 1e3
-
     def run(policy):
         servers = [make_server() for _ in range(n_replicas)]
         replicas = [ReplicaServer(srv, f"bench{i}", idle_wait=0.002)
@@ -839,8 +845,10 @@ def router_storm(cfg, n_replicas=2, n_families=3, sys_len=96, tail_len=8,
                 "policy": policy,
                 "value": round(hits / total, 3) if total else 0.0,
                 "unit": "cluster-wide prefix hit rate",
-                "ttft_p50_ms": round(pooled_ms(servers, "ttft", 50), 3),
-                "itl_p99_ms": round(pooled_ms(servers, "itl", 99), 3),
+                "ttft_p50_ms": round(
+                    _pooled_latency_ms(servers, "ttft", 50), 3),
+                "itl_p99_ms": round(
+                    _pooled_latency_ms(servers, "itl", 99), 3),
                 "decode_tok_s": round(emitted / wall, 1) if wall else 0.0,
                 "prefill_tokens_saved": sum(
                     r["prefill_tokens_saved"] for r in reuse),
@@ -856,6 +864,128 @@ def router_storm(cfg, n_replicas=2, n_families=3, sys_len=96, tail_len=8,
                 rep.shutdown(graceful=False)
 
     return tuple(run(p) for p in policies)
+
+
+def migration_storm(cfg, n_replicas=2, n_streams=4, prompt_len=24,
+                    max_new=48, page_size=16, n_slots=4,
+                    arms=("wait", "migrate")):
+    """Round-16 headline: drain a loaded replica with LIVE MIGRATION vs
+    waiting out natural stream end. Boots a router + *n_replicas* paged
+    replicas, launches *n_streams* long decode streams through keyed
+    router POSTs, then drains the most-loaded replica — the ``wait``
+    arm drains the classic way (scale-down blocked until every stream
+    finishes), the ``migrate`` arm hands the streams to a survivor
+    token-exactly and completes as fast as the wire. Reports
+    drain-complete latency per arm (the ``migration_drain_s`` gate
+    metric), streams preserved (parity vs a quiet unmigrated run), the
+    pooled ITL p99 (the handoff blip shows here), and committed
+    handoffs."""
+    import dataclasses
+    import random as _random
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.paged import PagedDecodeServer
+    from kubetpu.router import ReplicaServer, RouterServer
+    from kubetpu.wire.httpcommon import request_json
+
+    dcfg = dataclasses.replace(cfg, remat=False)
+    params = init_params(jax.random.PRNGKey(0), dcfg)
+    rng = _random.Random(0)
+    prompts = [[rng.randrange(1, dcfg.vocab) for _ in range(prompt_len)]
+               for _ in range(n_streams)]
+    max_seq = -(-(prompt_len + max_new + 2) // page_size) * page_size
+
+    def make_server():
+        return PagedDecodeServer(
+            dcfg, params, n_slots=n_slots, max_seq=max_seq,
+            max_new_tokens=max_new, page_size=page_size)
+
+    # quiet oracle + leg pre-compile in one pass (shared _LEG_CACHE)
+    quiet = make_server()
+    expected = []
+    for p in prompts:
+        rid = quiet.enqueue(p)
+        quiet.drain()
+        expected.append(quiet.pop_result(rid))
+
+    def run(arm):
+        servers = [make_server() for _ in range(n_replicas)]
+        replicas = [ReplicaServer(srv, f"mig{i}", idle_wait=0.002)
+                    for i, srv in enumerate(servers)]
+        router = RouterServer(load_refresh_s=0.05)
+        try:
+            router.start()
+            for rep in replicas:
+                rep.start()
+                router.register_replica(rep.address)
+
+            def one(item):
+                i, prompt = item
+                return request_json(
+                    router.address + "/generate",
+                    {"prompt": prompt, "timeout": 120.0},
+                    idempotency_key=f"mig-storm-{arm}-{i}",
+                    timeout=120.0)
+
+            ex = ThreadPoolExecutor(max_workers=n_streams)
+            futs = [ex.submit(one, (i, p)) for i, p in enumerate(prompts)]
+            # pick the drain victim once it actually holds streams
+            victim = None
+            deadline = time.monotonic() + 20.0
+            while victim is None and time.monotonic() < deadline:
+                loads = []
+                for rep in replicas:
+                    with rep._cv:
+                        loads.append(len(rep.server.migratable_rids()))
+                if max(loads) > 0:
+                    victim = replicas[loads.index(max(loads))]
+                else:
+                    time.sleep(0.002)
+            if victim is None:      # streams finished before the drain
+                victim = replicas[0]
+            survivor = next(r for r in replicas if r is not victim)
+            t0 = time.perf_counter()
+            router.pool.drain(
+                victim.name,
+                migrate_to=(survivor.address if arm == "migrate"
+                            else None),
+                reason=arm)
+            while not router.pool.drained(victim.name):
+                router.pool.refresh(0.0)
+                time.sleep(0.005)
+            drain_s = time.perf_counter() - t0
+            bodies = [f.result() for f in futs]
+            ex.shutdown()
+            preserved = sum(1 for b, want in zip(bodies, expected)
+                            if b.get("tokens") == want)
+            migrations = sum(
+                len(srv.events.events(kind="migrate_in"))
+                for srv in servers)
+            for srv in servers:
+                srv.check_invariants()   # the pool oracle rides the bench
+            return {
+                "metric": "migration_storm",
+                "arm": arm,
+                "value": round(drain_s, 4),
+                "unit": "drain-complete seconds",
+                "itl_p99_ms": round(
+                    _pooled_latency_ms(servers, "itl", 99), 3),
+                "streams_preserved": preserved,
+                "requests": n_streams,
+                "migrations": migrations,
+                "n_replicas": n_replicas,
+                "max_new": max_new,
+            }
+        finally:
+            router.shutdown()
+            for rep in replicas:
+                rep.shutdown(graceful=False)
+
+    return tuple(run(a) for a in arms)
 
 
 def spec_serving_throughput(cfg, n_slots, prompt_len, rounds):
@@ -1255,6 +1385,18 @@ def main() -> int:
                 page_size=16,
                 prefill_budget=32 if args.smoke else 256,
                 cache_pages=32 if args.smoke else 128):
+            emit(row)
+        # Round-16: drain-with-live-migration vs wait-for-stream-end —
+        # the elastic scale-down story (streams preserved, drain
+        # latency, ITL blip during the handoff)
+        for row in migration_storm(
+                cfg,
+                n_replicas=2,
+                n_streams=3 if args.smoke else 6,
+                prompt_len=16 if args.smoke else 64,
+                max_new=32 if args.smoke else 128,
+                page_size=16,
+                n_slots=2 if args.smoke else 4):
             emit(row)
         emit(spec_serving_throughput(cfg, n_slots=2 if args.smoke else 4,
                                      prompt_len=16 if args.smoke else 128,

@@ -56,7 +56,12 @@ HOT_ROOTS = ("step",)
 # serving.py spell each out): admission + prefill scheduling upload at
 # the invalidation points, route/materialize IS the one designed sync,
 # warmup runs before serving, retirement publishes pages by ownership
-# donation (and its obs writes are host-only state).
+# donation (and its obs writes are host-only state). The Round-16
+# migration legs (snapshot/restore and their freeze/finish bookkeeping)
+# are barrier legs too: a slot handoff's device gather and page upload
+# are its DESIGNED sync/transfer — they run on the wire thread between
+# steps, never inside one, and anything that ever reaches them from a
+# step closure must stop the traversal here, not charge the step.
 HOT_BARRIERS = {
     "_schedule_prefills",
     "_drain_queue_into_slots",
@@ -69,6 +74,15 @@ HOT_BARRIERS = {
     "enqueue",
     "cancel",
     "drain",
+    "snapshot_slot",
+    "restore_slot",
+    "_snapshot_request",
+    "_restore_request",
+    "freeze_slot",
+    "unfreeze_slot",
+    "finish_migrated",
+    "cancel_expired",
+    "migratable_rids",
 }
 
 # host-sync / host-upload constructs (the same set the PR 5/6 runtime

@@ -986,6 +986,235 @@ class PagedDecodeServer(SlotServerBase):
                     f"node refcount {node.refcount} != "
                     f"{pins.get(id(node), 0)} live pins")
 
+    # -- live KV migration (Round-16) ----------------------------------------
+
+    def _migration_kind(self) -> str:
+        """Compatibility tag a snapshot carries; restore refuses a
+        mismatch (a plain-paged snapshot must not land on a speculative
+        server whose table width includes the gamma margin)."""
+        return "paged"
+
+    def snapshot_slot(self, rid: int) -> dict:
+        """Capture everything needed to resume *rid* token-exactly on
+        another replica: the request state (``_snapshot_request`` — raw
+        request key included, so even SEEDED sampling continues
+        identically), and the slot's LIVE page contents gathered through
+        the page table. kv_int8 pools ship the (int8 values, f32 scales)
+        pairs AS STORED — no dequantize/requantize round-trip, so the
+        restored pool is bit-identical to the source's. Only pages
+        holding live tokens ship (positions 0..pos; the page at pos may
+        be partially stale — decode rewrites position pos before any
+        read, the standard overwrite-before-read invariant).
+
+        Migration happens only between steps/rounds: raises ValueError
+        for queued / mid-chunked-prefill / deferred-first-token /
+        already-frozen streams and under an unflushed overlap pipeline.
+        Windowed (ring) configs are refused — aliased rings are a
+        per-slot layout, not a shippable logical view. This is a BARRIER
+        leg: the device gather is its designed sync."""
+        if self._ring_pages:
+            raise NotImplementedError(
+                "windowed (ring) slots cannot migrate: the ring aliases "
+                "logical pages per slot; there is no shippable logical "
+                "page view")
+        if self._inflight is not None:
+            raise ValueError(
+                "snapshot requires the overlap pipeline flushed — an "
+                "un-materialized step may still move this stream")
+        if any(qrid == rid for qrid, _p, _d in self._queue):
+            raise ValueError(f"request {rid} is still queued — nothing "
+                             f"to migrate; route the prompt instead")
+        try:
+            slot = self._slot_rid.index(rid)
+        except ValueError:
+            raise ValueError(f"request {rid} holds no slot") from None
+        if slot in self._prefills:
+            raise ValueError(
+                f"request {rid} is mid-chunked-prefill — migration "
+                f"only between rounds (let the admission finish)")
+        if slot in self._pending_first:
+            raise ValueError(
+                f"request {rid}'s first token is still deferred — "
+                f"step once before migrating")
+        if slot in self._frozen:
+            # two concurrent policies (drain sweep + suspect sweep)
+            # racing for the same stream: the second must refuse, or
+            # both would ship epoch N+1 to DIFFERENT targets and each
+            # target's per-replica fence would admit its copy
+            raise ValueError(
+                f"request {rid} is already frozen for another handoff")
+        if not self.active[slot]:
+            raise ValueError(f"request {rid} is not decoding")
+        snap = self._snapshot_request(rid, slot)
+        n_live = self._pages_needed(self._host_len[slot])
+        row = self._table[slot, :n_live]
+        assert (row >= 0).all(), "live pages unmapped under a decode"
+        phys = np.asarray(row, np.int64)
+
+        def gather(pool):
+            # barrier-leg sync by design: the one host materialization a
+            # handoff pays (pages stay in their stored layout — int8
+            # pairs are shipped quantized)
+            if isinstance(pool, tuple):
+                return tuple(np.asarray(jax.device_get(p[:, phys]))
+                             for p in pool)
+            return np.asarray(jax.device_get(pool[:, phys]))
+
+        k = gather(self.k_pages)
+        v = gather(self.v_pages)
+        if self.kv_int8:
+            pages = {"k_q": k[0], "k_s": k[1], "v_q": v[0], "v_s": v[1]}
+        else:
+            pages = {"k": k, "v": v}
+        snap.update({
+            "kind": self._migration_kind(),
+            "cfg_fp": repr(self.cfg),
+            "page_size": self.page_size,
+            "kv_int8": bool(self.kv_int8),
+            "max_seq": self.max_seq,
+            "n_live_pages": int(n_live),
+            "pages": pages,
+        })
+        self.events.emit("snapshot", rid=rid, slot=slot, pages=int(n_live))
+        return snap
+
+    def migration_prefix_hint(self, prompt: List[int]) -> int:
+        """Full pages of *prompt* this server could map read-only from
+        its prefix cache RIGHT NOW — the ``/migrate_in`` begin phase
+        advertises this so the source ships only the uncached suffix
+        (matched pages never cross the wire at all). A HINT, never a
+        promise: eviction between begin and commit can shrink the real
+        match, and ``restore_slot`` refuses a receded match instead of
+        restoring with holes (the source then resumes and re-ships)."""
+        if self._prefix_cache is None or not prompt:
+            return 0
+        matched, _pages, _node = self._prefix_cache.match(prompt)
+        start = min(matched, ((len(prompt) - 1) // self.page_size)
+                    * self.page_size)
+        return max(0, start // self.page_size)
+
+    def restore_slot(self, snap: dict, reason: str = "migrate"):
+        """Rebuild a snapshot stream into a free slot and resume decode
+        -> the new LOCAL rid, or None when resources (slot / pool pages)
+        are unavailable — nothing mutated, the caller may retry another
+        replica. Prefix-cache matched pages map READ-ONLY instead of
+        shipping bytes (the Round-9 admission path — COW rules
+        unchanged: every future write lands past the shared rows); the
+        snapshot's ``pages`` may therefore START at logical page
+        ``ship_from_page`` (the begin-phase hint the source honored) —
+        and only the still-uncached suffix uploads into the pool. A
+        match that RECEDED below the shipped offset (eviction between
+        hint and commit) refuses with ValueError rather than restore
+        with holes. The restored stream's remaining tokens are greedy-
+        (and seeded-sampling-) identical to an unmigrated run:
+        identical page bytes, position, last token and request key. A
+        BARRIER leg — the page upload is its designed host->device
+        transfer."""
+        if self._ring_pages:
+            raise NotImplementedError(
+                "windowed (ring) servers cannot accept migrated slots")
+        if snap.get("kind") != self._migration_kind():
+            raise ValueError(
+                f"snapshot kind {snap.get('kind')!r} does not match this "
+                f"server ({self._migration_kind()!r})")
+        for field, mine in (("cfg_fp", repr(self.cfg)),
+                            ("page_size", self.page_size),
+                            ("kv_int8", bool(self.kv_int8)),
+                            ("max_seq", self.max_seq),
+                            ("max_new_tokens", self.max_new_tokens),
+                            ("eos_id", self.eos_id)):
+            if snap.get(field) != mine:
+                raise ValueError(
+                    f"snapshot {field}={snap.get(field)!r} does not match "
+                    f"this server's {mine!r} — migration requires "
+                    f"config-identical replicas")
+        prompt = [int(t) for t in snap["prompt"]]
+        emitted = [int(t) for t in snap["emitted"]]
+        if not emitted:
+            raise ValueError("snapshot carries no emitted tokens — the "
+                             "stream never started decoding")
+        if len(emitted) >= self.max_new_tokens or (
+                self.eos_id is not None and emitted[-1] == self.eos_id):
+            raise ValueError("snapshot stream is already finished")
+        free = self._free_slots()
+        if not free:
+            return None
+        slot = free[0]
+        # Round-9 reuse on the RESTORE path: map this server's cached
+        # prefix pages read-only (never copied — the bytes are already
+        # here); the uncached suffix uploads from the snapshot
+        ship_from = int(snap.get("ship_from_page", 0))
+        start = self._prefill_start(prompt, slot)
+        use = start // self.page_size if start else 0
+        if use < ship_from:
+            # the begin-phase hint promised pages the cache has since
+            # evicted: the shipped suffix has a HOLE — refuse (the
+            # source resumes and re-ships with a fresh hint) rather
+            # than restore a slot with missing KV
+            self._prefix_unmap(slot)
+            raise ValueError(
+                f"prefix receded: snapshot pages start at logical page "
+                f"{ship_from} but only {use} pages matched locally — "
+                f"re-ship with a fresh hint")
+        if not self._alloc_pages(slot, self._worst_case_tokens(len(prompt))):
+            self._prefix_unmap(slot)
+            return None
+        n_live = int(snap["n_live_pages"])
+        for name, arr in snap.get("pages", {}).items():
+            if arr.shape[1] != n_live - ship_from:
+                self._prefix_unmap(slot)
+                raise ValueError(
+                    f"snapshot page array {name!r} holds {arr.shape[1]} "
+                    f"pages, want {n_live - ship_from} "
+                    f"(n_live {n_live} - shipped-from {ship_from})")
+        rows = list(range(use, n_live))
+        if rows:
+            phys = np.asarray(
+                [int(self._table[slot, lp]) for lp in rows], np.int64)
+            cols = [lp - ship_from for lp in rows]
+            pages = snap["pages"]
+
+            def put(pool, names):
+                # upload-on-restore is this barrier leg's job (the
+                # mirror-cache rationale does not apply: each handoff
+                # ships fresh bytes exactly once)
+                if isinstance(pool, tuple):
+                    q8, sc = pool
+                    return (
+                        q8.at[:, phys].set(jnp.asarray(pages[names[0]][:, cols])),
+                        sc.at[:, phys].set(jnp.asarray(pages[names[1]][:, cols])),
+                    )
+                return pool.at[:, phys].set(
+                    jnp.asarray(pages[names[0]][:, cols]))
+
+            if self.kv_int8:
+                self.k_pages = put(self.k_pages, ("k_q", "k_s"))
+                self.v_pages = put(self.v_pages, ("v_q", "v_s"))
+            else:
+                self.k_pages = put(self.k_pages, ("k",))
+                self.v_pages = put(self.v_pages, ("v",))
+        rid = self._restore_request(snap, slot)
+        self.pos = self.pos.at[slot].set(int(snap["pos"]))
+        self.last = self.last.at[slot].set(int(snap["last"]))
+        self.active[slot] = True
+        self._invalidate_dev("active")
+        self._note_admitted(slot, prompt)   # prompt held for publication
+        # host length counts prompt + every emitted token (the last
+        # token's KV is written by the NEXT step, like any decode)
+        self._host_len[slot] = len(prompt) + len(emitted)
+        self.obs.counter(
+            "kubetpu_migration_pages_remapped_total",
+            "snapshot pages satisfied read-only by the local prefix "
+            "cache instead of shipped bytes").inc(use)
+        self.obs.counter(
+            "kubetpu_migration_pages_shipped_total",
+            "snapshot pages written into the pool from shipped "
+            "bytes").inc(len(rows))
+        self.events.emit("migrate_in", rid=rid, slot=slot, reason=reason,
+                         epoch=int(snap.get("epoch", 0)),
+                         pages_shipped=len(rows), pages_remapped=use)
+        return rid
+
     # -- device legs ---------------------------------------------------------
 
     def _chunk_quantum(self) -> int:

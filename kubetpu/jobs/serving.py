@@ -214,6 +214,17 @@ class SlotServerBase:
         self._queue: List[Tuple[int, List[int], Optional[float]]] = []
         self._expired: Dict[int, str] = {}     # rid -> reason
         self._pending_first: Dict[int, object] = {}    # slot -> device scalar
+        # -- live migration (Round-16): slots FROZEN mid-handoff (inactive
+        # for the step legs but not reusable), streams that FINISHED here
+        # by migrating away (rid -> new-owner info the wire layer reports
+        # instead of tokens), and per-stream handoff identity — the
+        # (origin replica, origin rid) pair and the handoff epoch the
+        # target's fence compares (a stream born here has epoch 0 and no
+        # origin until the wire layer names one)
+        self._frozen: set = set()
+        self._migrated: Dict[int, dict] = {}
+        self._stream_epoch: Dict[int, int] = {}
+        self._stream_origin: Dict[int, tuple] = {}
         # -- observability (Round-8): every histogram this server records
         # (admission stall, step, prefill chunks, and the per-request
         # TTFT / inter-token latency / queue wait) lives in ONE registry,
@@ -291,9 +302,11 @@ class SlotServerBase:
 
     def _free_slots(self) -> List[int]:
         """Slots holding neither an active decode nor an in-flight
-        prefill."""
+        prefill (nor a stream frozen mid-migration — inactive for the
+        step legs, but its pages and bookkeeping are still live)."""
         return [i for i in range(self.n_slots)
-                if not self.active[i] and i not in self._prefills]
+                if not self.active[i] and i not in self._prefills
+                and i not in self._frozen]
 
     # -- request lifecycle ---------------------------------------------------
 
@@ -515,7 +528,13 @@ class SlotServerBase:
         server adds pool pages and prefix-cache hit rate)."""
         return {
             "n_slots": self.n_slots,
-            "active_slots": int(self.active.sum()),
+            # frozen (mid-migration) slots COUNT as occupied: their
+            # handoff has not resolved, so the capacity is genuinely
+            # held — and the pool's drained() gate must never read a
+            # replica idle while a transfer is still in flight (the
+            # autoscaler would terminate the source before commit)
+            "active_slots": int(self.active.sum()) + len(self._frozen),
+            "migrating_slots": len(self._frozen),
             "queue_depth": len(self._queue),
             "inflight_prefills": len(self._prefills),
             "queue_wait_p99_ms": self._metrics.recent_percentile(
@@ -869,6 +888,7 @@ class SlotServerBase:
         self._done[rid] = True
         self.active[slot] = False           # slot immediately reusable
         self._invalidate_dev("active")
+        self._frozen.discard(slot)          # cancel() mid-migration
         self._slot_rid[slot] = None
         self._prefills.pop(slot, None)      # cancel() mid-prefill
         if slot in self._prefill_fifo:
@@ -898,6 +918,14 @@ class SlotServerBase:
                 return True
         for slot in range(self.n_slots):
             if self._slot_rid[slot] == rid:
+                if slot in self._frozen:
+                    # mid-handoff: a cancel here races the in-flight
+                    # wire transfer — the target could commit a live
+                    # copy AFTER the local retire, breaking
+                    # at-most-one-active. The handoff always resolves
+                    # (commit-ack retires, refusal unfreezes); cancel
+                    # again after it does.
+                    return False
                 # a deferred first token for this slot must not be routed
                 # to the next occupant
                 self._pending_first.pop(slot, None)
@@ -927,6 +955,181 @@ class SlotServerBase:
 
     def _on_retire(self, slot: int) -> None:
         pass
+
+    # -- live migration (Round-16) -------------------------------------------
+    #
+    # The HOST half of live KV migration: which streams may move, the
+    # pause/resume dance around a wire handoff, and how a migrated-away
+    # stream finishes locally. The page/cache half (snapshot_slot /
+    # restore_slot) lives on the paged server — these legs are
+    # cache-layout-free and shared with it. All of them are BARRIER legs
+    # (never called from inside step(); they may sync and upload —
+    # lint rule KTP001 classifies them so).
+
+    def migratable_rids(self) -> List[int]:
+        """Request ids whose stream may be snapshot NOW: actively
+        decoding, not mid-(chunked-)prefill, first token materialized,
+        not already frozen for another handoff. Migration happens only
+        BETWEEN steps and only between rounds — a half-written prefill
+        chunk has no token-exact resume point."""
+        if self._inflight is not None:
+            return []          # overlap pipeline holds an unrouted step
+        out: List[int] = []
+        for slot in range(self.n_slots):
+            rid = self._slot_rid[slot]
+            if (rid is None or not self.active[slot]
+                    or slot in self._prefills
+                    or slot in self._pending_first
+                    or slot in self._frozen):
+                continue
+            out.append(rid)
+        return out
+
+    def freeze_slot(self, rid: int) -> None:
+        """Pause *rid*'s slot for a handoff: inactive for the step legs
+        (decode neither advances nor writes it — the masked no-op path),
+        but NOT reusable and NOT idle. A frozen stream resumes exactly
+        where it stopped (``unfreeze_slot``) or finishes by migrating
+        (``finish_migrated``) — never both."""
+        slot = self._slot_rid.index(rid)
+        self._frozen.add(slot)
+        self.active[slot] = False
+        self._invalidate_dev("active")
+
+    def unfreeze_slot(self, rid: int) -> None:
+        """Resume a frozen stream after a DEFINITIVELY refused handoff —
+        the stream continues here token-exactly (a paused slot's device
+        state never moved). Tolerates a stream canceled mid-transfer."""
+        try:
+            slot = self._slot_rid.index(rid)
+        except ValueError:
+            return                 # canceled while the wire leg ran
+        if slot in self._frozen:
+            self._frozen.discard(slot)
+            self.active[slot] = True
+            self._invalidate_dev("active")
+
+    def finish_migrated(self, rid: int, info: dict) -> None:
+        """Source-side completion of a migrated stream: the slot frees
+        exactly like a retire (pages released, prefix published), but
+        the request FINISHES as migrated — result readers get the new
+        owner (*info*: replica/rid/epoch, via ``migrated_to``) instead
+        of tokens. Only call after the target's commit-ack (or on an
+        AMBIGUOUS outcome, where resuming locally could double-run the
+        stream — at-most-one-active beats finishing here)."""
+        if rid in self._prompts:   # a canceled-AND-popped rid must not
+            self._migrated[rid] = dict(info)   # leak an unpoppable entry
+        try:
+            slot = self._slot_rid.index(rid)
+        except ValueError:
+            return                 # canceled while the wire leg ran
+        self.events.emit("migrate_out", rid=rid, slot=slot,
+                         replica=info.get("replica"),
+                         epoch=info.get("epoch"))
+        self._retire(slot)
+
+    def migrated_to(self, rid: int) -> Optional[dict]:
+        """Where a migrated-away stream went ({replica, rid, epoch,
+        ambiguous?}), or None for streams that finished here."""
+        return self._migrated.get(rid)
+
+    def cancel_expired(self, rid: int, reason: str) -> bool:
+        """Cancel *rid* AND mark it expired with *reason* so the wire
+        layer reports a retryable refusal (503, like a queue-TTL expiry)
+        instead of returning partial tokens as success — the
+        drain-timeout escalation's spelling."""
+        if self._done.get(rid, False):
+            return False
+        self._expired[rid] = str(reason)
+        ok = self.cancel(rid)
+        if not ok:
+            self._expired.pop(rid, None)
+        return ok
+
+    def unfinished_rids(self) -> List[int]:
+        """Every request not yet finished — queued, mid-prefill, active
+        or frozen — the set a drain timeout must resolve."""
+        out: List[int] = [rid for rid, _p, _d in self._queue]
+        out += [st["rid"] for st in self._prefills.values()]
+        out += [r for r in self._slot_rid if r is not None]
+        seen: set = set()
+        uniq = []
+        for r in out:
+            if r not in seen and not self._done.get(r, False):
+                seen.add(r)
+                uniq.append(r)
+        return uniq
+
+    def snapshot_slot(self, rid: int) -> dict:
+        """Base servers carry no shippable cache view: live migration
+        is implemented by the PAGED servers (the page table is the
+        portable representation). Raises NotImplementedError, which the
+        wire layer's migrate leg treats as a per-stream skip — a fleet
+        of dense replicas degrades to wait-drain instead of crashing
+        the drain-migrate thread."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support live migration — "
+            f"snapshot/restore ship the paged servers' page view")
+
+    def restore_slot(self, snap: dict, reason: str = "migrate"):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support live migration — "
+            f"snapshot/restore ship the paged servers' page view")
+
+    def _snapshot_request(self, rid: int, slot: int) -> dict:
+        """The cache-layout-free half of a slot snapshot: request
+        bookkeeping (prompt, emitted, logprobs, sampling), the RAW
+        request key (restore must reuse it verbatim — the target's own
+        ``fold_in(seed, rid)`` would change every sampled draw), the
+        device position/last-token pair, and the stream's handoff
+        identity. The device reads here are the snapshot's designed
+        sync — a barrier leg, never inside step()."""
+        return {
+            "version": 1,
+            "prompt": [int(t) for t in self._prompts[rid]],
+            "emitted": [int(t) for t in self._emitted[rid]],
+            "logprobs": [float(x) for x in self._logprobs[rid]],
+            "sampling": list(self._rid_sampling.get(
+                rid, self._default_sampling)),
+            "reqkey": [int(x) for x in self._slot_reqkey[slot]],
+            "pos": int(np.asarray(self.pos)[slot]),
+            "last": int(np.asarray(self.last)[slot]),
+            "origin": (list(self._stream_origin[rid])
+                       if rid in self._stream_origin else None),
+            "epoch": int(self._stream_epoch.get(rid, 0)),
+            "max_new_tokens": self.max_new_tokens,
+            "eos_id": self.eos_id,
+        }
+
+    def _restore_request(self, snap: dict, slot: int) -> int:
+        """Rebuild the request-state half of a restored slot -> the new
+        LOCAL rid. The caller (``restore_slot``) owns page/cache
+        restoration and activation ordering; this leg only installs
+        bookkeeping + per-slot sampling state."""
+        prompt = [int(t) for t in snap["prompt"]]
+        emitted = [int(t) for t in snap["emitted"]]
+        rid = self._next_rid
+        self._next_rid += 1
+        s = snap.get("sampling") or list(self._default_sampling)
+        self._rid_sampling[rid] = (float(s[0]), int(s[1]), float(s[2]))
+        now = time.perf_counter()
+        self._arrive[rid] = now        # TTFT/ITL restart at the handoff:
+        self._last_emit[rid] = now     # the blip is the honest number
+        self._qw_recorded.add(rid)     # queue wait was paid at the source
+        self._bind_slot(rid, slot)
+        # the SOURCE's request key, verbatim: sampled continuation must
+        # draw exactly what an unmigrated run would have drawn
+        self._slot_reqkey[slot] = np.asarray(snap["reqkey"], np.uint32)
+        self._invalidate_dev("reqkey")
+        self._prompts[rid] = prompt
+        self._emitted[rid] = emitted
+        self._logprobs[rid] = [float(x) for x in snap.get("logprobs", [])]
+        self._done[rid] = False
+        self._slot_rid[slot] = rid
+        self._stream_epoch[rid] = int(snap.get("epoch", 0))
+        if snap.get("origin") is not None:
+            self._stream_origin[rid] = tuple(snap["origin"])
+        return rid
 
     # -- results -------------------------------------------------------------
 
@@ -958,13 +1161,26 @@ class SlotServerBase:
         self._arrive.pop(rid, None)   # observability stamps are too
         self._last_emit.pop(rid, None)
         self._qw_recorded.discard(rid)
+        self._migrated.pop(rid, None)
+        self._stream_epoch.pop(rid, None)
+        self._stream_origin.pop(rid, None)
         return out
+
+    def _runnable(self) -> bool:
+        """A ``step()`` would advance something: active decodes, queued
+        admissions, in-flight prefill chunks or an unflushed overlap
+        step. A server whose ONLY remaining work is frozen (mid-
+        migration) slots is NOT runnable — stepping it is a no-op, and
+        a driver loop should sleep instead of spinning until the
+        handoff resolves — but it is not idle either (``_idle``)."""
+        return bool(self.active.any() or self._queue
+                    or self._prefills or self._inflight is not None)
 
     def _idle(self) -> bool:
         """Nothing to do: no active decode, no queue, no in-flight
-        prefill chunks, no un-materialized overlap step."""
-        return (not self.active.any() and not self._queue
-                and not self._prefills and self._inflight is None)
+        prefill chunks, no un-materialized overlap step, no stream
+        frozen mid-migration (its handoff has not resolved yet)."""
+        return not self._runnable() and not self._frozen
 
     def drain(self, max_steps: int = 10_000) -> None:
         """Run until every admitted AND queued request finishes (flushing

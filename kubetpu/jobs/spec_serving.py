@@ -652,6 +652,48 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
                            proposed: int) -> None:
         self._update_gamma(slot, accepted, proposed)
 
+    # -- live KV migration (Round-16) -----------------------------------------
+
+    def _migration_kind(self) -> str:
+        return "paged_spec"
+
+    def snapshot_slot(self, rid: int) -> dict:
+        """The paged snapshot plus the speculative controller's state:
+        the slot's adaptive gamma and acceptance EMA survive the handoff
+        (a migrated low-agreement stream must not restart optimistic at
+        gamma_max and re-pay the walk down). The draft's dense cache
+        rows do NOT ship: stale draft KV on the target can only lower
+        acceptance, never change output — verification is greedy-exact
+        (the prefix-hit argument, applied to migration)."""
+        snap = super().snapshot_slot(rid)
+        slot = self._slot_rid.index(rid)
+        snap["draft_fp"] = repr(self.draft_cfg)
+        snap["spec"] = {
+            "gamma": int(self._gamma[slot]),
+            "accept_ema": float(self._accept_ema[slot]),
+        }
+        return snap
+
+    def restore_slot(self, snap: dict, reason: str = "migrate"):
+        if snap.get("draft_fp") != repr(self.draft_cfg):
+            raise ValueError(
+                "snapshot draft config does not match this server's — "
+                "migration requires config-identical replicas")
+        rid = super().restore_slot(snap, reason=reason)
+        if rid is None:
+            return None
+        spec = snap.get("spec") or {}
+        slot = self._slot_rid.index(rid)
+        # _note_admitted (via super) reset the controller optimistic;
+        # the snapshot's walked-down state wins
+        g = min(max(int(spec.get("gamma", self.gamma_max)), 1),
+                self.gamma_max)
+        if int(self._gamma[slot]) != g:
+            self._gamma[slot] = g
+            self._invalidate_dev("gamma")
+        self._accept_ema[slot] = float(spec.get("accept_ema", 1.0))
+        return rid
+
     def warmup(self) -> None:
         """Base warmup (target prompt buckets + chunked signatures + the
         one-token step; flushes the prefix tree), then the draft's

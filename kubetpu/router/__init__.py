@@ -13,7 +13,10 @@ Three cooperating parts in front of N serving replicas:
   (registration, breaker health, snapshots, federation);
 - :mod:`kubetpu.router.autoscaler` — ``ReplicaAutoscaler``, the
   reconcile loop scaling the replica set from the federated signals
-  with hysteresis and scale-down-only-after-drain.
+  with hysteresis and migrate-then-drain scale-down;
+- :mod:`kubetpu.router.migration` — the snapshot wire codec for live
+  KV migration (Round-16): meta + chunked blob encoding for the
+  ``POST /migrate_in`` transfer.
 
 Deliberately light: stdlib + ``kubetpu.obs`` + ``kubetpu.wire`` only —
 importing the router NEVER imports jax (the router process holds no
@@ -22,6 +25,7 @@ model state and routes for accelerator fleets it doesn't run on).
 
 from kubetpu.router.autoscaler import ReplicaAutoscaler, ScalePolicy
 from kubetpu.router.hashring import HashRing, prefix_head_key
+from kubetpu.router.migration import decode_snapshot, encode_snapshot
 from kubetpu.router.pool import ReplicaPool
 from kubetpu.router.replica import ReplicaServer
 from kubetpu.router.server import RouterServer
@@ -33,5 +37,7 @@ __all__ = [
     "ReplicaServer",
     "RouterServer",
     "ScalePolicy",
+    "decode_snapshot",
+    "encode_snapshot",
     "prefix_head_key",
 ]

@@ -12,13 +12,14 @@ with HYSTERESIS:
   operator's replica factory — boots a server, returns its URL) and
   the newcomer registers with the router, earning its ring arcs (which
   remaps only ~1/N prefix buckets — the hashring contract);
-- **scale down** only AFTER GRACEFUL DRAIN: ``down_after`` consecutive
-  cold passes pick the least-loaded routable victim and ask the pool
-  to drain it (routing stops immediately, in-flight requests finish).
-  Only when the victim's ``/load`` reads drained-and-idle is it
-  removed from the ring and handed to ``terminator`` — a scale-down
-  can never drop a live stream (ROADMAP's live-KV-migration item is
-  the future upgrade; drain-first is the safe spelling today);
+- **scale down** is MIGRATE -> DRAIN -> REMOVE (Round-16):
+  ``down_after`` consecutive cold passes pick the least-loaded
+  routable victim, hand its in-flight streams live to the least-loaded
+  survivor (token-exact slot handoff — ``scale_down_migrate`` event),
+  and drain it (routing stops immediately). Only when the victim's
+  ``/load`` reads drained-and-idle is it removed from the ring and
+  handed to ``terminator`` — a scale-down never drops a live stream
+  AND never waits out a long one;
 - **cooldown** after any action (``cooldown_s``) so a scale event's
   own disruption (warmup, cache cold start) can't trigger the next.
 
@@ -246,7 +247,21 @@ class ReplicaAutoscaler:
 
         victim = min(names, key=load_key)
         url = self.router.pool.url(victim)
-        self.router.pool.drain(victim)
+        # Round-16: scale-down is migrate -> drain -> remove. The
+        # victim's in-flight streams hand off live to the least-loaded
+        # SURVIVOR, so removal never waits out a long stream (and the
+        # drain-timeout backstop never has to cancel one). With no
+        # survivor to take them (shouldn't happen above min_replicas,
+        # but stay honest) the drain falls back to waiting.
+        survivors = [n for n in names if n != victim]
+        target = min(survivors, key=load_key) if survivors else None
+        target_url = (self.router.pool.url(target)
+                      if target is not None else None)
+        if target_url is not None:
+            self.events.emit("scale_down_migrate", replica=victim,
+                             target=target)
+        self.router.pool.drain(victim, migrate_to=target_url,
+                               reason="scale_down")
         self.events.emit("drain", replica=victim, reason="scale_down")
         with self._lock:
             self._cold = 0

@@ -1,0 +1,92 @@
+"""Snapshot wire codec for live KV migration (Round-16).
+
+``PagedDecodeServer.snapshot_slot`` returns a host-side dict whose
+``pages`` entry holds numpy arrays (f32 pools: ``k``/``v``; kv_int8
+pools: the quantized ``k_q``/``k_s``/``v_q``/``v_s`` pairs AS STORED —
+the codec never dequantizes). JSON can't carry them, and one monolithic
+body would couple the transfer's fault surface to the snapshot size —
+so the wire protocol splits a snapshot into:
+
+- **meta**: the JSON-safe fields plus an ``arrays`` manifest
+  (name/dtype/shape per array, in blob order);
+- **blob**: every array's raw bytes concatenated in manifest order,
+  shipped as base64 CHUNKS of ``chunk_bytes`` each.
+
+``encode_snapshot`` produces (meta, blob); ``decode_snapshot`` is the
+exact inverse (length-checked — a short blob means a lost chunk and
+must fail loudly, never restore garbage KV). ``blob_chunks`` is the
+splitter the replica's ``/migrate_in`` phases ride.
+
+Stdlib + numpy only (the router package stays jax-free); the arrays
+cross back into jax land inside ``restore_slot``.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+DEFAULT_CHUNK_BYTES = 256 * 1024
+
+
+def encode_snapshot(snap: dict) -> Tuple[dict, bytes]:
+    """Split a slot snapshot into (JSON-safe meta, raw page blob)."""
+    meta = {k: v for k, v in snap.items() if k != "pages"}
+    specs: List[dict] = []
+    parts: List[bytes] = []
+    for name in sorted(snap.get("pages", {})):
+        arr = np.ascontiguousarray(snap["pages"][name])
+        specs.append({"name": name, "dtype": str(arr.dtype),
+                      "shape": list(arr.shape)})
+        parts.append(arr.tobytes())
+    meta["arrays"] = specs
+    return meta, b"".join(parts)
+
+
+def decode_snapshot(meta: dict, blob: bytes) -> dict:
+    """Rebuild the snapshot dict ``restore_slot`` consumes. Raises
+    ValueError when the blob's length disagrees with the manifest — a
+    lost or duplicated chunk must refuse the restore, not scribble
+    half a cache."""
+    snap = {k: v for k, v in meta.items() if k != "arrays"}
+    pages: Dict[str, np.ndarray] = {}
+    off = 0
+    for spec in meta.get("arrays", ()):
+        dt = np.dtype(spec["dtype"])
+        n = int(np.prod(spec["shape"], dtype=np.int64)) * dt.itemsize
+        if off + n > len(blob):
+            raise ValueError(
+                f"snapshot blob truncated: need {off + n} bytes for "
+                f"{spec['name']!r}, have {len(blob)}")
+        pages[spec["name"]] = np.frombuffer(
+            blob[off:off + n], dtype=dt).reshape(spec["shape"]).copy()
+        off += n
+    if off != len(blob):
+        raise ValueError(
+            f"snapshot blob has {len(blob) - off} trailing bytes — "
+            f"manifest and chunks disagree")
+    snap["pages"] = pages
+    return snap
+
+
+def blob_chunks(blob: bytes,
+                chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> List[bytes]:
+    """Split *blob* into wire chunks (always at least one, so the
+    commit leg can assert it saw every sequence number even for an
+    empty manifest)."""
+    if chunk_bytes <= 0:
+        raise ValueError("chunk_bytes must be positive")
+    if not blob:
+        return [b""]
+    return [blob[i:i + chunk_bytes]
+            for i in range(0, len(blob), chunk_bytes)]
+
+
+def chunk_b64(chunk: bytes) -> str:
+    return base64.b64encode(chunk).decode("ascii")
+
+
+def chunk_unb64(data: str) -> bytes:
+    return base64.b64decode(data.encode("ascii"), validate=True)

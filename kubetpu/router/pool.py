@@ -260,24 +260,42 @@ class ReplicaPool:
 
     # -- drain ---------------------------------------------------------------
 
-    def drain(self, name: str) -> bool:
+    def drain(self, name: str, migrate_to: Optional[str] = None,
+              reason: str = "drain") -> bool:
         """Ask *name* to drain (idempotency-keyed POST) and stop routing
-        to it. Returns False for unknown replicas; a failed POST still
-        cordons the handle (the router stops sending work either way —
-        the replica-side refusal is belt on top of braces)."""
+        to it. With *migrate_to* (a replica URL) the drain is a LIVE
+        HANDOFF: the replica migrates its in-flight streams there
+        token-exactly and completes immediately (Round-16) instead of
+        waiting out every stream. Returns False for unknown replicas; a
+        failed POST still cordons the handle (the router stops sending
+        work either way — the replica-side refusal is belt on top of
+        braces)."""
         with self._lock:
             h = self._replicas.get(name)
             if h is None:
                 return False
             h.draining = True
             url = h.url
+        body: dict = {"reason": reason}
+        if migrate_to:
+            body["migrate_to"] = migrate_to
         try:
-            request_json(url + "/drain", {}, token=self.token,
+            request_json(url + "/drain", body, token=self.token,
                          timeout=self.scrape_timeout,
                          idempotency_key=f"router-drain-{uuid.uuid4().hex}")
         except Exception:  # noqa: BLE001 — cordon held locally regardless
             pass
         return True
+
+    def name_for_url(self, url: str) -> Optional[str]:
+        """Registered name owning *url* (None when unknown) — how the
+        router resolves a migrated-to target named only by URL."""
+        url = url.rstrip("/")
+        with self._lock:
+            for n, h in self._replicas.items():
+                if h.url == url:
+                    return n
+        return None
 
     def drained(self, name: str) -> bool:
         """True once the replica's LAST snapshot shows it draining and
@@ -298,6 +316,9 @@ class ReplicaPool:
         return (int(load.get("active_slots", 1)) == 0
                 and int(load.get("queue_depth", 1)) == 0
                 and int(load.get("inflight_prefills", 0)) == 0
+                # a slot frozen mid-handoff is NOT drained: removing
+                # the source before its commit-ack drops the stream
+                and int(load.get("migrating_slots", 0)) == 0
                 and bool(load.get("draining")))
 
     def alive(self) -> List[str]:

@@ -24,9 +24,29 @@ wire. This server is that missing half — the leg the affinity router
                         "timeout": s?} -> {"rid", "tokens", "emitted"}
                         — synchronous generate: enqueue, wait for the
                         step loop to finish the request, return
-                        prompt + emitted tokens
+                        prompt + emitted tokens. A stream that MIGRATED
+                        away mid-generate answers 409 with the new
+                        owner ({"migrated": {replica, rid, epoch}}) so
+                        the router can re-pin and retry there
     POST /drain      -> stop accepting generates (503); in-flight
-                        requests run to completion
+                        requests run to completion — or, with
+                        {"migrate_to": url}, are handed off live and
+                        the drain completes immediately
+    POST /migrate_out-> {"target": url, "reason"?, "wait"?} — snapshot
+                        every migratable stream and hand it to the
+                        target replica (the breaker-suspect and
+                        drain-escalation leg)
+    POST /migrate_in -> the chunked snapshot transfer (Round-16):
+                        phase "begin" (meta + chunk count) -> "chunk"*N
+                        (base64 blob slices) -> "commit" (restore +
+                        adoption). Every phase POST carries an
+                        Idempotency-Key derived from the stream's
+                        (origin, rid, epoch), so a lost response
+                        REPLAYS — a retry can never double-restore;
+                        the commit additionally EPOCH-FENCES per
+                        (origin, rid): a stale or duplicate handoff
+                        generation is refused 409, keeping at most one
+                        copy of a stream active fleet-wide
 
 Robustness (the Round-7 contract, uniformly):
 
@@ -39,7 +59,20 @@ Robustness (the Round-7 contract, uniformly):
 - **graceful drain**: ``drain()`` refuses NEW generates with 503 while
   requests already admitted (or waiting on the handler) complete —
   the autoscaler's scale-down path depends on this (drain first,
-  remove only once ``/load`` reads idle);
+  remove only once ``/load`` reads idle). ``drain(migrate_to=url)``
+  upgrades the wait to a LIVE HANDOFF: every in-flight stream
+  snapshots to the target token-exactly and the drain completes as
+  fast as the wire, not as slow as the longest stream.
+  ``drain_timeout_s`` bounds the no-migration wait: past it, remaining
+  streams either escalate to migration (a target was named) or cancel
+  with a ``drain_timeout`` event — scale-down never wedges behind one
+  long-max_tokens stream;
+- **at-most-one-active migration**: the source retires a migrated slot
+  only after the target's commit-ack; an AMBIGUOUS outcome (transport
+  dead past the retry budget) finishes the stream as migrated rather
+  than resuming — the target may have committed, and a resumed copy
+  would double-run the stream. Only a DEFINITIVE refusal (an HTTP
+  error answer) unfreezes and resumes locally;
 - **fault injection**: ``faults=FaultInjector(...)`` chaos-tests the
   surface like every other wire server.
 
@@ -59,17 +92,29 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.error
+import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from kubetpu.api import utils
 from kubetpu.obs import trace as obs_trace
 from kubetpu.obs.events import EventLog, merge_events
+from kubetpu.router.migration import (
+    DEFAULT_CHUNK_BYTES,
+    blob_chunks,
+    chunk_b64,
+    chunk_unb64,
+    decode_snapshot,
+    encode_snapshot,
+)
 from kubetpu.wire.httpcommon import (
     IdempotencyCache,
     InflightTracker,
     check_bearer,
     handle_guarded,
+    request_json,
     run_idempotent,
     serve_events_jsonl,
     write_json,
@@ -77,6 +122,10 @@ from kubetpu.wire.httpcommon import (
 )
 
 DEFAULT_GENERATE_TIMEOUT = 30.0
+DEFAULT_MIGRATE_TIMEOUT = 20.0
+# staging slots for inbound chunked transfers: stale entries (a source
+# that died mid-ship) are reaped after this many seconds
+MIGRATE_STAGING_TTL = 60.0
 
 
 class ReplicaServer:
@@ -93,11 +142,20 @@ class ReplicaServer:
         faults=None,
         idem_window: float = 300.0,
         idle_wait: float = 0.005,
+        drain_timeout_s: Optional[float] = None,
+        migrate_timeout: float = DEFAULT_MIGRATE_TIMEOUT,
+        migrate_chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     ) -> None:
         """*server*: the serving object (enqueue/step/finished/
         pop_result/load_info — ``SlotServerBase`` and every subclass).
         *idle_wait*: step-loop sleep while the server is idle (bounds
-        enqueue-to-first-step latency when work arrives)."""
+        enqueue-to-first-step latency when work arrives).
+        *drain_timeout_s*: bound on a no-migration drain's wait for
+        natural stream end — past it, remaining streams escalate to
+        migration (when a target was named) or cancel with a
+        ``drain_timeout`` event, so scale-down never wedges behind one
+        long-max_tokens stream. None = wait forever (the pre-Round-16
+        behavior)."""
         self.server = server
         self.name = name
         self.token = token or None
@@ -110,9 +168,40 @@ class ReplicaServer:
         self._cv = threading.Condition()
         self._running = False
         self._idle_wait = float(idle_wait)
+        if drain_timeout_s is not None and drain_timeout_s < 0:
+            raise ValueError("drain_timeout_s must be >= 0 (None = wait)")
+        self.drain_timeout_s = drain_timeout_s
+        self.migrate_timeout = float(migrate_timeout)
+        if int(migrate_chunk_bytes) <= 0:
+            raise ValueError("migrate_chunk_bytes must be positive")
+        self.migrate_chunk_bytes = int(migrate_chunk_bytes)
+        # -- live-migration state (all under self._cv: the handlers, the
+        # step loop and the drain-migrate thread share it):
+        # rid -> the generate leg's Idempotency-Key (shipped in the
+        # snapshot meta so the TARGET can adopt a router retry of the
+        # same logical request into the restored stream)
+        self._gen_keys: dict = {}
+        # gen key -> restored local rid, installed at migrate-in commit
+        # and consumed by the first /generate carrying that key
+        # (bounded: an orphaned handoff whose retry never arrives must
+        # not leak an entry per stream forever)
+        self._adopted: "OrderedDict[str, int]" = OrderedDict()
+        # gen key -> migrated-away info: a retry of a migrated request
+        # must deterministically re-learn the new owner (409), never
+        # re-admit here (run_idempotent only replays 200s)
+        self._migrated_keys: "OrderedDict[str, dict]" = OrderedDict()
+        # inbound chunked transfers: (origin, rid, epoch) -> staging
+        self._mig_staging: dict = {}
+        # the EPOCH FENCE: (origin, rid) -> highest committed epoch; a
+        # commit at <= that epoch is a duplicate/stale handoff and is
+        # refused — at most one copy of a stream ever goes active
+        self._mig_epochs: "OrderedDict[tuple, int]" = OrderedDict()
+        self._drain_migrate: Optional[str] = None
+        self._drain_deadline: Optional[float] = None
+        self._drain_thread: Optional[threading.Thread] = None
         # replica wire counters land on the SERVING registry so one
         # /metrics scrape carries both (the router federates it whole)
-        for key in ("requests", "replays", "errors"):
+        for key in ("requests", "replays", "errors", "adopted"):
             # key ranges over the fixed literal tuple above — KTP004's
             # bounded-f-string proof expands and validates every name
             self.server.obs.counter(f"kubetpu_replica_generate_{key}_total")
@@ -175,28 +264,39 @@ class ReplicaServer:
             def _do_post(self):
                 if not self._authorized():
                     return
-                if self.path == "/drain":
-                    replica.drain()
-                    write_json(self, 200, {"draining": True})
-                    return
-                if self.path != "/generate":
-                    write_json(self, 404, {"error": f"no route {self.path}"})
-                    return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
                 except ValueError:
                     write_json(self, 400, {"error": "body is not JSON"})
                     return
+                if self.path == "/drain":
+                    replica.drain(migrate_to=req.get("migrate_to"),
+                                  reason=req.get("reason") or "drain")
+                    write_json(self, 200, {"draining": True})
+                    return
+                if self.path == "/migrate_out":
+                    write_json(self, *replica._migrate_out(req))
+                    return
+                if self.path == "/migrate_in":
+                    run_idempotent(
+                        self, replica.idem,
+                        self.headers.get("Idempotency-Key"),
+                        lambda: replica._migrate_in(req),
+                    )
+                    return
+                if self.path != "/generate":
+                    write_json(self, 404, {"error": f"no route {self.path}"})
+                    return
 
                 def replayed():
                     bump("replays")
                     replica.events.emit("generate_replay")
 
+                key = self.headers.get("Idempotency-Key")
                 run_idempotent(
-                    self, replica.idem,
-                    self.headers.get("Idempotency-Key"),
-                    lambda: replica._generate(req),
+                    self, replica.idem, key,
+                    lambda: replica._generate(req, key=key),
                     on_replay=replayed,
                 )
 
@@ -206,13 +306,18 @@ class ReplicaServer:
 
     # -- the generate leg ----------------------------------------------------
 
-    def _generate(self, req: dict):
+    def _generate(self, req: dict, key: Optional[str] = None):
         """One generate execution -> (code, obj); runs on the handler
         thread under ``run_idempotent`` (200 commits into the replay
         window, anything else aborts so a retry re-executes). The
         draining refusal lives HERE, after the replay lookup: a keyed
         retry of an already-committed generate must get its replay even
-        mid-drain (replaying mutates nothing)."""
+        mid-drain (replaying mutates nothing). Round-16 additions: a
+        keyed retry of a request that MIGRATED away deterministically
+        answers 409 with the new owner (never re-admits here), and a
+        keyed request whose stream migrated IN is ADOPTED — attached to
+        the restored stream instead of admitted fresh (adoption works
+        mid-drain too: attaching mutates nothing new)."""
         deadline = time.monotonic() + float(
             req.get("timeout") or DEFAULT_GENERATE_TIMEOUT)
         prompt = req.get("prompt")
@@ -221,22 +326,48 @@ class ReplicaServer:
             return 400, {"error": "prompt must be a non-empty list of "
                                   "token ids"}
         with self._cv:
-            if self.draining:
-                return 503, {"error": "replica is draining"}
-            if not self._running:
-                return 503, {"error": "replica step loop is not running"}
-            self.events.emit("generate", prompt_tokens=len(prompt))
-            try:
-                rid = self.server.enqueue(prompt,
-                                          sampling=req.get("sampling"))
-            except ValueError as e:
-                return 400, {"error": str(e)}
-            except Exception as e:  # noqa: BLE001 — report, stay up
+            gone = self._migrated_keys.get(key) if key else None
+            if gone is not None:
+                return 409, {"error": "request migrated",
+                             "migrated": dict(gone)}
+            adopted = self._adopted.pop(key, None) if key else None
+            if adopted is None and key:
+                # a retry of a request still LIVE here — its earlier
+                # handler timed out (e.g. while the stream was frozen
+                # mid-handoff) and run_idempotent aborted the entry, so
+                # a naive path would re-ADMIT the same logical request
+                # next to its own live stream. Re-attach instead.
+                adopted = next(
+                    (r for r, k in self._gen_keys.items()
+                     if k == key and not self.server.finished(r)), None)
+            if adopted is not None:
+                rid = adopted
                 self.server.obs.counter(
-                    "kubetpu_replica_generate_errors_total").inc()
-                return 500, {"error": str(e)}
-            self.server.obs.counter(
-                "kubetpu_replica_generate_requests_total").inc()
+                    "kubetpu_replica_generate_adopted_total",
+                    "router retries attached to a migrated-in stream "
+                    "instead of admitted fresh").inc()
+                self.events.emit("generate_adopt", rid=rid)
+            else:
+                if self.draining:
+                    return 503, {"error": "replica is draining"}
+                if not self._running:
+                    return 503, {"error": "replica step loop is not "
+                                          "running"}
+                self.events.emit("generate", prompt_tokens=len(prompt))
+                try:
+                    rid = self.server.enqueue(prompt,
+                                              sampling=req.get("sampling"))
+                except ValueError as e:
+                    return 400, {"error": str(e)}
+                except Exception as e:  # noqa: BLE001 — report, stay up
+                    self.server.obs.counter(
+                        "kubetpu_replica_generate_errors_total").inc()
+                    return 500, {"error": str(e)}
+                self.server.obs.counter(
+                    "kubetpu_replica_generate_requests_total").inc()
+                if key:
+                    self._gen_keys[rid] = key
+                    self._gc_gen_keys_locked()
             self._cv.notify_all()
             while not self.server.finished(rid):
                 remaining = deadline - time.monotonic()
@@ -244,9 +375,26 @@ class ReplicaServer:
                     self.server.cancel(rid)
                     if self.server.finished(rid):
                         self.server.pop_result(rid)
+                        self._gen_keys.pop(rid, None)
+                    # a stream cancel() REFUSED (frozen mid-handoff)
+                    # keeps its key binding: a retry of this key must
+                    # RE-ATTACH to the live stream (or learn the 409
+                    # after the handoff resolves), never re-admit
                     return 503, {"error": "generate deadline exceeded"
                                  if self._running else "replica stopping"}
                 self._cv.wait(timeout=min(remaining, 0.25))
+            self._gen_keys.pop(rid, None)
+            mig = self.server.migrated_to(rid)
+            if mig is not None:
+                # the stream lives on elsewhere: remember the verdict
+                # per key (a retry must re-learn it, not re-admit) and
+                # reclaim local bookkeeping — the target owns the tokens
+                if key:
+                    self._migrated_keys[key] = dict(mig)
+                    self._trim_locked(self._migrated_keys)
+                self.server.pop_result(rid)
+                return 409, {"error": "request migrated",
+                             "migrated": dict(mig)}
             reason = self.server.expire_reason(rid)
             tokens = self.server.pop_result(rid)
         if reason is not None:
@@ -257,6 +405,428 @@ class ReplicaServer:
             "tokens": tokens,
             "emitted": tokens[len(prompt):],
         }
+
+    # -- live KV migration (Round-16) ----------------------------------------
+
+    def migrate_rid(self, rid: int, target_url: str,
+                    reason: str = "manual") -> bool:
+        """Hand ONE in-flight stream to *target_url* token-exactly:
+        snapshot + freeze under the condition (the step loop pauses the
+        slot, nothing else moves it), ship the snapshot as idempotency-
+        keyed begin/chunk*N/commit POSTs (keys derive from the stream's
+        (origin, rid, epoch) — a lost response replays, never a second
+        restore), and retire the local slot only after the target's
+        commit-ack. Outcomes:
+
+        - **committed**: target ack'd — the slot retires as migrated
+          (callers get 409 + the new owner);
+        - **refused** (definitive HTTP error answer): the slot
+          unfreezes and resumes locally, token-exactly;
+        - **fenced** (409 fenced): another copy already owns the stream
+          at >= this epoch — never resume (at-most-one-active);
+        - **ambiguous** (transport dead past the retry budget): the
+          target MAY have committed, so resuming could double-run the
+          stream — the slot finishes as migrated toward the attempted
+          target; a router retry either adopts the restored stream or
+          re-admits fresh (token-exact either way).
+
+        Counted as ``kubetpu_migrations_total{reason,result}``."""
+        target_url = target_url.rstrip("/")
+        with self._cv:
+            try:
+                snap = self.server.snapshot_slot(rid)
+            except (ValueError, NotImplementedError) as e:
+                self.events.emit("migrate_skip", rid=rid, error=str(e))
+                return False
+            self.server.freeze_slot(rid)
+            # the stream's generate key: from an attached handler, or —
+            # for a migrated-IN stream whose router retry has not landed
+            # yet — from the adoption map. It ships in the meta so the
+            # key keeps following the stream across EVERY hop.
+            gen_key = self._gen_keys.get(rid)
+            if gen_key is None:
+                gen_key = next((k for k, v in self._adopted.items()
+                                if v == rid), None)
+        try:
+            # from freeze to the wire leg, ANY failure must unfreeze —
+            # a raise here would otherwise wedge the stream frozen with
+            # no resolution path (no commit, no refusal)
+            origin = list(snap.get("origin") or (self.name, rid))
+            epoch = int(snap.get("epoch", 0)) + 1
+            snap["origin"] = origin
+            snap["epoch"] = epoch
+            pages = snap["pages"]
+            n_live = int(snap["n_live_pages"])
+            meta = {k: v for k, v in snap.items() if k != "pages"}
+            meta["gen_key"] = gen_key
+            meta["reason"] = reason
+            meta["source"] = self.name
+            tok = {"origin": origin, "epoch": epoch}
+            # keys are per ATTEMPT (nonce), not per epoch: retries
+            # inside request_json reuse them (lost-response replay),
+            # while a fresh migrate_rid call after a REFUSAL re-stages
+            # under new keys — an epoch-only key would replay the old
+            # begin 200 against deleted staging and spin hopelessly.
+            # At-most-once is the commit fence's job (a second commit
+            # at the same epoch is refused), not the key's.
+            kbase = (f"mig-{origin[0]}-{origin[1]}-e{epoch}-"
+                     f"{uuid.uuid4().hex[:8]}")
+        except Exception:
+            with self._cv:
+                self.server.unfreeze_slot(rid)
+                self._cv.notify_all()
+            raise
+        self.events.emit("migrate_begin", rid=rid, target=target_url,
+                         reason=reason, epoch=epoch)
+        # Outcome classification is PER LEG: only a failure of the
+        # COMMIT POST can mask an executed (or still-executing) restore
+        # — begin/chunk/encode failures provably left no copy at the
+        # target (staging is not a stream; its TTL reaps it), so the
+        # source resumes token-exactly. A commit-phase 4xx is a
+        # definitive ANSWER of non-commit (restore raised / staging
+        # gone); a commit-phase 5xx or transport death is AMBIGUOUS
+        # (run_idempotent's in-flight 503 can outlive the retry budget
+        # while the restore still runs) and must never resume.
+        leg = "begin"
+        try:
+            with obs_trace.span("migrate.out",
+                                component=self.obs_component,
+                                reason=reason):
+                resp = request_json(
+                    target_url + "/migrate_in",
+                    {"phase": "begin", "token": tok, "meta": meta},
+                    token=self.token, idempotency_key=kbase + "-begin",
+                    timeout=self.migrate_timeout)
+                # the target's prefix hint: pages it can map read-only
+                # from its own cache never cross the wire — ship only
+                # the uncached suffix (commit re-checks; a receded
+                # match refuses and we resume + re-ship). Encoded ONCE,
+                # after the hint, so a warm-target handoff never pays a
+                # full-blob copy it then throws away.
+                skip = min(max(0, int(resp.get("skip_pages") or 0)),
+                           n_live)
+                ship = (pages if skip == 0 else
+                        {n: a[:, skip:] for n, a in pages.items()})
+                enc, blob = encode_snapshot({"pages": ship})
+                arrays, ship_from = enc["arrays"], skip
+                chunks = blob_chunks(blob, self.migrate_chunk_bytes)
+                leg = "chunk"
+                for i, chunk in enumerate(chunks):
+                    request_json(
+                        target_url + "/migrate_in",
+                        {"phase": "chunk", "token": tok, "seq": i,
+                         "data": chunk_b64(chunk)},
+                        token=self.token,
+                        idempotency_key=f"{kbase}-c{i}",
+                        timeout=self.migrate_timeout)
+                leg = "commit"
+                ack = request_json(
+                    target_url + "/migrate_in",
+                    {"phase": "commit", "token": tok,
+                     "n_chunks": len(chunks), "arrays": arrays,
+                     "ship_from_page": ship_from},
+                    token=self.token, idempotency_key=kbase + "-commit",
+                    timeout=self.migrate_timeout)
+        except urllib.error.HTTPError as e:
+            detail = {}
+            try:
+                detail = json.loads(e.read() or b"{}")
+            except Exception:  # noqa: BLE001 — body unreadable/withheld
+                pass
+            if detail.get("fenced"):
+                info = {"replica": detail.get("replica"),
+                        "epoch": int(detail.get("epoch", epoch)),
+                        "fenced": True}
+                with self._cv:
+                    self.server.finish_migrated(rid, info)
+                    self._note_stream_left_locked(rid, gen_key, info)
+                    self._cv.notify_all()
+                self._count_migration(reason, "fenced")
+                return False
+            if e.code < 500 or leg != "commit":
+                with self._cv:
+                    self.server.unfreeze_slot(rid)
+                    self._cv.notify_all()
+                self._count_migration(reason, "refused")
+                self.events.emit("migrate_refused", rid=rid, code=e.code,
+                                 leg=leg,
+                                 error=str(detail.get("error", ""))[:120])
+                return False
+            return self._migrate_ambiguous(rid, gen_key, target_url,
+                                           epoch, reason,
+                                           f"HTTP {e.code} on commit")
+        except Exception as e:  # noqa: BLE001 — transport death
+            if leg != "commit":
+                # no commit POST was ever sent: the target cannot hold
+                # a copy — resume, don't sacrifice the stream
+                with self._cv:
+                    self.server.unfreeze_slot(rid)
+                    self._cv.notify_all()
+                self._count_migration(reason, "refused")
+                self.events.emit("migrate_refused", rid=rid, code=0,
+                                 leg=leg, error=str(e)[:120])
+                return False
+            return self._migrate_ambiguous(rid, gen_key, target_url,
+                                           epoch, reason, str(e))
+        info = {"replica": ack.get("replica"), "rid": ack.get("rid"),
+                "epoch": epoch}
+        with self._cv:
+            self.server.finish_migrated(rid, info)
+            self._note_stream_left_locked(rid, gen_key, info)
+            self._cv.notify_all()
+        self.server.obs.counter(
+            "kubetpu_migration_bytes_shipped_total",
+            "snapshot blob bytes shipped over /migrate_in").inc(len(blob))
+        self._count_migration(reason, "committed")
+        self.events.emit("migrate_commit", rid=rid,
+                         target=ack.get("replica"), epoch=epoch)
+        return True
+
+    def _count_migration(self, reason: str, result: str) -> None:
+        self.server.obs.counter("kubetpu_migrations_total",
+                                reason=reason, result=result).inc()
+
+    def _migrate_ambiguous(self, rid: int, gen_key: Optional[str],
+                           target_url: str, epoch: int, reason: str,
+                           err: str) -> bool:
+        """A commit whose outcome is unknowable (transport death or a
+        5xx that can mask a still-executing restore): the stream
+        finishes as migrated toward the attempted target — resuming
+        could double-run it, and at-most-one-active beats finishing
+        here. The router retry adopts the restored copy or re-computes
+        fresh; token-exact either way."""
+        info = {"replica": None, "url": target_url, "epoch": epoch,
+                "ambiguous": True}
+        with self._cv:
+            self.server.finish_migrated(rid, info)
+            self._note_stream_left_locked(rid, gen_key, info)
+            self._cv.notify_all()
+        self._count_migration(reason, "ambiguous")
+        self.events.emit("migrate_ambiguous", rid=rid, error=err[:120])
+        return False
+
+    @staticmethod
+    def _trim_locked(od: OrderedDict, cap: int = 4096) -> None:
+        """Caller holds ``self._cv``: FIFO-evict the oldest entries
+        past *cap* — the one spelling of every bounded map's policy."""
+        while len(od) > cap:
+            od.popitem(last=False)
+
+    def _gc_gen_keys_locked(self) -> None:
+        """Caller holds ``self._cv``: drop generate-key entries whose
+        rid is no longer unfinished (adopted-but-never-attached streams
+        that completed naturally) once the map grows past the cap."""
+        if len(self._gen_keys) > 4096:
+            live = set(self.server.unfinished_rids())
+            for r in [r for r in self._gen_keys if r not in live]:
+                del self._gen_keys[r]
+
+    def _note_stream_left_locked(self, rid: int, gen_key: Optional[str],
+                                 info: dict) -> None:
+        """Caller holds ``self._cv``. A stream just left this replica:
+        retire its key bookkeeping and record the 409 verdict per key,
+        so ANY later visit with that key — an attached handler's retry,
+        or a router attempt chasing a multi-hop stream that was adopted
+        here but never attached — deterministically re-learns the new
+        owner instead of re-admitting (the at-most-one-active ledger
+        depends on this surviving every hop). *gen_key* is the caller's
+        already-resolved key — ``migrate_rid`` resolves it once through
+        both the attached and adopted maps before the wire leg."""
+        self._gen_keys.pop(rid, None)
+        if gen_key is not None:
+            self._adopted.pop(gen_key, None)
+            self._migrated_keys[gen_key] = dict(info)
+            self._trim_locked(self._migrated_keys)
+
+    def migrate_all(self, target_url: str,
+                    reason: str = "manual") -> "tuple[int, int]":
+        """Migrate every currently-migratable stream to *target_url*
+        -> (committed, not_committed)."""
+        with self._cv:
+            rids = self.server.migratable_rids()
+        done = failed = 0
+        for rid in rids:
+            if self.migrate_rid(rid, target_url, reason=reason):
+                done += 1
+            else:
+                failed += 1
+        return done, failed
+
+    def _migrate_out(self, req: dict):
+        """``POST /migrate_out`` — the policy layer's push-button:
+        snapshot every migratable stream toward ``target``. ``wait``
+        (default true) runs inline and returns counts; false kicks a
+        background sweep (the router's breaker-suspect path, which
+        must not stall its signals loop on a slow transfer)."""
+        target = req.get("target")
+        if not isinstance(target, str) or not target:
+            return 400, {"error": "target url required"}
+        reason = str(req.get("reason") or "manual")
+        if req.get("wait", True):
+            done, failed = self.migrate_all(target, reason=reason)
+            return 200, {"migrated": done, "failed": failed}
+        with self._cv:
+            pending = len(self.server.migratable_rids())
+        threading.Thread(
+            target=self.migrate_all, args=(target, reason),
+            name=f"kubetpu-replica-migrate-out-{self.name}",
+            daemon=True).start()
+        return 200, {"started": pending}
+
+    def _migrate_in(self, req: dict):
+        """One phase of the inbound chunked transfer -> (code, obj);
+        runs under ``run_idempotent`` (every phase POST is keyed by the
+        source, so a lost response replays instead of re-executing)."""
+        phase = req.get("phase")
+        tok = req.get("token") or {}
+        origin = tok.get("origin") or (None, None)
+        try:
+            key = (str(origin[0]), int(origin[1]), int(tok.get("epoch")))
+        except (TypeError, ValueError, IndexError):
+            return 400, {"error": "migrate token must carry "
+                                  "origin [replica, rid] + epoch"}
+        if phase == "begin":
+            meta = req.get("meta")
+            if not isinstance(meta, dict):
+                return 400, {"error": "begin needs a meta object"}
+            # prefix NEGOTIATION: advertise how many leading prompt
+            # pages this server can map read-only from its own cache —
+            # the source ships only the suffix, so matched pages never
+            # cross the wire. A hint, not a promise: the commit-time
+            # match is re-checked and a receded one refuses.
+            skip = 0
+            hint = getattr(self.server, "migration_prefix_hint", None)
+            if hint is not None and isinstance(meta.get("prompt"), list):
+                try:
+                    skip = int(hint(meta["prompt"]))
+                except Exception:  # noqa: BLE001 — a hint must never
+                    skip = 0       # fail a transfer; 0 = ship it all
+            with self._cv:
+                now = time.monotonic()
+                for stale in [k for k, st in self._mig_staging.items()
+                              if now - st["ts"] > MIGRATE_STAGING_TTL]:
+                    del self._mig_staging[stale]
+                self._mig_staging[key] = {"meta": meta, "chunks": {},
+                                          "ts": now}
+            return 200, {"staged": True, "skip_pages": skip}
+        if phase == "chunk":
+            seq = req.get("seq")
+            try:
+                data = chunk_unb64(req.get("data") or "")
+            except (ValueError, TypeError):
+                return 400, {"error": "chunk data is not base64"}
+            with self._cv:
+                st = self._mig_staging.get(key)
+                if st is None:
+                    # definitive: without staging a retry cannot help —
+                    # the source resumes the stream locally
+                    return 409, {"error": "no staging for this transfer "
+                                          "(begin missing or expired)"}
+                if not isinstance(seq, int) or seq < 0:
+                    return 400, {"error": f"chunk seq {seq!r} invalid"}
+                st["chunks"][seq] = data
+                st["ts"] = time.monotonic()
+            return 200, {"staged": seq}
+        if phase == "commit":
+            return self._migrate_commit(key, req)
+        return 400, {"error": f"unknown migrate phase {phase!r}"}
+
+    def _migrate_commit(self, key: tuple, req: dict):
+        """The restore leg: fence the epoch, rebuild the snapshot
+        (the commit carries the shipped-array manifest + chunk count —
+        they depend on the begin-phase prefix hint, so the source only
+        knows them now), resume decode, adopt the generate key. The 200
+        here IS the commit-ack the source retires on; it lands in the
+        idempotency window, so a retry after a lost ack replays — never
+        a second restore (the migrate-check counter assert)."""
+        n = req.get("n_chunks")
+        arrays = req.get("arrays")
+        if not isinstance(n, int) or n < 1 or not isinstance(arrays, list):
+            return 400, {"error": "commit needs n_chunks >= 1 + the "
+                                  "shipped-array manifest"}
+        with self._cv:
+            st = self._mig_staging.get(key)
+            if st is None:
+                return 409, {"error": "no staging for this transfer"}
+            missing = [i for i in range(n) if i not in st["chunks"]]
+            if missing:
+                return 409, {"error": f"transfer incomplete: missing "
+                                      f"chunks {missing[:4]}"}
+            okey = (key[0], key[1])
+            fence = self._mig_epochs.get(okey)
+            if fence is not None and key[2] <= fence:
+                self.server.obs.counter(
+                    "kubetpu_migrations_fenced_total",
+                    "stale/duplicate handoff generations refused by "
+                    "the epoch fence").inc()
+                self.events.emit("migrate_fenced",
+                                 origin=f"{okey[0]}/{okey[1]}",
+                                 epoch=key[2], fence=fence)
+                return 409, {"error": "stale migration epoch",
+                             "fenced": True, "replica": self.name,
+                             "epoch": fence}
+            if self.draining:
+                # a draining target would just hand the stream onward;
+                # refuse so the source resumes or the policy re-picks
+                return 503, {"error": "replica is draining"}
+            gk = st["meta"].get("gen_key")
+            if gk and (gk in self._adopted
+                       or gk in self._gen_keys.values()):
+                # the router already RE-ADMITTED this logical request
+                # here (an earlier ambiguous attempt): a restore now
+                # would start a second active copy the epoch fence
+                # cannot see (a fresh admission carries no origin).
+                # Definitive refusal — the source must never resume
+                # either (it classified the attempt ambiguous already
+                # or will treat this as refused-with-fence-semantics).
+                self.server.obs.counter(
+                    "kubetpu_migrations_in_total",
+                    result="refused").inc()
+                return 409, {"error": "stream already active here "
+                                      "under this generate key",
+                             "fenced": True, "replica": self.name,
+                             "epoch": key[2]}
+            try:
+                blob = b"".join(st["chunks"][i] for i in range(n))
+                meta = dict(st["meta"], arrays=arrays)
+                snap = decode_snapshot(meta, blob)
+                snap["ship_from_page"] = int(
+                    req.get("ship_from_page", 0) or 0)
+                rid = self.server.restore_slot(
+                    snap, reason=str(st["meta"].get("reason", "migrate")))
+            except (ValueError, NotImplementedError) as e:
+                del self._mig_staging[key]
+                self.server.obs.counter(
+                    "kubetpu_migrations_in_total", result="refused").inc()
+                return 400, {"error": f"restore refused: {e}"}
+            if rid is None:
+                # transient capacity shortfall: the source's keyed retry
+                # lands after a slot / pool pages free up
+                return 503, {"error": "no capacity for migrated stream"}
+            del self._mig_staging[key]
+            self._mig_epochs[okey] = key[2]
+            # refresh recency: a long-lived frequently-migrating stream
+            # must not be the FIRST fence evicted just because its
+            # lineage is old (that would re-open the double-restore
+            # window the fence closes)
+            self._mig_epochs.move_to_end(okey)
+            self._trim_locked(self._mig_epochs)
+            if gk:
+                self._adopted[gk] = rid
+                self._trim_locked(self._adopted)
+                # the key follows the stream: a FURTHER hop must ship
+                # it onward even if no handler ever attaches here
+                self._gen_keys[rid] = gk
+                self._gc_gen_keys_locked()
+                # a stream RETURNING here (A->B->A) must shed the stale
+                # migrated-away verdict, or _generate keeps answering
+                # 409 with the OLD lower-epoch owner forever
+                self._migrated_keys.pop(gk, None)
+            self._cv.notify_all()
+        self.server.obs.counter(
+            "kubetpu_migrations_in_total",
+            "inbound migrations by outcome", result="committed").inc()
+        return 200, {"rid": rid, "replica": self.name, "epoch": key[2]}
 
     # -- observability -------------------------------------------------------
 
@@ -287,16 +857,51 @@ class ReplicaServer:
         """Drive the serving object: step while any request is in
         flight, sleep (bounded) while idle. Every touch of the serving
         object happens under the condition — the handlers' enqueue and
-        result reads interleave between steps, never during one."""
+        result reads interleave between steps, never during one. The
+        drain-timeout check rides the same loop: a bounded drain whose
+        deadline passed cancels what's left instead of wedging."""
         while True:
             with self._cv:
                 if not self._running:
                     return
-                if self.server._idle():
+                self._check_drain_timeout_locked()
+                # sleep when a step would advance nothing: idle, OR the
+                # only remaining work is frozen mid-handoff (stepping a
+                # frozen-only server is a busy no-op spin for the whole
+                # wire transfer)
+                runnable = getattr(self.server, "_runnable", None)
+                if self.server._idle() or (runnable is not None
+                                           and not runnable()):
                     self._cv.wait(timeout=self._idle_wait)
                     continue
                 self.server.step()
                 self._cv.notify_all()
+
+    def _check_drain_timeout_locked(self) -> None:
+        """Caller holds ``self._cv``. A draining replica past its
+        ``drain_timeout_s`` with streams still in flight CANCELS them
+        (each expires with reason ``drain_timeout`` — their callers get
+        a retryable 503, and retries land elsewhere via the router).
+        When a migrate target was named, the migrate loop had the same
+        window to move them — the deadline is the hard bound either
+        way, so scale-down can never wait out a long-max_tokens
+        stream."""
+        if (not self.draining or self._drain_deadline is None
+                or time.monotonic() < self._drain_deadline
+                or self.server._idle()):
+            return
+        unresolved = False
+        for rid in self.server.unfinished_rids():
+            if self.server.cancel_expired(rid, "drain_timeout"):
+                self.events.emit("drain_timeout", rid=rid)
+            elif not self.server.finished(rid):
+                # a frozen (mid-handoff) stream refuses cancel — its
+                # transfer resolves it; keep the deadline ARMED so the
+                # next tick sweeps whatever a refusal resumed
+                unresolved = True
+        if not unresolved:
+            self._drain_deadline = None
+        self._cv.notify_all()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -320,15 +925,66 @@ class ReplicaServer:
         self._thread.start()
         return self.address
 
-    def drain(self) -> None:
+    def drain(self, migrate_to: Optional[str] = None,
+              reason: str = "drain") -> None:
         """Refuse NEW generates (503); admitted and handler-waiting
         requests run to completion — the step loop keeps stepping until
-        the server goes idle."""
+        the server goes idle. With *migrate_to*, in-flight streams are
+        HANDED OFF live to that replica instead (token-exact; their
+        callers learn the new owner via 409) — the drain completes as
+        fast as the wire, not as slow as the longest stream.
+        ``drain_timeout_s`` arms the cancel backstop either way."""
         with self._cv:
             if not self.draining:
-                self.events.emit("drain", replica=self.name)
+                self.events.emit("drain", replica=self.name,
+                                 reason=reason)
             self.draining = True
+            if migrate_to:
+                self._drain_migrate = migrate_to.rstrip("/")
+            if (self.drain_timeout_s is not None
+                    and self._drain_deadline is None):
+                self._drain_deadline = (time.monotonic()
+                                        + self.drain_timeout_s)
+            if (self._drain_migrate is not None
+                    and (self._drain_thread is None
+                         or not self._drain_thread.is_alive())):
+                # created AND started under the cv: two racing drain
+                # POSTs must never both .start() one Thread object
+                # (start() returns before the target body needs the cv)
+                self._drain_thread = threading.Thread(
+                    target=self._drain_migrate_loop,
+                    args=(self._drain_migrate, reason),
+                    name=f"kubetpu-replica-drain-migrate-{self.name}",
+                    daemon=True)
+                self._drain_thread.start()
             self._cv.notify_all()
+
+    def _drain_migrate_loop(self, target_url: str, reason: str) -> None:
+        """Hand every in-flight stream to the drain's migrate target
+        until this replica is idle. Loops because queued requests
+        surface as migratable only once freed slots admit them and
+        their first token lands; bounded so a target refusing
+        everything cannot spin forever (the drain-timeout cancel is the
+        final word). The target is RE-READ each pass: a re-issued drain
+        naming a different target (the first one died) must redirect
+        the remaining streams, not keep shipping to a corpse."""
+        deadline = time.monotonic() + max(
+            30.0, 2.0 * (self.drain_timeout_s or 0.0))
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._running or self.server._idle():
+                    return
+                pending = bool(self.server.migratable_rids())
+                target_url = self._drain_migrate or target_url
+            if not pending:
+                time.sleep(0.01)
+                continue
+            done, failed = self.migrate_all(target_url, reason=reason)
+            # nothing committed (an unmigratable server, or a target
+            # refusing everything): back off instead of spamming
+            # per-stream attempts every couple of milliseconds — the
+            # drain-timeout cancel remains the hard bound
+            time.sleep(0.25 if done == 0 and failed else 0.002)
 
     def shutdown(self, graceful: bool = True, timeout: float = 10.0) -> None:
         """Stop the server. ``graceful`` drains, waits (bounded) for the
@@ -344,7 +1000,10 @@ class ReplicaServer:
             self._inflight.wait_idle(timeout)
         with self._cv:
             self._running = False
+            drain_thread, self._drain_thread = self._drain_thread, None
             self._cv.notify_all()
+        if drain_thread is not None:
+            drain_thread.join(timeout=5.0)
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=5.0)
             self._loop_thread = None

@@ -59,6 +59,7 @@ import threading
 import time
 import urllib.error
 import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -70,7 +71,7 @@ from kubetpu.obs.registry import Registry, install_process_gauges
 from kubetpu.obs.slo import Objective, SloEngine
 from kubetpu.router.hashring import DEFAULT_HEAD_QUANTUM, \
     DEFAULT_HEAD_TOKENS, HashRing, prefix_head_key
-from kubetpu.router.pool import ReplicaPool
+from kubetpu.router.pool import HEALTHY, SUSPECT, ReplicaPool
 from kubetpu.wire.httpcommon import (
     IdempotencyCache,
     InflightTracker,
@@ -160,6 +161,24 @@ class RouterServer:
         self._c_queued = self.registry.counter(
             "kubetpu_router_queued_total",
             "requests parked by SLO-class admission while burning")
+        # -- live migration (Round-16): the mid-stream rid -> replica
+        # RE-PIN map. A source replica answering 409-migrated names the
+        # new owner; the pin (keyed by the request's downstream
+        # idempotency key, epoch-fenced so a stale notice can't repoint
+        # a later handoff) makes this attempt — and any client retry of
+        # the same logical request — land on the new owner instead of
+        # re-running affinity against a replica that no longer holds
+        # the stream.
+        self._pins: "OrderedDict[str, Tuple[Optional[str], int]]" = \
+            OrderedDict()
+        self._suspect_handled: set = set()
+        self._c_repin = self.registry.counter(
+            "kubetpu_router_repins_total",
+            "mid-stream rid->replica re-pins after a 409-migrated "
+            "answer")
+        self._c_migrate_away = self.registry.counter(
+            "kubetpu_router_migrate_away_total",
+            "breaker-suspect migrate-away sweeps requested")
         self.registry.gauge_fn("kubetpu_router_burning",
                                lambda: 1.0 if self._burning() else 0.0)
         # SLO engine over the FEDERATED scrape (worst replica judged) —
@@ -377,13 +396,22 @@ class RouterServer:
         # router can carry.
         leg_key = ("router-gen-" + (client_key or uuid.uuid4().hex))
         last_err: Optional[str] = None
-        for attempt in range(2):
-            name, affinity = self._pick(prompt)
+        # attempts: the affinity pick, one failover re-pick, plus
+        # several migrated-stream re-pins — a request must be able to
+        # CHASE a stream that hops more than once (drain chains, the
+        # migrate-check ping-pong) before its budget gives up
+        for attempt in range(6):
+            pinned = self._pinned_replica(leg_key)
+            if pinned is not None:
+                name, affinity = pinned, False
+            else:
+                name, affinity = self._pick(prompt)
             if name is None:
                 self._c_norep.inc()
                 return 503, {"error": "no routable replica"}
             url = self.pool.url(name)
             if url is None:
+                self._unpin(leg_key)
                 continue
             payload = {"prompt": prompt,
                        "timeout": max(0.1, deadline - time.monotonic())}
@@ -398,22 +426,42 @@ class RouterServer:
                 self._metrics.record("upstream",
                                      time.perf_counter() - tup)
             except urllib.error.HTTPError as e:
+                detail_obj: dict = {}
+                try:
+                    detail_obj = json.loads(e.read() or b"{}")
+                except Exception:  # noqa: BLE001 — body unreadable
+                    pass
+                if e.code == 409 and detail_obj.get("migrated"):
+                    # the stream moved mid-flight: RE-PIN to the new
+                    # owner (epoch-fenced) and retry there — the target
+                    # either ADOPTS the restored stream via this same
+                    # leg key or serves the request fresh; token-exact
+                    # either way
+                    self._note_migrated(leg_key, detail_obj["migrated"],
+                                        from_replica=name)
+                    self._c_repin.inc()
+                    self.events.emit(
+                        "repin", replica=name,
+                        target=detail_obj["migrated"].get("replica"),
+                        epoch=detail_obj["migrated"].get("epoch"))
+                    continue
                 if e.code < 500:
                     # a deterministic CLIENT error (bad sampling params,
                     # oversized prompt) — failing over would just repeat
                     # it and mis-file it as infrastructure trouble;
                     # surface the replica's verdict as-is
-                    try:
-                        detail = json.loads(e.read()).get("error", "")
-                    except Exception:  # noqa: BLE001 — body unreadable
-                        detail = ""
+                    detail = str(detail_obj.get("error", ""))
                     return e.code, {"error": f"replica {name}: "
                                              f"{detail or f'HTTP {e.code}'}"}
                 last_err = f"{name}: HTTP {e.code}"
+                # a pinned owner answering 5xx is not serving the pin:
+                # drop it so the next attempt re-picks fresh
+                self._unpin(leg_key)
                 self.pool.refresh(0.0)
                 continue
             except TRANSIENT_ERRORS as e:
                 last_err = f"{name}: {e}"
+                self._unpin(leg_key)
                 self.pool.refresh(0.0)
                 continue
             self._c_routed.inc()
@@ -421,12 +469,109 @@ class RouterServer:
             self.events.emit("route", replica=name, slo_class=slo_class,
                              affinity=affinity,
                              prompt_tokens=len(prompt))
+            self._unpin(leg_key)     # the stream completed: pin done
             body = dict(body)
             body["replica"] = name
             body["affinity"] = affinity
             return 200, body
         self._c_uperr.inc()
         return 502, {"error": f"upstream generate failed: {last_err}"}
+
+    # -- live migration (Round-16) -------------------------------------------
+
+    def _pinned_replica(self, leg_key: str) -> Optional[str]:
+        with self._lock:
+            pin = self._pins.get(leg_key)
+        if pin is None:
+            return None
+        name = pin[0]
+        if name is None or self.pool.url(name) is None:
+            self._unpin(leg_key)
+            return None
+        return name
+
+    def _unpin(self, leg_key: str) -> None:
+        with self._lock:
+            self._pins.pop(leg_key, None)
+
+    def _note_migrated(self, leg_key: str, mig: dict,
+                       from_replica: Optional[str] = None) -> None:
+        """Record a 409-migrated notice as the request's new owner pin.
+        EPOCH-FENCED: a notice at a lower epoch than the recorded pin
+        is stale (the stream has since moved again) and must not
+        repoint — the at-most-one-active argument's router half. One
+        exception: a notice FROM the pinned owner itself always wins —
+        the live owner disclaiming the stream is fresher than any
+        recorded epoch, and epochs are only comparable within one
+        stream lineage (an ambiguous handoff followed by a fresh
+        re-admission restarts the lineage at 0, so a strict compare
+        would wedge the pin on the old lineage's number)."""
+        name = mig.get("replica")
+        if not name and mig.get("url"):
+            name = self.pool.name_for_url(str(mig["url"]))
+        epoch = int(mig.get("epoch", 0))
+        with self._lock:
+            cur = self._pins.get(leg_key)
+            if (cur is not None and epoch < cur[1]
+                    and cur[0] != from_replica):
+                return
+            self._pins[leg_key] = (name, epoch)
+            self._pins.move_to_end(leg_key)
+            while len(self._pins) > 4096:
+                self._pins.popitem(last=False)
+
+    def migrate_away(self, name: str, reason: str = "suspect") -> bool:
+        """Ask *name* to hand its in-flight streams to the least-loaded
+        OTHER routable replica (a background sweep on the source; this
+        call only kicks it). The breaker-suspect policy: a suspect node
+        is cordoned but may well still serve — asking it to migrate
+        away turns "pray the blackout is transient" into a live
+        handoff; if the node is truly dark the POST fails and the
+        breaker path continues as before (the honest residue)."""
+        src_url = self.pool.url(name)
+        candidates = [n for n in self.pool.routable() if n != name]
+        if src_url is None or not candidates:
+            self.events.emit("migrate_away_skip", replica=name,
+                             reason=reason)
+            return False
+
+        def depth(n):
+            load = self.pool.snapshot(n) or {}
+            return (int(load.get("active_slots", 0)),
+                    int(load.get("queue_depth", 0)), n)
+
+        target = min(candidates, key=depth)
+        target_url = self.pool.url(target)
+        if target_url is None:
+            return False
+        self._c_migrate_away.inc()
+        self.events.emit("migrate_away", replica=name, target=target,
+                         reason=reason)
+        try:
+            request_json(
+                src_url + "/migrate_out",
+                {"target": target_url, "reason": reason, "wait": False},
+                token=self.token, timeout=self.pool.scrape_timeout,
+                idempotency_key=f"router-mig-away-{uuid.uuid4().hex}")
+        except Exception as e:  # noqa: BLE001 — source dark: the pray path
+            self.events.emit("migrate_away_failed", replica=name,
+                             error=str(e)[:120])
+            return False
+        return True
+
+    def _check_suspects(self) -> None:
+        """Breaker-suspect -> migrate-away, once per suspect episode:
+        the signals loop calls this each tick; a replica newly marked
+        SUSPECT gets one migrate-away sweep (repeated ticks must not
+        re-spam a struggling node), and recovery to HEALTHY re-arms
+        it."""
+        for name in self.pool.names():
+            st = self.pool.state(name)
+            if st == SUSPECT and name not in self._suspect_handled:
+                self._suspect_handled.add(name)
+                self.migrate_away(name, reason="suspect")
+            elif st == HEALTHY:
+                self._suspect_handled.discard(name)
 
     def _admit(self, slo_class: str):
         """The SLO-class gate: (None, None) to proceed; a (code, obj)
@@ -526,6 +671,11 @@ class RouterServer:
                 # must not drag it along (the throttle returns cached
                 # verdicts inside slo_interval_s)
                 self.evaluate_slos(self._slo_interval)
+                # breaker-suspect -> migrate-away (Round-16): the sweep
+                # itself runs on the SOURCE replica in the background —
+                # this tick only asks, so a slow transfer never stalls
+                # the signals loop
+                self._check_suspects()
             except Exception:  # noqa: BLE001 — the loop survives a bad
                 pass           # scrape; next tick retries
 

@@ -11,6 +11,8 @@ they also carry a ``storms`` dict of serving storm metrics:
     router_hit_rate / router_ttft_p50_ms   Round-14 data-plane rows
     paged_kernel_decode_toks_s  Round-15: decode tok/s through the fused
                     paged-attention kernel (interpret)   (higher good)
+    migration_drain_s  Round-16: drain-complete latency of a loaded
+                    replica via live KV migration        (lower good)
 
 Modes:
 
@@ -52,7 +54,7 @@ HIGHER_IS_BETTER = {"decode_tok_s", "router_hit_rate",
                     "paged_kernel_decode_toks_s"}
 GATED = ("decode_tok_s", "ttft_p50_ms", "itl_p99_ms",
          "router_hit_rate", "router_ttft_p50_ms",
-         "paged_kernel_decode_toks_s")
+         "paged_kernel_decode_toks_s", "migration_drain_s")
 # ratios/counters are load-independent: the host-speed calibration must
 # only rescale wall-clock metrics, never a hit rate
 NOT_NORMALIZED = {"router_hit_rate"}
@@ -186,6 +188,34 @@ def measure_storm(repeats: int = 3, rounds: int = 2) -> dict:
         best["paged_kernel_decode_toks_s"] = max(
             best.get("paged_kernel_decode_toks_s", 0.0),
             round(emitted / wall, 1) if wall else 0.0)
+    # Round-16 row: drain-complete latency of a loaded replica through
+    # LIVE MIGRATION (the elastic scale-down path) — best-of-2 VALID
+    # samples: a run where the stream finished before the drain landed
+    # (migrations == 0) measured an EMPTY drain and must not seed the
+    # ratchet with a vacuous number no real handoff can match.
+    from bench_model import migration_storm
+
+    mig_cfg = dataclasses.replace(flagship_cfg(smoke=True), remat=False)
+    valid = 0
+    for _attempt in range(6):
+        if valid >= 2:
+            break
+        (mig,) = migration_storm(
+            mig_cfg, n_replicas=2, n_streams=2, prompt_len=16,
+            max_new=48, page_size=16, n_slots=2, arms=("migrate",))
+        if mig["streams_preserved"] != mig["requests"]:
+            raise SystemExit(
+                "bench-gate: migration storm dropped a stream — "
+                f"{mig['streams_preserved']}/{mig['requests']} preserved")
+        if mig["migrations"] < 1:
+            continue            # vacuous draw: nothing actually moved
+        valid += 1
+        best["migration_drain_s"] = min(
+            best.get("migration_drain_s", float("inf")), mig["value"])
+    if valid == 0:
+        raise SystemExit(
+            "bench-gate: migration storm never migrated a stream — "
+            "lengthen the streams")
     best["calib_s"] = round(_calibrate(), 5)
     return best
 

@@ -1,0 +1,334 @@
+#!/usr/bin/env python3
+"""``make migrate-check`` — the live-KV-migration oracle.
+
+Boots a router + 2 paged serving replicas (prefix cache on)
+IN-PROCESS on the CPU backend, injects >=10% wire faults
+(drop / injected 503 / truncated response) on the ``/migrate_in``
+transfer leg, drives waves of long decode streams through keyed router
+POSTs while ROLLING ``/migrate_out`` sweeps ping-pong the in-flight
+streams between the replicas, and fails (exit 1) on:
+
+- PARITY: any migrated stream's tokens differing byte-for-byte from a
+  quiet unmigrated run (token-exact resume is the whole point —
+  retries, replays, prefix-remaps and mid-stream handoffs
+  notwithstanding);
+- DOUBLE RESTORE / DOUBLE ADMISSION: the epoch-fence + idempotency
+  counters must balance — source-side committed handoffs == target-side
+  committed restores, zero ambiguous outcomes under the generous retry
+  budget, fresh admissions == logical requests (a restore is a
+  ``migrate_in``, never an ``admit``), and a deliberately forged stale
+  commit must be FENCED 409 (the counter asserts exactly one, from the
+  probe);
+- an UNSTITCHED handoff trace: one traced migration must render
+  source-replica and target-replica spans under a single trace id;
+- the POOL ORACLE (``check_invariants``) on BOTH replicas after every
+  wave, and faults that never actually fired.
+
+Runs in well under a minute with no accelerator; wired into
+``make chaos`` so every fault-injection run also proves a slot handoff
+is exact and at-most-once.
+"""
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.obs import span  # noqa: E402
+from kubetpu.router import ReplicaServer, RouterServer  # noqa: E402
+from kubetpu.router.migration import chunk_b64, encode_snapshot  # noqa: E402
+from kubetpu.wire.faults import FaultInjector, RoutePolicy  # noqa: E402
+from kubetpu.wire.httpcommon import RetryPolicy, request_json  # noqa: E402
+
+# the storm clients chase streams that keep hopping: give them a wider
+# retry budget than the default so an unluckily-timed 502 retries into
+# the post-ping-pong calm instead of surfacing
+STORM_RETRY = RetryPolicy(attempts=6, deadline=55.0)
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+MAX_NEW = 96
+WAVES = 3           # always-run waves
+EXTRA_WAVES = 2     # top-up waves, run only until faults have fired
+WAVE_STREAMS = 3
+# >=10% total injection on the migrate leg (25% here — the leg is only
+# a few dozen POSTs per run, and a chaos run that fires nothing proves
+# nothing; the top-up waves keep even an unlucky seed honest): drop +
+# injected 503 + truncated response (the latter manufactures the
+# lost-commit-ack replay window)
+MIG_FAULTS = RoutePolicy(drop=0.10, error=0.08, partial=0.07)
+
+
+def fail(msg: str) -> None:
+    print(f"migrate-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def make_server(params):
+    return PagedDecodeServer(
+        CFG, params, n_slots=4, max_seq=128, max_new_tokens=MAX_NEW,
+        page_size=PS, prefix_cache_pages=24)
+
+
+def storm_prompts():
+    """One shared-prefix family + loners, (WAVES + EXTRA_WAVES) x
+    WAVE_STREAMS total — the family exercises the
+    restore-remaps-cached-pages path."""
+    fam = [(i * 5) % 60 + 1 for i in range(2 * PS)]
+    prompts = []
+    for i in range((WAVES + EXTRA_WAVES) * WAVE_STREAMS):
+        if i % 3 == 2:
+            prompts.append([(i * 11) % 60 + 1 for j in range(12)])
+        else:
+            prompts.append(fam + [i + 1])
+    return prompts
+
+
+def mig_counter(rep, result):
+    total = 0
+    for name, labels, kind, inst in rep.server.obs.snapshot():
+        if (name == "kubetpu_migrations_total"
+                and dict(labels).get("result") == result):
+            total += int(inst.value)
+    return total
+
+
+def main() -> int:
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = storm_prompts()
+
+    # the quiet oracle: one replica, serial, no wire, no faults
+    direct = make_server(params)
+    expected = []
+    for p in prompts:
+        rid = direct.enqueue(p)
+        direct.drain()
+        expected.append(direct.pop_result(rid))
+
+    injector = FaultInjector(seed=13, routes={"/migrate_in": MIG_FAULTS})
+    replicas = []
+    for i in range(2):
+        rep = ReplicaServer(make_server(params), f"mchk{i}",
+                            faults=injector, idle_wait=0.002)
+        rep.start()
+        replicas.append(rep)
+    router = RouterServer(load_refresh_s=0.1)
+    router.start()
+    results = [None] * len(prompts)
+    try:
+        for rep in replicas:
+            router.register_replica(rep.address)
+
+        def one(i):
+            results[i] = request_json(
+                router.address + "/generate",
+                {"prompt": prompts[i], "timeout": 60.0},
+                idempotency_key=f"migrate-check-{i}", timeout=60.0,
+                retry=STORM_RETRY)
+
+        def sweep(src, dst, trace=False):
+            """One /migrate_out sweep src -> dst; returns committed."""
+            if trace:
+                with span("migrate-check.handoff") as root:
+                    res = request_json(
+                        src.address + "/migrate_out",
+                        {"target": dst.address, "reason": "check",
+                         "wait": True},
+                        idempotency_key=f"mc-sweep-{time.monotonic()}",
+                        timeout=60.0)
+                    return res.get("migrated", 0), root.trace_id
+            res = request_json(
+                src.address + "/migrate_out",
+                {"target": dst.address, "reason": "check", "wait": True},
+                idempotency_key=f"mc-sweep-{time.monotonic()}",
+                timeout=60.0)
+            return res.get("migrated", 0), None
+
+        committed_sweeps = 0
+        trace_id = None
+        ran = 0
+        for wave in range(WAVES + EXTRA_WAVES):
+            if (wave >= WAVES
+                    and sum(injector.counts.values()) > 0
+                    and committed_sweeps >= 2):
+                break        # top-up waves only run until faults fired
+            threads = []
+            for j in range(WAVE_STREAMS):
+                i = wave * WAVE_STREAMS + j
+                t = threading.Thread(target=one, args=(i,), daemon=True)
+                t.start()
+                threads.append(t)
+            ran += WAVE_STREAMS
+            # ping-pong the wave's in-flight streams between the
+            # replicas (up to 4 hops) so the migrate leg sees real
+            # traffic; the first committing sweep is traced so the
+            # stitching oracle has a handoff to render
+            for _hop in range(4):
+                deadline = time.monotonic() + 20.0
+                src = None
+                while src is None and time.monotonic() < deadline:
+                    for rep in replicas:
+                        with rep._cv:
+                            if rep.server.migratable_rids():
+                                src = rep
+                                break
+                    if src is None and not any(
+                            t.is_alive() for t in threads):
+                        break
+                    time.sleep(0.003)
+                if src is None:
+                    break
+                dst = replicas[1] if src is replicas[0] else replicas[0]
+                n, tid = sweep(src, dst, trace=(trace_id is None))
+                committed_sweeps += n
+                if n and tid:
+                    trace_id = tid
+                # a breather between hops: the routed requests' re-pin
+                # chase must be able to catch a stream between handoffs
+                time.sleep(0.05)
+            for t in threads:
+                t.join(90.0)
+                if t.is_alive():
+                    fail("a routed stream never completed")
+            for rep in replicas:
+                rep.server.check_invariants()
+
+        # 1) parity: every stream's tokens == the quiet direct run
+        for i, (body, want) in enumerate(zip(results[:ran],
+                                             expected[:ran])):
+            if body is None or body.get("tokens") != want:
+                fail(f"request {i}: routed tokens != quiet direct run "
+                     f"(got {body and body.get('tokens')}, want {want})")
+
+        # 2) the at-most-once ledger: committed out == committed in,
+        # nothing ambiguous, zero fenced (before the probe), and fresh
+        # admissions == logical requests (restores are migrate_in
+        # events, never admits)
+        out_committed = sum(mig_counter(rep, "committed")
+                            for rep in replicas)
+        ambiguous = sum(mig_counter(rep, "ambiguous") for rep in replicas)
+        in_committed = sum(
+            int(rep.server.obs.counter(
+                "kubetpu_migrations_in_total",
+                result="committed").value) for rep in replicas)
+        fenced = sum(
+            int(rep.server.obs.counter(
+                "kubetpu_migrations_fenced_total").value)
+            for rep in replicas)
+        if out_committed < 2:
+            fail(f"only {out_committed} committed handoffs — the storm "
+                 f"exercised nothing; raise stream length")
+        if out_committed != in_committed:
+            fail(f"{out_committed} committed handoffs at sources vs "
+                 f"{in_committed} committed restores at targets — a "
+                 f"lost ack double-restored or a restore went missing")
+        if ambiguous:
+            fail(f"{ambiguous} ambiguous handoffs under a generous "
+                 f"retry budget — the transfer leg is flakier than the "
+                 f"injected faults explain")
+        if fenced:
+            fail(f"{fenced} fence hits before the probe — a duplicate "
+                 f"handoff generation reached commit")
+        admits = sum(len(rep.server.events.events(kind="admit"))
+                     for rep in replicas)
+        migrate_ins = sum(len(rep.server.events.events(kind="migrate_in"))
+                          for rep in replicas)
+        if admits != ran:
+            fail(f"{admits} fresh admissions for {ran} logical "
+                 f"requests — a handoff double-admitted")
+        if migrate_ins != in_committed:
+            fail(f"{migrate_ins} migrate_in events vs {in_committed} "
+                 f"committed restores")
+
+        # 3) the epoch fence catches a forged stale handoff: replay the
+        # ledger's highest committed epoch for an already-handled stream
+        # under FRESH idempotency keys — only the fence can refuse it
+        probe_rep = next(rep for rep in replicas if rep._mig_epochs)
+        okey, epoch = next(iter(probe_rep._mig_epochs.items()))
+        victim = make_server(params)
+        vrid = victim.enqueue(prompts[0])
+        while len(victim._emitted.get(vrid, [])) < 2:
+            victim.step()
+        snap = victim.snapshot_slot(vrid)
+        snap["origin"] = [okey[0], okey[1]]
+        snap["epoch"] = epoch
+        meta, blob = encode_snapshot(snap)
+        tok = {"origin": [okey[0], okey[1]], "epoch": epoch}
+        import urllib.error
+        request_json(probe_rep.address + "/migrate_in",
+                     {"phase": "begin", "token": tok, "meta": meta},
+                     idempotency_key="mc-forge-begin", timeout=30.0)
+        request_json(probe_rep.address + "/migrate_in",
+                     {"phase": "chunk", "token": tok, "seq": 0,
+                      "data": chunk_b64(blob)},
+                     idempotency_key="mc-forge-c0", timeout=30.0)
+        try:
+            request_json(probe_rep.address + "/migrate_in",
+                         {"phase": "commit", "token": tok, "n_chunks": 1,
+                          "arrays": meta["arrays"],
+                          "ship_from_page": 0},
+                         idempotency_key="mc-forge-commit", timeout=30.0)
+            fail("forged stale-epoch commit was ACCEPTED — the fence "
+                 "is not fencing")
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                fail(f"forged stale commit got HTTP {e.code}, want 409")
+        fenced = sum(
+            int(rep.server.obs.counter(
+                "kubetpu_migrations_fenced_total").value)
+            for rep in replicas)
+        # >= 1, not == 1: the probe's own commit rides the faulted
+        # /migrate_in leg, and a truncated 409 response makes the keyed
+        # retry re-execute the (side-effect-free) fence check — a
+        # second counter tick with no second restore
+        if fenced < 1:
+            fail(f"fence counter reads {fenced} after the probe, "
+                 f"want >= 1")
+
+        # 4) the faults actually fired (a chaos run that injected
+        # nothing proves nothing), and replays were observed somewhere
+        fired = dict(injector.counts)
+        if sum(fired.values()) == 0:
+            fail("no faults fired on the migrate leg; raise rates")
+
+        # 5) one handoff renders source AND target replica spans under
+        # one trace id
+        if trace_id is None:
+            fail("no traced handoff was captured")
+        trace = router.trace(trace_id)
+        comps = {s.get("component", "") for s in trace["spans"]}
+        rep_comps = {c for c in comps if c.startswith("replica:")}
+        if len(rep_comps) < 2:
+            fail(f"handoff trace {trace_id} did not stitch source and "
+                 f"target replica spans (components: {sorted(comps)})")
+
+        # 6) both pools honest after the whole storm
+        for rep in replicas:
+            rep.server.check_invariants()
+        repins = int(router._c_repin.value)
+    finally:
+        router.shutdown()
+        for rep in replicas:
+            rep.shutdown(graceful=False)
+
+    print(f"migrate-check OK: {out_committed} token-exact handoffs under "
+          f"injected faults ({dict(injector.counts)}), "
+          f"{in_committed} restores / 0 double, {repins} router re-pins, "
+          f"fence probe refused, pools clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

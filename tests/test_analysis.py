@@ -1181,6 +1181,30 @@ def test_hot_closure_covers_kernel_dispatch_and_ops_lints_clean():
                 if k.startswith("kubetpu/ops/")], baseline["counts"]
 
 
+def test_migration_legs_are_barrier_legs():
+    """Round-16 pin: the live-migration legs (snapshot/restore and
+    their freeze/finish bookkeeping) are classified BARRIER legs —
+    architecturally allowed to sync/upload (the handoff's device gather
+    and page upload), and the KTP001 closure traversal stops at them.
+    If one ever becomes reachable from step() WITHOUT barrier status,
+    its np.asarray/device_get calls would fail lint at the line; this
+    test keeps the classification explicit instead of incidental."""
+    from kubetpu.analysis.core import load_project
+    from kubetpu.analysis.rules_device import HOT_BARRIERS, hot_closure
+
+    for leg in ("snapshot_slot", "restore_slot", "freeze_slot",
+                "unfreeze_slot", "finish_migrated", "migratable_rids",
+                "cancel_expired"):
+        assert leg in HOT_BARRIERS, leg
+    project = load_project(REPO_ROOT, ["kubetpu"])
+    quals = {qual.split(".")[-1] if "." in qual else qual
+             for _, qual, _ in hot_closure(project).values()}
+    # barrier status means NOT in the step closure — the designed syncs
+    # in snapshot/restore never read as hot-path syncs
+    assert "snapshot_slot" not in quals
+    assert "restore_slot" not in quals
+
+
 def test_repo_lints_clean_against_committed_baseline():
     """`make lint` green is a merge gate; this pins it in tier-1. Any
     new violation of KTP001–KTP006 in kubetpu/ or scripts/ fails here

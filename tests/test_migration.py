@@ -1,0 +1,695 @@
+"""Round-16 live KV migration: token-exact slot handoff between paged
+replicas, and the drain/failover/scale-down paths that use it.
+
+The serving-layer half (snapshot/restore round trips — f32 + kv_int8
+pools, prefix-cache shared pages, mid-chunked-prefill refusal, spec
+gamma-EMA survival) drives the servers in-process; the wire half
+(chunked idempotent ``/migrate_in``, replayed commit-acks, the epoch
+fence, drain-with-migration and the drain-timeout escalation) runs real
+``ReplicaServer``s over HTTP. The chaos-grade fault soak lives in
+``make migrate-check`` (scripts/migrate_check.py)."""
+
+import json
+import threading
+import time
+import urllib.error
+
+import jax
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.jobs.spec_serving import PagedSpeculativeDecodeServer
+from kubetpu.router import ReplicaServer, RouterServer
+from kubetpu.router.migration import (
+    blob_chunks,
+    chunk_b64,
+    decode_snapshot,
+    encode_snapshot,
+)
+from kubetpu.wire.httpcommon import NO_RETRY, request_json
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def make_server(params, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("max_new_tokens", 12)
+    kw.setdefault("page_size", PS)
+    return PagedDecodeServer(CFG, params, **kw)
+
+
+def quiet_run(server, prompt):
+    rid = server.enqueue(prompt)
+    server.drain()
+    return server.pop_result(rid)
+
+
+def decode_until(server, rid, n_emitted):
+    for _ in range(200):
+        if len(server._emitted.get(rid, [])) >= n_emitted:
+            return
+        server.step()
+    raise AssertionError(f"never reached {n_emitted} emitted tokens")
+
+
+def handoff(src, dst, rid, epoch=1):
+    """The in-process spelling of one migration: snapshot -> freeze ->
+    restore -> finish; returns the target-local rid."""
+    snap = src.snapshot_slot(rid)
+    src.freeze_slot(rid)
+    rid2 = dst.restore_slot(snap)
+    assert rid2 is not None
+    src.finish_migrated(rid, {"replica": "dst", "rid": rid2,
+                              "epoch": epoch})
+    return rid2
+
+
+PROMPT = [(i * 7) % 60 + 1 for i in range(19)]
+
+
+# -- serving-layer round trips ------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_int8", [False, True],
+                         ids=["f32", "kv_int8"])
+def test_snapshot_restore_token_exact(params, kv_int8):
+    """The headline: a stream migrated mid-decode emits exactly the
+    tokens (and logprobs) an unmigrated run emits — f32 and quantized
+    pools (int8 pairs ship AS STORED, no dequant round-trip)."""
+    quiet = make_server(params, kv_int8=kv_int8)
+    want = quiet_run(quiet, PROMPT)
+    want_lps = None
+    rid_q = quiet.enqueue(PROMPT)
+    quiet.drain()
+    want_lps = quiet.result_logprobs(rid_q)
+    quiet.pop_result(rid_q)
+
+    src = make_server(params, kv_int8=kv_int8)
+    dst = make_server(params, kv_int8=kv_int8)
+    rid = src.enqueue(PROMPT)
+    decode_until(src, rid, 4)
+    rid2 = handoff(src, dst, rid)
+    assert src.migrated_to(rid) == {"replica": "dst", "rid": rid2,
+                                    "epoch": 1}
+    src.check_invariants()          # pages freed/published on the source
+    while not dst.finished(rid2):
+        dst.step()
+    assert dst.result_logprobs(rid2)[-1] == want_lps[-1]
+    assert dst.pop_result(rid2) == want
+    dst.check_invariants()
+
+
+def test_snapshot_int8_pages_stay_quantized(params):
+    """The snapshot of a kv_int8 pool carries the stored int8 values +
+    f32 scales — never a dequantized f32 copy (byte size pins it)."""
+    src = make_server(params, kv_int8=True)
+    rid = src.enqueue(PROMPT)
+    decode_until(src, rid, 2)
+    snap = src.snapshot_slot(rid)
+    assert set(snap["pages"]) == {"k_q", "k_s", "v_q", "v_s"}
+    assert snap["pages"]["k_q"].dtype == np.int8
+    assert snap["pages"]["k_s"].dtype == np.float32
+    assert snap["pages"]["k_s"].shape[-1] == 1     # per-token per-head scale
+
+
+def test_seeded_sampling_continues_exactly_across_seeds(params):
+    """The restored slot reuses the SOURCE's raw request key, so even
+    seeded sampling continues identically on a target built with a
+    different server seed."""
+    quiet = make_server(params, temperature=0.9, seed=3)
+    want = quiet_run(quiet, PROMPT)
+    src = make_server(params, temperature=0.9, seed=3)
+    dst = make_server(params, temperature=0.9, seed=999)
+    rid = src.enqueue(PROMPT)
+    decode_until(src, rid, 5)
+    rid2 = handoff(src, dst, rid)
+    while not dst.finished(rid2):
+        dst.step()
+    assert dst.pop_result(rid2) == want
+
+
+def test_restore_maps_prefix_cache_pages_readonly(params):
+    """A target whose radix tree already holds the prompt's prefix maps
+    those pages READ-ONLY instead of writing shipped bytes — pinned by
+    the pages_remapped counter, byte-stability of the shared pages, and
+    balanced refcounts after both retirements."""
+    fam = [(i * 5) % 60 + 1 for i in range(2 * PS)]
+    warm_prompt = fam + [11]
+    mig_prompt = fam + [9]
+    quiet = make_server(params)
+    want = quiet_run(quiet, mig_prompt)
+
+    src = make_server(params, prefix_cache_pages=16)
+    dst = make_server(params, prefix_cache_pages=16)
+    quiet_run(dst, warm_prompt)     # dst tree now owns the family pages
+    tree_pages = sorted(dst._prefix_cache.owned_pages())
+    before = {p: np.asarray(jax.device_get(dst.k_pages[:, p]))
+              for p in tree_pages}
+
+    rid = src.enqueue(mig_prompt)
+    decode_until(src, rid, 4)
+    rid2 = handoff(src, dst, rid)
+    assert int(dst.obs.counter(
+        "kubetpu_migration_pages_remapped_total").value) == 2
+    slot = dst._slot_rid.index(rid2)
+    assert dst._slot_shared[slot] == 2      # two leading rows are shared
+    while not dst.finished(rid2):
+        dst.step()
+    assert dst.pop_result(rid2) == want
+    # shared pages were mapped, never copied into: bytes unchanged
+    for p in tree_pages:
+        np.testing.assert_array_equal(
+            before[p], np.asarray(jax.device_get(dst.k_pages[:, p])))
+    src.check_invariants()
+    dst.check_invariants()                  # refcounts balanced
+
+
+def test_snapshot_refusals(params):
+    """Migration only between rounds: queued, mid-chunked-prefill and
+    deferred-first-token streams refuse to snapshot (nothing mutated)."""
+    src = make_server(params, prefill_budget=PS, max_seq=64)
+    long_prompt = [(i * 3) % 60 + 1 for i in range(3 * PS)]
+    rid = src.enqueue(long_prompt)
+    with pytest.raises(ValueError, match="queued"):
+        src.snapshot_slot(rid)
+    src.step()                               # first chunk only
+    assert src._prefills, "prompt should still be mid-prefill"
+    with pytest.raises(ValueError, match="mid-chunked-prefill"):
+        src.snapshot_slot(rid)
+    src.drain()
+    src.pop_result(rid)
+    src.check_invariants()
+
+
+def test_restore_refuses_mismatched_config(params):
+    src = make_server(params)
+    dst = make_server(params, max_new_tokens=20)   # different budget
+    rid = src.enqueue(PROMPT)
+    decode_until(src, rid, 2)
+    snap = src.snapshot_slot(rid)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        dst.restore_slot(snap)
+    # the source stream is untouched and finishes normally
+    src.drain()
+    assert len(src.pop_result(rid)) == len(PROMPT) + 12
+
+
+def test_restore_returns_none_when_full_and_rolls_back(params):
+    """A target with no free slot refuses with None and mutates
+    nothing — the source resumes (unfreeze) token-exactly."""
+    quiet = make_server(params)
+    want = quiet_run(quiet, PROMPT)
+    src = make_server(params)
+    dst = make_server(params, n_slots=1)
+    blocker = dst.enqueue([5] * 4)
+    dst.step()                                # occupies the only slot
+    rid = src.enqueue(PROMPT)
+    decode_until(src, rid, 3)
+    snap = src.snapshot_slot(rid)
+    src.freeze_slot(rid)
+    assert dst.restore_slot(snap) is None
+    dst.check_invariants()
+    src.unfreeze_slot(rid)
+    src.drain()
+    assert src.pop_result(rid) == want
+    src.check_invariants()
+    dst.drain()
+    dst.pop_result(blocker)
+
+
+def test_spec_server_gamma_ema_survive_handoff(params):
+    """PagedSpeculativeDecodeServer: the adaptive-gamma EMA migrates
+    with the stream (no optimistic reset on the target) and the
+    migrated stream's output stays greedy-exact."""
+    dcfg = ModelConfig(vocab=64, d_model=16, n_layers=1, n_heads=2,
+                       d_ff=32)
+    dparams = init_params(jax.random.PRNGKey(7), dcfg)
+
+    def mk():
+        return PagedSpeculativeDecodeServer(
+            CFG, dcfg, params, dparams, n_slots=2, max_seq=64,
+            max_new_tokens=16, page_size=PS, gamma_max=3)
+
+    quiet = mk()
+    want = quiet_run(quiet, PROMPT)
+    src, dst = mk(), mk()
+    rid = src.enqueue(PROMPT)
+    decode_until(src, rid, 5)
+    slot = src._slot_rid.index(rid)
+    snap = src.snapshot_slot(rid)
+    assert snap["spec"]["gamma"] == int(src._gamma[slot])
+    assert snap["spec"]["accept_ema"] == pytest.approx(
+        float(src._accept_ema[slot]))
+    src.freeze_slot(rid)
+    rid2 = dst.restore_slot(snap)
+    src.finish_migrated(rid, {"replica": "dst", "rid": rid2, "epoch": 1})
+    slot2 = dst._slot_rid.index(rid2)
+    assert int(dst._gamma[slot2]) == snap["spec"]["gamma"]
+    assert float(dst._accept_ema[slot2]) == pytest.approx(
+        snap["spec"]["accept_ema"])
+    while not dst.finished(rid2):
+        dst.step()
+    assert dst.pop_result(rid2) == want
+    src.check_invariants()
+    dst.check_invariants()
+
+
+def test_spec_snapshot_refused_by_plain_server(params):
+    dcfg = ModelConfig(vocab=64, d_model=16, n_layers=1, n_heads=2,
+                       d_ff=32)
+    spec = PagedSpeculativeDecodeServer(
+        CFG, dcfg, params, init_params(jax.random.PRNGKey(7), dcfg),
+        n_slots=2, max_seq=64, max_new_tokens=12, page_size=PS,
+        gamma_max=2)
+    plain = make_server(params)
+    rid = spec.enqueue(PROMPT)
+    decode_until(spec, rid, 2)
+    snap = spec.snapshot_slot(rid)
+    with pytest.raises(ValueError, match="kind"):
+        plain.restore_slot(snap)
+
+
+def test_frozen_slot_is_not_free_not_idle_not_snapshottable(params):
+    """A frozen slot is mid-handoff: not reusable, not idle, not
+    migratable — and NOT snapshottable again (two racing policies must
+    never ship the same stream's next epoch to two different targets),
+    and the /load surface must read it as occupied + migrating (the
+    pool's drained() gate would otherwise let the autoscaler terminate
+    the source before the commit-ack)."""
+    src = make_server(params)
+    rid = src.enqueue(PROMPT)
+    decode_until(src, rid, 2)
+    slot = src._slot_rid.index(rid)
+    free_before = src._free_slots()
+    active_before = src.load_info()["active_slots"]
+    src.freeze_slot(rid)
+    assert slot not in src._free_slots()
+    assert not src._idle()
+    assert rid not in src.migratable_rids()
+    with pytest.raises(ValueError, match="already frozen"):
+        src.snapshot_slot(rid)
+    info = src.load_info()
+    assert info["migrating_slots"] == 1
+    assert info["active_slots"] == active_before
+    src.unfreeze_slot(rid)
+    assert src._free_slots() == free_before
+    assert src.load_info()["migrating_slots"] == 0
+    src.drain()
+    src.pop_result(rid)
+
+
+def test_dense_server_migration_degrades_to_skip(params):
+    """Non-paged servers carry no shippable cache view: snapshot
+    raises NotImplementedError, and the wire layer's migrate leg turns
+    that into a per-stream SKIP (migrate_skip event, False) — a dense
+    fleet's drain degrades to wait-drain instead of crashing the
+    drain-migrate thread."""
+    from kubetpu.jobs.serving import DecodeServer
+
+    dense = DecodeServer(CFG, params, n_slots=2, max_seq=64,
+                         max_new_tokens=8)
+    rid = dense.enqueue(PROMPT)
+    for _ in range(3):
+        dense.step()
+    with pytest.raises(NotImplementedError, match="live migration"):
+        dense.snapshot_slot(rid)
+    rep = ReplicaServer(dense, "dense0", idle_wait=0.002)
+    rep.start()
+    try:
+        assert rep.migrate_rid(rid, "http://127.0.0.1:9",
+                               reason="test") is False
+        assert any(e["kind"] == "migrate_skip"
+                   for e in rep.events.events())
+    finally:
+        rep.shutdown(graceful=False)
+
+
+def test_snapshot_codec_roundtrip_and_truncation():
+    snap = {
+        "prompt": [1, 2, 3], "epoch": 2,
+        "pages": {
+            "k": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+            "q8": np.arange(6, dtype=np.int8).reshape(2, 3),
+        },
+    }
+    meta, blob = encode_snapshot(snap)
+    chunks = blob_chunks(blob, 16)
+    assert b"".join(chunks) == blob
+    back = decode_snapshot(meta, blob)
+    assert back["prompt"] == [1, 2, 3] and back["epoch"] == 2
+    np.testing.assert_array_equal(back["pages"]["k"], snap["pages"]["k"])
+    np.testing.assert_array_equal(back["pages"]["q8"],
+                                  snap["pages"]["q8"])
+    with pytest.raises(ValueError, match="truncated"):
+        decode_snapshot(meta, blob[:-1])
+    with pytest.raises(ValueError, match="trailing"):
+        decode_snapshot(meta, blob + b"x")
+    assert blob_chunks(b"", 16) == [b""]    # empty manifest still commits
+
+
+# -- wire-level paths ---------------------------------------------------------
+
+
+@pytest.fixture()
+def wire(params):
+    """(replica list, shutdown) — two real ReplicaServers over paged
+    servers with longer streams so a handoff can land mid-flight."""
+    made = []
+
+    def build(n=2, rep_kw=None, **server_kw):
+        # long streams by default: a handoff must land MID-flight, not
+        # race a short sprint to natural completion
+        server_kw.setdefault("max_new_tokens", 96)
+        server_kw.setdefault("max_seq", 192)
+        reps = []
+        for i in range(n):
+            rep = ReplicaServer(make_server(params, **server_kw),
+                                f"mig{i}", idle_wait=0.002,
+                                **(rep_kw or {}))
+            rep.start()
+            reps.append(rep)
+        made.extend(reps)
+        return reps
+
+    yield build
+    for rep in made:
+        rep.shutdown(graceful=False)
+
+
+def _generate_async(rep_or_router_addr, prompt, key, timeout=30.0,
+                    retry=None):
+    out = {}
+
+    def go():
+        try:
+            out["body"] = request_json(
+                rep_or_router_addr + "/generate",
+                {"prompt": prompt, "timeout": timeout},
+                idempotency_key=key, timeout=timeout, retry=retry)
+            out["code"] = 200
+        except urllib.error.HTTPError as e:
+            out["code"] = e.code
+            out["body"] = json.loads(e.read() or b"{}")
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    return t, out
+
+
+def _wait_midstream(rep, min_emitted=3, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with rep._cv:
+            rids = rep.server.migratable_rids()
+            if rids and len(rep.server._emitted.get(
+                    rids[0], [])) >= min_emitted:
+                return rids[0]
+        time.sleep(0.003)
+    raise AssertionError("stream never reached mid-flight")
+
+
+def test_wire_migrate_409_and_adoption(params, wire):
+    """/migrate_out hands the stream over; the source's open generate
+    answers 409 with the new owner; a retry with the same key at the
+    target ADOPTS the restored stream (no re-admission) and returns the
+    full quiet-run tokens."""
+    want = quiet_run(make_server(params, max_new_tokens=96, max_seq=192),
+                     PROMPT)
+    src, dst = wire(2)
+    t, out = _generate_async(src.address, PROMPT, "w-adopt")
+    rid = _wait_midstream(src)
+    res = request_json(src.address + "/migrate_out",
+                       {"target": dst.address, "reason": "test",
+                        "wait": True},
+                       idempotency_key="w-adopt-mo", timeout=30.0)
+    assert res == {"migrated": 1, "failed": 0}
+    t.join(20.0)
+    assert out["code"] == 409
+    assert out["body"]["migrated"]["replica"] == dst.name
+    body = request_json(dst.address + "/generate",
+                        {"prompt": PROMPT, "timeout": 30.0},
+                        idempotency_key="w-adopt", timeout=30.0)
+    assert body["tokens"] == want
+    assert int(dst.server.obs.counter(
+        "kubetpu_replica_generate_adopted_total").value) == 1
+    # the generate was NOT re-admitted fresh on the target
+    assert int(dst.server.obs.counter(
+        "kubetpu_replica_generate_requests_total").value) == 0
+    # a retry at the SOURCE deterministically re-learns the 409
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        request_json(src.address + "/generate",
+                     {"prompt": PROMPT, "timeout": 30.0},
+                     idempotency_key="w-adopt", timeout=30.0)
+    assert ei.value.code == 409
+    assert int(src.server.obs.counter(
+        "kubetpu_migrations_total", reason="test",
+        result="committed").value) == 1
+    src.server.check_invariants()
+    dst.server.check_invariants()
+
+
+def test_wire_commit_replay_never_double_restores(params, wire):
+    """A re-sent commit (same idempotency key — the lost-response
+    retry) REPLAYS the committed ack: one restore, one active copy."""
+    src, dst = wire(2)
+    t, out = _generate_async(src.address, PROMPT, "w-replay")
+    rid = _wait_midstream(src)
+    with src._cv:
+        snap = src.server.snapshot_slot(rid)
+        src.server.freeze_slot(rid)
+    snap["origin"] = [src.name, rid]
+    snap["epoch"] = 1
+    meta, blob = encode_snapshot(snap)
+    meta["gen_key"] = "w-replay"
+    tok = {"origin": [src.name, rid], "epoch": 1}
+    kbase = f"mig-{src.name}-{rid}-e1"
+    commit_body = {"phase": "commit", "token": tok, "n_chunks": 1,
+                   "arrays": meta["arrays"], "ship_from_page": 0}
+    request_json(dst.address + "/migrate_in",
+                 {"phase": "begin", "token": tok, "meta": meta},
+                 idempotency_key=kbase + "-begin", timeout=10.0)
+    request_json(dst.address + "/migrate_in",
+                 {"phase": "chunk", "token": tok, "seq": 0,
+                  "data": chunk_b64(blob)},
+                 idempotency_key=kbase + "-c0", timeout=10.0)
+    ack1 = request_json(dst.address + "/migrate_in", commit_body,
+                        idempotency_key=kbase + "-commit", timeout=10.0)
+    ack2 = request_json(dst.address + "/migrate_in", commit_body,
+                        idempotency_key=kbase + "-commit", timeout=10.0)
+    assert ack1 == ack2                     # replay, not re-execution
+    assert int(dst.server.obs.counter(
+        "kubetpu_migrations_in_total", result="committed").value) == 1
+    with src._cv:
+        src.server.finish_migrated(
+            rid, {"replica": ack1["replica"], "rid": ack1["rid"],
+                  "epoch": 1})
+        src._cv.notify_all()
+    t.join(20.0)
+    assert out["code"] == 409
+
+
+def test_wire_epoch_fence_refuses_stale_handoff(params, wire):
+    """A DUPLICATE handoff of the same (origin, rid) at an epoch the
+    target has already committed is fenced 409 — at most one copy of a
+    stream ever goes active (zero double-restores)."""
+    src, dst = wire(2)
+    t, out = _generate_async(src.address, PROMPT, "w-fence")
+    rid = _wait_midstream(src)
+    with src._cv:
+        snap = src.server.snapshot_slot(rid)
+    assert src.migrate_rid(rid, dst.address, reason="test")
+    t.join(20.0)
+    # forge a second handoff of the SAME stream at the SAME epoch under
+    # DIFFERENT idempotency keys (so the replay window can't save us —
+    # only the fence can)
+    tok = {"origin": [src.name, rid], "epoch": 1}
+    meta, blob = encode_snapshot(dict(snap, origin=[src.name, rid],
+                                      epoch=1))
+    request_json(dst.address + "/migrate_in",
+                 {"phase": "begin", "token": tok, "meta": meta},
+                 idempotency_key="forge-begin", timeout=10.0)
+    request_json(dst.address + "/migrate_in",
+                 {"phase": "chunk", "token": tok, "seq": 0,
+                  "data": chunk_b64(blob)},
+                 idempotency_key="forge-c0", timeout=10.0)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        request_json(dst.address + "/migrate_in",
+                     {"phase": "commit", "token": tok, "n_chunks": 1,
+                      "arrays": meta["arrays"], "ship_from_page": 0},
+                     idempotency_key="forge-commit", timeout=10.0)
+    assert ei.value.code == 409
+    assert json.loads(ei.value.read())["fenced"] is True
+    assert int(dst.server.obs.counter(
+        "kubetpu_migrations_fenced_total").value) == 1
+    assert int(dst.server.obs.counter(
+        "kubetpu_migrations_in_total", result="committed").value) == 1
+    dst.server.check_invariants()
+
+
+def test_return_hop_sheds_stale_migrated_verdict(params, wire):
+    """A stream that RETURNS to a replica (A -> B -> A) must shed the
+    stale migrated-away verdict there: a keyed retry at A attaches to
+    the live stream (200, full tokens), never loops on the old
+    lower-epoch 409."""
+    want = quiet_run(make_server(params, max_new_tokens=96, max_seq=192),
+                     PROMPT)
+    a, b = wire(2)
+    t, out = _generate_async(a.address, PROMPT, "w-return")
+    rid = _wait_midstream(a)
+    assert a.migrate_rid(rid, b.address, reason="test")     # A -> B
+    t.join(20.0)
+    assert out["code"] == 409                                # stale owner: B
+    rid_b = _wait_midstream(b, min_emitted=0)
+    assert b.migrate_rid(rid_b, a.address, reason="test")   # B -> A
+    body = request_json(a.address + "/generate",
+                        {"prompt": PROMPT, "timeout": 30.0},
+                        idempotency_key="w-return", timeout=30.0)
+    assert body["tokens"] == want
+    assert int(a.server.obs.counter(
+        "kubetpu_replica_generate_adopted_total").value) == 1
+    a.server.check_invariants()
+    b.server.check_invariants()
+
+
+def test_prefix_negotiation_skips_shipping_matched_pages(params, wire):
+    """The begin-phase prefix hint: pages the target can map from its
+    own radix tree never cross the wire — bytes-shipped counts only
+    the uncached suffix, and the restore still lands token-exact."""
+    fam = [(i * 5) % 60 + 1 for i in range(2 * PS)]
+    warm_prompt = fam + [11]
+    mig_prompt = fam + [9]
+    want = quiet_run(make_server(params, max_new_tokens=96, max_seq=192),
+                     mig_prompt)
+    src, dst = wire(2, prefix_cache_pages=16)
+    # warm the TARGET's tree with the family
+    with dst._cv:
+        r = dst.server.enqueue(warm_prompt)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        with dst._cv:
+            if dst.server.finished(r):
+                dst.server.pop_result(r)
+                break
+        time.sleep(0.005)
+    assert dst.server.migration_prefix_hint(mig_prompt) == 2
+    t, out = _generate_async(src.address, mig_prompt, "w-skip")
+    rid = _wait_midstream(src)
+    with src._cv:
+        full_bytes = len(encode_snapshot(
+            {"pages": src.server.snapshot_slot(rid)["pages"]})[1])
+    assert src.migrate_rid(rid, dst.address, reason="test")
+    shipped = int(src.server.obs.counter(
+        "kubetpu_migration_bytes_shipped_total").value)
+    assert 0 < shipped < full_bytes
+    assert int(dst.server.obs.counter(
+        "kubetpu_migration_pages_remapped_total").value) == 2
+    t.join(20.0)
+    body = request_json(dst.address + "/generate",
+                        {"prompt": mig_prompt, "timeout": 30.0},
+                        idempotency_key="w-skip", timeout=30.0)
+    assert body["tokens"] == want
+    src.server.check_invariants()
+    dst.server.check_invariants()
+
+
+def test_drain_with_migration_completes_without_stream_end(params, wire):
+    """drain(migrate_to=...) hands the in-flight stream off and goes
+    idle immediately — the drain-complete gate never waits for the
+    stream's natural end (pinned by the stream still being mid-flight
+    on the TARGET when the source reads drained)."""
+    src, dst = wire(2)
+    t, out = _generate_async(src.address, PROMPT, "w-drain")
+    _wait_midstream(src)
+    src.drain(migrate_to=dst.address, reason="scale_down")
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        with src._cv:
+            if src.server._idle():
+                break
+        time.sleep(0.005)
+    with src._cv:
+        assert src.server._idle(), "drain did not complete via migration"
+    t.join(20.0)
+    assert out["code"] == 409
+    assert out["body"]["migrated"]["replica"] == dst.name
+    assert int(src.server.obs.counter(
+        "kubetpu_migrations_total", reason="scale_down",
+        result="committed").value) == 1
+    src.server.check_invariants()
+    dst.server.check_invariants()
+
+
+def test_drain_timeout_cancels_instead_of_wedging(params, wire):
+    """The satellite fix: a drain with no migrate target and a
+    long-max_tokens stream escalates at drain_timeout_s — the stream
+    cancels with a drain_timeout event and its caller gets a retryable
+    503, instead of scale-down wedging on natural stream end."""
+    (src,) = wire(1, rep_kw={"drain_timeout_s": 0.15},
+                  max_new_tokens=4096, max_seq=8192, n_pages=2048)
+    # NO_RETRY: the shared client would otherwise retry the 503 into
+    # the draining replica and surface the generic draining refusal —
+    # in production that retry is the router landing elsewhere
+    t, out = _generate_async(src.address, PROMPT, "w-timeout",
+                             timeout=30.0, retry=NO_RETRY)
+    _wait_midstream(src)
+    src.drain()                              # no migrate target
+    t.join(10.0)
+    assert out["code"] == 503
+    assert "drain_timeout" in out["body"]["error"]
+    assert any(e["kind"] == "drain_timeout"
+               for e in src.events.events())
+    with src._cv:
+        assert src.server._idle()
+    src.server.check_invariants()
+
+
+def test_router_repin_follows_migrated_stream(params, wire):
+    """RouterServer re-pins the rid->replica mapping mid-stream: a
+    routed request whose replica migrates the stream away lands on the
+    new owner via the 409 notice and completes token-exactly."""
+    # a longer stream: the drain-migrate must land MID-flight, not race
+    # a 24-token sprint to the finish line
+    want = quiet_run(make_server(params, max_new_tokens=96, max_seq=192),
+                     PROMPT)
+    src, dst = wire(2)
+    router = RouterServer(load_refresh_s=0.05)
+    router.start()
+    try:
+        for rep in (src, dst):
+            router.register_replica(rep.address)
+        t, out = _generate_async(router.address, PROMPT, "w-repin")
+        rep0 = None
+        deadline = time.monotonic() + 10.0
+        while rep0 is None and time.monotonic() < deadline:
+            for rep in (src, dst):
+                with rep._cv:
+                    rids = rep.server.migratable_rids()
+                    if rids and len(rep.server._emitted.get(
+                            rids[0], [])) >= 3:
+                        rep0 = rep
+                        break
+            time.sleep(0.003)
+        assert rep0 is not None
+        other = dst if rep0 is src else src
+        router.pool.drain(rep0.name, migrate_to=other.address,
+                          reason="scale_down")
+        t.join(25.0)
+        assert out["code"] == 200
+        assert out["body"]["tokens"] == want
+        assert out["body"]["replica"] == other.name
+        assert int(router._c_repin.value) >= 1
+        kinds = [e["kind"] for e in router.events.events()]
+        assert "repin" in kinds
+        rep0.server.check_invariants()
+        other.server.check_invariants()
+    finally:
+        router.shutdown()

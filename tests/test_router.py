@@ -49,6 +49,7 @@ class FakeSlotServer:
         self._emitted = {}
         self._active = set()
         self._done = {}
+        self._expired = {}
         self.obs.gauge_fn("kubetpu_serving_queue_depth",
                           lambda: len(self._queue))
         self.obs.gauge_fn("kubetpu_serving_active_slots",
@@ -101,12 +102,30 @@ class FakeSlotServer:
         return True
 
     def expire_reason(self, rid):
-        return None
+        return self._expired.get(rid)
 
     def pop_result(self, rid):
         out = self._prompts.pop(rid) + self._emitted.pop(rid)
         del self._done[rid]
         return out
+
+    # -- Round-16 migration duck surface (host-only: nothing to
+    # snapshot, so drains with a migrate target complete via idleness)
+
+    def migratable_rids(self):
+        return []
+
+    def migrated_to(self, rid):
+        return None
+
+    def unfinished_rids(self):
+        return sorted(set(self._queue) | self._active)
+
+    def cancel_expired(self, rid, reason):
+        if self._done.get(rid, False):
+            return False
+        self._expired[rid] = str(reason)
+        return self.cancel(rid)
 
     def metrics_text(self):
         return self.obs.render()
@@ -636,6 +655,66 @@ def test_autoscaler_event_sequence_up_drain_down(fleet):
     assert seqs["scale_up"] < seqs["drain"] < seqs["scale_down"]
     for rep, _f in extra:
         rep.shutdown(graceful=False)
+
+
+def test_autoscaler_scale_down_is_migrate_then_drain(fleet):
+    """Round-16: scale-down names a survivor target and emits
+    ``scale_down_migrate -> drain -> scale_down`` in seq order — the
+    migrate-then-remove contract the ISSUE pins."""
+    router, replicas = fleet(n=2)
+    scaler = ReplicaAutoscaler(
+        router, lambda: (_ for _ in ()).throw(RuntimeError("no launch")),
+        policy=ScalePolicy(min_replicas=1, max_replicas=3, up_after=99,
+                           down_after=1, cooldown_s=0.0))
+    action = scaler.poll_once()["action"]
+    assert action and action.startswith("drain:")
+    victim = action.split(":", 1)[1]
+    action = scaler.poll_once()["action"]
+    assert action == f"scale_down:{victim}"
+    seqs = {}
+    targets = {}
+    for e in router.events.events():
+        if e["kind"] in ("scale_down_migrate", "drain", "scale_down"):
+            seqs.setdefault(e["kind"], e["seq"])
+            targets[e["kind"]] = e
+    assert (seqs["scale_down_migrate"] < seqs["drain"]
+            < seqs["scale_down"])
+    # the handoff target is the surviving replica, never the victim
+    assert targets["scale_down_migrate"]["target"] != victim
+    assert targets["scale_down_migrate"]["replica"] == victim
+
+
+def test_suspect_triggers_migrate_away_once(fleet):
+    """Round-16 breaker policy: a replica newly SUSPECT gets ONE
+    migrate-away sweep toward a routable survivor ('migrate away'
+    instead of 'pray'); repeated ticks don't re-spam it, and recovery
+    to healthy re-arms the trigger."""
+    router, replicas = fleet(n=2)
+    pool = router.pool
+    victim = pool.names()[0]
+    for _ in range(pool.suspect_after):
+        pool._record_miss(victim)
+    assert pool.state(victim) == "suspect"
+    router._check_suspects()
+    router._check_suspects()          # second tick: no duplicate sweep
+    aways = [e for e in router.events.events()
+             if e["kind"] == "migrate_away"]
+    assert len(aways) == 1
+    assert aways[0]["replica"] == victim
+    assert aways[0]["target"] != victim
+    assert int(router._c_migrate_away.value) == 1
+    # recovery through probation -> healthy re-arms the trigger
+    pool._record_ok(victim, {"draining": False})
+    for _ in range(pool.probation_passes):
+        pool._record_ok(victim, {"draining": False})
+    assert pool.state(victim) == "healthy"
+    router._check_suspects()
+    for _ in range(pool.suspect_after):
+        pool._record_miss(victim)
+    router._check_suspects()
+    aways = [e for e in router.events.events()
+             if e["kind"] == "migrate_away"]
+    assert len(aways) == 2
 
 
 def test_autoscaler_respects_min_and_drain_gate(fleet):
