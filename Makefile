@@ -48,7 +48,7 @@ test: tpuinfo gpuinfo dataio
 # still fails the round).
 .PHONY: chaos
 chaos: lint obs-check prefix-check spec-check router-check migrate-check \
-		bench-gate-smoke
+		disagg-check bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py \
 		tests/test_race_soak.py -q
 
@@ -133,6 +133,17 @@ router-check:
 .PHONY: migrate-check
 migrate-check:
 	python scripts/migrate_check.py
+
+# disaggregated prefill/decode oracle (Round-17): router + 1 prefill +
+# 2 decode replicas under >=10% injected faults on the KV-stream leg —
+# routed tokens byte-equal a quiet colocated run, committed handoffs ==
+# requests == fleet-wide admissions (zero double-admissions), pages
+# actually streamed mid-prefill (the pipelining), warm decode-side
+# prefix pages never shipped, a stitched prefill->decode handoff trace,
+# pool invariants on all three pools
+.PHONY: disagg-check
+disagg-check:
+	python scripts/disagg_check.py
 
 # observability smoke oracle: controller + 2 fake agents, scrape the
 # federated /metrics, fail on malformed Prometheus text / missing

@@ -988,6 +988,179 @@ def migration_storm(cfg, n_replicas=2, n_streams=4, prompt_len=24,
     return tuple(run(a) for a in arms)
 
 
+def disagg_storm(cfg, n_long=2, long_len=96, n_short=6, short_len=8,
+                 max_new=24, page_size=16, prefill_budget=16, n_slots=8,
+                 n_prefill=1, n_decode=2, disagg_prefill_budget=None,
+                 arms=("colocated", "disagg")):
+    """Round-17 headline: DISAGGREGATED prefill/decode vs colocated
+    serving over the mixed long-prompt/short-decode storm. Both arms
+    run the SAME replica count (``n_prefill + n_decode``) behind the
+    router under identical concurrent traffic (long prompts that chew
+    prefill + short prompts that decode long); the ``colocated`` arm
+    is all-``both`` replicas — every decode stream shares steps with
+    its neighbors' prefill chunks — the ``disagg`` arm splits them
+    into ``n_prefill`` PREFILL + ``n_decode`` DECODE workers: prompts
+    admit and chunk-prefill on the prefill pool (streaming completed
+    KV spans over the wire while later chunks compute) and every token
+    is emitted by the decode pool, whose steps never carry anyone's
+    prompt. Reports pooled ITL p99 (the number disaggregation exists
+    to protect — the ``disagg_itl_p99_ms`` gate metric), decode tok/s
+    (``disagg_decode_toks_s``), source-side TTFT p50 (recorded when
+    the first token materializes at the prefill replica — the handoff
+    hop shows in the router's route latency, not here), token parity
+    vs a quiet serial run, and the
+    pipelining stats (committed handoffs, pages streamed mid-prefill,
+    overlap fraction)."""
+    import dataclasses
+    import random as _random
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.paged import PagedDecodeServer
+    from kubetpu.router import ReplicaServer, RouterServer
+    from kubetpu.wire.httpcommon import request_json
+
+    dcfg = dataclasses.replace(cfg, remat=False)
+    params = init_params(jax.random.PRNGKey(0), dcfg)
+    rng = _random.Random(0)
+    prompts = [[rng.randrange(1, dcfg.vocab) for _ in range(long_len)]
+               for _ in range(n_long)]
+    prompts += [[rng.randrange(1, dcfg.vocab) for _ in range(short_len)]
+                for _ in range(n_short)]
+    max_seq = -(-(long_len + max_new + 2) // page_size) * page_size
+    n_pages = n_slots * ((max_seq + page_size - 1) // page_size) + 8
+
+    def make_server(budget=None):
+        return PagedDecodeServer(
+            dcfg, params, n_slots=n_slots, max_seq=max_seq,
+            max_new_tokens=max_new, page_size=page_size,
+            n_pages=n_pages,
+            prefill_budget=(prefill_budget if budget is None else budget))
+
+    # quiet oracle + leg pre-compile in one pass (shared _LEG_CACHE)
+    quiet = make_server()
+    expected = []
+    for p in prompts:
+        rid = quiet.enqueue(p)
+        quiet.drain()
+        expected.append(quiet.pop_result(rid))
+
+    def run(arm):
+        # SAME replica count per arm — the comparison is topology, not
+        # hardware: colocated = every node serves both phases, disagg =
+        # a prefill pool streaming KV to a decode pool
+        roles = (("both",) * (n_prefill + n_decode)
+                 if arm == "colocated"
+                 else ("prefill",) * n_prefill + ("decode",) * n_decode)
+        # the asymmetric-budget dividend of disaggregation: a dedicated
+        # prefill node has no decode neighbors to protect, so it runs a
+        # much larger chunk budget (faster admission) while colocated
+        # nodes must keep chunks small exactly because decode shares
+        # their steps — each arm gets its honest configuration
+        pre_budget = (disagg_prefill_budget
+                      if disagg_prefill_budget is not None
+                      else 4 * prefill_budget)
+
+        def build_fleet(tag):
+            servers = [make_server(budget=(pre_budget
+                                           if role == "prefill"
+                                           else None))
+                       for role in roles]
+            for srv in servers:
+                # full leg warmup per budget signature: the disagg
+                # arm's bigger prefill budget produces chunk/gather
+                # shapes the shared pre-compile server never traced,
+                # and a mid-storm 1s XLA compile is not a serving
+                # number (the jit caches are process-global, so
+                # repeated fleets pay nothing)
+                srv.warmup()
+            replicas = [ReplicaServer(srv, f"dsg-{tag}-{role}{i}",
+                                      role=role, idle_wait=0.002)
+                        for i, (srv, role)
+                        in enumerate(zip(servers, roles))]
+            router = RouterServer(load_refresh_s=0.05)
+            router.start()
+            for rep in replicas:
+                rep.start()
+                router.register_replica(rep.address)
+            return servers, replicas, router
+
+        # THROWAWAY warm fleet: one long + one short request drive the
+        # wire + RESTORE legs once, landing the first-shape XLA
+        # compiles (~100-250ms each) in the process-global caches —
+        # then it is torn down, so the TIMED fleet below starts with
+        # CLEAN counters and latency reservoirs (warmup samples and
+        # warmup handoffs must never pollute the reported row)
+        _ws, wreplicas, wrouter = build_fleet("warm")
+        try:
+            wrng = _random.Random(991)
+            for j, n in enumerate((long_len, short_len)):
+                request_json(
+                    wrouter.address + "/generate",
+                    {"prompt": [wrng.randrange(1, dcfg.vocab)
+                                for _ in range(n)],
+                     "timeout": 120.0},
+                    idempotency_key=f"disagg-warm-{arm}-{j}",
+                    timeout=120.0)
+        finally:
+            wrouter.shutdown()
+            for rep in wreplicas:
+                rep.shutdown(graceful=False)
+
+        servers, replicas, router = build_fleet("run")
+        try:
+            def one(item):
+                i, prompt = item
+                return request_json(
+                    router.address + "/generate",
+                    {"prompt": prompt, "timeout": 120.0},
+                    idempotency_key=f"disagg-storm-{arm}-{i}",
+                    timeout=120.0)
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=len(prompts)) as ex:
+                bodies = list(ex.map(one, enumerate(prompts)))
+            wall = time.perf_counter() - t0
+            emitted = sum(len(b["emitted"]) for b in bodies)
+            preserved = sum(1 for b, want in zip(bodies, expected)
+                            if b.get("tokens") == want)
+            for srv in servers:
+                srv.check_invariants()   # the pool oracle rides the bench
+            committed = sum(int(rep.server.obs.counter(
+                "kubetpu_handoffs_total", result="committed").value)
+                for rep in replicas)
+            streamed = sum(int(rep.server.obs.counter(
+                "kubetpu_handoff_pages_streamed_total").value)
+                for rep in replicas)
+            early = sum(rep._handoff_early_bytes for rep in replicas)
+            total = sum(rep._handoff_bytes for rep in replicas)
+            return {
+                "metric": "disagg_storm",
+                "arm": arm,
+                "value": round(
+                    _pooled_latency_ms(servers, "itl", 99), 3),
+                "unit": "pooled ITL p99 ms",
+                "ttft_p50_ms": round(
+                    _pooled_latency_ms(servers, "ttft", 50), 3),
+                "decode_tok_s": round(emitted / wall, 1) if wall else 0.0,
+                "streams_preserved": preserved,
+                "requests": len(prompts),
+                "handoffs_committed": committed,
+                "pages_streamed": streamed,
+                "overlap_frac": round(early / total, 3) if total else 0.0,
+                "n_long": n_long,
+                "n_short": n_short,
+                "max_new": max_new,
+            }
+        finally:
+            router.shutdown()
+            for rep in replicas:
+                rep.shutdown(graceful=False)
+
+    return tuple(run(a) for a in arms)
+
+
 def spec_serving_throughput(cfg, n_slots, prompt_len, rounds):
     """Continuous batching WITH speculation: tokens per round under churn
     (the round replaces the one-token step; acceptance sets the speedup
@@ -1397,6 +1570,24 @@ def main() -> int:
                 max_new=32 if args.smoke else 128,
                 page_size=16,
                 n_slots=2 if args.smoke else 4):
+            emit(row)
+        # Round-17: disaggregated prefill/decode vs colocated over the
+        # PREFILL-HEAVY mixed storm (long prompts poisoning short
+        # decodes — the traffic disaggregation exists for): decode ITL
+        # p99 stops paying for other users' prompts, and with the
+        # pools matched to the work ratio the decode pool's tok/s
+        # comes out ahead too (the pipelined KV handoff)
+        for row in disagg_storm(
+                cfg,
+                n_long=3 if args.smoke else 4,
+                long_len=192 if args.smoke else 384,
+                n_short=5 if args.smoke else 6,
+                short_len=8,
+                max_new=24 if args.smoke else 64,
+                page_size=16,
+                prefill_budget=16 if args.smoke else 64,
+                n_slots=8 if args.smoke else 10,
+                n_prefill=2, n_decode=1):
             emit(row)
         emit(spec_serving_throughput(cfg, n_slots=2 if args.smoke else 4,
                                      prompt_len=16 if args.smoke else 128,

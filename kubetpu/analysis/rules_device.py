@@ -61,7 +61,11 @@ HOT_ROOTS = ("step",)
 # are barrier legs too: a slot handoff's device gather and page upload
 # are its DESIGNED sync/transfer — they run on the wire thread between
 # steps, never inside one, and anything that ever reaches them from a
-# step closure must stop the traversal here, not charge the step.
+# step closure must stop the traversal here, not charge the step. The
+# Round-17 disaggregated-handoff legs (the mid-prefill page-span gather
+# and the progress probe the handoff streamer polls) carry the same
+# argument: their device gathers run on the handoff loop thread between
+# steps, by design.
 HOT_BARRIERS = {
     "_schedule_prefills",
     "_drain_queue_into_slots",
@@ -83,6 +87,9 @@ HOT_BARRIERS = {
     "finish_migrated",
     "cancel_expired",
     "migratable_rids",
+    "snapshot_pages",
+    "_gather_page_span",
+    "prefill_progress",
 }
 
 # host-sync / host-upload constructs (the same set the PR 5/6 runtime

@@ -205,6 +205,44 @@ def render_summary(metrics_text: str, source: str) -> str:
             lines.append(
                 f"replica   {rep}: active={int(c.get('active', 0))} "
                 f"queue={int(c.get('queue', 0))}{pages_s}{hit_s}")
+
+    # Round-17 disaggregated prefill/decode (present when any replica
+    # advertises a role / ships handoffs): per-role replica counts, the
+    # in-flight + per-outcome handoff ledger, and the pipelining proof
+    # (fraction of KV bytes shipped before prefill finished)
+    role_counts: Dict[str, int] = {}
+    for labels, v in idx.get("kubetpu_serving_role", []):
+        role = labels.get("role")
+        if role and v:
+            role_counts[role] = role_counts.get(role, 0) + 1
+    # SUM per outcome: the federated scrape carries one series per
+    # prefill replica (replica="..."), and a dict comprehension would
+    # keep whichever replica iterates last
+    handoffs: Dict[str, int] = {}
+    for labels, v in idx.get("kubetpu_handoffs_total", []):
+        result = labels.get("result")
+        if result:
+            handoffs[result] = handoffs.get(result, 0) + int(v)
+    if role_counts or handoffs:
+        inflight = sum(v for _labels, v in
+                       idx.get("kubetpu_handoffs_inflight", []))
+        streamed = sum(v for _labels, v in
+                       idx.get("kubetpu_handoff_pages_streamed_total", []))
+        overlap = max((v for _labels, v in
+                       idx.get("kubetpu_handoff_overlap_frac", [])),
+                      default=0.0)
+        lines.append(
+            "disagg    roles " + "  ".join(
+                f"{r}={role_counts.get(r, 0)}"
+                for r in ("prefill", "decode", "both")))
+        lines.append(
+            f"disagg    handoffs inflight={int(inflight)} "
+            f"committed={handoffs.get('committed', 0)} "
+            f"aborted={handoffs.get('aborted', 0)} "
+            f"refused={handoffs.get('refused', 0)} "
+            f"ambiguous={handoffs.get('ambiguous', 0)}  "
+            f"pages_streamed={int(streamed)} "
+            f"overlap={overlap:.2f}")
     return "\n".join(lines)
 
 

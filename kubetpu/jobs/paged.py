@@ -994,7 +994,55 @@ class PagedDecodeServer(SlotServerBase):
         server whose table width includes the gamma margin)."""
         return "paged"
 
-    def snapshot_slot(self, rid: int) -> dict:
+    def _gather_page_span(self, slot: int, from_page: int,
+                          to_page: int) -> dict:
+        """Host copies of the slot's logical pages ``[from_page,
+        to_page)`` gathered through the table, in their STORED layout
+        (f32: k/v; kv_int8: the quantized k_q/k_s/v_q/v_s quadruple —
+        never dequantized). The one designed device->host sync a handoff
+        span pays; shared by the full-slot snapshot and the Round-17
+        streaming leg."""
+        row = self._table[slot, from_page:to_page]
+        assert (row >= 0).all(), "live pages unmapped under a gather"
+        phys = np.asarray(row, np.int64)
+
+        def gather(pool):
+            if isinstance(pool, tuple):
+                return tuple(np.asarray(jax.device_get(p[:, phys]))
+                             for p in pool)
+            return np.asarray(jax.device_get(pool[:, phys]))
+
+        k = gather(self.k_pages)
+        v = gather(self.v_pages)
+        if self.kv_int8:
+            return {"k_q": k[0], "k_s": k[1], "v_q": v[0], "v_s": v[1]}
+        return {"k": k, "v": v}
+
+    def snapshot_pages(self, rid: int, from_page: int,
+                       to_page: int) -> dict:
+        """Gather a COMPLETED page span of *rid*'s slot — the
+        disaggregated-prefill streaming leg (Round-17): page-aligned
+        chunk starts make every full page below ``prefill_progress``
+        final, so a prefill replica ships spans to the decode replica
+        while later chunks are still computing. Valid for mid-prefill
+        AND decoding slots (the caller owns the stability argument: only
+        ship pages below the progress mark / the decode position's
+        page). A BARRIER leg — the device gather is its designed
+        sync."""
+        if self._ring_pages:
+            raise NotImplementedError(
+                "windowed (ring) slots have no shippable logical page "
+                "view")
+        if not 0 <= from_page < to_page:
+            raise ValueError(f"bad page span [{from_page}, {to_page})")
+        try:
+            slot = self._slot_rid.index(rid)
+        except ValueError:
+            raise ValueError(f"request {rid} holds no slot") from None
+        return self._gather_page_span(slot, from_page, to_page)
+
+    def snapshot_slot(self, rid: int, from_page: int = 0,
+                      allow_frozen: bool = False) -> dict:
         """Capture everything needed to resume *rid* token-exactly on
         another replica: the request state (``_snapshot_request`` — raw
         request key included, so even SEEDED sampling continues
@@ -1005,6 +1053,13 @@ class PagedDecodeServer(SlotServerBase):
         holding live tokens ship (positions 0..pos; the page at pos may
         be partially stale — decode rewrites position pos before any
         read, the standard overwrite-before-read invariant).
+        *from_page* skips pages the caller already shipped (the Round-17
+        streaming handoff gathers only the tail here); ``n_live_pages``
+        stays ABSOLUTE either way. *allow_frozen* lets the handoff
+        owner snapshot a slot it froze itself (freeze-then-gather keeps
+        the stream from decoding past the snapshot on the source) —
+        third parties must keep getting the refusal, or two racing
+        policies would ship the same epoch to different targets.
 
         Migration happens only between steps/rounds: raises ValueError
         for queued / mid-chunked-prefill / deferred-first-token /
@@ -1036,36 +1091,22 @@ class PagedDecodeServer(SlotServerBase):
             raise ValueError(
                 f"request {rid}'s first token is still deferred — "
                 f"step once before migrating")
-        if slot in self._frozen:
+        if slot in self._frozen and not allow_frozen:
             # two concurrent policies (drain sweep + suspect sweep)
             # racing for the same stream: the second must refuse, or
             # both would ship epoch N+1 to DIFFERENT targets and each
             # target's per-replica fence would admit its copy
             raise ValueError(
                 f"request {rid} is already frozen for another handoff")
-        if not self.active[slot]:
+        if not self.active[slot] and slot not in self._frozen:
             raise ValueError(f"request {rid} is not decoding")
         snap = self._snapshot_request(rid, slot)
         n_live = self._pages_needed(self._host_len[slot])
-        row = self._table[slot, :n_live]
-        assert (row >= 0).all(), "live pages unmapped under a decode"
-        phys = np.asarray(row, np.int64)
-
-        def gather(pool):
-            # barrier-leg sync by design: the one host materialization a
-            # handoff pays (pages stay in their stored layout — int8
-            # pairs are shipped quantized)
-            if isinstance(pool, tuple):
-                return tuple(np.asarray(jax.device_get(p[:, phys]))
-                             for p in pool)
-            return np.asarray(jax.device_get(pool[:, phys]))
-
-        k = gather(self.k_pages)
-        v = gather(self.v_pages)
-        if self.kv_int8:
-            pages = {"k_q": k[0], "k_s": k[1], "v_q": v[0], "v_s": v[1]}
-        else:
-            pages = {"k": k, "v": v}
+        if not 0 <= from_page <= n_live:
+            raise ValueError(
+                f"from_page {from_page} outside the live span "
+                f"[0, {n_live}]")
+        pages = self._gather_page_span(slot, from_page, n_live)
         snap.update({
             "kind": self._migration_kind(),
             "cfg_fp": repr(self.cfg),

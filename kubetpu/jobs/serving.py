@@ -541,6 +541,11 @@ class SlotServerBase:
                 "queue_wait", 99) * 1e3,
             "ttft_p50_ms": self._metrics.recent_percentile(
                 "ttft", 50) * 1e3,
+            # the DECODE-pool saturation signal (Round-17): a
+            # disaggregated decode fleet scales on inter-token latency,
+            # not admission-queue pressure (prompts never queue there)
+            "itl_p99_ms": self._metrics.recent_percentile(
+                "itl", 99) * 1e3,
         }
 
     # -- Round-11 signal layer ------------------------------------------------
@@ -985,6 +990,20 @@ class SlotServerBase:
             out.append(rid)
         return out
 
+    def prefill_progress(self, rid: int) -> "Optional[Tuple[int, int]]":
+        """(prompt tokens prefilled so far, prompt length) for a request
+        currently MID-chunked-prefill — None otherwise (queued, active,
+        finished). Chunk starts are quantum-aligned (the paged server's
+        page size), so every full page below the progress mark is final
+        and will never be rewritten by a later chunk: the disaggregated
+        handoff streamer (Round-17) reads this to know which page spans
+        may ship while later chunks are still computing. A BARRIER leg —
+        host bookkeeping reads only, never called from step()."""
+        for st in self._prefills.values():
+            if st["rid"] == rid:
+                return int(st["done"]), len(st["prompt"])
+        return None
+
     def freeze_slot(self, rid: int) -> None:
         """Pause *rid*'s slot for a handoff: inactive for the step legs
         (decode neither advances nor writes it — the masked no-op path),
@@ -1060,13 +1079,16 @@ class SlotServerBase:
                 uniq.append(r)
         return uniq
 
-    def snapshot_slot(self, rid: int) -> dict:
+    def snapshot_slot(self, rid: int, from_page: int = 0,
+                      allow_frozen: bool = False) -> dict:
         """Base servers carry no shippable cache view: live migration
         is implemented by the PAGED servers (the page table is the
         portable representation). Raises NotImplementedError, which the
-        wire layer's migrate leg treats as a per-stream skip — a fleet
-        of dense replicas degrades to wait-drain instead of crashing
-        the drain-migrate thread."""
+        wire layer's migrate AND disagg-handoff legs treat as a
+        per-stream skip — a fleet of dense replicas degrades to
+        wait-drain / local decode instead of crashing the transfer
+        thread (the signature must match the paged one exactly, or the
+        keyword call would raise TypeError past those handlers)."""
         raise NotImplementedError(
             f"{type(self).__name__} does not support live migration — "
             f"snapshot/restore ship the paged servers' page view")

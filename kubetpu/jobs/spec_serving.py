@@ -657,7 +657,8 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
     def _migration_kind(self) -> str:
         return "paged_spec"
 
-    def snapshot_slot(self, rid: int) -> dict:
+    def snapshot_slot(self, rid: int, from_page: int = 0,
+                      allow_frozen: bool = False) -> dict:
         """The paged snapshot plus the speculative controller's state:
         the slot's adaptive gamma and acceptance EMA survive the handoff
         (a migrated low-agreement stream must not restart optimistic at
@@ -665,7 +666,8 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
         rows do NOT ship: stale draft KV on the target can only lower
         acceptance, never change output — verification is greedy-exact
         (the prefix-hit argument, applied to migration)."""
-        snap = super().snapshot_slot(rid)
+        snap = super().snapshot_slot(rid, from_page=from_page,
+                                     allow_frozen=allow_frozen)
         slot = self._slot_rid.index(rid)
         snap["draft_fp"] = repr(self.draft_cfg)
         snap["spec"] = {

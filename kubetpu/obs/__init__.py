@@ -74,6 +74,7 @@ from kubetpu.obs.events import (
 from kubetpu.obs.slo import (
     Objective,
     SloEngine,
+    disagg_slos,
     fleet_slos,
     router_slos,
     serving_slos,
@@ -94,6 +95,7 @@ __all__ = [
     "current_span_id",
     "current_trace_id",
     "default_registry",
+    "disagg_slos",
     "event_log",
     "federate",
     "fleet_slos",

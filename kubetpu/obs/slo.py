@@ -493,6 +493,75 @@ def router_slos(
     return out
 
 
+def disagg_slos(
+    itl_p99_s: Optional[float] = None,
+    ttft_p95_s: Optional[float] = None,
+    queue_wait_p99_s: Optional[float] = None,
+    min_free_pages: Optional[int] = None,
+    handoff_success: Optional[float] = None,
+    target: float = 0.99,
+) -> List[Objective]:
+    """The disaggregated-fleet objective set (Round-17), evaluated over
+    the router's FEDERATED ``/metrics`` like ``router_slos``. The two
+    pools fail differently, so the set watches both halves: the DECODE
+    pool's inter-token latency ceiling and free-page floor (decode ITL
+    no longer pays for anyone's prompts — this is the number
+    disaggregation exists to protect), the PREFILL pool's admission
+    queue wait, the client-visible route latency (the router-side
+    number that INCLUDES the handoff wire hop — serving-side TTFT is
+    recorded at the prefill source and excludes it), and the handoff
+    success ratio
+    (``kubetpu_handoffs_total{result="committed"}`` over all outcomes
+    — a fleet quietly degrading to colocated serving via refused
+    handoffs still meets latency SLOs while silently losing the
+    topology; this objective makes that visible)."""
+    out: List[Objective] = []
+    if itl_p99_s is not None:
+        out.append(Objective(
+            "disagg_itl_p99", metric="kubetpu_serving_latency_seconds",
+            labels={"op": "itl"}, percentile=99, threshold=itl_p99_s,
+            target=target,
+            description="decode-pool inter-token latency, p99 "
+                        "(worst replica)"))
+    if ttft_p95_s is not None:
+        # deliberately the ROUTER's route latency, not the serving
+        # ttft histogram: serving records TTFT at the PREFILL source
+        # when the first token materializes — BEFORE the freeze/ship/
+        # commit/adoption sequence that delivers it — so it
+        # structurally excludes exactly the wire latency
+        # disaggregation adds. The route op covers pick -> final
+        # upstream answer including the 409-chase to the decode
+        # replica: the client-visible number.
+        out.append(Objective(
+            "disagg_route_p95", metric="kubetpu_router_latency_seconds",
+            labels={"op": "route"}, percentile=95, threshold=ttft_p95_s,
+            target=target,
+            description="client-visible routed-request latency incl. "
+                        "the handoff hop, p95"))
+    if queue_wait_p99_s is not None:
+        out.append(Objective(
+            "disagg_queue_wait_p99",
+            metric="kubetpu_serving_latency_seconds",
+            labels={"op": "queue_wait"}, percentile=99,
+            threshold=queue_wait_p99_s, target=target,
+            description="prefill-pool admission-queue wait, p99"))
+    if min_free_pages is not None:
+        out.append(Objective(
+            "disagg_free_pages", metric="kubetpu_serving_pages_free",
+            threshold=float(min_free_pages), op=">=", reduce="min",
+            target=target,
+            description="tightest decode-pool free-pages floor"))
+    if handoff_success is not None:
+        out.append(Objective(
+            "disagg_handoff_success", metric="kubetpu_handoffs_total",
+            labels={"result": "committed"},
+            ratio_of="kubetpu_handoffs_total",
+            threshold=float(handoff_success), op=">=", target=target,
+            description="fraction of prefill->decode handoffs that "
+                        "committed"))
+    return out
+
+
 def fleet_slos(
     min_healthy_fraction: float = 0.99,
     schedule_p99_s: Optional[float] = None,

@@ -16,7 +16,17 @@ Three cooperating parts in front of N serving replicas:
   with hysteresis and migrate-then-drain scale-down;
 - :mod:`kubetpu.router.migration` — the snapshot wire codec for live
   KV migration (Round-16): meta + chunked blob encoding for the
-  ``POST /migrate_in`` transfer.
+  ``POST /migrate_in`` transfer, plus the page-SPAN naming the
+  Round-17 disaggregated prefill->decode handoff streams over the
+  same phases.
+
+Round-17 layers DISAGGREGATED serving on top: replicas carry a role
+(``prefill`` / ``decode`` / ``both`` — ``ReplicaServer(role=...)``),
+the router places prompts on the prefill pool by affinity and picks a
+decode target by load at admission, prefill replicas stream completed
+KV spans to their decode target while later chunks still compute, and
+the autoscaler reconciles each role pool independently. All-"both"
+fleets behave exactly as before — the topology is opt-in.
 
 Deliberately light: stdlib + ``kubetpu.obs`` + ``kubetpu.wire`` only —
 importing the router NEVER imports jax (the router process holds no
@@ -26,7 +36,7 @@ model state and routes for accelerator fleets it doesn't run on).
 from kubetpu.router.autoscaler import ReplicaAutoscaler, ScalePolicy
 from kubetpu.router.hashring import HashRing, prefix_head_key
 from kubetpu.router.migration import decode_snapshot, encode_snapshot
-from kubetpu.router.pool import ReplicaPool
+from kubetpu.router.pool import ReplicaPool, role_compatible
 from kubetpu.router.replica import ReplicaServer
 from kubetpu.router.server import RouterServer
 
@@ -40,4 +50,5 @@ __all__ = [
     "decode_snapshot",
     "encode_snapshot",
     "prefix_head_key",
+    "role_compatible",
 ]

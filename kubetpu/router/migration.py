@@ -90,3 +90,57 @@ def chunk_b64(chunk: bytes) -> str:
 
 def chunk_unb64(data: str) -> bytes:
     return base64.b64decode(data.encode("ascii"), validate=True)
+
+
+# -- page-span streaming (Round-17 disaggregated prefill/decode) --------------
+#
+# A full-slot snapshot ships each pool array as ONE manifest entry
+# ("k"/"v", or the int8 quadruple) — fine when the snapshot exists all at
+# once. The disaggregated handoff streams pages AS PREFILL COMPLETES
+# THEM, so the blob grows span by span: each completed page range is its
+# own set of manifest entries ("k@5" = the k pages starting at logical
+# page 5), encoded and chunked independently, appended to the transfer
+# in ship order. The commit's manifest lists the spans in exactly that
+# order (decode_snapshot follows manifest order, not name order), and
+# ``assemble_spans`` stitches them back into the contiguous per-field
+# arrays ``restore_slot`` consumes — refusing gaps and overlaps, because
+# a hole would restore a slot with missing KV.
+
+
+def span_name(field: str, start_page: int) -> str:
+    """Manifest name for *field*'s pages starting at logical page
+    *start_page* (``"k@5"``)."""
+    return f"{field}@{int(start_page)}"
+
+
+def assemble_spans(pages: Dict[str, "np.ndarray"],
+                   from_page: int) -> Dict[str, "np.ndarray"]:
+    """Stitch span-named arrays back into contiguous per-field arrays
+    whose page axis starts at *from_page* (the transfer's
+    ``ship_from_page``). Plain (span-free) names pass through untouched
+    — the Round-16 full-snapshot path. Raises ValueError on a gap,
+    overlap, or mixed plain+span naming for one field."""
+    if not any("@" in name for name in pages):
+        return dict(pages)
+    spans: Dict[str, List[Tuple[int, "np.ndarray"]]] = {}
+    for name, arr in pages.items():
+        if "@" not in name:
+            raise ValueError(
+                f"transfer mixes span-named and plain page arrays "
+                f"({name!r} next to spans)")
+        field, _, start = name.partition("@")
+        spans.setdefault(field, []).append((int(start), arr))
+    out: Dict[str, "np.ndarray"] = {}
+    for field, parts in spans.items():
+        parts.sort(key=lambda p: p[0])
+        expect = from_page
+        for start, arr in parts:
+            if start != expect:
+                raise ValueError(
+                    f"span {field}@{start} does not continue at page "
+                    f"{expect} — transfer has a "
+                    f"{'gap' if start > expect else 'overlap'}")
+            expect = start + arr.shape[1]
+        out[field] = (parts[0][1] if len(parts) == 1 else
+                      np.concatenate([a for _s, a in parts], axis=1))
+    return out

@@ -56,13 +56,30 @@ SUSPECT = "suspect"
 PROBATION = "probation"
 DEAD = "dead"
 
+# serving roles (Round-17 disaggregated prefill/decode)
+ROLES = ("prefill", "decode", "both")
+
+
+def role_compatible(src_role: Optional[str],
+                    dst_role: Optional[str]) -> bool:
+    """May *dst* take over *src*'s in-flight streams? Same pool or a
+    colocated ``"both"`` node — never across dedicated pools: a suspect
+    PREFILL replica's streams hand off to another prefill (or both)
+    replica, not a decode-only one whose pool is sized and SLO-judged
+    for pure decode traffic (and vice versa). Unknown roles read as
+    ``"both"`` (the pre-Round-17 fleet)."""
+    src = src_role or "both"
+    dst = dst_role or "both"
+    return dst == "both" or dst == src
+
 
 class ReplicaHandle:
     """One replica's registration + breaker + last load snapshot."""
 
-    def __init__(self, name: str, url: str) -> None:
+    def __init__(self, name: str, url: str, role: str = "both") -> None:
         self.name = name
         self.url = url.rstrip("/")
+        self.role = role if role in ROLES else "both"
         self.state = HEALTHY
         self.misses = 0
         self.passes = 0
@@ -77,6 +94,7 @@ class ReplicaHandle:
         return {
             "name": self.name,
             "url": self.url,
+            "role": self.role,
             "state": self.state,
             "draining": self.draining,
             "load": self.load,
@@ -123,18 +141,26 @@ class ReplicaPool:
 
     # -- membership ----------------------------------------------------------
 
-    def add(self, url: str, name: Optional[str] = None) -> str:
+    def add(self, url: str, name: Optional[str] = None,
+            role: Optional[str] = None) -> str:
         """Register a replica by URL; probes ``/healthz`` for its name
-        unless given. Idempotent: the same URL re-registers as the same
-        handle (breaker state kept). A DIFFERENT url under an existing
-        name is refused — silently swapping the handle would orphan the
-        first replica (running, unobserved, undrained) and repoint its
-        ring arcs; remove the old one first."""
+        (and serving ROLE — Round-17) unless given. Idempotent: the
+        same URL re-registers as the same handle (breaker state kept).
+        A DIFFERENT url under an existing name is refused — silently
+        swapping the handle would orphan the first replica (running,
+        unobserved, undrained) and repoint its ring arcs; remove the
+        old one first."""
         url = url.rstrip("/")
         if name is None:
             body = request_json(url + "/healthz",
                                 timeout=self.scrape_timeout)
             name = body.get("replica") or url
+            role = role or body.get("role")
+        # explicit-name registration stays probe-free: the role
+        # defaults to "both" and the replica's own /load word corrects
+        # it on the first refresh (the router refreshes right after
+        # registering, before granting ring arcs)
+        role = role or "both"
         with self._lock:
             existing = self._replicas.get(name)
             if existing is not None:
@@ -144,8 +170,9 @@ class ReplicaPool:
                     f"replica name {name!r} is already registered at "
                     f"{existing.url}; remove it before registering "
                     f"{url}")
-            self._replicas[name] = ReplicaHandle(name, url)
-        self.events.emit("replica_register", replica=name, url=url)
+            self._replicas[name] = ReplicaHandle(name, url, role=role)
+        self.events.emit("replica_register", replica=name, url=url,
+                         role=role)
         return name
 
     def remove(self, name: str) -> bool:
@@ -168,6 +195,14 @@ class ReplicaPool:
         with self._lock:
             h = self._replicas.get(name)
             return h.url if h is not None else None
+
+    def role(self, name: str) -> Optional[str]:
+        """The replica's serving role (``prefill``/``decode``/``both``;
+        None for unknown names). Placement, migrate-target selection
+        and the per-pool autoscaler all key on this."""
+        with self._lock:
+            h = self._replicas.get(name)
+            return h.role if h is not None else None
 
     def snapshot(self, name: str) -> Optional[dict]:
         """The last ``/load`` body for *name* (None before the first
@@ -236,6 +271,8 @@ class ReplicaPool:
             if h is None:
                 return
             h.load = dict(load)
+            if load.get("role") in ROLES:
+                h.role = load["role"]     # the replica's own word wins
             # the LOCAL cordon is sticky: pool.drain() promises the
             # router stops routing even when the /drain POST was lost,
             # so a replica still reporting draining=False must not

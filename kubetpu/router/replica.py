@@ -98,16 +98,20 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+import numpy as np
+
 from kubetpu.api import utils
 from kubetpu.obs import trace as obs_trace
 from kubetpu.obs.events import EventLog, merge_events
 from kubetpu.router.migration import (
     DEFAULT_CHUNK_BYTES,
+    assemble_spans,
     blob_chunks,
     chunk_b64,
     chunk_unb64,
     decode_snapshot,
     encode_snapshot,
+    span_name,
 )
 from kubetpu.wire.httpcommon import (
     IdempotencyCache,
@@ -145,6 +149,8 @@ class ReplicaServer:
         drain_timeout_s: Optional[float] = None,
         migrate_timeout: float = DEFAULT_MIGRATE_TIMEOUT,
         migrate_chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+        role: str = "both",
+        handoff_workers: int = 2,
     ) -> None:
         """*server*: the serving object (enqueue/step/finished/
         pop_result/load_info — ``SlotServerBase`` and every subclass).
@@ -155,7 +161,24 @@ class ReplicaServer:
         migration (when a target was named) or cancel with a
         ``drain_timeout`` event, so scale-down never wedges behind one
         long-max_tokens stream. None = wait forever (the pre-Round-16
-        behavior)."""
+        behavior).
+        *role* (Round-17 disaggregated serving): ``"prefill"`` makes
+        this replica a PREFILL worker — a routed generate carrying a
+        ``decode_target`` admits + chunk-prefills here, STREAMS its
+        completed page-aligned KV spans to that decode replica while
+        later chunks are still computing, and hands the stream off on
+        first token (the decode replica emits every token). ``"decode"``
+        advertises a decode worker (the router stops sending it fresh
+        prompts); ``"both"`` (default) is today's colocated behavior —
+        the topology is opt-in, and a role is ADVISORY for routing:
+        every replica remains a full server (a refused handoff resumes
+        locally)."""
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError("role must be 'prefill', 'decode' or 'both'")
+        self.role = role
+        if int(handoff_workers) < 1:
+            raise ValueError("handoff_workers must be >= 1")
+        self.handoff_workers = int(handoff_workers)
         self.server = server
         self.name = name
         self.token = token or None
@@ -199,6 +222,24 @@ class ReplicaServer:
         self._drain_migrate: Optional[str] = None
         self._drain_deadline: Optional[float] = None
         self._drain_thread: Optional[threading.Thread] = None
+        # -- Round-17 disaggregated handoffs (prefill role only): rid ->
+        # streaming-transfer state machine, driven by the handoff loop
+        # thread; all mutation under self._cv
+        self._handoffs: dict = {}
+        self._handoff_thread: Optional[threading.Thread] = None
+        # the pipelining proof: KV bytes shipped BEFORE the prefill
+        # finished vs total handoff bytes (gauge below)
+        self._handoff_early_bytes = 0
+        self._handoff_bytes = 0
+        # role is a federatable fact: the router's cli summary counts
+        # per-role replicas from this series (value is always 1)
+        self.server.obs.gauge("kubetpu_serving_role", role=role).set(1.0)
+        self.server.obs.gauge_fn("kubetpu_handoffs_inflight",
+                                 lambda: len(self._handoffs))
+        self.server.obs.gauge_fn(
+            "kubetpu_handoff_overlap_frac",
+            lambda: (self._handoff_early_bytes / self._handoff_bytes
+                     if self._handoff_bytes else 0.0))
         # replica wire counters land on the SERVING registry so one
         # /metrics scrape carries both (the router federates it whole)
         for key in ("requests", "replays", "errors", "adopted"):
@@ -233,6 +274,7 @@ class ReplicaServer:
                     write_json(self, 200, {
                         "ok": True,
                         "replica": replica.name,
+                        "role": replica.role,
                         "draining": replica.draining,
                     })
                 elif not self._authorized():
@@ -368,6 +410,19 @@ class ReplicaServer:
                 if key:
                     self._gen_keys[rid] = key
                     self._gc_gen_keys_locked()
+                # Round-17: a routed prompt naming a decode target on a
+                # PREFILL replica registers a streaming handoff — the
+                # handoff loop begins shipping completed KV spans while
+                # later prefill chunks still compute. Only FRESH
+                # admissions: an adopted/re-attached stream is already
+                # decoding (possibly HERE after an earlier handoff was
+                # refused) and must not be re-shipped by this leg.
+                target = req.get("decode_target")
+                if (self.role == "prefill" and isinstance(target, str)
+                        and target):
+                    self._register_handoff_locked(
+                        rid, target, prompt,
+                        target_name=req.get("decode_name"))
             self._cv.notify_all()
             while not self.server.finished(rid):
                 remaining = deadline - time.monotonic()
@@ -673,6 +728,422 @@ class ReplicaServer:
             daemon=True).start()
         return 200, {"started": pending}
 
+    # -- Round-17: disaggregated prefill -> decode streaming handoff ---------
+    #
+    # The prefill role's whole point: a routed generate carrying a
+    # ``decode_target`` admits + chunk-prefills HERE, but every token is
+    # emitted at the decode replica. The transfer rides the Round-16
+    # begin/chunk/commit wire path with the SAME per-(origin, rid,
+    # epoch) idempotency keys and commit-only retirement — what changes
+    # is WHEN bytes move: completed page-aligned KV spans ship while
+    # later prefill chunks are still computing (each span is its own
+    # manifest entry, ``migration.span_name``), so by first token only
+    # the tail pages + request meta remain. The state machine:
+    #
+    #   begin    POST the prompt + identity; learn the target's prefix
+    #            hint (cached pages never cross the wire)
+    #   stream   while mid-prefill: gather pages below the progress
+    #            mark (page-aligned chunk starts make them FINAL) and
+    #            append them as wire chunks
+    #   commit   the step loop freezes the slot at its first migratable
+    #            boundary (zero extra decode steps on the prefill side);
+    #            the handoff loop snapshots the TAIL (from_page = what
+    #            already shipped), ships it, and commits with the full
+    #            request meta. Outcomes mirror ``migrate_rid``:
+    #            commit-ack retires (finish_migrated -> callers chase
+    #            the 409 to the decode replica, where the gen key
+    #            ADOPTS the restored stream), a definitive refusal
+    #            unfreezes and resumes locally (the colocated-degrade
+    #            safety net), an ambiguous commit never resumes.
+
+    def _register_handoff_locked(self, rid: int, target: str,
+                                 prompt: list,
+                                 target_name: Optional[str] = None) -> None:
+        """Caller holds ``self._cv`` (the _generate admission branch)."""
+        self._handoffs[rid] = {
+            "rid": rid,
+            "target": target.rstrip("/"),
+            "target_name": target_name,
+            "state": "begin",
+            "prompt": [int(t) for t in prompt],
+            # locally-born stream: this handoff is generation 1 of the
+            # (this replica, rid) lineage — the target's fence compares
+            "tok": {"origin": [self.name, rid], "epoch": 1},
+            "epoch": 1,
+            # per-ATTEMPT nonce like migrate_rid's: at-most-once lives
+            # in the commit fence, not the key
+            "kbase": (f"dis-{self.name}-{rid}-e1-"
+                      f"{uuid.uuid4().hex[:8]}"),
+            "seq": 0,
+            "manifest": [],
+            "skip": 0,
+            # pages CAPTURED off the device (host copies, taken under
+            # the step loop's own lock hold so a fast prefill can never
+            # outrun the capture) vs pages actually SENT on the wire
+            "captured": 0,
+            "spans": [],           # [(lo, hi, pages-dict)] awaiting send
+            "early_pages": 0,
+            "frozen": False,
+        }
+        self.events.emit("handoff_intent", rid=rid,
+                         target=target_name or target)
+
+    def _page_fields(self) -> tuple:
+        """Manifest field order for one page span — matches the stored
+        pool layout ``snapshot_slot`` ships."""
+        return (("k_q", "k_s", "v_q", "v_s")
+                if getattr(self.server, "kv_int8", False) else ("k", "v"))
+
+    def _count_handoff(self, result: str) -> None:
+        self.server.obs.counter(
+            "kubetpu_handoffs_total",
+            "disaggregated prefill->decode stream handoffs by outcome",
+            result=result).inc()
+
+    def _advance_handoffs_locked(self) -> None:
+        """Caller holds ``self._cv`` (the step loop, right after a
+        step). Two duties, both cheap enough to ride the loop:
+
+        - CAPTURE newly completed page spans of mid-prefill handoff
+          streams (a host copy of a few pages). Riding the step's own
+          lock hold makes the pipelining deterministic: a prefill that
+          outruns the wire can never outrun the capture, so the spans
+          genuinely ship from work completed while later chunks compute
+          — the wire sends happen on the handoff loop thread,
+          overlapped with the following steps;
+        - FREEZE every handoff stream the moment it becomes migratable
+          (first token materialized, prefill done), so the prefill
+          replica never decodes past the snapshot point."""
+        if not self._handoffs:
+            return
+        progress = getattr(self.server, "prefill_progress", None)
+        gather = getattr(self.server, "snapshot_pages", None)
+        ps = int(getattr(self.server, "page_size", 0) or 0)
+        ready = None
+        for rid, h in self._handoffs.items():
+            if h["frozen"]:
+                continue
+            if progress is not None and gather is not None and ps:
+                prog = progress(rid)
+                if prog is not None:
+                    stable = min(prog[0] // ps,
+                                 len(h["prompt"]) // ps)
+                    if stable > h["captured"]:
+                        try:
+                            h["spans"].append(
+                                (h["captured"], stable,
+                                 gather(rid, h["captured"], stable)))
+                            h["captured"] = stable
+                        except (ValueError, NotImplementedError):
+                            pass   # ships with the commit tail instead
+                    continue       # mid-prefill: not migratable yet
+            if ready is None:
+                ready = set(self.server.migratable_rids())
+            if rid in ready:
+                self.server.freeze_slot(rid)
+                h["frozen"] = True
+
+    def _handoff_loop(self) -> None:
+        """Drive every in-flight handoff: one bounded action per rid
+        per round (a begin POST, one page span's chunks, or the
+        tail+commit), rounds fanned over a small worker pool
+        (``handoff_workers``) — each action is mostly wire wait, and a
+        frozen stream makes no progress ANYWHERE until its commit-ack,
+        so serializing N commits costs the Nth stream N x the wire
+        latency of dead frozen time. Per-rid ordering is preserved
+        (one action per rid per round, rounds joined). Wire work runs
+        OUTSIDE the condition — the step loop keeps prefilling other
+        slots while bytes move, which is the pipelining."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(
+            max_workers=self.handoff_workers,
+            thread_name_prefix=f"kubetpu-handoff-{self.name}")
+        try:
+            while True:
+                with self._cv:
+                    if not self._running:
+                        return
+                    rids = list(self._handoffs)
+                    if not rids:
+                        self._cv.wait(timeout=0.05)
+                        continue
+                if len(rids) == 1 or self.handoff_workers == 1:
+                    # no any()-short-circuit: EVERY rid gets its action
+                    progressed = any(
+                        [bool(self._handoff_pass_safe(rid))
+                         for rid in rids])
+                else:
+                    # list() BEFORE any(): the round must JOIN — a
+                    # short-circuited map iterator would let the next
+                    # round start while this round's slow passes still
+                    # run, racing two passes of one rid on its chunk
+                    # sequence (caught by disagg-check as a
+                    # missing-chunk refusal)
+                    progressed = any(
+                        list(pool.map(self._handoff_pass_safe, rids)))
+                if not progressed:
+                    with self._cv:
+                        if self._running and self._handoffs:
+                            self._cv.wait(timeout=0.002)
+        finally:
+            pool.shutdown(wait=False)
+
+    def _handoff_pass_safe(self, rid: int) -> bool:
+        """``_handoff_pass`` with the loop's survival guarantee: ANY
+        unexpected exception aborts that one handoff (stream unfrozen,
+        resumes locally — the pre-commit failure spelling) instead of
+        killing the streamer thread. A dead streamer would be a
+        fleet-wide black hole: the step loop keeps freezing every new
+        handoff stream at its first token with nothing left to ship,
+        commit, or thaw them, while /healthz keeps reporting
+        healthy."""
+        try:
+            return bool(self._handoff_pass(rid))
+        except Exception as e:  # noqa: BLE001 — the loop must survive
+            with self._cv:
+                h = self._handoffs.get(rid)
+                if h is not None:
+                    self._handoff_abort_locked(
+                        rid, h, f"unexpected: {type(e).__name__}: {e}")
+            return False
+
+    def _handoff_pass(self, rid: int) -> bool:
+        """One bounded action for *rid*'s handoff -> True if anything
+        moved (wire bytes or a state transition). Spans captured by the
+        step loop drain first (in page order); the commit fires only
+        once the stream is frozen AND every captured span is on the
+        wire."""
+        snap = span = None
+        with self._cv:
+            h = self._handoffs.get(rid)
+            if h is None:
+                return False
+            if self.server.finished(rid):
+                # completed (or canceled) locally before the handoff
+                # could commit — a short stream can outrun its own
+                # transfer; the caller already has the tokens
+                self._handoffs.pop(rid, None)
+                self._count_handoff("skipped")
+                self.events.emit("handoff_skip", rid=rid,
+                                 reason="finished_locally")
+                return False
+            if h["state"] == "stream":
+                if h["spans"]:
+                    span = h["spans"].pop(0)
+                elif h["frozen"]:
+                    try:
+                        snap = self.server.snapshot_slot(
+                            rid,
+                            from_page=max(h["captured"], h["skip"]),
+                            allow_frozen=True)
+                    except (ValueError, NotImplementedError) as e:
+                        return self._handoff_abort_locked(
+                            rid, h, f"snapshot: {e}")
+        if h["state"] == "begin":
+            return self._handoff_begin(rid, h)
+        if span is not None:
+            return self._handoff_send_span(rid, h, span[0], span[1],
+                                           span[2], early=True)
+        if snap is not None:
+            return self._handoff_commit(rid, h, snap)
+        return False
+
+    def _handoff_begin(self, rid: int, h: dict) -> bool:
+        """The begin leg: ship the prompt + identity, learn how many
+        leading pages the target can map from its own prefix cache —
+        those never cross the wire."""
+        with self._cv:
+            gen_key = self._gen_keys.get(rid)
+        meta = {"prompt": h["prompt"], "reason": "disagg",
+                "source": self.name}
+        if gen_key:
+            meta["gen_key"] = gen_key
+        try:
+            resp = request_json(
+                h["target"] + "/migrate_in",
+                {"phase": "begin", "token": h["tok"], "meta": meta},
+                token=self.token, idempotency_key=h["kbase"] + "-begin",
+                timeout=self.migrate_timeout)
+        except Exception as e:  # noqa: BLE001 — target dark or refusing
+            with self._cv:
+                return self._handoff_abort_locked(rid, h,
+                                                  f"begin: {e}")
+        ps = int(getattr(self.server, "page_size", 1) or 1)
+        cap = max(0, (len(h["prompt"]) - 1) // ps)
+        h["skip"] = min(max(0, int(resp.get("skip_pages") or 0)), cap)
+        h["state"] = "stream"
+        self.events.emit("handoff_begin", rid=rid, target=h["target"],
+                         skip_pages=h["skip"])
+        return True
+
+    def _handoff_send_span(self, rid: int, h: dict, from_page: int,
+                           to_page: int, pages: dict,
+                           early: bool) -> bool:
+        """Append one page span to the transfer: manifest entries in
+        field order, bytes as sequenced wire chunks. *early* spans are
+        the pipelining — pages captured while later prefill chunks were
+        still computing. Pages below the target's prefix hint are
+        sliced off (a span captured before the begin answer arrived may
+        cover pages the target already holds warm — they never cross
+        the wire)."""
+        lo = max(from_page, h["skip"])
+        if to_page <= lo:
+            return True            # entirely covered by the warm hint
+        parts = []
+        manifest = []
+        for field in self._page_fields():
+            arr = np.ascontiguousarray(pages[field][:, lo - from_page:])
+            manifest.append({"name": span_name(field, lo),
+                             "dtype": str(arr.dtype),
+                             "shape": list(arr.shape)})
+            parts.append(arr.tobytes())
+        blob = b"".join(parts)
+        try:
+            for piece in blob_chunks(blob, self.migrate_chunk_bytes):
+                request_json(
+                    h["target"] + "/migrate_in",
+                    {"phase": "chunk", "token": h["tok"],
+                     "seq": h["seq"], "data": chunk_b64(piece)},
+                    token=self.token,
+                    idempotency_key=f"{h['kbase']}-c{h['seq']}",
+                    timeout=self.migrate_timeout)
+                h["seq"] += 1
+        except Exception as e:  # noqa: BLE001 — pre-commit: resume is safe
+            with self._cv:
+                return self._handoff_abort_locked(rid, h, f"chunk: {e}")
+        h["manifest"].extend(manifest)
+        with self._cv:
+            # plain-int accumulators shared across concurrent handoff
+            # workers: += is a read-modify-write, so take the lock
+            self._handoff_bytes += len(blob)
+            if early:
+                self._handoff_early_bytes += len(blob)
+        self.server.obs.counter(
+            "kubetpu_migration_bytes_shipped_total",
+            "snapshot blob bytes shipped over /migrate_in").inc(len(blob))
+        if early:
+            h["early_pages"] += to_page - lo
+            self.server.obs.counter(
+                "kubetpu_handoff_pages_streamed_total",
+                "KV pages captured+shipped while later prefill chunks "
+                "were still computing — the pipelining proof").inc(
+                    to_page - lo)
+        return True
+
+    def _handoff_commit(self, rid: int, h: dict, snap: dict) -> bool:
+        """Ship the tail span + the full request meta, then commit.
+        Outcome classification is ``migrate_rid``'s: only a commit-POST
+        failure can mask an executed restore — tail-chunk failures
+        provably left no live copy (staging is not a stream) and resume
+        locally; the commit 200 is the retirement ack."""
+        n_live = int(snap["n_live_pages"])
+        with self._cv:
+            gen_key = self._gen_keys.get(rid)
+        meta = {k: v for k, v in snap.items() if k != "pages"}
+        meta.update(origin=h["tok"]["origin"], epoch=h["epoch"],
+                    gen_key=gen_key, reason="disagg", source=self.name)
+        target_label = h.get("target_name") or h["target"]
+        with obs_trace.span("disagg.handoff",
+                            component=self.obs_component,
+                            target=target_label):
+            tail_from = max(h["captured"], h["skip"])
+            if (n_live > tail_from and not self._handoff_send_span(
+                    rid, h, tail_from, n_live, snap["pages"],
+                    early=False)):
+                return False        # aborted (and unfrozen) inside
+            try:
+                ack = request_json(
+                    h["target"] + "/migrate_in",
+                    {"phase": "commit", "token": h["tok"],
+                     "n_chunks": h["seq"], "arrays": h["manifest"],
+                     "ship_from_page": h["skip"], "meta": meta},
+                    token=self.token,
+                    idempotency_key=h["kbase"] + "-commit",
+                    timeout=self.migrate_timeout)
+            except urllib.error.HTTPError as e:
+                detail = {}
+                try:
+                    detail = json.loads(e.read() or b"{}")
+                except Exception:  # noqa: BLE001 — body unreadable
+                    pass
+                if detail.get("fenced"):
+                    info = {"replica": detail.get("replica"),
+                            "epoch": int(detail.get("epoch", h["epoch"])),
+                            "fenced": True}
+                    with self._cv:
+                        self.server.finish_migrated(rid, info)
+                        self._note_stream_left_locked(rid, gen_key, info)
+                        self._handoffs.pop(rid, None)
+                        self._cv.notify_all()
+                    self._count_handoff("fenced")
+                    return True
+                if e.code < 500:
+                    # definitive refusal: the restore raised / staging
+                    # gone — resume locally, token-exact (the colocated
+                    # degrade)
+                    with self._cv:
+                        self.server.unfreeze_slot(rid)
+                        self._handoffs.pop(rid, None)
+                        self._cv.notify_all()
+                    self._count_handoff("refused")
+                    self.events.emit("handoff_refused", rid=rid,
+                                     code=e.code,
+                                     error=str(detail.get("error",
+                                                          ""))[:120])
+                    return True
+                return self._handoff_ambiguous(rid, h, gen_key,
+                                               f"HTTP {e.code} on commit")
+            except Exception as e:  # noqa: BLE001 — transport death
+                return self._handoff_ambiguous(rid, h, gen_key, str(e))
+            info = {"replica": ack.get("replica"),
+                    "rid": ack.get("rid"), "epoch": h["epoch"]}
+            with self._cv:
+                self.server.finish_migrated(rid, info)
+                self._note_stream_left_locked(rid, gen_key, info)
+                self._handoffs.pop(rid, None)
+                self._cv.notify_all()
+            self._count_handoff("committed")
+            # emitted INSIDE the span so the event captures the
+            # handoff's trace id — disagg-check stitches source and
+            # target spans through it
+            self.events.emit("handoff_commit", rid=rid,
+                             target=ack.get("replica"),
+                             epoch=h["epoch"],
+                             early_pages=h["early_pages"],
+                             pages=n_live - h["skip"])
+        return True
+
+    def _handoff_ambiguous(self, rid: int, h: dict,
+                           gen_key: Optional[str], err: str) -> bool:
+        """A commit whose outcome is unknowable: the target may hold a
+        live copy, so the stream finishes as migrated toward it — the
+        router retry adopts the restored stream or recomputes fresh
+        (at-most-one-active beats resuming here)."""
+        info = {"replica": None, "url": h["target"],
+                "epoch": h["epoch"], "ambiguous": True}
+        with self._cv:
+            self.server.finish_migrated(rid, info)
+            self._note_stream_left_locked(rid, gen_key, info)
+            self._handoffs.pop(rid, None)
+            self._cv.notify_all()
+        self._count_handoff("ambiguous")
+        self.events.emit("handoff_ambiguous", rid=rid, error=err[:120])
+        return True
+
+    def _handoff_abort_locked(self, rid: int, h: dict, err) -> bool:
+        """Caller holds ``self._cv``. Pre-commit failure: no copy can
+        exist at the target (begin/chunk legs only stage), so the
+        stream RESUMES here — prefill continues / decode proceeds
+        locally, the colocated-degrade safety net."""
+        if h.get("frozen"):
+            self.server.unfreeze_slot(rid)
+        self._handoffs.pop(rid, None)
+        self._cv.notify_all()
+        self._count_handoff("aborted")
+        self.events.emit("handoff_abort", rid=rid, error=str(err)[:120])
+        return False
+
     def _migrate_in(self, req: dict):
         """One phase of the inbound chunked transfer -> (code, obj);
         runs under ``run_idempotent`` (every phase POST is keyed by the
@@ -769,7 +1240,13 @@ class ReplicaServer:
                 # a draining target would just hand the stream onward;
                 # refuse so the source resumes or the policy re-picks
                 return 503, {"error": "replica is draining"}
-            gk = st["meta"].get("gen_key")
+            # the Round-17 streaming handoff only knows the FULL request
+            # state at commit time (emitted tokens, position, sampler
+            # state all moved while spans streamed), so the commit may
+            # carry a meta update that merges over the begin phase's
+            extra = req.get("meta") if isinstance(req.get("meta"),
+                                                  dict) else {}
+            gk = extra.get("gen_key") or st["meta"].get("gen_key")
             if gk and (gk in self._adopted
                        or gk in self._gen_keys.values()):
                 # the router already RE-ADMITTED this logical request
@@ -788,12 +1265,19 @@ class ReplicaServer:
                              "epoch": key[2]}
             try:
                 blob = b"".join(st["chunks"][i] for i in range(n))
-                meta = dict(st["meta"], arrays=arrays)
+                meta = dict(st["meta"])
+                meta.update(extra)
+                meta["arrays"] = arrays
                 snap = decode_snapshot(meta, blob)
-                snap["ship_from_page"] = int(
-                    req.get("ship_from_page", 0) or 0)
+                ship_from = int(req.get("ship_from_page", 0) or 0)
+                # a streamed transfer's pages arrive as ordered SPANS
+                # (migration.span_name); stitch them back into the
+                # contiguous per-field arrays restore_slot consumes —
+                # a gap or overlap refuses here, never restores holes
+                snap["pages"] = assemble_spans(snap["pages"], ship_from)
+                snap["ship_from_page"] = ship_from
                 rid = self.server.restore_slot(
-                    snap, reason=str(st["meta"].get("reason", "migrate")))
+                    snap, reason=str(meta.get("reason", "migrate")))
             except (ValueError, NotImplementedError) as e:
                 del self._mig_staging[key]
                 self.server.obs.counter(
@@ -836,7 +1320,16 @@ class ReplicaServer:
         bounded percentile reads) plus this wire layer's flags."""
         info = dict(self.server.load_info())
         info["replica"] = self.name
+        info["role"] = self.role
         info["draining"] = self.draining
+        # GIL-atomic len reads, like the server's own host counters —
+        # the load snapshot is advisory, never a synchronized view
+        info["inflight_handoffs"] = len(self._handoffs)
+        # staged INBOUND transfers: streams about to land in this
+        # pool's slots — the decode-target picker counts them so a
+        # burst of handoffs spreads instead of clumping on whichever
+        # node's /load snapshot was scraped before the burst
+        info["inbound_transfers"] = len(self._mig_staging)
         return info
 
     def render_events(self, kind: Optional[str] = None,
@@ -875,7 +1368,23 @@ class ReplicaServer:
                     self._cv.wait(timeout=self._idle_wait)
                     continue
                 self.server.step()
+                # Round-17: capture completed KV spans + pause handoff
+                # streams AT the step boundary (the wire work runs on
+                # the handoff loop thread, overlapped with later steps)
+                self._advance_handoffs_locked()
                 self._cv.notify_all()
+            # yield OUTSIDE the condition when a KV transfer is in
+            # flight (outbound handoffs here / inbound staging on a
+            # decode target): a busy step loop re-acquires the lock
+            # faster than notified waiters wake, starving the handoff
+            # streamer and the transfer handlers for hundreds of
+            # milliseconds — one scheduler yield per step lets a parked
+            # thread actually take the lock. Transfer-free replicas
+            # skip it: the yield costs ~ms of TTFT per admission
+            # (pinned by the bench gate's router_ttft_p50_ms ratchet)
+            # and buys nothing without a transfer to unblock.
+            if self._handoffs or self._mig_staging:
+                time.sleep(0)
 
     def _check_drain_timeout_locked(self) -> None:
         """Caller holds ``self._cv``. A draining replica past its
@@ -919,6 +1428,11 @@ class ReplicaServer:
             target=self._poll_loop, name=f"kubetpu-replica-{self.name}",
             daemon=True)
         self._loop_thread.start()
+        if self.role == "prefill":
+            self._handoff_thread = threading.Thread(
+                target=self._handoff_loop,
+                name=f"kubetpu-replica-handoff-{self.name}", daemon=True)
+            self._handoff_thread.start()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name=f"kubetpu-replica-http-{self.name}", daemon=True)
@@ -1004,6 +1518,9 @@ class ReplicaServer:
             self._cv.notify_all()
         if drain_thread is not None:
             drain_thread.join(timeout=5.0)
+        if self._handoff_thread is not None:
+            self._handoff_thread.join(timeout=5.0)
+            self._handoff_thread = None
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=5.0)
             self._loop_thread = None

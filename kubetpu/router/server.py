@@ -59,7 +59,7 @@ import threading
 import time
 import urllib.error
 import uuid
-from collections import OrderedDict
+from collections import Counter, OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -71,7 +71,8 @@ from kubetpu.obs.registry import Registry, install_process_gauges
 from kubetpu.obs.slo import Objective, SloEngine
 from kubetpu.router.hashring import DEFAULT_HEAD_QUANTUM, \
     DEFAULT_HEAD_TOKENS, HashRing, prefix_head_key
-from kubetpu.router.pool import HEALTHY, SUSPECT, ReplicaPool
+from kubetpu.router.pool import (HEALTHY, SUSPECT, ReplicaPool,
+                                 role_compatible)
 from kubetpu.wire.httpcommon import (
     IdempotencyCache,
     InflightTracker,
@@ -172,6 +173,13 @@ class RouterServer:
         self._pins: "OrderedDict[str, Tuple[Optional[str], int]]" = \
             OrderedDict()
         self._suspect_handled: set = set()
+        self._decode_rr = 0          # round-robin decode-target ties
+        # recent decode-target handouts: (monotonic ts, name). The
+        # /load snapshots are throttled, so a burst of admissions
+        # inside one refresh window would all read the same "emptiest"
+        # node — the router charges its own recent assignments on top
+        # of the stale snapshot until the next scrape can see them.
+        self._recent_decode: "deque" = deque()
         self._c_repin = self.registry.counter(
             "kubetpu_router_repins_total",
             "mid-stream rid->replica re-pins after a 409-migrated "
@@ -247,7 +255,8 @@ class RouterServer:
                     try:
                         req = self._body()
                         name = router.register_replica(
-                            req["url"], name=req.get("name"))
+                            req["url"], name=req.get("name"),
+                            role=req.get("role"))
                         write_json(self, 200, {"replica": name})
                     except ValueError as e:
                         # name conflict: the caller's mistake, not an
@@ -294,17 +303,24 @@ class RouterServer:
 
     # -- membership ----------------------------------------------------------
 
-    def register_replica(self, url: str, name: Optional[str] = None) -> str:
+    def register_replica(self, url: str, name: Optional[str] = None,
+                         role: Optional[str] = None) -> str:
         """Register a replica and give it ring arcs. Idempotent at the
         same URL. Ring membership changes ONLY here and in
         ``remove_replica`` — transient health blips cordon via the
-        breaker without remapping anyone's prefix buckets."""
-        name = self.pool.add(url, name=name)
-        with self._lock:
-            self.ring.add(name)
-        # seed a load snapshot so the first routed request after a scale
-        # event doesn't see the newcomer as "unknown load"
+        breaker without remapping anyone's prefix buckets. Round-17:
+        DECODE-only replicas get NO ring arcs — prompts never route to
+        them by affinity (they receive streams over the handoff wire),
+        so their arcs would only manufacture fallbacks."""
+        name = self.pool.add(url, name=name, role=role)
+        # seed a load snapshot FIRST: besides giving the first routed
+        # request a view of the newcomer, the /load body resolves the
+        # ROLE for explicit-name registrations (probe-free in the
+        # pool), and the ring-arc decision below must see it
         self.pool.refresh(0.0)
+        if self.pool.role(name) != "decode":
+            with self._lock:
+                self.ring.add(name)
         return name
 
     def remove_replica(self, name: str) -> bool:
@@ -327,8 +343,16 @@ class RouterServer:
         Affinity: walk the key's preference order, skipping unroutable
         and overloaded replicas; everyone overloaded -> least-queued
         routable. Random policy: seeded uniform choice (the bench
-        baseline)."""
+        baseline). Round-17: fresh prompts route only to PREFILL-capable
+        replicas (role prefill/both) — decode workers receive their
+        streams over the handoff wire, not the prompt path. A fleet
+        with nothing prefill-capable (a misconfiguration) degrades to
+        routing anywhere rather than going dark."""
         routable = set(self.pool.routable())
+        capable = {n for n in routable
+                   if self.pool.role(n) != "decode"}
+        if capable:
+            routable = capable
         if not routable:
             return None, False
         with self._lock:
@@ -360,6 +384,52 @@ class RouterServer:
         if name != target:
             self._c_fallback.inc()
         return name, name == target
+
+    def _pick_decode(self, exclude=()) -> Optional[str]:
+        """The DECODE-pool placement decision (Round-17): where a
+        prefill replica should stream a prompt's KV, chosen at
+        admission from the decode pool's load — dedicated decode
+        replicas first, then colocated ``both`` nodes; within a tier
+        the fewest active slots, then the most free pool pages (the
+        page floor is the decode pool's real capacity). None when no
+        decode-capable replica is routable — the prefill replica then
+        serves the stream itself (colocated degrade)."""
+        cands = sorted(n for n in self.pool.routable()
+                       if n not in exclude
+                       and self.pool.role(n) != "prefill")
+        if not cands:
+            return None
+        now = time.monotonic()
+        horizon = max(2.0 * self.load_refresh_s, 0.25)
+        with self._lock:
+            while (self._recent_decode
+                   and now - self._recent_decode[0][0] > horizon):
+                self._recent_decode.popleft()
+            recent = Counter(n for _t, n in self._recent_decode)
+            self._decode_rr += 1
+            rot = self._decode_rr % len(cands)
+
+        def key(n):
+            load = self.pool.snapshot(n) or {}
+            free = load.get("pages_free")
+            # occupancy = the stale snapshot PLUS this router's own
+            # handouts since (inbound transfers + recent assignments):
+            # a burst of admissions inside one refresh window must
+            # spread, not clump on whichever node was scraped emptiest
+            return (0 if self.pool.role(n) == "decode" else 1,
+                    int(load.get("active_slots", 0))
+                    + int(load.get("queue_depth", 0))
+                    + int(load.get("inbound_transfers", 0))
+                    + recent[n],
+                    -(int(free) if free is not None else 1 << 30))
+
+        # rotate before the (stable) min so residual LOAD TIES break
+        # round-robin across admissions instead of always on the first
+        # name
+        pick = min(cands[rot:] + cands[:rot], key=key)
+        with self._lock:
+            self._recent_decode.append((now, pick))
+        return pick
 
     def _route_request(self, req: dict, client_key: Optional[str] = None):
         """One routed generate -> (code, obj); runs under
@@ -417,6 +487,17 @@ class RouterServer:
                        "timeout": max(0.1, deadline - time.monotonic())}
             if req.get("sampling") is not None:
                 payload["sampling"] = req["sampling"]
+            # Round-17 disaggregated placement: a prompt landing on a
+            # DEDICATED prefill replica names its decode target NOW —
+            # picked from the decode pool by load/free pages — so the
+            # prefill replica can stream KV spans there while later
+            # chunks still compute. Never on a pinned (chasing) attempt:
+            # the stream is already decoding wherever the pin points.
+            if pinned is None and self.pool.role(name) == "prefill":
+                decode = self._pick_decode(exclude=(name,))
+                if decode is not None:
+                    payload["decode_target"] = self.pool.url(decode)
+                    payload["decode_name"] = decode
             try:
                 tup = time.perf_counter()
                 body = request_json(
@@ -529,7 +610,14 @@ class RouterServer:
         handoff; if the node is truly dark the POST fails and the
         breaker path continues as before (the honest residue)."""
         src_url = self.pool.url(name)
-        candidates = [n for n in self.pool.routable() if n != name]
+        # Round-17: migrate targets must be ROLE-compatible — a suspect
+        # prefill replica's streams hand off to another prefill (or
+        # "both") replica, never a decode-only one; cross-pool handoffs
+        # would load a pool that is sized and SLO-judged for other work
+        src_role = self.pool.role(name)
+        candidates = [n for n in self.pool.routable()
+                      if n != name
+                      and role_compatible(src_role, self.pool.role(n))]
         if src_url is None or not candidates:
             self.events.emit("migrate_away_skip", replica=name,
                              reason=reason)
@@ -572,6 +660,22 @@ class RouterServer:
                 self.migrate_away(name, reason="suspect")
             elif st == HEALTHY:
                 self._suspect_handled.discard(name)
+
+    def _sync_ring_roles(self) -> None:
+        """Drop ring arcs from replicas whose learned role is DECODE:
+        the registration-time decision used whatever role was known
+        then, and a correction from the first successful /load scrape
+        must not leave a decode-only replica owning prefix buckets
+        (every prompt hashed there would be a permanent fallback).
+        One-shot per correction — removing a member remaps only its
+        own arcs, the register/remove-only membership contract's
+        amendment clause."""
+        stale = [n for n in self.ring.members()
+                 if self.pool.role(n) == "decode"]
+        if stale:
+            with self._lock:
+                for n in stale:
+                    self.ring.remove(n)
 
     def _admit(self, slo_class: str):
         """The SLO-class gate: (None, None) to proceed; a (code, obj)
@@ -676,6 +780,13 @@ class RouterServer:
                 # this tick only asks, so a slow transfer never stalls
                 # the signals loop
                 self._check_suspects()
+                # Round-17: revoke ring arcs granted on a STALE role
+                # (an explicit-name registration whose seed scrape
+                # missed defaults to "both"; the replica's own /load
+                # word corrects the handle later, but ring membership
+                # only changes here) — a decode replica must never
+                # keep owning prefix buckets
+                self._sync_ring_roles()
             except Exception:  # noqa: BLE001 — the loop survives a bad
                 pass           # scrape; next tick retries
 

@@ -13,6 +13,11 @@ they also carry a ``storms`` dict of serving storm metrics:
                     paged-attention kernel (interpret)   (higher good)
     migration_drain_s  Round-16: drain-complete latency of a loaded
                     replica via live KV migration        (lower good)
+    disagg_itl_p99_ms / disagg_decode_toks_s  Round-17: the
+                    disaggregated arm of the mixed long-prompt/
+                    short-decode storm (ITL lower good, tok/s higher
+                    good; the colocated arm rides along un-gated as
+                    colocated_* for the topology comparison)
 
 Modes:
 
@@ -51,10 +56,12 @@ import time
 sys.path.insert(0, ".")
 
 HIGHER_IS_BETTER = {"decode_tok_s", "router_hit_rate",
-                    "paged_kernel_decode_toks_s"}
+                    "paged_kernel_decode_toks_s",
+                    "disagg_decode_toks_s"}
 GATED = ("decode_tok_s", "ttft_p50_ms", "itl_p99_ms",
          "router_hit_rate", "router_ttft_p50_ms",
-         "paged_kernel_decode_toks_s", "migration_drain_s")
+         "paged_kernel_decode_toks_s", "migration_drain_s",
+         "disagg_itl_p99_ms", "disagg_decode_toks_s")
 # ratios/counters are load-independent: the host-speed calibration must
 # only rescale wall-clock metrics, never a hit rate
 NOT_NORMALIZED = {"router_hit_rate"}
@@ -92,7 +99,8 @@ def _calibrate(iters: int = 30, reps: int = 3) -> float:
     return best
 
 
-def measure_storm(repeats: int = 3, rounds: int = 2) -> dict:
+def measure_storm(repeats: int = 3, rounds: int = 2,
+                  strict: bool = False) -> dict:
     """The gate's own chunked mixed-load storm (tiny flagship config,
     DecodeServer, token-budget admission): per-metric best of *repeats*
     full runs — max tok/s, min latencies — so one co-tenant stall
@@ -216,6 +224,77 @@ def measure_storm(repeats: int = 3, rounds: int = 2) -> dict:
         raise SystemExit(
             "bench-gate: migration storm never migrated a stream — "
             "lengthen the streams")
+    # Round-17 rows. The GATE keys measure the disaggregated arm alone
+    # on the tiny flagship config (fast, ratchet-stable, best-of-2;
+    # streams-preserved and handoffs-committed are hard correctness
+    # guards). The topology COMPARISON needs a scale where serving
+    # compute dominates dispatch overhead — on the tiny config the two
+    # arms sit within host noise of each other — so *strict* (the
+    # --record path) additionally runs both arms once at a 4-layer
+    # d256 config and enforces the Round-17 acceptance: decode ITL p99
+    # strictly better disaggregated, decode tok/s no worse. Its
+    # numbers are recorded un-gated as *_cmp_* so the trajectory file
+    # documents the comparison each round.
+    from bench_model import disagg_storm
+
+    disagg_cfg = dataclasses.replace(flagship_cfg(smoke=True), remat=False)
+    for _ in range(2):
+        (disagg,) = disagg_storm(
+            disagg_cfg, n_long=3, long_len=192, n_short=5, short_len=8,
+            max_new=24, page_size=16, prefill_budget=16, n_slots=8,
+            n_prefill=2, n_decode=1, arms=("disagg",))
+        if disagg["streams_preserved"] != disagg["requests"]:
+            raise SystemExit(
+                "bench-gate: disagg storm dropped a stream — "
+                f"{disagg['streams_preserved']}/{disagg['requests']} "
+                f"preserved")
+        if disagg["handoffs_committed"] != disagg["requests"]:
+            raise SystemExit(
+                "bench-gate: disagg handoffs committed != requests "
+                f"({disagg['handoffs_committed']} for "
+                f"{disagg['requests']}) — a handoff silently degraded "
+                f"or double-shipped")
+        best["disagg_itl_p99_ms"] = min(
+            best.get("disagg_itl_p99_ms", float("inf")),
+            disagg["value"])
+        best["disagg_decode_toks_s"] = max(
+            best.get("disagg_decode_toks_s", 0.0),
+            disagg["decode_tok_s"])
+    if strict:
+        import jax.numpy as jnp
+
+        from kubetpu.jobs import ModelConfig
+
+        cmp_cfg = ModelConfig(vocab=256, d_model=256, n_layers=4,
+                              n_heads=8, d_ff=512, max_seq=512,
+                              dtype=jnp.bfloat16)
+        last_err = None
+        for _attempt in range(2):
+            coloc, disagg = disagg_storm(
+                cmp_cfg, n_long=4, long_len=256, n_short=6,
+                short_len=8, max_new=48, page_size=16,
+                prefill_budget=16, n_slots=10, n_prefill=2, n_decode=1)
+            for row in (coloc, disagg):
+                if row["streams_preserved"] != row["requests"]:
+                    raise SystemExit(
+                        "bench-gate: disagg comparison dropped a "
+                        f"stream ({row['arm']})")
+            best["disagg_cmp_itl_p99_ms"] = disagg["value"]
+            best["disagg_cmp_decode_toks_s"] = disagg["decode_tok_s"]
+            best["colocated_cmp_itl_p99_ms"] = coloc["value"]
+            best["colocated_cmp_decode_toks_s"] = coloc["decode_tok_s"]
+            if (disagg["value"] < coloc["value"]
+                    and disagg["decode_tok_s"] >= coloc["decode_tok_s"]):
+                last_err = None
+                break
+            last_err = (
+                f"ITL {disagg['value']} vs {coloc['value']} ms, tok/s "
+                f"{disagg['decode_tok_s']} vs {coloc['decode_tok_s']}")
+        if last_err is not None:
+            raise SystemExit(
+                "bench-gate: the Round-17 acceptance did not hold — "
+                "disaggregated must beat colocated ITL p99 with tok/s "
+                f"no worse ({last_err})")
     best["calib_s"] = round(_calibrate(), 5)
     return best
 
@@ -249,7 +328,7 @@ def record(root: str, repeats: int) -> str:
     """Measure this round and write the next ``BENCH_r0N.json`` —
     the legacy scheduler-bench shape (n/cmd/rc/tail/parsed) plus the
     Round-6+ ``storms`` dict the gate compares."""
-    storms = measure_storm(repeats=repeats)
+    storms = measure_storm(repeats=repeats, strict=True)
     cmd = "if [ -f bench.py ]; then python bench.py; else exit 0; fi"
     proc = subprocess.run(["sh", "-c", cmd], capture_output=True,
                           text=True, cwd=root)

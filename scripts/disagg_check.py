@@ -1,0 +1,260 @@
+#!/usr/bin/env python3
+"""``make disagg-check`` — the disaggregated prefill/decode oracle.
+
+Boots a router + 1 PREFILL replica + 2 DECODE replicas (paged servers,
+prefix cache on, chunked prefill) IN-PROCESS on the CPU backend,
+injects >=10% wire faults (drop / injected 503 / truncated response) on
+the KV-stream leg (``/migrate_in`` — begin, every streamed span chunk,
+and the commit all ride it), drives waves of mixed long-prompt/
+short-prompt requests through keyed router POSTs, and fails (exit 1)
+on:
+
+- PARITY: any routed stream's tokens differing byte-for-byte from a
+  quiet colocated run (the decode replica must emit exactly what a
+  single server would have — prefix remaps, streamed spans, replays
+  and the handoff notwithstanding);
+- the HANDOFF LEDGER: committed handoffs == logical requests (every
+  stream moved, none silently degraded to colocated under the retry
+  budget), committed == decode-side committed restores, zero
+  ambiguous/aborted/refused outcomes, and fresh admissions == requests
+  fleet-wide (a restore is a ``migrate_in``, never an ``admit`` — the
+  zero-double-admission guarantee under lost acks);
+- NO PIPELINING: zero pages streamed before prefill finished would
+  mean the spans all shipped at commit — the overlap is the point;
+- an UNSTITCHED handoff trace: one handoff must render prefill-replica
+  and decode-replica spans under a single trace id;
+- the POOL ORACLE (``check_invariants``) on ALL THREE pools after the
+  storm, and faults that never actually fired.
+
+Runs in well under a minute with no accelerator; wired into
+``make chaos`` so every fault-injection run also proves the
+disaggregated topology is exact and at-most-once.
+"""
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.router import ReplicaServer, RouterServer  # noqa: E402
+from kubetpu.wire.faults import FaultInjector, RoutePolicy  # noqa: E402
+from kubetpu.wire.httpcommon import RetryPolicy, request_json  # noqa: E402
+
+STORM_RETRY = RetryPolicy(attempts=6, deadline=55.0)
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+MAX_NEW = 24
+WAVES = 3
+WAVE_STREAMS = 3
+# >=10% total injection on the KV-stream leg: the streamed spans give
+# this leg dozens of POSTs per run, so moderate per-POST rates still
+# fire plenty while the 4-attempt keyed retries keep every handoff
+# committing (an abort would silently degrade the topology — the
+# ledger assert below is exactly that guard)
+MIG_FAULTS = RoutePolicy(drop=0.05, error=0.04, partial=0.05)
+
+
+def fail(msg: str) -> None:
+    print(f"disagg-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def make_server(params):
+    return PagedDecodeServer(
+        CFG, params, n_slots=4, max_seq=128, max_new_tokens=MAX_NEW,
+        page_size=PS, n_pages=64, prefill_budget=8,
+        prefix_cache_pages=24)
+
+
+def storm_prompts():
+    """Mixed long-prompt/short-prompt traffic: one shared-prefix long
+    family (exercises the begin-phase hint — warm decode-side pages
+    never cross the wire; both caches cold, its FIRST member streams
+    spans) plus medium cold loners whose multi-chunk prefills are the
+    reliable early-streaming window (budget 8 -> ~6+ chunk steps per
+    loner, far wider than a fault-retry backoff)."""
+    fam = [(i * 5) % 60 + 1 for i in range(10 * PS)]
+    prompts = []
+    for i in range(WAVES * WAVE_STREAMS):
+        if i % 3 == 2:
+            prompts.append([(i * 11 + j) % 60 + 1 for j in range(48)])
+        else:
+            prompts.append(fam + [i + 1])
+    return prompts
+
+
+def handoff_counter(rep, result):
+    return int(rep.server.obs.counter(
+        "kubetpu_handoffs_total", result=result).value)
+
+
+def main() -> int:
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = storm_prompts()
+
+    # the quiet oracle: one colocated replica, serial, no wire
+    direct = make_server(params)
+    expected = []
+    for p in prompts:
+        rid = direct.enqueue(p)
+        direct.drain()
+        expected.append(direct.pop_result(rid))
+
+    injector = FaultInjector(seed=7, routes={"/migrate_in": MIG_FAULTS})
+    prefill = ReplicaServer(make_server(params), "dchk-pre", faults=None,
+                            role="prefill", idle_wait=0.002)
+    decodes = [ReplicaServer(make_server(params), f"dchk-dec{i}",
+                             faults=injector, role="decode",
+                             idle_wait=0.002)
+               for i in range(2)]
+    replicas = [prefill] + decodes
+    for rep in replicas:
+        rep.start()
+    router = RouterServer(load_refresh_s=0.1)
+    router.start()
+    results = [None] * len(prompts)
+    try:
+        for rep in replicas:
+            router.register_replica(rep.address)
+
+        def one(i):
+            results[i] = request_json(
+                router.address + "/generate",
+                {"prompt": prompts[i], "timeout": 60.0},
+                idempotency_key=f"disagg-check-{i}", timeout=60.0,
+                retry=STORM_RETRY)
+
+        for wave in range(WAVES):
+            threads = []
+            for j in range(WAVE_STREAMS):
+                t = threading.Thread(
+                    target=one, args=(wave * WAVE_STREAMS + j,),
+                    daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(90.0)
+                if t.is_alive():
+                    fail("a routed stream never completed")
+            for rep in replicas:
+                rep.server.check_invariants()
+
+        # 1) parity: every stream's tokens == the quiet colocated run,
+        # and every stream was EMITTED by a decode replica
+        for i, (body, want) in enumerate(zip(results, expected)):
+            if body is None or body.get("tokens") != want:
+                fail(f"request {i}: routed tokens != quiet colocated "
+                     f"run (got {body and body.get('tokens')}, "
+                     f"want {want})")
+            if body.get("replica") == prefill.name:
+                fail(f"request {i} was emitted by the PREFILL replica "
+                     f"— its handoff silently degraded to colocated")
+
+        # 2) the handoff ledger: every logical request handed off
+        # exactly once, restores == commits, nothing ambiguous, and
+        # fleet-wide fresh admissions == requests (a restore is a
+        # migrate_in, never an admit — zero double-admissions under
+        # lost acks)
+        committed = handoff_counter(prefill, "committed")
+        bad = {r: handoff_counter(prefill, r)
+               for r in ("aborted", "refused", "ambiguous", "fenced",
+                         "skipped")}
+        if committed != len(prompts):
+            fail(f"{committed} committed handoffs for {len(prompts)} "
+                 f"requests (other outcomes: {bad})")
+        if any(bad.values()):
+            fail(f"non-committed handoff outcomes under a generous "
+                 f"retry budget: {bad}")
+        restores = sum(
+            int(rep.server.obs.counter(
+                "kubetpu_migrations_in_total",
+                result="committed").value) for rep in replicas)
+        if restores != committed:
+            fail(f"{committed} committed handoffs at the source vs "
+                 f"{restores} committed restores at targets — a lost "
+                 f"ack double-restored or a restore went missing")
+        admits = sum(len(rep.server.events.events(kind="admit"))
+                     for rep in replicas)
+        if admits != len(prompts):
+            fail(f"{admits} fresh admissions for {len(prompts)} "
+                 f"logical requests — a handoff double-admitted")
+        migrate_ins = sum(
+            len(rep.server.events.events(kind="migrate_in"))
+            for rep in replicas)
+        if migrate_ins != restores:
+            fail(f"{migrate_ins} migrate_in events vs {restores} "
+                 f"committed restores")
+
+        # 3) pipelining actually happened: pages shipped BEFORE their
+        # prefill finished, on the faulted leg
+        streamed = int(prefill.server.obs.counter(
+            "kubetpu_handoff_pages_streamed_total").value)
+        if streamed <= 0:
+            fail("zero pages streamed before prefill finished — the "
+                 "transfer degenerated to a commit-time blob")
+        if prefill._handoff_bytes <= 0 or prefill._handoff_early_bytes <= 0:
+            fail("handoff byte accounting is empty")
+        overlap = prefill._handoff_early_bytes / prefill._handoff_bytes
+
+        # 4) warm decode-side prefix pages never crossed the wire: the
+        # shared family re-lands where its prefix is published, so
+        # some restores MUST have mapped cached pages read-only — a
+        # broken begin-phase hint would read 0 here while every byte
+        # silently ships
+        remapped = sum(
+            int(rep.server.obs.counter(
+                "kubetpu_migration_pages_remapped_total").value)
+            for rep in decodes)
+        if remapped <= 0:
+            fail("zero pages satisfied by the decode-side prefix "
+                 "cache — the begin-phase hint shipped warm pages")
+
+        # 5) the faults actually fired on the KV-stream leg
+        fired = dict(injector.counts)
+        if sum(fired.values()) == 0:
+            fail("no faults fired on the KV-stream leg; raise rates")
+
+        # 6) one handoff renders prefill-replica AND decode-replica
+        # spans under a single trace id
+        commits = prefill.events.events(kind="handoff_commit")
+        tid = next((e.get("trace_id") for e in commits
+                    if e.get("trace_id")), None)
+        if tid is None:
+            fail("no handoff_commit event carries a trace id")
+        trace = router.trace(tid)
+        comps = {s.get("component", "") for s in trace["spans"]}
+        rep_comps = {c for c in comps if c.startswith("replica:")}
+        if len(rep_comps) < 2:
+            fail(f"handoff trace {tid} did not stitch prefill and "
+                 f"decode replica spans (components: {sorted(comps)})")
+
+        # 7) all three pools honest after the whole storm
+        for rep in replicas:
+            rep.server.check_invariants()
+    finally:
+        router.shutdown()
+        for rep in replicas:
+            rep.shutdown(graceful=False)
+
+    print(f"disagg-check OK: {committed} token-exact prefill->decode "
+          f"handoffs under injected faults ({dict(injector.counts)}), "
+          f"{streamed} pages streamed mid-prefill "
+          f"(overlap {overlap:.2f}), {remapped} warm pages never "
+          f"shipped, admissions == requests, pools clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1205,6 +1205,51 @@ def test_migration_legs_are_barrier_legs():
     assert "restore_slot" not in quals
 
 
+def test_disagg_handoff_legs_are_barrier_legs(tmp_path):
+    """Round-17 pin: the disaggregated-handoff legs the prefill
+    streamer polls — the mid-prefill page-span gather
+    (``snapshot_pages`` / ``_gather_page_span``) and the progress probe
+    (``prefill_progress``) — are classified KTP001 BARRIER legs: their
+    device gathers run on the handoff loop thread between steps, by
+    design, and the closure traversal stops at them. The fixture pair
+    proves the classification does real work: the same device sync is
+    CLEAN behind the barrier name and VIOLATING behind a non-barrier
+    one."""
+    from kubetpu.analysis.core import load_project
+    from kubetpu.analysis.rules_device import HOT_BARRIERS, hot_closure
+
+    for leg in ("snapshot_pages", "_gather_page_span",
+                "prefill_progress"):
+        assert leg in HOT_BARRIERS, leg
+    project = load_project(REPO_ROOT, ["kubetpu"])
+    quals = {qual.split(".")[-1] if "." in qual else qual
+             for _, qual, _ in hot_closure(project).values()}
+    assert "snapshot_pages" not in quals
+    assert "_gather_page_span" not in quals
+    # violating: the SAME span gather reachable from step() under a
+    # non-barrier name charges the step with its sync
+    res = lint(tmp_path, {"kubetpu/jobs/paged.py": """
+        class Server:
+            def step(self):
+                return self._stream_kv(0, 0, 2)
+
+            def _stream_kv(self, rid, lo, hi):
+                return np.asarray(self.k_pages[:, lo:hi])
+        """}, rules=["KTP001"])
+    assert codes(res) == ["KTP001"]
+    # clean: behind the barrier classification the traversal stops —
+    # the designed gather never reads as a hot-path sync
+    res = lint(tmp_path / "clean", {"kubetpu/jobs/paged.py": """
+        class Server:
+            def step(self):
+                return self.snapshot_pages(0, 0, 2)
+
+            def snapshot_pages(self, rid, lo, hi):
+                return np.asarray(self.k_pages[:, lo:hi])
+        """}, rules=["KTP001"])
+    assert res.active == []
+
+
 def test_repo_lints_clean_against_committed_baseline():
     """`make lint` green is a merge gate; this pins it in tier-1. Any
     new violation of KTP001–KTP006 in kubetpu/ or scripts/ fails here

@@ -1,0 +1,292 @@
+"""Round-17: disaggregated prefill/decode serving.
+
+The tentpole contract, on the real stack (CPU jax, tiny model): a
+routed prompt admits on a PREFILL replica, its completed page-aligned
+KV spans stream to the assigned DECODE replica while later chunks are
+still computing, the stream hands off on first token, and the decode
+replica emits every token — token-exact vs a quiet colocated run, with
+warm decode-side prefix pages never crossing the wire, the pipelining
+visible in the overlap counters, and all-"both" fleets degrading to
+exactly the pre-Round-17 behavior.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.obs import disagg_slos
+from kubetpu.obs.slo import SloEngine
+from kubetpu.router import ReplicaServer, RouterServer
+from kubetpu.router.migration import assemble_spans, span_name
+from kubetpu.wire.httpcommon import request_json, request_text
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+MAX_NEW = 10
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def make_server(params, kv_int8=False, cache_pages=16):
+    return PagedDecodeServer(
+        CFG, params, n_slots=4, max_seq=128, max_new_tokens=MAX_NEW,
+        page_size=PS, prefill_budget=16, kv_int8=kv_int8,
+        prefix_cache_pages=cache_pages)
+
+
+def family_prompts(n, fam_tokens=40):
+    fam = [(i * 5) % 60 + 1 for i in range(fam_tokens)]
+    return [fam + [i + 1] for i in range(n)]
+
+
+def quiet_run(params, prompts, kv_int8=False, sampling=None):
+    direct = make_server(params, kv_int8=kv_int8)
+    out = []
+    for p in prompts:
+        rid = direct.enqueue(p, sampling=sampling)
+        direct.drain()
+        out.append(direct.pop_result(rid))
+    return out
+
+
+# -- serving-layer legs -------------------------------------------------------
+
+
+def test_snapshot_pages_matches_full_snapshot(params):
+    """The streaming gather is byte-identical to the full snapshot's
+    view of the same pages — spans + tail reassemble into exactly what
+    a monolithic Round-16 snapshot ships."""
+    srv = make_server(params, cache_pages=0)
+    rid = srv.enqueue([(i * 3) % 60 + 1 for i in range(40)])
+    while len(srv._emitted.get(rid, [])) < 2:
+        srv.step()
+    full = srv.snapshot_slot(rid)
+    n_live = int(full["n_live_pages"])
+    assert n_live >= 3
+    early = srv.snapshot_pages(rid, 0, 2)
+    tail = srv.snapshot_slot(rid, from_page=2, allow_frozen=False)
+    for field in ("k", "v"):
+        np.testing.assert_array_equal(early[field],
+                                      full["pages"][field][:, :2])
+        np.testing.assert_array_equal(tail["pages"][field],
+                                      full["pages"][field][:, 2:])
+    # span reassembly (the decode-side stitch) round-trips
+    spans = {span_name(f, 0): early[f] for f in ("k", "v")}
+    spans.update({span_name(f, 2): tail["pages"][f] for f in ("k", "v")})
+    stitched = assemble_spans(spans, 0)
+    for field in ("k", "v"):
+        np.testing.assert_array_equal(stitched[field],
+                                      full["pages"][field])
+    # a gap refuses — never restore holes
+    with pytest.raises(ValueError):
+        assemble_spans({span_name("k", 1): early["k"]}, 0)
+    srv.drain()
+    srv.check_invariants()
+
+
+def test_prefill_progress_only_mid_prefill(params):
+    srv = make_server(params, cache_pages=0)
+    rid = srv.enqueue([1] * 40)
+    assert srv.prefill_progress(rid) is None        # still queued
+    srv.step()
+    prog = srv.prefill_progress(rid)
+    assert prog is not None and 0 < prog[0] < prog[1] == 40
+    assert prog[0] % PS == 0                        # page-aligned
+    srv.drain()
+    assert srv.prefill_progress(rid) is None        # finished
+
+
+# -- the wire topology --------------------------------------------------------
+
+
+@pytest.fixture()
+def disagg_fleet(params):
+    """router + 1 prefill + 1 decode replica over real paged servers."""
+    made = {}
+
+    def build(kv_int8=False, roles=("prefill", "decode")):
+        replicas = []
+        for i, role in enumerate(roles):
+            rep = ReplicaServer(make_server(params, kv_int8=kv_int8),
+                                f"d{role}{i}", role=role,
+                                idle_wait=0.002)
+            rep.start()
+            replicas.append(rep)
+        router = RouterServer(load_refresh_s=0.1)
+        router.start()
+        for rep in replicas:
+            router.register_replica(rep.address)
+        made["fleet"] = (router, replicas)
+        return router, replicas
+
+    yield build
+    router, replicas = made["fleet"]
+    router.shutdown()
+    for rep in replicas:
+        rep.shutdown(graceful=False)
+
+
+def _drive(router, prompts, tag, sampling=None):
+    bodies = []
+    for i, p in enumerate(prompts):
+        req = {"prompt": p, "timeout": 60.0}
+        if sampling is not None:
+            req["sampling"] = sampling
+        bodies.append(request_json(
+            router.address + "/generate", req,
+            idempotency_key=f"disagg-{tag}-{i}", timeout=60.0))
+    return bodies
+
+
+def test_disagg_token_parity_and_pipelining(params, disagg_fleet):
+    """The tentpole: routed tokens byte-equal a quiet colocated run;
+    every request admits ONCE (on the prefill replica), restores once
+    (on the decode replica), and some KV bytes shipped before prefill
+    finished — the pipelining the overlap gauge proves."""
+    prompts = family_prompts(4)
+    expected = quiet_run(params, prompts)
+    router, (pre, dec) = disagg_fleet()
+    bodies = _drive(router, prompts, "parity")
+    for body, want in zip(bodies, expected):
+        assert body["tokens"] == want
+        assert body["replica"] == dec.name    # decode emitted the stream
+    committed = int(pre.server.obs.counter(
+        "kubetpu_handoffs_total", result="committed").value)
+    assert committed == len(prompts)
+    assert len(pre.server.events.events(kind="admit")) == len(prompts)
+    assert len(dec.server.events.events(kind="admit")) == 0
+    assert (len(dec.server.events.events(kind="migrate_in"))
+            == len(prompts))
+    # pipelining: early KV bytes shipped while later chunks computed
+    assert pre._handoff_early_bytes > 0
+    assert pre._handoff_bytes > pre._handoff_early_bytes
+    streamed = int(pre.server.obs.counter(
+        "kubetpu_handoff_pages_streamed_total").value)
+    assert streamed > 0
+    pre.server.check_invariants()
+    dec.server.check_invariants()
+    # the obs surface: role series, handoff ledger + overlap on the
+    # federated scrape; the cli summary renders the disagg section;
+    # disagg_slos' handoff-success ratio reads healthy
+    text = router.metrics_text()
+    assert 'kubetpu_serving_role{role="prefill"' in text
+    assert 'kubetpu_handoffs_total{result="committed"' in text
+    from kubetpu.cli.obs import render_summary
+
+    out = render_summary(text, "router")
+    assert "disagg    roles prefill=1  decode=1  both=0" in out
+    assert "committed=4" in out
+    engine = SloEngine(disagg_slos(itl_p99_s=60.0, handoff_success=0.9))
+    results = engine.evaluate(text)
+    assert results["disagg_handoff_success"]["ok"] is True
+
+
+def test_disagg_seeded_sampling_parity(params, disagg_fleet):
+    """Sampled streams survive the handoff exactly: the raw request key
+    ships with the snapshot, so the decode replica draws what an
+    unmigrated run would have drawn."""
+    prompts = family_prompts(2)
+    sampling = {"temperature": 0.8, "top_k": 8}
+    expected = quiet_run(params, prompts, sampling=sampling)
+    router, (pre, dec) = disagg_fleet()
+    bodies = _drive(router, prompts, "seeded", sampling=sampling)
+    for body, want in zip(bodies, expected):
+        assert body["tokens"] == want
+
+
+def test_disagg_kv_int8_ships_quantized(params, disagg_fleet):
+    """kv_int8 pools hand off disaggregated too — the spans carry the
+    quantized quadruple as stored, and greedy decode stays exact."""
+    prompts = family_prompts(2)
+    expected = quiet_run(params, prompts, kv_int8=True)
+    router, (pre, dec) = disagg_fleet(kv_int8=True)
+    bodies = _drive(router, prompts, "int8")
+    for body, want in zip(bodies, expected):
+        assert body["tokens"] == want
+    assert int(pre.server.obs.counter(
+        "kubetpu_handoffs_total", result="committed").value) == 2
+
+
+def test_disagg_warm_prefix_pages_never_cross_the_wire(params,
+                                                       disagg_fleet):
+    """The begin-phase hint: once the decode replica's radix tree holds
+    a family's prefix (published at the first stream's retire), later
+    family members ship only the uncached suffix — matched pages map
+    read-only from the local cache instead of crossing the wire."""
+    prompts = family_prompts(3)
+    expected = quiet_run(params, prompts)
+    router, (pre, dec) = disagg_fleet()
+    bodies = _drive(router, prompts, "warm")
+    for body, want in zip(bodies, expected):
+        assert body["tokens"] == want
+    remapped = int(dec.server.obs.counter(
+        "kubetpu_migration_pages_remapped_total").value)
+    assert remapped > 0
+    pre.server.check_invariants()
+    dec.server.check_invariants()
+
+
+def test_dense_prefill_replica_degrades_not_crashes(params):
+    """A DENSE (non-paged) server behind a prefill role has no
+    shippable page view: the handoff must ABORT per stream (the base
+    ``snapshot_slot`` stub's NotImplementedError — its signature must
+    accept the handoff keywords, or the TypeError would kill the
+    handoff loop thread and wedge the frozen stream forever) and the
+    request completes LOCALLY, token-exact."""
+    from kubetpu.jobs.serving import DecodeServer
+
+    def make_dense():
+        return DecodeServer(CFG, params, n_slots=2, max_seq=128,
+                            max_new_tokens=6, prefill_budget=16)
+
+    prompt = [(i * 3) % 60 + 1 for i in range(24)]
+    direct = make_dense()
+    rid = direct.enqueue(prompt)
+    direct.drain()
+    want = direct.pop_result(rid)
+    pre = ReplicaServer(make_dense(), "dense-pre", role="prefill",
+                        idle_wait=0.002)
+    dec = ReplicaServer(make_server(params), "dense-dec", role="decode",
+                        idle_wait=0.002)
+    router = RouterServer(load_refresh_s=0.1)
+    pre.start()
+    dec.start()
+    router.start()
+    try:
+        router.register_replica(pre.address)
+        router.register_replica(dec.address)
+        body = request_json(router.address + "/generate",
+                            {"prompt": prompt, "timeout": 30.0},
+                            idempotency_key="dense-degrade",
+                            timeout=30.0)
+        assert body["tokens"] == want
+        assert body["replica"] == "dense-pre"    # served locally
+        assert int(pre.server.obs.counter(
+            "kubetpu_handoffs_total", result="aborted").value) == 1
+        assert pre._handoff_thread.is_alive()    # the loop survived
+    finally:
+        router.shutdown()
+        pre.shutdown(graceful=False)
+        dec.shutdown(graceful=False)
+
+
+def test_all_both_fleet_degrades_to_colocated(params, disagg_fleet):
+    """The opt-in contract: with no dedicated roles the router never
+    names a decode target and zero handoffs happen — Round-14/16
+    behavior exactly."""
+    prompts = family_prompts(2)
+    expected = quiet_run(params, prompts)
+    router, (r0, r1) = disagg_fleet(roles=("both", "both"))
+    bodies = _drive(router, prompts, "coloc")
+    for body, want in zip(bodies, expected):
+        assert body["tokens"] == want
+    for rep in (r0, r1):
+        assert rep.events.events(kind="handoff_intent") == []
+        assert int(rep.server.obs.counter(
+            "kubetpu_handoffs_total", result="committed").value) == 0

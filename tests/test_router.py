@@ -758,6 +758,192 @@ def test_autoscaler_respects_min_and_drain_gate(fleet):
     assert len(router.pool.names()) == 1
 
 
+# -- Round-17: disaggregated roles -------------------------------------------
+
+
+@pytest.fixture()
+def role_fleet(request):
+    """(router, {name: (replica_server, fake)}) with one replica per
+    requested role; everything shut down at teardown."""
+    made = []
+
+    def build(roles, router_kw=None, fake_kw=None):
+        router = RouterServer(load_refresh_s=0.0, **(router_kw or {}))
+        router.start()
+        replicas = {}
+        for i, role in enumerate(roles):
+            fake = FakeSlotServer(**(fake_kw or {}))
+            rep = ReplicaServer(fake, f"{role}{i}", role=role,
+                                idle_wait=0.002)
+            rep.start()
+            router.register_replica(rep.address)
+            replicas[rep.name] = (rep, fake)
+        made.append((router, replicas))
+        return router, replicas
+
+    yield build
+    for router, replicas in made:
+        router.shutdown()
+        for rep, _fake in replicas.values():
+            rep.shutdown(graceful=False)
+
+
+def test_decode_role_gets_no_ring_arcs_or_fresh_prompts(role_fleet):
+    """A decode-only replica receives streams over the handoff wire,
+    never fresh prompts: no ring arcs at registration, and the prompt
+    path routes to the prefill-capable replica."""
+    router, replicas = role_fleet(["prefill", "decode"])
+    assert router.ring.members() == ["prefill0"]
+    assert router.pool.role("prefill0") == "prefill"
+    assert router.pool.role("decode1") == "decode"
+    for i in range(4):
+        body = request_json(router.address + "/generate",
+                            {"prompt": [i + 1] * 40},
+                            idempotency_key=f"t-role-{i}")
+        # the FakeSlotServer has no page machinery, so the handoff
+        # degrades to local completion — the routing decision is what
+        # this test pins
+        assert body["replica"] == "prefill0"
+
+
+def test_migrate_away_respects_role(role_fleet):
+    """The Round-17 satellite pin: a suspect PREFILL replica's
+    in-flight streams hand off to another prefill replica or a "both"
+    node — never to a decode-only target (its pool is sized and
+    SLO-judged for pure decode traffic)."""
+    router, replicas = role_fleet(["prefill", "decode", "both"])
+    pool = router.pool
+    for _ in range(pool.suspect_after):
+        pool._record_miss("prefill0")
+    assert pool.state("prefill0") == "suspect"
+    router._check_suspects()
+    aways = [e for e in router.events.events()
+             if e["kind"] == "migrate_away"]
+    assert len(aways) == 1
+    assert aways[0]["replica"] == "prefill0"
+    assert aways[0]["target"] == "both2"       # never decode1
+
+
+def test_migrate_away_skips_when_only_decode_survives(role_fleet):
+    """With no role-compatible survivor the sweep is SKIPPED — honest
+    residue beats shipping prefill streams into the decode pool."""
+    router, replicas = role_fleet(["prefill", "decode", "decode"])
+    pool = router.pool
+    for _ in range(pool.suspect_after):
+        pool._record_miss("prefill0")
+    router._check_suspects()
+    kinds = [e["kind"] for e in router.events.events()]
+    assert "migrate_away_skip" in kinds
+    assert "migrate_away" not in kinds
+
+
+def test_autoscaler_scales_pools_independently(role_fleet):
+    """Round-17: the prefill pool scales on queue-wait/TTFT pressure,
+    the decode pool on ITL p99 — each with its own hysteresis, and a
+    signal from the wrong pool never buys the other pool hardware."""
+    router, replicas = role_fleet(["prefill", "decode"])
+    launched = []
+
+    def launcher(role):
+        fake = FakeSlotServer()
+        rep = ReplicaServer(fake, f"auto-{role}-{len(launched)}",
+                            role=role, idle_wait=0.002)
+        rep.start()
+        launched.append((role, rep))
+        return rep.address
+
+    scaler = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=2, up_after=1,
+                           cooldown_s=0.0))
+    pre_fake = replicas["prefill0"][1]
+    dec_fake = replicas["decode1"][1]
+    # decode-pool signals (queue wait) on the DECODE replica must not
+    # scale the decode pool — its criteria are ITL + free pages
+    dec_fake.load_override = {"queue_wait_p99_ms": 9999.0}
+    res = scaler.poll_once()
+    assert res["actions"] == []
+    dec_fake.load_override = {}
+    # prefill pressure scales the PREFILL pool only
+    pre_fake.load_override = {"queue_wait_p99_ms": 9999.0}
+    res = scaler.poll_once()
+    assert [r for r, _ in launched] == ["prefill"]
+    assert any(a.startswith("scale_up:") for a in res["actions"])
+    pre_fake.load_override = {}
+    # decode ITL pressure scales the DECODE pool only
+    dec_fake.load_override = {"itl_p99_ms": 9999.0}
+    res = scaler.poll_once()
+    assert [r for r, _ in launched] == ["prefill", "decode"]
+    ups = [e for e in router.events.events() if e["kind"] == "scale_up"]
+    assert [e.get("role") for e in ups] == ["prefill", "decode"]
+    for _role, rep in launched:
+        rep.shutdown(graceful=False)
+
+
+def test_autoscaler_heals_a_fully_dead_role_pool(role_fleet):
+    """A dedicated pool whose LAST replica died and was reaped must
+    keep reconciling: the decode pool's min_replicas floor-heal fires
+    even though no alive replica carries the role anymore — otherwise
+    a disagg fleet that lost its whole decode pool would silently
+    degrade to colocated forever."""
+    router, replicas = role_fleet(["prefill", "decode"])
+    launched = []
+
+    def launcher(role):
+        fake = FakeSlotServer()
+        rep = ReplicaServer(fake, f"heal-{role}-{len(launched)}",
+                            role=role, idle_wait=0.002)
+        rep.start()
+        launched.append((role, rep))
+        return rep.address
+
+    scaler = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=2, up_after=99,
+                           cooldown_s=0.0))
+    scaler.poll_once()                 # observe both pools alive
+    dead_rep, _fake = replicas["decode1"]
+    dead_rep.shutdown(graceful=False)
+    for _ in range(5):
+        router.pool.refresh(0.0)
+    assert router.pool.state("decode1") == "dead"
+    res = scaler.poll_once()           # reap + floor-heal the pool
+    assert [r for r, _ in launched] == ["decode"]
+    assert any(a.startswith("scale_up:") for a in res["actions"])
+    for _role, rep in launched:
+        rep.shutdown(graceful=False)
+
+
+def test_dedicated_pool_never_floor_heals_with_roleless_launcher(
+        role_fleet):
+    """A zero-arg launcher cannot boot a dedicated-role replica: the
+    floor-heal must FAIL LOUDLY (scale_error, no launch) instead of
+    booting a "both" node that leaves the pool empty and buying
+    hardware every pass forever."""
+    router, replicas = role_fleet(["prefill", "decode"])
+    launched = []
+
+    def launcher():                     # roleless: colocated-era shape
+        launched.append(1)
+        return "http://127.0.0.1:1"
+
+    scaler = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=2, up_after=99,
+                           cooldown_s=0.0))
+    scaler.poll_once()
+    dead_rep, _fake = replicas["decode1"]
+    dead_rep.shutdown(graceful=False)
+    for _ in range(5):
+        router.pool.refresh(0.0)
+    res = scaler.poll_once()            # reap + attempt to heal decode
+    assert launched == []               # never launched the wrong kind
+    assert res["actions"] == []
+    errs = [e for e in router.events.events()
+            if e["kind"] == "scale_error"]
+    assert any("takes no role" in str(e.get("error")) for e in errs)
+
+
 def test_router_metrics_and_slo_and_trace_surfaces(fleet):
     router, _replicas = fleet(
         n=2, router_kw={"slos": _ALWAYS_BURNING, "slo_interval_s": 0.0})
