@@ -32,8 +32,9 @@ $(BUILD_DIR)/libkubetpu_dataio.so: kubetpu/dataio/loader.cc
 test: tpuinfo gpuinfo dataio
 	python -m pytest tests/ -x -q
 
-# seeded fault-injection soaks + the resilience suite (the short soak
-# also runs in tier-1; this target adds the slow 30% one). lint runs
+# seeded fault-injection soaks + the resilience suite (both race soaks
+# are slow-marked for the tier-1 wall budget — this target is where
+# they run, short then the 30% long one). lint runs
 # FIRST (a chaos run over code that violates the wire/lock invariants
 # proves the wrong thing — a raw urlopen is invisible to the very faults
 # the soak injects), then obs-check (a chaos run whose faults are
@@ -48,7 +49,7 @@ test: tpuinfo gpuinfo dataio
 # still fails the round).
 .PHONY: chaos
 chaos: lint obs-check prefix-check spec-check router-check migrate-check \
-		disagg-check bench-gate-smoke
+		disagg-check pack-check bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py \
 		tests/test_race_soak.py -q
 
@@ -133,6 +134,16 @@ router-check:
 .PHONY: migrate-check
 migrate-check:
 	python scripts/migrate_check.py
+
+# fractional-packing oracle (Round-18): a mixed vChip + whole-chip
+# workload through the real Cluster — the packing invariants
+# (Σ fractions <= 1.0 per chip, exact capacity restoration on release
+# AND preemption), no whole-chip gang starvation behind fractional
+# confetti, and greedy token parity of a pool_frac-packed paged
+# replica vs an unpacked one
+.PHONY: pack-check
+pack-check:
+	python scripts/pack_check.py
 
 # disaggregated prefill/decode oracle (Round-17): router + 1 prefill +
 # 2 decode replicas under >=10% injected faults on the KV-stream leg —

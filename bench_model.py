@@ -1161,6 +1161,168 @@ def disagg_storm(cfg, n_long=2, long_len=96, n_short=6, short_len=8,
     return tuple(run(a) for a in arms)
 
 
+def packing_storm(cfg, n_tenants=4, n_adapters=2, prompt_len=10,
+                  max_new=16, n_slots=2, pack=4, window_s=1.5,
+                  think_s=0.05, topology="v5e-1",
+                  arms=("whole", "packed")):
+    """Round-18 headline: MULTI-TENANT REPLICA PACKING under fractional
+    chip virtualization (vChips) vs whole-chip gang granularity, at
+    EQUAL hardware. Each tenant runs its OWN small multi-LoRA replica
+    (*n_adapters* private adapters over the shared base — tenants
+    cannot share a replica: different adapter stacks, isolation); the
+    replica needs only 1/*pack* of a chip's HBM. The arms differ only
+    in how many tenant replicas the SCHEDULER can place on the same
+    chips: the ``whole`` arm requests one whole chip per replica (the
+    pre-Round-18 granularity — the other (pack-1)/pack of every chip is
+    STRANDED and (n_tenants - n_chips) tenants get no replica at all),
+    the ``packed`` arm requests ``1000//pack`` milli-chips so *pack*
+    tenant replicas co-locate per chip and every tenant is served.
+    Placement runs through the REAL ``Cluster`` (fake device manager,
+    fractional accounting, ``check_invariants`` oracle); each SERVED
+    tenant then drives its replica closed-loop (one interactive stream,
+    *think_s* between requests — small tenants are exactly the traffic
+    that leaves a whole chip idle) for a fixed *window_s* wall window.
+    Reports aggregate fleet tok/s per chip (the
+    ``packing_fleet_toks_s`` gate metric), replicas per chip, tenants
+    served, plus a cross-arm greedy parity rider on the tenants both
+    arms serve — packing must change THROUGHPUT, never tokens."""
+    import dataclasses
+    import random as _random
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kubetpu.api.types import ContainerInfo, PodInfo
+    from kubetpu.core import Cluster, SchedulingError
+    from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.lora import LoraConfig, init_lora_params
+    from kubetpu.jobs.multi_lora import MultiLoraDecodeServer, stack_adapters
+    from kubetpu.plugintypes import ResourceTPU
+    from kubetpu.plugintypes.mesh import TOPOLOGIES
+    from kubetpu.scheduler.meshstate import MILLI_PER_CHIP, FracKey
+
+    dcfg = dataclasses.replace(cfg, remat=False)
+    params = init_params(jax.random.PRNGKey(0), dcfg)
+    lcfg = LoraConfig(rank=4, alpha=8.0)
+
+    def tenant_stack(t):
+        adapters = []
+        for a in range(n_adapters):
+            lora = init_lora_params(
+                jax.random.PRNGKey(t * 10 + a), dcfg, lcfg)
+            keys = jax.random.split(
+                jax.random.PRNGKey(100 + t * 10 + a), len(lcfg.targets))
+            for i, tgt in enumerate(lcfg.targets):
+                b = lora["blocks"][f"{tgt}_b"]
+                lora["blocks"][f"{tgt}_b"] = (
+                    jax.random.normal(keys[i], b.shape, b.dtype) * 0.05)
+            adapters.append(lora)
+        return stack_adapters(lcfg, adapters)
+
+    stacks = [tenant_stack(t) for t in range(n_tenants)]
+    rng = _random.Random(0)
+    prompts = [[[rng.randrange(1, dcfg.vocab) for _ in range(prompt_len)]
+                for _ in range(8)] for _ in range(n_tenants)]
+    max_seq = prompt_len + max_new + 2
+    n_chips = len(TOPOLOGIES[topology].host_coords(0))
+
+    def make_server(tenant):
+        return MultiLoraDecodeServer(
+            dcfg, params, lcfg, stacks[tenant], n_slots=n_slots,
+            max_seq=max_seq, max_new_tokens=max_new)
+
+    # pre-compile the replica's leg shapes once (shared _LEG_CACHE) AND
+    # seed the parity oracle from INDEPENDENT quiet reference runs —
+    # one per tenant — so a single-arm invocation (the bench-gate smoke
+    # runs only "packed") still compares against a real reference
+    # instead of vacuously against itself
+    expected = {}   # (tenant, 0) -> tokens from the quiet reference
+    for t in range(n_tenants):
+        ref = make_server(t)
+        rid = ref.enqueue(prompts[t][0], adapter=0)
+        ref.drain()
+        expected[(t, 0)] = ref.pop_result(rid)
+
+    def run(arm):
+        cluster = Cluster()
+        cluster.register_node(
+            "bench-n0",
+            device=new_fake_tpu_dev_manager(make_fake_tpus_info(topology)))
+        placed = []
+        # one replica pod per tenant, submitted until the hardware is
+        # provably full — the SERVED-tenant count is the scheduler's
+        # answer, not the bench's
+        for t in range(n_tenants):
+            if arm == "whole":
+                pod = PodInfo(
+                    name=f"tenant{t}",
+                    running_containers={
+                        "main": ContainerInfo(requests={ResourceTPU: 1})})
+            else:
+                pod = PodInfo(
+                    name=f"tenant{t}",
+                    requests={FracKey: MILLI_PER_CHIP // pack},
+                    running_containers={"main": ContainerInfo()})
+            try:
+                cluster.schedule(pod)
+                placed.append(t)
+            except SchedulingError:
+                continue   # this tenant is not served in this arm
+        oracle = cluster.check_invariants()
+        assert not oracle, oracle
+        servers = {t: make_server(t) for t in placed}
+        for srv in servers.values():
+            srv.warmup()
+
+        def client(t):
+            """Tenant *t*'s interactive stream: request, read, think."""
+            srv = servers[t]
+            emitted = 0
+            k = 0
+            deadline = time.perf_counter() + window_s
+            while time.perf_counter() < deadline:
+                prompt = prompts[t][k % len(prompts[t])]
+                rid = srv.enqueue(prompt, adapter=k % n_adapters)
+                srv.drain()
+                toks = srv.pop_result(rid)
+                emitted += len(toks) - len(prompt)
+                if k == 0:
+                    want = expected.setdefault((t, 0), toks)
+                    if want != toks:
+                        return emitted, False
+                k += 1
+                time.sleep(think_s)
+            return emitted, True
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=max(1, len(placed))) as ex:
+            results = list(ex.map(client, placed))
+        wall = time.perf_counter() - t0
+        emitted = sum(e for e, _ok in results)
+        parity = all(ok for _e, ok in results)
+        toks_s = (emitted / wall) if wall else 0.0
+        return {
+            "metric": "packing_storm",
+            "arm": arm,
+            "value": round(toks_s / n_chips, 1),
+            "unit": "aggregate fleet tok/s per chip",
+            "fleet_toks_s": round(toks_s, 1),
+            "replicas": len(placed),
+            "replicas_per_chip": round(len(placed) / n_chips, 2),
+            "tenants_served": len(placed),
+            "n_tenants": n_tenants,
+            "n_chips": n_chips,
+            "pack": pack,
+            "parity": parity,
+            "n_slots": n_slots,
+            "max_new": max_new,
+            "window_s": window_s,
+            "think_s": think_s,
+        }
+
+    return tuple(run(a) for a in arms)
+
+
 def spec_serving_throughput(cfg, n_slots, prompt_len, rounds):
     """Continuous batching WITH speculation: tokens per round under churn
     (the round replaces the one-token step; acceptance sets the speedup
@@ -1588,6 +1750,18 @@ def main() -> int:
                 prefill_budget=16 if args.smoke else 64,
                 n_slots=8 if args.smoke else 10,
                 n_prefill=2, n_decode=1):
+            emit(row)
+        # Round-18: fractional chip virtualization — multi-tenant
+        # replica packing (vChips) vs whole-chip granularity at equal
+        # hardware; the scheduler decides each arm's replica count
+        for row in packing_storm(
+                cfg,
+                n_tenants=4,
+                prompt_len=8 if args.smoke else 24,
+                max_new=12 if args.smoke else 32,
+                window_s=1.2 if args.smoke else 3.0,
+                n_slots=2,
+                pack=4):
             emit(row)
         emit(spec_serving_throughput(cfg, n_slots=2 if args.smoke else 4,
                                      prompt_len=16 if args.smoke else 128,

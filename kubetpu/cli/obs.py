@@ -104,6 +104,19 @@ def render_summary(metrics_text: str, source: str) -> str:
     if pending is not None:
         lines.append(f"pending   {int(pending)} pod(s)")
 
+    # Round-18 vChips: fleet fragmentation from the per-chip occupancy
+    # gauges — how many chips carry fractional confetti, how full they
+    # are on average, and how many vChip placements were ever made
+    occ = [v for _labels, v in idx.get("kubetpu_chip_occupancy_frac", [])]
+    if occ:
+        partial = [v for v in occ if 0.0 < v < 1.0]
+        frac_allocs = _pick(
+            idx, "kubetpu_fractional_allocations_total") or 0
+        mean = (sum(partial) / len(partial)) if partial else 0.0
+        lines.append(
+            f"frag      partial_chips={len(partial)}/{len(occ)} "
+            f"mean_occ={mean:.2f} frac_allocs={int(frac_allocs)}")
+
     # scheduler latency summaries: one row per op
     lat = {}
     for labels, v in idx.get("kubetpu_schedule_latency_seconds", []):

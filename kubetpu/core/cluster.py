@@ -290,6 +290,15 @@ class Cluster:
     def _schedule_inner(
         self, pod: PodInfo, node_filter: Optional[Callable[[str], bool]]
     ) -> PodInfo:
+        # Round-18 vChips: validate the fractional stamp up front — a
+        # malformed milli value raises here (ValueError) instead of
+        # failing as a mysterious "no node fits", and mixing the two
+        # grammars in one pod is a config error, not a capacity miss.
+        if meshstate.pod_milli(pod) > 0 and pod_device_need(TPU, pod) > 0:
+            raise SchedulingError(
+                f"pod {pod.name!r}: cannot mix whole-chip and vChip "
+                f"({meshstate.FracKey}) requests"
+            )
         # One scratch copy serves the whole predicate sweep: fit/score never
         # read the translation artifacts a previous node left in it (the fit
         # decision is scalar pre-filter + shape cache + mesh geometry), and
@@ -463,19 +472,22 @@ class Cluster:
         slices = self._tpu_slices()
         # pod_wants_device covers device-native AND kube-native requests
         # over both container kinds, so a kube-only gang is still pinned
-        # to a single slice below.
+        # to a single slice below. Fractional (vChip) members count too:
+        # an all-fractional gang is still an ICI gang and must land
+        # within one slice.
         tpu_gang = bool(pods) and all(
-            pod_wants_device(TPU, pod) for pod in pods
+            pod_wants_device(TPU, pod) or meshstate.pod_milli(pod) > 0
+            for pod in pods
         )
-        # provable-capacity pre-filter: a slice whose free chips cannot
-        # cover the gang's total need would fail only after placing
-        # (and rolling back) pods one by one — at 60-pod gangs that
-        # wasted pass per slice dominates placement latency.
-        # pod_device_need (not _count): these are UN-translated
-        # templates, so the kube/device max-merge must apply inline.
+        # provable-capacity pre-filter, in MILLI-chips (Round-18): a
+        # slice whose free fractional capacity cannot cover the gang's
+        # total need would fail only after placing (and rolling back)
+        # pods one by one — at 60-pod gangs that wasted pass per slice
+        # dominates placement latency. pod_device_need (not _count):
+        # these are UN-translated templates, so the kube/device
+        # max-merge must apply inline.
         total_need = (
-            sum(max(1, pod_device_need(TPU, p)) for p in pods)
-            if tpu_gang else 0
+            sum(self._pod_need_millis(p) for p in pods) if tpu_gang else 0
         )
         for slice_nodes in slices.values():
             # cordoned hosts never host gang members; NOTE a slice with
@@ -485,7 +497,7 @@ class Cluster:
                            if n not in self.cordoned]
             if not slice_nodes:
                 continue
-            if tpu_gang and self._slice_free_chips(slice_nodes) < total_need:
+            if tpu_gang and self._slice_free_millis(slice_nodes) < total_need:
                 continue
             try:
                 return self._try_gang_slice(pods, slice_nodes)
@@ -512,12 +524,25 @@ class Cluster:
         # non-TPU gangs (or clusters without slice geometry): anywhere
         return self._try_gang(pods, None)
 
-    def _slice_free_chips(self, nodes: Sequence[str]) -> int:
-        """Free chips across a slice's (already cordon-filtered) nodes —
-        the ONE free-capacity tally both the single-slice pre-filter and
-        the multislice candidate ordering use."""
+    @staticmethod
+    def _pod_need_millis(pod: PodInfo) -> int:
+        """A gang template's TPU need in milli-chips: its vChip share
+        when fractional, its (max-merged) whole-chip count otherwise —
+        the common currency of the fractional capacity pre-filter."""
+        frac = meshstate.pod_milli(pod)
+        if frac > 0:
+            return frac
+        return max(1, pod_device_need(TPU, pod)) * meshstate.MILLI_PER_CHIP
+
+    def _slice_free_millis(self, nodes: Sequence[str]) -> int:
+        """Free capacity across a slice's (already cordon-filtered) nodes
+        in MILLI-chips — the ONE free-capacity tally both the
+        single-slice pre-filter and the multislice candidate ordering
+        use. Whole-free chips count MILLI_PER_CHIP each; partially
+        occupied chips contribute their fractional remainder (Round-18:
+        ``_slice_free_chips`` generalized to a fractional capacity sum)."""
         return sum(
-            len(st.free)
+            st.free_milli()
             for n in nodes
             if (st := meshstate.parse_mesh_state(
                 self.nodes[n].info.allocatable)) is not None
@@ -566,14 +591,14 @@ class Cluster:
         MEGASCALE_NUM_SLICES / MEGASCALE_SLICE_ID at container start, and
         ``gang_slice_filter`` uses them to pin re-placements to the pod's
         OWN sub-gang's slice."""
-        free_chips: Dict[str, int] = {
-            sname: self._slice_free_chips(
+        free_millis: Dict[str, int] = {
+            sname: self._slice_free_millis(
                 [n for n in nodes if n not in self.cordoned]
             )
             for sname, nodes in slices.items()
         }
-        order = sorted(slices, key=lambda s: (-free_chips[s], s))
-        needs = [max(1, pod_device_need(TPU, p)) for p in pods]
+        order = sorted(slices, key=lambda s: (-free_millis[s], s))
+        needs = [self._pod_need_millis(p) for p in pods]
 
         for k in range(2, min(max_slices, len(order), len(pods)) + 1):
             if len(pods) % k:
@@ -587,7 +612,7 @@ class Cluster:
                 if not nodes:
                     continue
                 lo = len(groups) * sub_n
-                if sum(needs[lo : lo + sub_n]) > free_chips[sname]:
+                if sum(needs[lo : lo + sub_n]) > free_millis[sname]:
                     continue  # provably too full for a sub-gang
                 try:
                     groups.append(
@@ -798,7 +823,8 @@ class Cluster:
                 )
         n_tpu = pod_device_count(TPU, probe)
         n_gpu = pod_device_count(GPU, probe)
-        if n_tpu == 0 and n_gpu == 0:
+        frac = meshstate.pod_milli(probe)
+        if n_tpu == 0 and n_gpu == 0 and frac == 0:
             raise SchedulingError(f"pod {pod.name!r}: no node fits (nothing to preempt for)")
 
         for name in utils.sorted_string_keys(self.nodes):
@@ -806,7 +832,7 @@ class Cluster:
                 continue  # maintenance nodes take no new pods, even by force
             node = self.nodes[name]
             state = meshstate.parse_mesh_state(node.info.allocatable)
-            if n_tpu > 0 and state is None:
+            if (n_tpu > 0 or frac > 0) and state is None:
                 continue  # the TPU leg needs mesh geometry on this node
             victims = sorted(
                 (p for p in node.pods.values() if pod_priority(p) < prio),
@@ -816,12 +842,22 @@ class Cluster:
             # provably open a contiguous block); GPU (tree) is scalar — the
             # structural fill spills across NVLink groups, so free count is
             # exact (group_scheduler._pick_pool_tree fails only on count).
+            # Round-18 fractional: evictions are tracked per chip in
+            # milli-chips — a chip rejoins the whole-free set only when
+            # its LAST fractional occupant is gone (exact restoration),
+            # and a vChip preemptor fits once any chip's freed milli
+            # covers its share.
             avail = set(state.free) if state is not None else set()
+            frac_free: Dict = dict(state.frac_free) if state is not None else {}
             free_gpu = node.info.allocatable.get(GPU.resource_name, 0)
             chosen: List[PodInfo] = []
 
             def _fits() -> bool:
                 if n_tpu > 0 and find_contiguous_block(avail, n_tpu, state.topo) is None:
+                    return False
+                if frac > 0 and not any(
+                    f >= frac for f in frac_free.values()
+                ):
                     return False
                 return not (n_gpu > 0 and free_gpu < n_gpu)
 
@@ -833,12 +869,32 @@ class Cluster:
                 # class — a CPU-only (or wrong-class) neighbor must not be
                 # killed for nothing.
                 contributes = False
-                if n_tpu > 0:
+                if n_tpu > 0 or frac > 0:
                     _topo, vcoords = self.pod_chip_coords(victim)
                     fresh_coords = set(vcoords) - avail
                     if fresh_coords:
                         avail |= fresh_coords
                         contributes = True
+                        # a freed WHOLE chip is fractional capacity too
+                        # (only vChip-capable chips — those advertising
+                        # a /milli key — can host a share)
+                        for c in fresh_coords:
+                            local = state.coord_chip.get(c)
+                            if local in state.milli_key:
+                                frac_free[c] = meshstate.MILLI_PER_CHIP
+                    for key, amt in group_scheduler.held_milli(
+                            victim).items():
+                        mm = meshstate.CHIP_MILLI_RE.match(key)
+                        local = int(mm.group(1)) if mm else -1
+                        if local not in state.chip_coord:
+                            continue
+                        c = state.chip_coord[local]
+                        frac_free[c] = frac_free.get(c, 0) + amt
+                        contributes = True
+                        if frac_free[c] >= meshstate.MILLI_PER_CHIP:
+                            # every fractional occupant evicted: the
+                            # chip is whole again
+                            avail.add(c)
                 if n_gpu > 0:
                     cards = group_scheduler.held_cards(victim, GPU.base)
                     if cards:
@@ -1176,13 +1232,20 @@ class Cluster:
           group_scheduler._account), and held + free == capacity for
           every advertised cards key;
         - scalar device counts (tpu/gpu) balance: allocatable ==
-          capacity - held cards of that class, within [0, capacity].
+          capacity - held cards of that class, within [0, capacity];
+        - fractional (Round-18 vChip) holds balance per chip: the sum of
+          co-located pods' milli shares + the advertised free milli ==
+          MILLI_PER_CHIP (so Σ fractions on a chip <= 1.0 by
+          construction, free >= 0 enforced), a fractionally-occupied
+          chip's cards key is never ALSO whole-held, and every placed
+          fractional pod actually holds exactly one /milli key.
         """
         problems: List[str] = []
         owner: Dict[str, str] = {}
         for name in utils.sorted_string_keys(self.nodes):
             node = self.nodes[name]
             held_keys: Dict[str, int] = {}
+            held_millis: Dict[str, int] = {}
             scalar_held = {ResourceTPU: 0, ResourceGPU: 0}
             for pname, pod in node.pods.items():
                 if pname in owner:
@@ -1191,7 +1254,20 @@ class Cluster:
                         f"and {name!r}"
                     )
                 owner[pname] = name
+                try:
+                    pod_frac = meshstate.pod_milli(pod)
+                except ValueError as e:
+                    problems.append(f"{name}/{pname}: {e}")
+                    pod_frac = 0
+                frac_holds = 0
                 for key in group_scheduler._pod_held_keys(pod):
+                    mm = group_scheduler._MILLI_KEY_RE.match(key)
+                    if mm:
+                        frac_holds += 1
+                        held_millis[key] = (
+                            held_millis.get(key, 0) + pod_frac
+                        )
+                        continue
                     m = group_scheduler._CARDS_KEY_RE.match(key)
                     if not m:
                         continue
@@ -1199,6 +1275,11 @@ class Cluster:
                     scalar = group_scheduler._SCALAR_BY_BASE.get(m.group(5))
                     if scalar in scalar_held:
                         scalar_held[scalar] += 1
+                if pod_frac > 0 and frac_holds != 1:
+                    problems.append(
+                        f"{name}: fractional pod {pname!r} holds "
+                        f"{frac_holds} /milli keys (want exactly 1)"
+                    )
             for key, n in sorted(held_keys.items()):
                 if n > 1:
                     problems.append(
@@ -1208,6 +1289,22 @@ class Cluster:
             # currently-held ones — a key leaked while free (held 0 but
             # allocatable corrupted downward) must not hide from the audit
             for key in sorted(node.info.capacity):
+                if key.endswith("/milli"):
+                    held = held_millis.get(key, 0)
+                    cap = int(node.info.capacity.get(key, 0))
+                    free = int(node.info.allocatable.get(key, 0))
+                    if not 0 <= free <= cap or held + free != cap:
+                        problems.append(
+                            f"{name}: {key!r} held({held}) + free({free}) "
+                            f"!= capacity({cap})"
+                        )
+                    cards_key = key[: -len("/milli")] + "/cards"
+                    if held > 0 and held_keys.get(cards_key, 0) > 0:
+                        problems.append(
+                            f"{name}: chip {cards_key!r} is whole-held "
+                            f"AND carries {held} fractional milli"
+                        )
+                    continue
                 if not key.endswith("/cards"):
                     continue
                 n = held_keys.get(key, 0)
@@ -1252,6 +1349,17 @@ class Cluster:
                 entry["slice"] = state.slice_name
                 entry["host_index"] = state.host_index
                 entry["free_chips"] = len(state.free)
+                if state.milli_key:
+                    # Round-18 fragmentation view: chips carrying
+                    # fractional occupants AND free milli (a fully-packed
+                    # chip strands nothing, so it isn't fragmentation —
+                    # same definition as the obs CLI's frag line over the
+                    # occupancy gauges), plus the milli they have left
+                    entry["frac_partial_chips"] = sum(
+                        1 for f in state.frac_free.values()
+                        if 0 < f < meshstate.MILLI_PER_CHIP
+                    )
+                    entry["free_milli"] = state.free_milli()
             nodes[name] = entry
         slices: Dict[str, int] = {}
         for entry in nodes.values():
@@ -1280,6 +1388,58 @@ class Cluster:
                     if local in state.chip_coord:
                         coords.append(state.chip_coord[local])
         return state.topo, sorted(coords)
+
+    def pod_vchip(self, pod: PodInfo):
+        """A placed fractional pod's (topology, chip coordinate, milli
+        share) — or (None, None, 0) for whole-chip / unplaced pods. The
+        vChip sibling of ``pod_chip_coords``."""
+        milli = meshstate.pod_milli(pod)
+        node = self.nodes.get(pod.node_name)
+        if milli == 0 or node is None:
+            return None, None, 0
+        state = meshstate.parse_mesh_state(node.info.capacity)
+        if state is None:
+            return None, None, 0
+        # held_milli is THE "which /milli key does this pod hold" scan
+        # (shared with the packing oracle and preemption) — one grammar,
+        # one implementation
+        for key in group_scheduler.held_milli(pod):
+            m = meshstate.CHIP_MILLI_RE.match(key)
+            local = int(m.group(1)) if m else -1
+            if local in state.chip_coord:
+                return state.topo, state.chip_coord[local], milli
+        return None, None, 0
+
+    def chip_occupancy(
+        self, nodes: Optional[Sequence[str]] = None
+    ) -> Dict[str, Dict[int, float]]:
+        """node -> local chip id -> occupancy fraction in [0, 1], for
+        every vChip-capable chip: 1.0 when the chip is whole-held,
+        otherwise (MILLI_PER_CHIP - free milli) / MILLI_PER_CHIP. Feeds
+        the ``kubetpu_chip_occupancy_frac{node,chip}`` gauges and the
+        obs CLI's fragmentation line. *nodes* scopes the sweep (the
+        submit hot path asks only about the nodes it touched)."""
+        out: Dict[str, Dict[int, float]] = {}
+        names = (utils.sorted_string_keys(self.nodes) if nodes is None
+                 else [n for n in sorted(nodes) if n in self.nodes])
+        for name in names:
+            node = self.nodes[name]
+            st = meshstate.parse_mesh_state(node.info.allocatable)
+            if st is None or not st.milli_key:
+                continue
+            per: Dict[int, float] = {}
+            for local, mkey in sorted(st.milli_key.items()):
+                cards_key = st.chip_key.get(local, "")
+                if node.info.allocatable.get(cards_key, 0) < 1:
+                    per[local] = 1.0
+                    continue
+                free = node.info.allocatable.get(
+                    mkey, meshstate.MILLI_PER_CHIP)
+                per[local] = (
+                    meshstate.MILLI_PER_CHIP - free
+                ) / float(meshstate.MILLI_PER_CHIP)
+            out[name] = per
+        return out
 
     def gang_slice_contiguity(self, pods: Sequence[PodInfo]) -> Dict[str, float]:
         """Per-slice ICI-contiguity of a placed gang's chips: slice name ->

@@ -40,6 +40,11 @@ from kubetpu.scheduler import meshstate
 _CARDS_KEY_RE = re.compile(
     r"^resource/group/([a-z]+grp1)/([^/]+)/([a-z]+grp0)/([^/]+)/([a-z]+)/([^/]+)/cards$"
 )
+# the fractional sibling (Round-18 vChips): per-chip capacity in
+# milli-chips, shared by up to 1000/m fractional pods
+_MILLI_KEY_RE = re.compile(
+    r"^resource/group/([a-z]+grp1)/([^/]+)/([a-z]+grp0)/([^/]+)/([a-z]+)/([^/]+)/milli$"
+)
 
 _SCALAR_BY_BASE = {"tpu": ResourceTPU, "gpu": ResourceGPU}
 
@@ -130,10 +135,63 @@ def _pick_pool_mesh(n: int, state: meshstate.NodeMeshState) -> Optional[List[str
     return sorted(keys)
 
 
+def _fill_fractional(
+    state: meshstate.NodeMeshState, pod_info: PodInfo, milli: int
+) -> bool:
+    """Bind a fractional (vChip) pod to ONE chip's ``/milli`` key,
+    BEST-FIT: the fitting chip with the least remaining capacity wins
+    (ties to the lowest local id), so fractional confetti concentrates
+    on already-broken chips and pristine chips stay whole for future
+    gangs — the anti-fragmentation policy. Every container shares the
+    pod's single vChip (the pod-level request grammar); the binding is
+    key -> key because the fractional grammar has no translation stage."""
+    cands = []
+    for local, mkey in state.milli_key.items():
+        coord = state.chip_coord[local]
+        free = state.frac_free.get(coord, 0)
+        if free >= milli:
+            cands.append((free, local, mkey))
+    if not cands:
+        return False
+    conts = list(pod_info.running_containers.values()) + list(
+        pod_info.init_containers.values()
+    )
+    if not conts:
+        # nothing to bind the share to — a container-less pod placed
+        # "successfully" would hold no /milli key and corrupt the books
+        return False
+    _free, _local, mkey = min(cands)
+    for cont in conts:
+        # strip stale /milli bindings from a PREVIOUS placement first (a
+        # re-scheduled pod — preemption re-pend, dead-node reconcile —
+        # arrives still carrying its old chip's key; binding the new one
+        # on top would make _account move the share on BOTH keys and
+        # strand phantom capacity on the new node's books)
+        for stale in [k for k in cont.allocate_from
+                      if _MILLI_KEY_RE.match(k)]:
+            del cont.allocate_from[stale]
+        for stale in [k for k in cont.dev_requests
+                      if _MILLI_KEY_RE.match(k)]:
+            del cont.dev_requests[stale]
+        cont.dev_requests[mkey] = milli
+        cont.allocate_from[mkey] = mkey
+    return True
+
+
 def fill_allocate_from(node_info: NodeInfo, pod_info: PodInfo) -> bool:
     """Fill every container's AllocateFrom from the node's allocatable;
-    all-or-nothing per pod (no partial state on failure)."""
+    all-or-nothing per pod (no partial state on failure). Fractional
+    (vChip) pods take the dedicated best-fit chip binding instead of the
+    grouped-cards pool walk."""
     state = meshstate.parse_mesh_state(node_info.allocatable)
+    milli = meshstate.pod_milli(pod_info)
+    if milli > 0:
+        # a vChip needs mesh geometry (the /milli advertisement rides the
+        # chip-coordinate grammar); mixing with whole-chip requests is
+        # refused upstream by the schedulers' fit predicate
+        if state is None:
+            return False
+        return _fill_fractional(state, pod_info, milli)
     running = [
         pod_info.running_containers[k]
         for k in utils.sorted_string_keys(pod_info.running_containers)
@@ -214,6 +272,20 @@ def held_cards(pod_info: PodInfo, base: str) -> Set[str]:
     return out
 
 
+def held_milli(pod_info: PodInfo) -> Dict[str, int]:
+    """The fractional holds of a placed pod as milli-key -> milli-chips
+    (at most one entry today: a pod carries one vChip). Input to the
+    Round-18 packing oracle and fractional preemption."""
+    out: Dict[str, int] = {}
+    milli = meshstate.pod_milli(pod_info)
+    if not milli:
+        return out
+    for key in _pod_held_keys(pod_info):
+        if _MILLI_KEY_RE.match(key):
+            out[key] = milli
+    return out
+
+
 def free_cards_by_group(node_info: NodeInfo, base: str) -> Dict[str, List[str]]:
     """Free cards keys of *base* grouped by their level-1 group id — the
     structural-fill view of a tree node's fragmentation (NVLink locality:
@@ -240,6 +312,16 @@ def _account(node_info: NodeInfo, pod_info: PodInfo, sign: int) -> None:
     for to_key in _pod_held_keys(pod_info):
         m = _CARDS_KEY_RE.match(to_key)
         if not m:
+            if _MILLI_KEY_RE.match(to_key):
+                # fractional hold: the pod's vChip share moves on the
+                # chip's milli key; the scalar whole-chip tally is
+                # untouched (the chip's cards key stays advertised — it
+                # is the mesh-state parse that hides a partially-
+                # occupied chip from whole-chip placement)
+                node_info.allocatable[to_key] = (
+                    node_info.allocatable.get(to_key, 0)
+                    + sign * meshstate.pod_milli(pod_info)
+                )
             continue
         node_info.allocatable[to_key] = node_info.allocatable.get(to_key, 0) + sign
         scalar = _SCALAR_BY_BASE.get(m.group(5))

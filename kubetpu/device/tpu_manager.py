@@ -35,8 +35,10 @@ from kubetpu.plugintypes import ResourceTPU
 from kubetpu.plugintypes.mesh import TOPOLOGIES, TpuTopology
 from kubetpu.scheduler.deviceclass import TPU
 from kubetpu.scheduler.meshstate import (
+    MILLI_PER_CHIP,
     GangSliceIdKey,
     GangSlicesKey,
+    pod_milli,
     slice_resource_key,
 )
 
@@ -177,6 +179,11 @@ class TpuDevManager(Device):
             for reslist in (node_info.capacity, node_info.allocatable):
                 add_group_resource(reslist, chip.name + "/cards", 1)
                 add_group_resource(reslist, chip.name + "/memory", chip.memory.global_bytes)
+                # Round-18 vChips: the chip's fractional capacity in
+                # milli-chips, next to the exclusive cards key — the
+                # hierarchical fractional resource the scheduler
+                # bin-packs small replicas onto
+                add_group_resource(reslist, chip.name + "/milli", MILLI_PER_CHIP)
         if self.topology is not None:
             for reslist in (node_info.capacity, node_info.allocatable):
                 reslist[
@@ -193,11 +200,15 @@ class TpuDevManager(Device):
                 return [], [], {}
             indices: List[int] = []
             devices: List[str] = []
+            vchip_idx: List[int] = []  # Round-18: fractionally-shared chips
             for res in container.allocate_from.values():
                 utils.logf(4, "PodName: %s -- searching for device: %s", pod.name, res)
                 m = TPU.alloc_re.search(res)
                 if not m:
-                    continue
+                    m = TPU.milli_alloc_re.search(res)
+                    if not m:
+                        continue
+                    vchip_idx.append(int(m.group(1)))
                 idx = int(m.group(1))
                 indices.append(idx)
                 chip_id = self.index_to_id.get(idx)
@@ -213,6 +224,20 @@ class TpuDevManager(Device):
                 "TPU_WORKER_ID": str(self.host_index),
             }
             env.update(self._bounds_env(indices))
+            # Fractional (vChip) allocation: stamp the share and its HBM
+            # budget so the container's serving stack can partition the
+            # paged pool honestly (pool_frac = MILLI/1000); the chip's
+            # device node is shared with the co-located tenants.
+            if vchip_idx:
+                milli = pod_milli(pod)
+                env["KUBETPU_VCHIP_MILLI"] = str(milli)
+                hbm = 0
+                chip_id = self.index_to_id.get(vchip_idx[0])
+                if chip_id is not None:
+                    hbm = self.tpus[chip_id].memory.global_bytes
+                env["KUBETPU_VCHIP_HBM_BYTES"] = str(
+                    tputypes.vchip_hbm_budget(milli, hbm) if milli and hbm
+                    else 0)
             # Multislice gang members (stamped by schedule_gang's multislice
             # path) get the libtpu/megascale identity: how many slices the
             # job spans and which one this pod's chips live in. The

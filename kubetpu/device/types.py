@@ -26,6 +26,20 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 
+def vchip_hbm_budget(milli: int, chip_hbm_bytes: int) -> int:
+    """The HBM byte budget of a vChip share (Round-18 fractional chip
+    virtualization): the chip's HBM scaled by the share, floored — the
+    sum of co-located shares' budgets never exceeds the chip. Stamped
+    into every fractional allocation's environment
+    (``KUBETPU_VCHIP_HBM_BYTES``) so the serving layer can size its
+    paged pool honestly (``PagedDecodeServer(pool_frac=...)``)."""
+    from kubetpu.scheduler.meshstate import MILLI_PER_CHIP
+
+    if not 0 < milli <= MILLI_PER_CHIP:
+        raise ValueError(f"milli {milli} outside (0, {MILLI_PER_CHIP}]")
+    return (int(chip_hbm_bytes) * int(milli)) // MILLI_PER_CHIP
+
+
 def default_tpuinfo_path() -> str:
     """Probe binary location. Configurable (SURVEY.md §5.6 flags the
     reference's hardcoded /usr/local/bin/nvmlinfo as build debt)."""

@@ -507,7 +507,10 @@ class PagedDecodeServer(SlotServerBase):
         overlap: bool = False,
         queue_ttl: Optional[float] = None,
         prefix_cache_pages: int = 0,
+        pool_frac: float = 1.0,
     ) -> None:
+        if not 0.0 < pool_frac <= 1.0:
+            raise ValueError("pool_frac must be in (0, 1]")
         if prefix_cache_pages < 0:
             raise ValueError("prefix_cache_pages must be >= 0 (0 = off)")
         if prefix_cache_pages and cfg.window > 0:
@@ -541,8 +544,15 @@ class PagedDecodeServer(SlotServerBase):
             self._pages_needed(cfg.window) + 1 if cfg.window > 0 else 0
         )
         # default pool: HALF the dense equivalent — the win is configurable,
-        # callers size it to expected live tokens
-        self.pool_pages = n_pages or (n_slots * self.max_pages_per_slot + 1) // 2
+        # callers size it to expected live tokens.
+        # Round-18 vChips: ``pool_frac`` is this replica's share of the
+        # chip's HBM budget (KUBETPU_VCHIP_MILLI / 1000 when launched on a
+        # fractional allocation) — the pool is SIZED to the share, so N
+        # packed replicas on one chip partition the page budget honestly
+        # and the router's /load free-pages signal reflects the partition.
+        self.pool_frac = float(pool_frac)
+        base_pages = n_pages or (n_slots * self.max_pages_per_slot + 1) // 2
+        self.pool_pages = max(1, int(base_pages * self.pool_frac))
         self.kv_int8 = kv_int8
         self.k_pages, self.v_pages = init_page_pool(
             cfg, self.pool_pages, page_size, kv_int8=kv_int8
@@ -579,6 +589,11 @@ class PagedDecodeServer(SlotServerBase):
                           lambda: self.pages_in_use())
         self.obs.gauge_fn("kubetpu_serving_pages_free",
                           lambda: len(self._free))
+        # Round-18: this replica's vChip share of the chip pool (1.0 =
+        # whole-chip replica) — lets federated dashboards tell a small
+        # pool from a starved one
+        self.obs.gauge_fn("kubetpu_serving_pool_frac",
+                          lambda: self.pool_frac)
         # -- shared-prefix KV reuse (Round-9): host-side radix tree over
         # token prefixes whose nodes OWN pool pages; per-slot: how many
         # leading table rows are shared (read-only) mappings, the pinned
@@ -915,6 +930,8 @@ class PagedDecodeServer(SlotServerBase):
         info["pool_pages"] = self.pool_pages
         info["pages_free"] = len(self._free)
         info["pages_in_use"] = self.pages_in_use()
+        if self.pool_frac < 1.0:
+            info["pool_frac"] = self.pool_frac
         if self._prefix_cache is not None:
             stats = self.prefix_cache_stats()
             info["prefix_hit_rate"] = stats["hit_rate"]

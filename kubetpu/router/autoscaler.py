@@ -91,12 +91,19 @@ class ScalePolicy:
     itl_p99_ms: float = 250.0
     # cold when ALL of: queues empty, occupancy under this, not burning
     cold_active_frac: float = 0.25
+    # Round-18 vChips: the chip share each scale-up boots — passed to
+    # ``launcher(role, frac)`` launchers so the autoscaler can scale
+    # DENSITY (packed fractional replicas) and not just replica count;
+    # 1.0 keeps whole-chip replicas and the legacy launcher shapes
+    vchip_frac: float = 1.0
 
     def __post_init__(self):
         if not 1 <= self.min_replicas <= self.max_replicas:
             raise ValueError("need 1 <= min_replicas <= max_replicas")
         if self.up_after < 1 or self.down_after < 1:
             raise ValueError("up_after/down_after must be >= 1")
+        if not 0.0 < self.vchip_frac <= 1.0:
+            raise ValueError("vchip_frac must be in (0, 1]")
 
 
 class ReplicaAutoscaler:
@@ -114,10 +121,14 @@ class ReplicaAutoscaler:
         """*launcher*: boots one replica, returns its URL (raises on
         failure — the pass records the error and retries next time).
         May accept the pool's role (``launcher(role)``) so a
-        disaggregated fleet scales the starving kind; zero-arg
-        launchers keep the colocated behavior. *terminator*: called
-        with (name, url) AFTER a drained victim is removed, so the
-        operator can reclaim the process/chips. *policies*: per-role
+        disaggregated fleet scales the starving kind, and additionally
+        the vChip share (``launcher(role, frac)``, Round-18) so a
+        scale-up boots a PACKED fractional replica sized to the pool's
+        ``vchip_frac`` policy; zero-arg launchers keep the colocated
+        whole-chip behavior — a one-arg launcher must never be handed a
+        share it would silently drop. *terminator*: called with
+        (name, url) AFTER a drained victim is removed, so the operator
+        can reclaim the process/chips. *policies*: per-role
         ``ScalePolicy`` overrides (missing roles use *policy*)."""
         self.router = router
         self.launcher = launcher
@@ -126,12 +137,38 @@ class ReplicaAutoscaler:
         self.policies = dict(policies or {})
         try:
             sig = inspect.signature(launcher)
-            self._launcher_takes_role = any(
-                p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
-                           p.VAR_POSITIONAL)
-                for p in sig.parameters.values())
+            nargs = 0
+            var_positional = False
+            frac_capable = False
+            for p in sig.parameters.values():
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                    nargs += 1
+                    if nargs == 2:
+                        # the share goes to a second positional only when
+                        # the launcher clearly declared it: a REQUIRED
+                        # second parameter, or one named for the share. A
+                        # legacy `launcher(role, port_base=9000)` worked
+                        # pre-Round-18 by being called with one arg —
+                        # feeding 1.0 into its defaulted extra would
+                        # silently misconfigure the replica.
+                        frac_capable = (
+                            p.default is p.empty
+                            or p.name in ("frac", "vchip_frac", "share",
+                                          "milli")
+                        )
+                elif p.kind == p.VAR_POSITIONAL:
+                    var_positional = True
+            if var_positional and nargs < 1:
+                # a bare *args launcher keeps the legacy one-arg call
+                # (it predates the share; silently handing it a second
+                # positional would break `def launcher(*a): boot(*a)`
+                # wrappers around one-parameter factories) — declare
+                # (role, frac) explicitly to receive the share
+                nargs = 1
+            self._launcher_nargs = (
+                2 if nargs >= 2 and frac_capable else min(nargs, 1))
         except (TypeError, ValueError):
-            self._launcher_takes_role = False
+            self._launcher_nargs = 0
         self.events = router.events
         self._lock = threading.Lock()
         self._known_pools: set = set()
@@ -352,7 +389,7 @@ class ReplicaAutoscaler:
                 "actions": actions}
 
     def _scale_up(self, key: str, sig: dict) -> Optional[str]:
-        if key not in ("both", None) and not self._launcher_takes_role:
+        if key not in ("both", None) and self._launcher_nargs < 1:
             # a zero-arg launcher cannot boot a DEDICATED role replica:
             # launching anyway would register a "both" node, leave this
             # pool at zero, and the floor-heal would buy hardware every
@@ -363,9 +400,26 @@ class ReplicaAutoscaler:
                 error=f"pool {key!r} needs replicas but the launcher "
                       f"takes no role — pass launcher(role)")
             return None
+        frac = self._policy_for(key).vchip_frac
+        if frac < 1.0 and self._launcher_nargs < 2:
+            # Round-18: a fractional policy with a launcher that cannot
+            # receive the share would silently boot WHOLE-chip replicas
+            # — the fleet would look packed in config while stranding
+            # 1-frac of every chip. Fail loudly, like the role case.
+            self._c_errors.inc()
+            self.events.emit(
+                "scale_error", role=key,
+                error=f"pool {key!r} wants vchip_frac={frac} but the "
+                      f"launcher takes no share — pass "
+                      f"launcher(role, frac)")
+            return None
         try:
-            url = (self.launcher(key) if self._launcher_takes_role
-                   else self.launcher())
+            if self._launcher_nargs >= 2:
+                url = self.launcher(key, frac)
+            elif self._launcher_nargs == 1:
+                url = self.launcher(key)
+            else:
+                url = self.launcher()
             name = self.router.register_replica(url)
             got = self.router.pool.role(name) or "both"
             if key not in ("both", None) and got != key:

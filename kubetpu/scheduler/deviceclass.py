@@ -39,6 +39,9 @@ class DeviceClass:
     cards_re: Pattern = field(init=False, repr=False, compare=False)
     any_base_re: Pattern = field(init=False, repr=False, compare=False)
     alloc_re: Pattern = field(init=False, repr=False, compare=False)
+    # Round-18 vChips: the fractional sibling of alloc_re — a fully
+    # grouped per-chip /milli key in an AllocateFrom value.
+    milli_alloc_re: Pattern = field(init=False, repr=False, compare=False)
 
     def __post_init__(self):
         object.__setattr__(
@@ -63,6 +66,16 @@ class DeviceClass:
                 + "/" + re.escape(self.grp1) + "/.*/"
                 + re.escape(self.grp0) + "/.*/"
                 + re.escape(self.base) + "/(.*?)/cards"
+            ),
+        )
+        object.__setattr__(
+            self,
+            "milli_alloc_re",
+            re.compile(
+                re.escape(DeviceGroupPrefix)
+                + "/" + re.escape(self.grp1) + "/.*/"
+                + re.escape(self.grp0) + "/.*/"
+                + re.escape(self.base) + "/(.*?)/milli"
             ),
         )
 

@@ -34,6 +34,67 @@ SLICE_KEY_RE = re.compile(
 )
 # any grouped per-chip cards key: .../tpu/<localid>/cards
 CHIP_CARDS_RE = re.compile(r".*/tpu/(\d+)/cards$")
+# any grouped per-chip fractional-capacity key: .../tpu/<localid>/milli
+# (Round-18 vChips: the chip's capacity in milli-chips, 1000 = whole)
+CHIP_MILLI_RE = re.compile(r".*/tpu/(\d+)/milli$")
+
+# Fractional (vChip) resource model, grounded in PAPERS.md (Topology-Aware
+# Virtualization over Inter-Core Connected NPUs): one chip subdivides into
+# MILLI_PER_CHIP milli-units. A pod requests a vChip by carrying FracKey
+# (the resource-list-as-config channel, like priority/multislice) with a
+# value in [1, MILLI_PER_CHIP): "give me this fraction of ONE chip". The
+# device manager advertises per-chip `/milli` capacity keys next to the
+# exclusive `/cards` keys; accounting keeps the two mutually exclusive —
+# a chip is either whole-held (cards) or carries fractional occupants
+# (milli), never both.
+MILLI_PER_CHIP = 1000
+FracKey = "kubetpu/tpu-milli"
+
+
+def parse_milli(qty) -> int:
+    """Parse a vChip quantity into milli-chips: ``"250m"`` (kube milli
+    grammar), ``"0.25"`` / ``0.25`` (chip fraction), or a bare int that
+    already IS milli. Raises ValueError outside (0, MILLI_PER_CHIP) —
+    whole chips go through the scalar resource, not FracKey."""
+    if isinstance(qty, str):
+        s = qty.strip()
+        if s.endswith("m"):
+            m = int(s[:-1])
+        else:
+            m = int(round(float(s) * MILLI_PER_CHIP))
+    elif isinstance(qty, float):
+        m = int(round(qty * MILLI_PER_CHIP))
+    else:
+        m = int(qty)
+    if not 0 < m < MILLI_PER_CHIP:
+        raise ValueError(
+            f"vChip request {qty!r} -> {m} milli-chips is outside "
+            f"(0, {MILLI_PER_CHIP}); request whole chips via the scalar "
+            f"resource instead"
+        )
+    return m
+
+
+def pod_milli(pod_requests) -> int:
+    """The pod's fractional (vChip) request in milli-chips, 0 when absent.
+    Accepts a PodInfo or a bare requests ResourceList; the stamp value
+    may be an int (already milli — the hot-path form) or any
+    ``parse_milli`` grammar (``"250m"``, ``"0.25"``, a float) — wire
+    clients POST pod requests verbatim, so the documented grammar must
+    work here, not only in client-side helpers. Values outside
+    (0, MILLI_PER_CHIP) raise ValueError — a malformed stamp must fail
+    loudly at the first placement attempt, not silently round."""
+    requests = getattr(pod_requests, "requests", pod_requests)
+    raw = requests.get(FracKey, 0)
+    if not raw:
+        return 0
+    if isinstance(raw, int):
+        if not 0 < raw < MILLI_PER_CHIP:
+            raise ValueError(
+                f"{FracKey}={raw!r} is outside (0, {MILLI_PER_CHIP})"
+            )
+        return raw
+    return parse_milli(raw)
 
 DEFAULT_SLICE_UID = "slice0"
 
@@ -82,17 +143,43 @@ class NodeMeshState:
     chip_coord: Dict[int, Coord]   # local chip id -> global torus coord
     coord_chip: Dict[Coord, int]   # inverse
     chip_key: Dict[int, str]       # local chip id -> advertised cards key
-    free: Set[Coord]               # coords whose cards key is allocatable
+    # WHOLE-chip availability: coords whose cards key is allocatable AND
+    # (Round-18) whose milli key, when advertised, reads full — a chip
+    # carrying fractional occupants is invisible to every whole-chip
+    # geometry path (fit, fill, preemption feasibility, defrag)
+    free: Set[Coord]
     slice_uid: str = DEFAULT_SLICE_UID
     # n -> find_contiguous_block(free, n, topo) result. Valid for this
     # state object's lifetime: the parse memo rebuilds the whole state
     # whenever the advertised resources change, so the cache dies with it.
     # NOTE: cache users must not mutate ``free`` in place.
     fit_cache: Dict[int, object] = None  # type: ignore[assignment]
+    # Round-18 fractional capacity: coord -> free milli-chips, for chips
+    # that (a) advertise a /milli key (vChip-capable) and (b) are not
+    # whole-held via their cards key. A whole-held chip reads 0 here; a
+    # pristine vChip-capable chip reads MILLI_PER_CHIP.
+    frac_free: Dict[Coord, int] = None  # type: ignore[assignment]
+    milli_key: Dict[int, str] = None    # local chip id -> /milli key
 
     def __post_init__(self) -> None:
         if self.fit_cache is None:
             self.fit_cache = {}
+        if self.frac_free is None:
+            self.frac_free = {}
+        if self.milli_key is None:
+            self.milli_key = {}
+
+    def free_milli(self) -> int:
+        """Total free capacity of this host in milli-chips: whole-free
+        chips count MILLI_PER_CHIP each (via frac_free when vChip-capable,
+        directly otherwise); partially-occupied chips contribute their
+        remainder — the fractional generalization of ``len(free)``."""
+        total = sum(self.frac_free.values())
+        covered = {self.chip_coord[l] for l in self.milli_key
+                   if l in self.chip_coord}
+        total += MILLI_PER_CHIP * sum(
+            1 for c in self.free if c not in covered)
+        return total
 
     @property
     def slice_name(self) -> str:
@@ -162,6 +249,8 @@ def _parse_mesh_state_uncached(node_resources: ResourceList) -> Optional[NodeMes
     coord_chip = {c: i for i, c in chip_coord.items()}
 
     chip_key: Dict[int, str] = {}
+    milli_key: Dict[int, str] = {}
+    milli_free: Dict[int, int] = {}  # local id -> advertised free milli
     free: Set[Coord] = set()
     for key, val in node_resources.items():
         m = CHIP_CARDS_RE.match(key)
@@ -171,6 +260,23 @@ def _parse_mesh_state_uncached(node_resources: ResourceList) -> Optional[NodeMes
                 chip_key[local] = key
                 if val >= 1:
                     free.add(chip_coord[local])
+            continue
+        m = CHIP_MILLI_RE.match(key)
+        if m:
+            local = int(m.group(1))
+            if local in chip_coord:
+                milli_key[local] = key
+                milli_free[local] = int(val)
+    # Round-18: a chip with fractional occupants (milli below full) is
+    # not whole-free, and a whole-held chip (cards gone) has no
+    # fractional capacity — the two allocation grammars are exclusive.
+    frac_free: Dict[Coord, int] = {}
+    for local, mkey in milli_key.items():
+        coord = chip_coord[local]
+        if coord in free:
+            frac_free[coord] = milli_free[local]
+            if milli_free[local] < MILLI_PER_CHIP:
+                free.discard(coord)
     return NodeMeshState(
         topo=topo,
         host_index=host_index,
@@ -179,4 +285,6 @@ def _parse_mesh_state_uncached(node_resources: ResourceList) -> Optional[NodeMes
         chip_key=chip_key,
         free=free,
         slice_uid=slice_uid,
+        frac_free=frac_free,
+        milli_key=milli_key,
     )

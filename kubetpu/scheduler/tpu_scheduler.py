@@ -123,6 +123,46 @@ class TpuScheduler(DeviceScheduler):
         _, score = placed
         return True, score
 
+    def _frac_fit(self, node_info: NodeInfo, want: int, frac: int) -> FitResult:
+        """Fractional (vChip, Round-18) placement: fits iff some chip has
+        ``frac`` free milli-chips; the score is the post-placement
+        occupancy of the BEST-FIT chip (tightest fitting remainder), so
+        the predicate sweep bin-packs — a node whose partially-filled
+        chip the vChip completes scores 1.0 (the perfect_score bound),
+        while breaking a pristine chip scores only frac/1000. That
+        ordering IS the anti-fragmentation policy: small replicas
+        concentrate on already-broken chips and whole chips stay free
+        for future whole-chip gangs. No translation stage — the fill
+        binds the chip's ``/milli`` key directly."""
+        if want > 0:
+            reason = PredicateFailureReason(
+                resource_name=meshstate.FracKey,
+                requested=frac,
+                capacity=0,
+                message="a pod cannot mix whole-chip and vChip requests",
+            )
+            return False, [reason], 0.0
+        state = meshstate.parse_mesh_state(node_info.allocatable)
+        fits = (
+            [f for f in state.frac_free.values() if f >= frac]
+            if state is not None else []
+        )
+        if not fits:
+            reason = PredicateFailureReason(
+                resource_name=meshstate.FracKey,
+                requested=frac,
+                capacity=max(state.frac_free.values(), default=0)
+                if state is not None else 0,
+                message="no chip with enough free fractional capacity"
+                if state is not None
+                else "vChips need mesh geometry (no tpu-slice advertised)",
+            )
+            return False, [reason], 0.0
+        best = min(fits)
+        score = (meshstate.MILLI_PER_CHIP - (best - frac)) / float(
+            meshstate.MILLI_PER_CHIP)
+        return True, [], score
+
     def pod_fits_device(
         self, node_info: NodeInfo, pod_info: PodInfo, fill_allocate_from: bool
     ) -> FitResult:
@@ -137,6 +177,9 @@ class TpuScheduler(DeviceScheduler):
         node's mesh state); (4) only for nodes that can actually host the
         pod, the grouped-key translation."""
         want, has_base = prepare_pod(TPU, pod_info)
+        frac = meshstate.pod_milli(pod_info)
+        if frac > 0:
+            return self._frac_fit(node_info, want, frac)
         if want == 0 and not has_base:
             # No TPUs requested and no stale TPU keys to strip: translation
             # would be a no-op — skip it (GPU-only pods must not pay the
@@ -191,8 +234,11 @@ class TpuScheduler(DeviceScheduler):
 
     def perfect_score(self, pod_info: PodInfo):
         """ICI contiguity is capped at 1.0 (a perfect rectangular block);
-        pods requesting no TPUs always score 0.0 here (see _mesh_fit)."""
-        return 1.0 if pod_wants_device(TPU, pod_info) else 0.0
+        a vChip's bin-pack score is likewise capped at 1.0 (an exact-fit
+        chip); pods requesting neither always score 0.0 (see _mesh_fit)."""
+        if pod_wants_device(TPU, pod_info):
+            return 1.0
+        return 1.0 if meshstate.pod_milli(pod_info) > 0 else 0.0
 
     def get_name(self) -> str:
         return "tpu"

@@ -84,6 +84,7 @@ from kubetpu.obs import trace as obs_trace
 from kubetpu.obs.events import EventLog
 from kubetpu.obs.registry import Registry, federate, install_process_gauges
 from kubetpu.obs.slo import Objective, SloEngine
+from kubetpu.scheduler import meshstate
 from kubetpu.scheduler.deviceclass import GPU, TPU
 from kubetpu.scheduler.translate import pod_device_count, pod_wants_device
 from kubetpu.wire.codec import (
@@ -103,6 +104,14 @@ from kubetpu.wire.httpcommon import (
     write_json,
     write_text,
 )
+
+class BadRequestError(Exception):
+    """A malformed request VALUE — e.g. a vChip stamp outside the milli
+    grammar — raised only by the controller's request-validation layer
+    and mapped to a deterministic 400. Distinct from SchedulingError
+    (409: well-formed but unplaceable) and from an internal ValueError
+    (500: a server fault must not read as "your request is bad")."""
+
 
 # circuit-breaker health states (healthy -> suspect -> probation -> dead)
 HEALTHY = "healthy"
@@ -187,6 +196,14 @@ class ControllerServer:
                 "kubetpu_chips_held",
                 lambda r=dc.resource_name: self._chip_totals(r)[1],
                 device=dc.resource_name)
+        # Round-18 vChips: fractional placements made by this controller,
+        # and per-chip occupancy gauges (labels are dynamic — refreshed
+        # by _update_occupancy_gauges on every reconcile pass; a chip
+        # that leaves the fleet reads 0.0, it cannot un-render)
+        self._c_frac_allocs = self.registry.counter(
+            "kubetpu_fractional_allocations_total",
+            "vChip (fractional) pod placements")
+        self._occ_seen: set = set()
         # circuit-breaker thresholds: ``suspect_after`` consecutive missed
         # probes health-cordon a node (pods kept, no new placements);
         # ``dead_after`` consecutive misses evict it. ``dead_after=1`` is
@@ -370,6 +387,15 @@ class ControllerServer:
                             self._reply(404, {"error": f"no node {name!r}"})
                     else:
                         self._reply(404, {"error": f"no route {self.path}"})
+                except BadRequestError as e:
+                    # a malformed request value (e.g. a vChip stamp
+                    # outside the milli grammar) is the CLIENT's error —
+                    # a deterministic 400, never a retryable-looking 500.
+                    # Only the request-validation layer raises this; an
+                    # internal ValueError still surfaces as a 500 (a
+                    # server fault must not read as "don't retry, your
+                    # request is bad").
+                    self._reply(400, {"error": str(e)})
                 except SchedulingError as e:
                     self._reply(409, {"error": str(e)})
                 except ConnectionError as e:
@@ -398,7 +424,17 @@ class ControllerServer:
                 name = self.path[len("/pods/"):]
                 with controller._lock:
                     try:
+                        node_name = next(
+                            (nn for nn, node
+                             in controller.cluster.nodes.items()
+                             if name in node.pods), None)
                         controller.cluster.release(name)
+                        if node_name is not None:
+                            # a released vChip share must leave the
+                            # occupancy gauge immediately, not at the
+                            # next submit that happens to touch the node
+                            controller._update_occupancy_gauges(
+                                only_nodes={node_name})
                         out = {"released": name}
                     except KeyError:
                         # a preemption/eviction victim waiting in the
@@ -764,6 +800,14 @@ class ControllerServer:
             pods = [pod_info_from_json(p) for p in req["gang"]]
         else:
             pods = [pod_info_from_json(req["pod"])]
+        for p in pods:
+            try:
+                meshstate.pod_milli(p)
+            except ValueError as e:
+                # validate vChip stamps at the wire boundary: a malformed
+                # milli value is the client's deterministic 400, not a
+                # ValueError escaping mid-schedule as a retryable 500
+                raise BadRequestError(str(e)) from e
         names = [p.name for p in pods]
         if len(set(names)) != len(names):
             raise SchedulingError(f"duplicate pod names in request: {names}")
@@ -814,6 +858,8 @@ class ControllerServer:
                 (p, *self._snapshot_placed(p.name, p.node_name))
                 for p in placed
             ]
+            self._update_occupancy_gauges(
+                only_nodes={p.node_name for p in placed})
         evicted_names = [p.name for p in evicted]
         out = {"placements": []}
         try:
@@ -823,6 +869,11 @@ class ControllerServer:
                     "node": p.node_name,
                     "containers": self._run_allocations(device, pod_copy),
                 })
+            # count COMMITTED fractional placements only: a rolled-back
+            # submit (below) is released and must not inflate the
+            # monotonic counter — it re-pends and is counted when its
+            # allocation actually lands
+            self._count_fractional(placed)
         except Exception:
             # all-or-nothing INCLUDING preemption: release what this request
             # placed, then put the victims back where they were — a failed
@@ -831,6 +882,7 @@ class ControllerServer:
                 node = placed[0].node_name if placed else ""
                 for p in placed:
                     self._release_if_current(p)
+                touched = {p.node_name for p in placed}
                 if evicted:
                     self._pending = [
                         p for p in self._pending if p.name not in evicted_names
@@ -845,6 +897,16 @@ class ControllerServer:
                     lost = self.cluster._restore_pods(to_restore, node)
                     for p in lost:  # could not restore: keep for reconcile
                         self._pending.append(p)
+                    # restored victims may have landed on a FALLBACK node
+                    # (the restore schedules a copy; look up where it
+                    # went) — its occupancy gauge must move now, not at
+                    # the next reconcile sweep (same standard as DELETE)
+                    restored = {p.name for p in to_restore} - {
+                        p.name for p in lost}
+                    touched.update(
+                        nn for nn, n in self.cluster.nodes.items()
+                        if restored & set(n.pods))
+                self._update_occupancy_gauges(only_nodes=touched)
             raise
         if contiguity is not None:
             out["gang_contiguity"] = contiguity
@@ -886,6 +948,11 @@ class ControllerServer:
             pending = (
                 pod_info_from_json(req["pending"]) if "pending" in req else None
             )
+            if pending is not None:
+                try:
+                    meshstate.pod_milli(pending)
+                except ValueError as e:
+                    raise BadRequestError(str(e)) from e
             moved, placed_pending = self.cluster.execute_defrag(plan, pending)
             out["moved"] = [
                 {"pod": p.name, "node": p.node_name} for p in moved
@@ -906,17 +973,63 @@ class ControllerServer:
                 if self._health_state(name) == state
             )
 
+    def _count_fractional(self, placed_pods) -> None:
+        """Tally vChip placements into the Round-18 counter."""
+        n = sum(
+            1 for p in placed_pods if p.requests.get(meshstate.FracKey)
+        )
+        if n:
+            self._c_frac_allocs.inc(n)
+
+    def _update_occupancy_gauges(self, only_nodes=None) -> None:
+        """Refresh ``kubetpu_chip_occupancy_frac{node,chip}`` from the
+        cluster's per-chip milli accounting — caller holds the lock.
+        *only_nodes* scopes the refresh to the nodes a placement just
+        touched (the submit hot path must not pay a fleet-wide sweep);
+        the reconcile pass runs the FULL sweep, where chips seen before
+        but absent now (node died/removed) are pinned to 0.0 ONCE and
+        dropped from the tracking set — a gauge cannot un-render, and a
+        stale last-good occupancy would fake fragmentation on dead
+        hardware, but re-zeroing departed chips every pass forever
+        would be an unbounded tax on node churn."""
+        occ = self.cluster.chip_occupancy(nodes=only_nodes)
+        fresh = set()
+        for node, per in occ.items():
+            for chip, frac in per.items():
+                key = (node, str(chip))
+                fresh.add(key)
+                self.registry.gauge(
+                    "kubetpu_chip_occupancy_frac",
+                    node=node, chip=str(chip)).set(frac)
+        if only_nodes is None:
+            for node, chip in self._occ_seen - fresh:
+                self.registry.gauge(
+                    "kubetpu_chip_occupancy_frac",
+                    node=node, chip=chip).set(0.0)
+            self._occ_seen = fresh
+        else:
+            self._occ_seen |= fresh
+
     def _chip_totals(self, resource: str):
-        """(free, held) chips of *resource* across the fleet."""
+        """(free, held) chips of *resource* across the fleet. "Free"
+        means WHOLE-chip free: fractional (vChip) placements never touch
+        the scalar tally (exclusivity is derived at parse), so on
+        vChip-capable nodes the count comes from the mesh state's free
+        set — a chip packed solid with 250m tenants must not read as an
+        idle chip on the fleet dashboard."""
         with self._lock:
-            free = sum(
-                int(n.info.allocatable.get(resource, 0))
-                for n in self.cluster.nodes.values()
-            )
-            total = sum(
-                int(n.info.capacity.get(resource, 0))
-                for n in self.cluster.nodes.values()
-            )
+            free = 0
+            total = 0
+            for n in self.cluster.nodes.values():
+                total += int(n.info.capacity.get(resource, 0))
+                state = (
+                    meshstate.parse_mesh_state(n.info.allocatable)
+                    if resource == TPU.resource_name else None
+                )
+                if state is not None and state.milli_key:
+                    free += len(state.free)
+                else:
+                    free += int(n.info.allocatable.get(resource, 0))
         return free, total - free
 
     def _agent_token(self, name: str) -> Optional[str]:
@@ -1194,6 +1307,11 @@ class ControllerServer:
                 for p in self._pending
             }
             pending_names = [p.name for p in self._pending]
+            # Round-18: the per-reconcile FULL occupancy sweep — evictions
+            # and re-placements above moved fractions around, and this is
+            # the one place departed chips get their final 0.0 (the
+            # submit/delete paths only refresh the nodes they touch)
+            self._update_occupancy_gauges()
         return {
             "failed_nodes": failed,
             "suspect_nodes": sorted(suspect),

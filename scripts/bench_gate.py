@@ -18,6 +18,13 @@ they also carry a ``storms`` dict of serving storm metrics:
                     short-decode storm (ITL lower good, tok/s higher
                     good; the colocated arm rides along un-gated as
                     colocated_* for the topology comparison)
+    packing_fleet_toks_s / replicas_per_chip  Round-18: the packed
+                    (vChip) arm of the multi-tenant packing storm
+                    (both higher good; replicas_per_chip is the
+                    scheduler's own density count, not normalized; the
+                    whole-chip arm rides un-gated as packing_cmp_* at
+                    --record, where the strictly-higher acceptance is
+                    enforced)
 
 Modes:
 
@@ -57,14 +64,17 @@ sys.path.insert(0, ".")
 
 HIGHER_IS_BETTER = {"decode_tok_s", "router_hit_rate",
                     "paged_kernel_decode_toks_s",
-                    "disagg_decode_toks_s"}
+                    "disagg_decode_toks_s",
+                    "packing_fleet_toks_s", "replicas_per_chip"}
 GATED = ("decode_tok_s", "ttft_p50_ms", "itl_p99_ms",
          "router_hit_rate", "router_ttft_p50_ms",
          "paged_kernel_decode_toks_s", "migration_drain_s",
-         "disagg_itl_p99_ms", "disagg_decode_toks_s")
+         "disagg_itl_p99_ms", "disagg_decode_toks_s",
+         "packing_fleet_toks_s", "replicas_per_chip")
 # ratios/counters are load-independent: the host-speed calibration must
-# only rescale wall-clock metrics, never a hit rate
-NOT_NORMALIZED = {"router_hit_rate"}
+# only rescale wall-clock metrics, never a hit rate — nor the
+# scheduler's replica-density count (Round-18)
+NOT_NORMALIZED = {"router_hit_rate", "replicas_per_chip"}
 
 
 def _round_files(root: str):
@@ -117,6 +127,12 @@ def measure_storm(repeats: int = 3, rounds: int = 2,
     cfg = dataclasses.replace(flagship_cfg(smoke=True), remat=False)
     params = init_params(jax.random.PRNGKey(0), cfg)
     rng = random.Random(0)
+    # calibrate at BOTH ends of the measurement and keep the fastest
+    # probe: the storm metrics are best-of-N across several minutes, so
+    # they latch the quietest moment — a single-moment probe on a
+    # bursty co-tenant box can sample a slow spike the storms dodged,
+    # and the mismatched ratio then fails honest code in the smoke gate
+    calib = _calibrate()
     longs = [[rng.randrange(1, cfg.vocab) for _ in range(56)]
              for _ in range(rounds)]
     shorts = [[rng.randrange(1, cfg.vocab) for _ in range(8)]
@@ -159,7 +175,7 @@ def measure_storm(repeats: int = 3, rounds: int = 2,
     from bench_model import router_storm
 
     router_cfg = dataclasses.replace(flagship_cfg(smoke=True), remat=False)
-    for _ in range(2):
+    for _ in range(3):   # best-of-3: the TTFT draw is jittery on 1-core hosts
         (affinity,) = router_storm(
             router_cfg,
             n_replicas=2, n_families=3, sys_len=64, tail_len=8,
@@ -238,7 +254,7 @@ def measure_storm(repeats: int = 3, rounds: int = 2,
     from bench_model import disagg_storm
 
     disagg_cfg = dataclasses.replace(flagship_cfg(smoke=True), remat=False)
-    for _ in range(2):
+    for _ in range(3):   # best-of-3, same jitter argument as the router row
         (disagg,) = disagg_storm(
             disagg_cfg, n_long=3, long_len=192, n_short=5, short_len=8,
             max_new=24, page_size=16, prefill_budget=16, n_slots=8,
@@ -260,6 +276,50 @@ def measure_storm(repeats: int = 3, rounds: int = 2,
         best["disagg_decode_toks_s"] = max(
             best.get("disagg_decode_toks_s", 0.0),
             disagg["decode_tok_s"])
+    # Round-18 rows: multi-tenant replica PACKING under fractional chip
+    # virtualization. The gate keys measure the PACKED arm alone
+    # (best-of-2 tok/s; replicas-per-chip is the scheduler's own count —
+    # deterministic, NOT_NORMALIZED); at --record the whole-chip arm
+    # rides along un-gated as packing_cmp_* and the Round-18 acceptance
+    # is enforced strictly: packed fleet tok/s per chip strictly higher
+    # than whole-chip granularity at equal hardware, parity intact.
+    from bench_model import packing_storm
+
+    pk_cfg = dataclasses.replace(flagship_cfg(smoke=True), remat=False)
+    for _ in range(2):
+        (packed,) = packing_storm(
+            pk_cfg, n_tenants=4, prompt_len=8, max_new=12,
+            window_s=1.0, n_slots=2, pack=4, arms=("packed",))
+        if not packed["parity"]:
+            raise SystemExit(
+                "bench-gate: packing storm broke greedy parity — a "
+                "vChip share must never change tokens")
+        best["packing_fleet_toks_s"] = max(
+            best.get("packing_fleet_toks_s", 0.0), packed["value"])
+        best["replicas_per_chip"] = packed["replicas_per_chip"]
+    if strict:
+        last_err = None
+        for _attempt in range(2):
+            whole, packed = packing_storm(
+                pk_cfg, n_tenants=4, prompt_len=8, max_new=12,
+                window_s=1.5, n_slots=2, pack=4)
+            if not (whole["parity"] and packed["parity"]):
+                raise SystemExit(
+                    "bench-gate: packing comparison broke greedy parity")
+            best["packing_cmp_whole_toks_s"] = whole["value"]
+            best["packing_cmp_packed_toks_s"] = packed["value"]
+            best["packing_cmp_whole_replicas_per_chip"] = (
+                whole["replicas_per_chip"])
+            if packed["value"] > whole["value"]:
+                last_err = None
+                break
+            last_err = (f"packed {packed['value']} vs whole "
+                        f"{whole['value']} tok/s per chip")
+        if last_err is not None:
+            raise SystemExit(
+                "bench-gate: the Round-18 acceptance did not hold — "
+                "packed fractional replicas must beat whole-chip "
+                f"granularity at equal hardware ({last_err})")
     if strict:
         import jax.numpy as jnp
 
@@ -295,7 +355,7 @@ def measure_storm(repeats: int = 3, rounds: int = 2,
                 "bench-gate: the Round-17 acceptance did not hold — "
                 "disaggregated must beat colocated ITL p99 with tok/s "
                 f"no worse ({last_err})")
-    best["calib_s"] = round(_calibrate(), 5)
+    best["calib_s"] = round(min(calib, _calibrate()), 5)
     return best
 
 
@@ -387,7 +447,11 @@ def main(argv=None) -> int:
             print(f"bench-gate --smoke: BENCH_r{n:02d}.json has no storms "
                   f"(pre-round-6 file) — run --record first; passing")
             return 0
-        cur = measure_storm(repeats=max(2, args.repeats - 1))
+        # best-of-3 minimum: on bursty co-tenant hosts a 2-draw smoke
+        # can land entirely inside one slow burst and flap a legacy
+        # metric the calibration probe dodged — one more draw buys the
+        # quiet moment the record's best-of-3 already enjoys
+        cur = measure_storm(repeats=max(3, args.repeats - 1))
         # load-normalize: the calibration probes bracket both runs, so a
         # machine uniformly K-times slower than at record time reads as
         # no regression (a real code regression moves the storm metrics

@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""``make pack-check`` — the Round-18 fractional-packing oracle.
+
+Schedules a mixed fractional (vChip) + whole-chip workload through the
+real ``Cluster`` on fake devices and fails (exit 1) on:
+
+- the PACKING ORACLE (``Cluster.check_invariants``) after every phase:
+  Σ(fractions on a chip) must stay <= 1.0, free milli must balance
+  against holds, a chip must never be whole-held AND fractionally
+  occupied, and releases must restore EXACT capacity;
+- ANTI-FRAGMENTATION / NO-STARVATION: after a storm of fractional
+  replicas lands, a whole-chip GANG must still place — the best-fit
+  policy must have concentrated the confetti on few chips instead of
+  smearing it across the slice;
+- fractional-preemption capacity: evicting the fractional pods of a
+  chip must restore it to the whole-chip pool exactly;
+- token PARITY of a packed replica: a ``PagedDecodeServer`` running on
+  a quarter vChip (``pool_frac=0.25``) must emit byte-identical greedy
+  tokens to an unpacked full-pool replica — a share changes capacity,
+  never results.
+
+Runs in under a minute with no accelerator; wired into ``make chaos``
+so every fault-injection run also proves fractional packing doesn't
+corrupt the scheduler's books.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.api.types import ContainerInfo, PodInfo  # noqa: E402
+from kubetpu.core import Cluster, SchedulingError  # noqa: E402
+from kubetpu.device import (  # noqa: E402
+    make_fake_tpus_info,
+    new_fake_tpu_dev_manager,
+)
+from kubetpu.plugintypes import ResourceTPU  # noqa: E402
+from kubetpu.scheduler.meshstate import (  # noqa: E402
+    MILLI_PER_CHIP,
+    FracKey,
+    parse_milli,
+)
+
+
+def fail(msg: str) -> None:
+    print(f"pack-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def oracle(cluster: Cluster, phase: str) -> None:
+    problems = cluster.check_invariants()
+    if problems:
+        fail(f"{phase}: invariants violated: {problems}")
+
+
+def frac_pod(name, qty, **extra):
+    return PodInfo(name=name, requests={FracKey: parse_milli(qty), **extra},
+                   running_containers={"main": ContainerInfo()})
+
+
+def tpu_pod(name, chips):
+    return PodInfo(
+        name=name,
+        running_containers={
+            "main": ContainerInfo(requests={ResourceTPU: chips})})
+
+
+def snapshot_free(cluster: Cluster):
+    """(scalar free, every /cards + /milli allocatable value) — the
+    exact-restoration fingerprint."""
+    out = {}
+    for name, node in sorted(cluster.nodes.items()):
+        for key, val in sorted(node.info.allocatable.items()):
+            if key.endswith(("/cards", "/milli")) or key == ResourceTPU:
+                out[(name, key)] = val
+    return out
+
+
+def main() -> int:
+    cluster = Cluster()
+    for i in range(2):
+        cluster.register_node(
+            f"pack-n{i}",
+            device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")))
+    pristine = snapshot_free(cluster)
+    oracle(cluster, "registration")
+
+    # -- phase 1: fractional workload mix ---------------------------------
+    placed = []
+    mix = [("250m", 6), ("500m", 3), ("0.125", 4)]
+    k = 0
+    for qty, count in mix:
+        for _ in range(count):
+            placed.append(cluster.schedule(frac_pod(f"vc{k}", qty)))
+            k += 1
+    # whole-chip pods ride along: the two grammars must coexist
+    placed.append(cluster.schedule(tpu_pod("whole2", 2)))
+    oracle(cluster, "fractional mix")
+    # 6*250 + 3*500 + 4*125 = 3500 milli -> best-fit packs <= 4 chips
+    occ = cluster.chip_occupancy()
+    partial = sum(1 for per in occ.values()
+                  for f in per.values() if 0.0 < f < 1.0)
+    if partial > 4:
+        fail(f"anti-fragmentation: {partial} partially-occupied chips "
+             f"for 3500 milli of confetti (best-fit should need <= 4)")
+
+    # -- phase 2: no whole-chip gang starvation ---------------------------
+    try:
+        gang = cluster.schedule_gang(
+            [tpu_pod(f"gang{i}", 4) for i in range(2)])
+    except SchedulingError as e:
+        fail(f"whole-chip gang starved behind fractional confetti: {e}")
+    oracle(cluster, "gang placement")
+    for p in gang:
+        cluster.release(p.name)
+
+    # -- phase 3: fractional release restores exact capacity --------------
+    for p in placed:
+        cluster.release(p.name)
+    oracle(cluster, "release")
+    if snapshot_free(cluster) != pristine:
+        fail("release did not restore exact capacity")
+
+    # -- phase 4: preemption restores a fractionally-held chip ------------
+    lows = [cluster.schedule(frac_pod(f"low{i}", "500m"))
+            for i in range(16 * 2)]          # saturate both nodes
+    oracle(cluster, "preemption setup")
+    high = tpu_pod("high8", 8)
+    high.requests["kubetpu/priority"] = 10
+    placed_high, evicted = cluster.schedule_preempting(high)
+    if len(evicted) == 0:
+        fail("preemption evicted nothing for a whole-node pod")
+    oracle(cluster, "preemption")
+    cluster.release(placed_high.name)
+    for p in lows:
+        if p.name not in {e.name for e in evicted}:
+            cluster.release(p.name)
+    oracle(cluster, "preemption cleanup")
+    if snapshot_free(cluster) != pristine:
+        fail("preemption + release did not restore exact capacity")
+
+    # -- phase 5: packed-replica token parity (pool_frac) -----------------
+    import dataclasses
+    import random
+
+    from kubetpu.jobs import ModelConfig, init_params
+    from kubetpu.jobs.paged import PagedDecodeServer
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    cfg = dataclasses.replace(cfg, remat=False)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = random.Random(0)
+    prompts = [[rng.randrange(1, cfg.vocab) for _ in range(12)]
+               for _ in range(4)]
+
+    def serve(pool_frac):
+        srv = PagedDecodeServer(
+            cfg, params, n_slots=2, max_seq=32, max_new_tokens=8,
+            page_size=8, n_pages=64, pool_frac=pool_frac)
+        out = []
+        for p in prompts:
+            rid = srv.enqueue(p)
+            srv.drain()
+            out.append(srv.pop_result(rid))
+        srv.check_invariants()
+        return srv, out
+
+    full_srv, full = serve(1.0)
+    packed_srv, packed = serve(0.25)
+    if full != packed:
+        bad = [i for i, (a, b) in enumerate(zip(full, packed)) if a != b]
+        fail(f"pool_frac parity: requests {bad} diverged")
+    if packed_srv.pool_pages != full_srv.pool_pages // 4:
+        fail(f"pool_frac=0.25 pool is {packed_srv.pool_pages} pages, "
+             f"want {full_srv.pool_pages // 4} (honest partition)")
+
+    print(f"pack-check: OK — {k} fractional + whole mix placed "
+          f"({partial} partial chips), gang unstarved, capacity "
+          f"restored exactly twice, preemption evicted "
+          f"{len(evicted)} fractional pods, packed-vs-full parity on "
+          f"{len(prompts)} requests (pool {packed_srv.pool_pages} vs "
+          f"{full_srv.pool_pages} pages)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -289,6 +289,7 @@ def test_chunked_multi_lora_applies_adapter_per_chunk(params):
     assert chunked == mono
 
 
+@pytest.mark.slow
 def test_paged_budgeted_warmup_and_long_admission(params):
     """A budgeted paged server's warmup pre-compiles the resumed-chunk
     (chunk, gather-prefix) shapes too; a long admission after warmup

@@ -1,0 +1,393 @@
+"""Fractional (vChip, Round-18) cluster accounting edge cases: milli-unit
+parsing and rounding, best-fit bin-packing and anti-fragmentation,
+exact-capacity restoration on release AND preemption, coexistence with
+whole-chip gangs and the multislice pseudo-resources, and the
+``check_invariants`` packing oracle."""
+
+import pytest
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.core import Cluster, SchedulingError
+from kubetpu.core.cluster import PriorityKey
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceTPU
+from kubetpu.scheduler.meshstate import (
+    MILLI_PER_CHIP,
+    FracKey,
+    MultisliceKey,
+    parse_milli,
+    pod_milli,
+)
+
+
+def frac_pod(name, milli, **extra_requests):
+    return PodInfo(name=name, requests={FracKey: milli, **extra_requests},
+                   running_containers={"main": ContainerInfo()})
+
+
+def tpu_pod(name, chips, **extra_requests):
+    return PodInfo(
+        name=name, requests=dict(extra_requests),
+        running_containers={
+            "main": ContainerInfo(requests={ResourceTPU: chips})})
+
+
+def v5e8_cluster(num_nodes=1):
+    cluster = Cluster()
+    for i in range(num_nodes):
+        cluster.register_node(
+            f"frac-n{i}",
+            device=new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")))
+    return cluster
+
+
+def free_snapshot(cluster):
+    out = {}
+    for name, node in sorted(cluster.nodes.items()):
+        for key, val in sorted(node.info.allocatable.items()):
+            if key.endswith(("/cards", "/milli")) or key == ResourceTPU:
+                out[(name, key)] = val
+    return out
+
+
+# -- milli-unit parsing and rounding ----------------------------------------
+
+
+def test_parse_milli_forms():
+    assert parse_milli("250m") == 250
+    assert parse_milli("0.25") == 250
+    assert parse_milli(0.25) == 250
+    assert parse_milli(1) == 1
+    assert parse_milli("999m") == 999
+    # float rounding: a third of a chip rounds to the nearest milli
+    assert parse_milli(1 / 3) == 333
+
+
+@pytest.mark.parametrize("bad", ["0m", "1000m", 0, 1000, 1.0, -0.5, "2.0"])
+def test_parse_milli_rejects_out_of_range(bad):
+    with pytest.raises(ValueError):
+        parse_milli(bad)
+
+
+def test_pod_milli_validates_stamp():
+    assert pod_milli(frac_pod("p", 250)) == 250
+    assert pod_milli(tpu_pod("w", 1)) == 0
+    with pytest.raises(ValueError):
+        pod_milli(frac_pod("p", 1000))
+    with pytest.raises(ValueError):
+        pod_milli(frac_pod("p", -1))
+    # wire clients POST pod requests verbatim: the documented milli
+    # grammar must work on the server-side read too, not only in
+    # client-side parse_milli calls
+    assert pod_milli(frac_pod("p", "250m")) == 250
+    assert pod_milli(frac_pod("p", "0.5")) == 500
+    with pytest.raises(ValueError):
+        pod_milli(frac_pod("p", "banana"))
+
+
+def test_string_stamp_schedules_end_to_end():
+    cluster = v5e8_cluster()
+    placed = cluster.schedule(frac_pod("s", "250m"))
+    assert cluster.pod_vchip(placed)[2] == 250
+    assert cluster.check_invariants() == []
+    cluster.release("s")
+    assert cluster.check_invariants() == []
+
+
+def test_rescheduled_fractional_pod_sheds_stale_milli_key():
+    """A pod object that was previously PLACED still carries its old
+    chip's /milli binding when it comes back through schedule (the
+    library boundary accepts re-submitted pod objects, like the
+    whole-chip grammar does) — the fill must shed the stale key, or
+    ``_account`` moves the share on BOTH chips and strands phantom
+    capacity on the new books."""
+    from kubetpu.core.group_scheduler import held_milli
+
+    cluster = v5e8_cluster()
+    cluster.schedule(frac_pod("a", 500))
+    vc = cluster.schedule(frac_pod("vc", 500))      # fills chip 0
+    old_coord = cluster.pod_vchip(vc)[1]
+    cluster.release("vc")
+    cluster.schedule(frac_pod("f", 500))            # re-fills chip 0
+    placed = cluster.schedule(vc)                   # still stamped w/ chip 0
+    assert cluster.pod_vchip(placed)[1] != old_coord
+    assert len(held_milli(placed)) == 1             # exactly one binding
+    assert cluster.check_invariants() == []
+
+
+# -- placement: bin-packing, rounding, exclusivity --------------------------
+
+
+def test_quarters_pack_one_chip_and_fill_exactly():
+    cluster = v5e8_cluster()
+    placed = [cluster.schedule(frac_pod(f"q{i}", 250)) for i in range(4)]
+    coords = {cluster.pod_vchip(p)[1] for p in placed}
+    assert len(coords) == 1          # best-fit concentrates the confetti
+    assert cluster.check_invariants() == []
+    # the chip is exactly full: a 1-milli crumb must land elsewhere
+    crumb = cluster.schedule(frac_pod("crumb", 1))
+    assert cluster.pod_vchip(crumb)[1] not in coords
+    assert cluster.check_invariants() == []
+
+
+def test_milli_rounding_999_plus_1_fills_exactly():
+    cluster = v5e8_cluster()
+    a = cluster.schedule(frac_pod("a", parse_milli("999m")))
+    b = cluster.schedule(frac_pod("b", parse_milli("1m")))
+    # best-fit: the 1m completes the 999m chip to exactly 1000
+    assert cluster.pod_vchip(a)[1] == cluster.pod_vchip(b)[1]
+    assert cluster.check_invariants() == []
+    occ = cluster.chip_occupancy()["frac-n0"]
+    assert any(f == 1.0 for f in occ.values())
+
+
+def test_fractional_chip_invisible_to_whole_placement():
+    cluster = v5e8_cluster()
+    cluster.schedule(frac_pod("f", 250))
+    # all 8 chips still advertise cards, but only 7 are whole-free:
+    # an 8-chip pod must not land on the fractionally-occupied chip
+    with pytest.raises(SchedulingError):
+        cluster.schedule(tpu_pod("whole8", 8))
+    placed = cluster.schedule(tpu_pod("whole7", 7))
+    assert placed.node_name == "frac-n0"
+    assert cluster.check_invariants() == []
+
+
+def test_whole_held_chip_refuses_fractions():
+    cluster = v5e8_cluster()
+    cluster.schedule(tpu_pod("whole8", 8))   # every chip whole-held
+    with pytest.raises(SchedulingError):
+        cluster.schedule(frac_pod("f", 1))
+    assert cluster.check_invariants() == []
+
+
+def test_mixing_whole_and_frac_in_one_pod_refused():
+    cluster = v5e8_cluster()
+    with pytest.raises(SchedulingError, match="cannot mix"):
+        cluster.schedule(tpu_pod("mixed", 1, **{FracKey: 250}))
+
+
+def test_malformed_frac_stamp_raises_value_error():
+    cluster = v5e8_cluster()
+    with pytest.raises(ValueError):
+        cluster.schedule(frac_pod("bad", 1500))
+
+
+def test_release_restores_exact_capacity():
+    cluster = v5e8_cluster()
+    pristine = free_snapshot(cluster)
+    placed = [cluster.schedule(frac_pod(f"f{i}", m))
+              for i, m in enumerate((250, 500, 125, 333))]
+    assert free_snapshot(cluster) != pristine
+    for p in placed:
+        cluster.release(p.name)
+    assert free_snapshot(cluster) == pristine
+    assert cluster.check_invariants() == []
+
+
+# -- preemption --------------------------------------------------------------
+
+
+def test_preempting_fractional_pods_restores_exact_capacity():
+    """A higher-priority whole-node pod evicts the fractional occupants;
+    the freed chips rejoin the whole pool at EXACTLY full capacity."""
+    cluster = v5e8_cluster()
+    pristine = free_snapshot(cluster)
+    lows = [cluster.schedule(frac_pod(f"low{i}", 500)) for i in range(16)]
+    assert cluster.check_invariants() == []
+    high = tpu_pod("high8", 8, **{PriorityKey: 10})
+    placed, evicted = cluster.schedule_preempting(high)
+    assert placed.node_name == "frac-n0"
+    assert len(evicted) == 16
+    assert cluster.check_invariants() == []
+    cluster.release("high8")
+    assert free_snapshot(cluster) == pristine
+
+
+def test_preemption_evicts_only_enough_fractions():
+    """A 1-chip preemptor needs ONE chip vacated — the greedy loop must
+    stop once a chip's occupants are gone, not clear the node."""
+    cluster = v5e8_cluster()
+    # two chips carry fractions (each 2x500m via best-fit); the other
+    # six are whole-held by mid-priority fillers
+    for i in range(4):
+        cluster.schedule(frac_pod(f"low{i}", 500))
+    for i in range(6):
+        cluster.schedule(tpu_pod(f"filler{i}", 1, **{PriorityKey: 5}))
+    placed, evicted = cluster.schedule_preempting(
+        tpu_pod("high1", 1, **{PriorityKey: 10}))
+    assert len(evicted) == 2           # one chip's worth of 500m shares
+    assert cluster.check_invariants() == []
+
+
+def test_fractional_preemptor_evicts_lower_priority_fraction():
+    cluster = v5e8_cluster()
+    # saturate every chip's milli with low-priority halves
+    lows = [cluster.schedule(frac_pod(f"low{i}", 500)) for i in range(16)]
+    assert len(lows) == 16
+    placed, evicted = cluster.schedule_preempting(
+        frac_pod("vip", 500, **{PriorityKey: 10}))
+    assert pod_milli(placed) == 500
+    assert len(evicted) >= 1
+    assert cluster.check_invariants() == []
+
+
+# -- gangs: capacity pre-filter, multislice coexistence ----------------------
+
+
+def test_fractional_gang_pins_single_slice_and_prefilters():
+    """An all-fractional gang is an ICI gang: the milli pre-filter must
+    skip a slice that provably lacks fractional capacity."""
+    cluster = Cluster()
+    for uid, prefix in (("podA", "a"), ("podB", "b")):
+        cluster.register_node(
+            f"{prefix}0",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-8", slice_uid=uid)))
+    # podA nearly full: 8 chips x 900m leaves 800 milli total
+    for i in range(8):
+        cluster.schedule(
+            frac_pod(f"fill{i}", 900),
+        )
+    gang = cluster.schedule_gang(
+        [frac_pod(f"g{i}", 600) for i in range(4)])
+    # 4x600m does not fit podA's 8x100m remainder -> whole gang on podB
+    homes = {p.node_name for p in gang}
+    assert len(homes) == 1
+    assert cluster.check_invariants() == []
+
+
+def test_fractional_and_multislice_stamps_coexist():
+    """Fractional confetti on both slices must not break a multislice
+    whole-chip gang, and the gang's pseudo-resources must not confuse
+    the fractional books."""
+    from kubetpu.scheduler.meshstate import GangSlicesKey
+
+    cluster = Cluster()
+    for uid, prefix in (("podA", "a"), ("podB", "b")):
+        for h in range(2):
+            cluster.register_node(
+                f"{prefix}{h}",
+                device=new_fake_tpu_dev_manager(
+                    make_fake_tpus_info(
+                        "v5e-16", host_index=h, slice_uid=uid)))
+    # a vChip on each slice
+    fracs = [cluster.schedule(frac_pod(f"vc{i}", 250)) for i in range(2)]
+    # a 16-chip gang must span both 8-chip-free... each v5e-16 host has
+    # 8 chips; slice = 16 chips, one chip per slice is fractional ->
+    # 15 whole-free per slice: an 8-pod x 3-chip gang (24 chips) needs
+    # the multislice escape hatch over the two slices
+    gang = cluster.schedule_gang([
+        tpu_pod(f"w{i}", 3, **{MultisliceKey: 2}) for i in range(8)])
+    assert len(gang) == 8
+    assert all(p.requests[GangSlicesKey] == 2 for p in gang)
+    assert cluster.check_invariants() == []
+    # fractional pods still release exactly under the gang
+    for p in fracs:
+        cluster.release(p.name)
+    assert cluster.check_invariants() == []
+
+
+# -- the packing oracle ------------------------------------------------------
+
+
+def test_check_invariants_catches_corrupted_milli():
+    cluster = v5e8_cluster()
+    placed = cluster.schedule(frac_pod("f", 250))
+    node = cluster.nodes["frac-n0"]
+    mkey = next(k for k in node.info.allocatable if k.endswith("/milli")
+                and node.info.allocatable[k] == MILLI_PER_CHIP - 250)
+    node.info.allocatable[mkey] += 100   # corrupt: free > cap - held
+    problems = cluster.check_invariants()
+    assert any("/milli" in p for p in problems)
+    node.info.allocatable[mkey] -= 100
+    assert cluster.check_invariants() == []
+    assert placed.node_name == "frac-n0"
+
+
+def test_check_invariants_catches_double_grammar_hold():
+    """A chip simultaneously whole-held and fractionally occupied is the
+    cardinal vChip violation."""
+    cluster = v5e8_cluster()
+    cluster.schedule(frac_pod("f", 250))
+    node = cluster.nodes["frac-n0"]
+    # forge a whole hold on the fractionally-occupied chip
+    mkey = next(k for k in node.info.allocatable if k.endswith("/milli")
+                and node.info.allocatable[k] == MILLI_PER_CHIP - 250)
+    ckey = mkey[: -len("/milli")] + "/cards"
+    forged = PodInfo(name="forged", running_containers={
+        "main": ContainerInfo(allocate_from={ckey: ckey})})
+    node.pods["forged"] = forged
+    node.info.allocatable[ckey] -= 1
+    node.info.allocatable[ResourceTPU] -= 1
+    problems = cluster.check_invariants()
+    assert any("whole-held AND carries" in p for p in problems)
+
+
+def test_status_and_occupancy_expose_fragmentation():
+    cluster = v5e8_cluster()
+    cluster.schedule(frac_pod("f", 400))
+    st = cluster.status()["nodes"]["frac-n0"]
+    assert st["frac_partial_chips"] == 1
+    assert st["free_milli"] == 8 * MILLI_PER_CHIP - 400
+    assert st["free_chips"] == 7          # the broken chip left the pool
+    occ = cluster.chip_occupancy()["frac-n0"]
+    assert sorted(occ.values(), reverse=True)[0] == pytest.approx(0.4)
+    # fill the chip exactly: a FULLY-packed chip strands nothing, so it
+    # leaves the fragmentation count (status and the CLI frag line agree
+    # on 0 < occupancy < 1.0 — the gauge renders packed and whole-held
+    # chips identically at 1.0, so "partial" must exclude both)
+    cluster.schedule(frac_pod("g", 600))
+    st = cluster.status()["nodes"]["frac-n0"]
+    assert st["frac_partial_chips"] == 0
+    assert st["free_milli"] == 7 * MILLI_PER_CHIP
+
+
+def test_controller_gauges_and_cli_frag_line():
+    """The Round-18 obs surface: per-chip occupancy gauges + the
+    fractional-allocations counter on the controller registry, and the
+    obs CLI's fragmentation line rendered from them."""
+    from kubetpu.cli.obs import render_summary
+    from kubetpu.wire.controller import ControllerServer
+
+    cluster = v5e8_cluster()
+    ctl = ControllerServer(cluster=cluster)
+    placed = [cluster.schedule(frac_pod(f"vc{i}", 250)) for i in range(3)]
+    with ctl._lock:
+        ctl._count_fractional(placed)
+        ctl._update_occupancy_gauges()
+    text = ctl.registry.render()
+    assert ('kubetpu_chip_occupancy_frac{node="frac-n0",chip="0"} 0.75'
+            in text)
+    assert "kubetpu_fractional_allocations_total 3" in text
+    out = render_summary(text, "controller")
+    assert "frag      partial_chips=1/8 mean_occ=0.75 frac_allocs=3" in out
+    # the legacy fleet gauges see the DERIVED exclusivity: the chip the
+    # three vChips broke is not whole-free, even though fractional
+    # accounting never touches the scalar tally
+    free, held = ctl._chip_totals(ResourceTPU)
+    assert (free, held) == (7, 1)
+    # a chip that leaves the fleet pins to 0.0, never a stale last-good
+    cluster.remove_node("frac-n0")
+    with ctl._lock:
+        ctl._update_occupancy_gauges()
+    text = ctl.registry.render()
+    assert ('kubetpu_chip_occupancy_frac{node="frac-n0",chip="0"} 0'
+            in text)
+
+
+def test_fractional_needs_mesh_geometry():
+    """A node without slice geometry (no tpu-slice key) cannot host
+    vChips — the milli advertisement rides the chip-coordinate
+    grammar."""
+    from kubetpu.api.types import NodeInfo
+
+    cluster = Cluster()
+    info = NodeInfo(name="flat")
+    info.kube_alloc[ResourceTPU] = 4
+    info.kube_cap[ResourceTPU] = 4
+    info.capacity[ResourceTPU] = 4
+    info.allocatable[ResourceTPU] = 4
+    cluster.register_node("flat", node_info=info)
+    with pytest.raises(SchedulingError):
+        cluster.schedule(frac_pod("f", 250))

@@ -111,6 +111,25 @@ def test_unschedulable_is_409(stack):
     assert e.value.code == 409
 
 
+def test_malformed_vchip_stamp_is_400(stack):
+    """A vChip stamp outside the milli grammar is the CLIENT's error: a
+    deterministic 400 at the wire boundary (BadRequestError), never a
+    retryable-looking 500 from a ValueError escaping mid-schedule —
+    while a well-formed fractional pod still places."""
+    from kubetpu.scheduler.meshstate import FracKey
+
+    controller, _agents = stack
+    bad = PodInfo(name="badfrac", requests={FracKey: "1500m"},
+                  running_containers={"main": ContainerInfo()})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(controller.address + "/pods", {"pod": pod_to_json(bad)})
+    assert e.value.code == 400
+    ok = PodInfo(name="okfrac", requests={FracKey: "250m"},
+                 running_containers={"main": ContainerInfo()})
+    out = _post(controller.address + "/pods", {"pod": pod_to_json(ok)})
+    assert len(out["placements"]) == 1
+
+
 def test_dead_agent_reconcile_reschedules(stack):
     controller, agents = stack
     out = _post(controller.address + "/pods", {"pod": pod_to_json(tpu_pod("job", 4))})

@@ -2,6 +2,7 @@
 full batched forward."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -117,6 +118,7 @@ def test_gqa_prefill_matches_forward():
     )
 
 
+@pytest.mark.slow
 def test_gqa_greedy_generate_matches_rescoring():
     """The grouped cached-attention decode path must agree with the full
     forward — for GQA (2 groups) and MQA (n_kv_heads=1)."""

@@ -28,6 +28,8 @@ def test_update_node_info_advertises_v5e8():
     for i in range(8):
         expected[_expected_chip_prefix(i) + "/cards"] = 1
         expected[_expected_chip_prefix(i) + "/memory"] = hbm
+        # Round-18 vChips: fractional capacity advertised per chip
+        expected[_expected_chip_prefix(i) + "/milli"] = 1000
     assert node.capacity == expected
     assert node.allocatable == expected
     assert node.kube_cap == {ResourceTPU: 8}

@@ -63,7 +63,10 @@ def test_masked_lm_loss_counts_only_masked_positions():
     assert loss > 0.0 and np.isfinite(loss)
 
 
+@pytest.mark.slow
 def test_mlm_train_step_learns_on_mesh():
+    # Slow: a real MLM train loop on an 8-way mesh; the loss-masking and
+    # forward-parity encoder pins stay tier-1.
     mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
     state, opt = init_state(jax.random.PRNGKey(0), CFG, mesh)
     step = make_mlm_train_step(CFG, mesh, MASK_ID, optimizer=opt)

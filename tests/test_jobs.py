@@ -301,7 +301,10 @@ def test_moe_capacity_drops_overflow():
     assert (tight_rows == 0.0).any()
 
 
+@pytest.mark.slow
 def test_moe_capacity_trains_on_ep_mesh():
+    # Slow: a second full MoE train loop on the ep mesh; the top2
+    # ep-mesh training test keeps the path tier-1.
     cfg = ModelConfig(
         vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
         n_experts=4, moe_capacity_factor=1.5,
@@ -355,9 +358,12 @@ def test_moe_aux_top_k_counts_secondary_assignments():
     )
 
 
+@pytest.mark.slow
 def test_moe_aux_loss_balances_router():
     """With the aux coefficient on, the loss gains a positive term that is
-    1.0*coeff*L for a perfectly uniform router and larger when collapsed."""
+    1.0*coeff*L for a perfectly uniform router and larger when collapsed.
+    Slow: compiles three MoE loss variants; the top2/capacity ep-mesh
+    training tests keep MoE tier-1 coverage."""
     from kubetpu.jobs.model import forward as fwd
 
     cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,

@@ -36,10 +36,13 @@ def test_lora_init_is_identity():
     np.testing.assert_array_equal(np.asarray(out_base), np.asarray(out_merged))
 
 
+@pytest.mark.slow
 def test_lora_trains_and_base_is_untouched():
     """Fine-tuning drops the loss while every base leaf stays frozen and
     only the adapters move; the merged export reproduces the trained
-    behavior."""
+    behavior.
+    Slow: a real train loop on an 8-way mesh; the structural pins
+    (identity init, targeting, spec coverage) stay tier-1."""
     mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
     base = init_params(jax.random.PRNGKey(0), CFG)
     base_snapshot = jax.tree.map(np.asarray, base)

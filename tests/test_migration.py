@@ -226,10 +226,13 @@ def test_restore_returns_none_when_full_and_rolls_back(params):
     dst.pop_result(blocker)
 
 
+@pytest.mark.slow
 def test_spec_server_gamma_ema_survive_handoff(params):
     """PagedSpeculativeDecodeServer: the adaptive-gamma EMA migrates
     with the stream (no optimistic reset on the target) and the
-    migrated stream's output stays greedy-exact."""
+    migrated stream's output stays greedy-exact.
+    Slow: boots two full spec servers (draft+target compiles on both
+    sides); the non-spec handoff paths keep tier-1 round trips."""
     dcfg = ModelConfig(vocab=64, d_model=16, n_layers=1, n_heads=2,
                        d_ff=32)
     dparams = init_params(jax.random.PRNGKey(7), dcfg)

@@ -89,6 +89,7 @@ def test_flash_in_train_step():
     np.testing.assert_allclose(float(loss_flash), float(loss_dense), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_noncausal_flash_matches_dense_bidirectional():
     """flash_attention(causal=False): the encoder-style full-visibility
     core must match a plain softmax over ALL positions, forward and grad."""

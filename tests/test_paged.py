@@ -109,6 +109,37 @@ def test_warmup_with_unaligned_max_seq(params):
     assert server.pages_in_use() == 0
 
 
+def test_pool_frac_partitions_pool_honestly(params):
+    """Round-18 vChips: ``pool_frac`` SIZES the pool to the replica's
+    chip share — N packed replicas on one chip partition the page
+    budget, the /load signal reflects it, and greedy tokens are
+    unchanged (capacity, never results)."""
+    full = PagedDecodeServer(CFG, params, n_slots=2, max_seq=32,
+                             max_new_tokens=8, page_size=8, n_pages=64)
+    quarter = PagedDecodeServer(CFG, params, n_slots=2, max_seq=32,
+                                max_new_tokens=8, page_size=8, n_pages=64,
+                                pool_frac=0.25)
+    assert quarter.pool_pages == 16
+    assert quarter.k_pages.shape[1] == 16    # the arrays ARE smaller
+    info = quarter.load_info()
+    assert info["pool_pages"] == 16
+    assert info["pool_frac"] == 0.25
+    assert "pool_frac" not in full.load_info()   # whole-chip: implicit
+    assert 'kubetpu_serving_pool_frac 0.25' in quarter.metrics_text()
+    prompts = [[3, 14, 15, 9, 2, 6], [26, 5]]
+    out = {}
+    for tag, server in (("full", full), ("quarter", quarter)):
+        rids = [server.enqueue(p) for p in prompts]
+        server.drain()
+        out[tag] = [server.pop_result(r) for r in rids]
+        server.check_invariants()
+    assert out["full"] == out["quarter"]
+    with pytest.raises(ValueError):
+        PagedDecodeServer(CFG, params, pool_frac=0.0)
+    with pytest.raises(ValueError):
+        PagedDecodeServer(CFG, params, pool_frac=1.5)
+
+
 def test_pool_smaller_than_worst_case_rejects_up_front(params):
     """A request whose worst case exceeds the WHOLE pool must raise at
     enqueue/submit — accepted-but-never-admittable would park the queue
@@ -484,9 +515,12 @@ def test_int8_page_pool_parity_and_bytes(trained_small):
     assert q8k._c_kernel_steps.value > 0
 
 
+@pytest.mark.slow
 def test_int8_windowed_paged_triple_composition(trained_small):
     """window x paged ring x int8 pool all at once: token-exact vs the
-    dense banded DecodeServer — every memory feature stacked."""
+    dense banded DecodeServer — every memory feature stacked.
+    Slow: the triple composition compiles its own server variant; each
+    pairwise composition keeps a tier-1 parity pin."""
     import dataclasses
 
     tcfg, params, data = trained_small

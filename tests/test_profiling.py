@@ -3,12 +3,16 @@
 import os
 
 import jax
+import pytest
 import jax.numpy as jnp
 
 from kubetpu.jobs.profiling import StepTimer, trace
 
 
+@pytest.mark.slow
 def test_trace_writes_profile(tmp_path):
+    # Slow: real profiler trace write + parse round trip; the StepTimer
+    # and coverage pins keep profiling tier-1.
     @jax.jit
     def f(x):
         return x @ x

@@ -3,6 +3,7 @@ savings, and end-to-end decode through quantized params (plain generate +
 both serving servers accept a quantized tree transparently)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -103,10 +104,13 @@ def test_serving_servers_accept_quantized_params():
 # trained_small: the SESSION-scoped shared fixture in conftest.py
 
 
+@pytest.mark.slow
 def test_kv_int8_quality_contract_on_trained_model(trained_small):
     """The VERDICT r4 #8 contract: on a TRAINED model, int8-cache greedy
     decode agrees with the bf16 cache token-for-token, and the one-step
-    logits stay within a small tolerance of the bf16-cache logits."""
+    logits stay within a small tolerance of the bf16-cache logits.
+    Slow: full greedy decode twice on the trained fixture; the random-
+    params int8 parity + byte-halving pins stay tier-1."""
     cfg, params, data = trained_small
     prompt = jnp.asarray(data[0][0][:4, :12])
     ref = make_generate(cfg)(params, prompt, jax.random.PRNGKey(0), 32)

@@ -208,9 +208,11 @@ def _run_race_soak(params, tmp_path, seconds, fault_rate, seed,
     return stats, injected
 
 
+@pytest.mark.slow
 def test_race_soak_short(params, tmp_path):
-    """Tier-1 soak: ~2.5s of concurrent step+scrape at >= 10% injected
-    faults, token-exact vs serial, clean pool + counters."""
+    """Short soak (make chaos / unfiltered runs — slow-marked for the
+    tier-1 wall budget): ~2.5s of concurrent step+scrape at >= 10%
+    injected faults, token-exact vs serial, clean pool + counters."""
     _run_race_soak(params, tmp_path, seconds=2.5, fault_rate=0.12,
                    seed=4242)
 

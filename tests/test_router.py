@@ -401,6 +401,130 @@ def test_replica_client_error_passes_through_without_failover(fleet):
     assert router._c_uperr.value == 0
 
 
+def test_autoscaler_launcher_receives_vchip_share(fleet):
+    """Round-18: a ``launcher(role, frac)`` is handed the pool's
+    ``vchip_frac`` so a scale-up boots a PACKED fractional replica; a
+    zero-arg launcher keeps today's whole-chip behavior; and a
+    fractional policy with a share-blind launcher fails loudly instead
+    of silently booting whole-chip replicas."""
+    router, replicas = fleet(n=1)
+    launched = []
+
+    def launcher(role, frac):
+        launched.append((role, frac))
+        fake = FakeSlotServer()
+        rep = ReplicaServer(fake, f"vc{len(launched)}", idle_wait=0.002)
+        rep.start()
+        launched_reps.append(rep)
+        return rep.address
+
+    launched_reps = []
+    scaler = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=3, up_after=1,
+                           cooldown_s=0.0, vchip_frac=0.25))
+    replicas[0][1].load_override = {"queue_wait_p99_ms": 9999.0}
+    res = scaler.poll_once()
+    assert res["action"] and res["action"].startswith("scale_up:")
+    assert launched == [("both", 0.25)]
+    for rep in launched_reps:
+        rep.shutdown(graceful=False)
+
+
+def test_autoscaler_zero_arg_launcher_keeps_whole_chip_default(fleet):
+    router, replicas = fleet(n=1)
+    launched = []
+
+    def launcher():
+        fake = FakeSlotServer()
+        rep = ReplicaServer(fake, f"z{len(launched)}", idle_wait=0.002)
+        rep.start()
+        launched.append(rep)
+        return rep.address
+
+    scaler = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=3, up_after=1,
+                           cooldown_s=0.0))          # vchip_frac=1.0
+    replicas[0][1].load_override = {"queue_wait_p99_ms": 9999.0}
+    res = scaler.poll_once()
+    assert res["action"] and res["action"].startswith("scale_up:")
+    assert len(launched) == 1
+    for rep in launched:
+        rep.shutdown(graceful=False)
+
+
+def test_autoscaler_legacy_two_param_launcher_not_fed_the_share(fleet):
+    """A pre-Round-18 ``launcher(role, port_base=9000)`` (defaulted
+    second extra) was called with ONE arg — raw arity must not start
+    feeding 1.0 into its unrelated parameter. Only a REQUIRED second
+    positional (or one named for the share) receives vchip_frac."""
+    router, replicas = fleet(n=1)
+    launched = []
+    launched_reps = []
+
+    def launcher(role, port_base=9000):
+        launched.append((role, port_base))
+        fake = FakeSlotServer()
+        rep = ReplicaServer(fake, f"lg{len(launched)}", idle_wait=0.002)
+        rep.start()
+        launched_reps.append(rep)
+        return rep.address
+
+    scaler = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=3, up_after=1,
+                           cooldown_s=0.0))          # vchip_frac=1.0
+    replicas[0][1].load_override = {"queue_wait_p99_ms": 9999.0}
+    res = scaler.poll_once()
+    assert res["action"] and res["action"].startswith("scale_up:")
+    assert launched == [("both", 9000)]   # default intact, no 1.0 fed in
+    for rep in launched_reps:
+        rep.shutdown(graceful=False)
+    # and under a FRACTIONAL policy the same launcher is share-blind:
+    # loud scale_error, never 0.5 silently bound to port_base
+    launched.clear()
+    scaler2 = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=3, up_after=1,
+                           cooldown_s=0.0, vchip_frac=0.5))
+    replicas[0][1].load_override = {"queue_wait_p99_ms": 9999.0}
+    scaler2.poll_once()
+    assert launched == []                 # never called with the share
+    errs = [e for e in router.events.events() if e["kind"] == "scale_error"]
+    assert errs and "launcher(role, frac)" in errs[-1]["error"]
+
+
+def test_autoscaler_fractional_policy_refuses_share_blind_launcher(fleet):
+    """vchip_frac < 1 with a launcher that cannot receive the share
+    would strand (1 - frac) of every chip while the config claims
+    packing — the pass must scale_error, not launch."""
+    router, replicas = fleet(n=1)
+    launched = []
+
+    def launcher(role):                  # role-aware but share-blind
+        launched.append(role)
+        return "http://127.0.0.1:1"
+
+    scaler = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=3, up_after=1,
+                           cooldown_s=0.0, vchip_frac=0.5))
+    replicas[0][1].load_override = {"queue_wait_p99_ms": 9999.0}
+    res = scaler.poll_once()
+    assert res["action"] is None
+    assert launched == []
+    errs = [e for e in router.events.events() if e["kind"] == "scale_error"]
+    assert errs and "launcher(role, frac)" in errs[-1]["error"]
+
+
+def test_scale_policy_rejects_bad_vchip_frac():
+    with pytest.raises(ValueError):
+        ScalePolicy(vchip_frac=0.0)
+    with pytest.raises(ValueError):
+        ScalePolicy(vchip_frac=1.5)
+
+
 def test_autoscaler_reaps_dead_and_scale_up_gate_uses_alive(fleet):
     """A breaker-DEAD replica is reaped from the pool/ring, and the
     max_replicas gate counts ALIVE capacity — a dead handle must not

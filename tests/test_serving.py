@@ -3,6 +3,7 @@ produce exactly the same tokens as a dedicated plain greedy decode — slot
 sharing, reuse, and uneven positions must be invisible to every request."""
 
 import jax
+import pytest
 import numpy as np
 
 from kubetpu.jobs import ModelConfig, init_params
@@ -102,10 +103,13 @@ def test_pop_result_evicts_bookkeeping():
         server.pop_result(rid)      # evicted
 
 
+@pytest.mark.slow
 def test_bucketed_prefill_exact_for_same_bucket_lengths():
     """Prompt lengths 5, 6, 7 all pad to the 8-bucket; each must still
     match its dedicated greedy decode exactly (pads never influence real
-    positions: causal masks forward, overwrite-before-read in decode)."""
+    positions: causal masks forward, overwrite-before-read in decode).
+    Slow: three dedicated-reference decodes back to back; warmup +
+    parity tests keep the bucket path pinned in tier-1."""
     params = init_params(jax.random.PRNGKey(0), CFG)
     server = DecodeServer(CFG, params, n_slots=3, max_seq=64, max_new_tokens=4)
     prompts = [[11, 3, 5, 60, 2], [1, 2, 3, 4, 5, 6], [9, 8, 7, 6, 5, 4, 3]]
@@ -148,6 +152,7 @@ def test_enqueue_admits_at_step_boundary_without_blocking():
     assert stats["admission_stall"]["p50_ms"] >= 0
 
 
+@pytest.mark.slow
 def test_warmup_precompiles_every_bucket():
     """After warmup, admissions hit cached executables: no admission may
     take compile-scale time (compiles are >100x a cached dispatch)."""

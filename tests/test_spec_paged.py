@@ -45,10 +45,13 @@ def _staggered(server, prompts):
     return [server.result(r) for r in (ra, rb, rc)]
 
 
+@pytest.mark.slow
 def test_paged_spec_matches_plain_paged_greedy_staggered(params):
     """Same tokens as PagedDecodeServer for staggered requests crossing
     page boundaries mid-decode — speculation through the pool must be
-    invisible in the output stream."""
+    invisible in the output stream.
+    Slow: the kv_int8 staggered variant keeps the same tier-1 parity
+    path through the pool (plus spec-check's seeded storms)."""
     t, _d = params
     prompts = [[3, 14, 15, 9, 2, 6], [26, 5], [35, 8, 9, 7, 9, 3, 2, 1, 4]]
     plain = PagedDecodeServer(CFG, t, n_slots=2, max_seq=64,

@@ -67,6 +67,7 @@ def test_spec_server_self_draft_accepts_everything(params):
     assert srv.mean_tokens_per_round() > 2.0
 
 
+@pytest.mark.slow
 def test_spec_server_eos_and_queue(params):
     """EOS emitted mid-round clips the request there; queued requests
     enter freed slots at round boundaries."""
@@ -123,9 +124,12 @@ def test_spec_server_queue_ttl_and_queue_wait(params):
     assert stats["queue_wait"]["count"] == 2
 
 
+@pytest.mark.slow
 def test_spec_server_exports_round_metrics(params):
     """Round/acceptance counters + the tokens-per-round gauge land on
-    the serving registry (the obs satellite of Round 10)."""
+    the serving registry (the obs satellite of Round 10).
+    Slow: boots its own spec server just for the metrics surface; the
+    greedy-parity spec tests keep the serve path tier-1."""
     t, _d = params
     srv = SpeculativeDecodeServer(CFG, CFG, t, t, n_slots=1, max_seq=64,
                                   max_new_tokens=9, gamma=3)
@@ -147,11 +151,14 @@ def test_spec_server_exports_round_metrics(params):
         srv.mean_tokens_per_round())
 
 
+@pytest.mark.slow
 def test_spec_server_acceptance_sustains_over_long_generation(params):
     """Self-draft acceptance must hold the gamma+1 ceiling across MANY
     rounds — regression for the draft-cache hole: the scan fed only
     [last, d_0..d_{gamma-2}], so a fully-accepted round left position
-    pos+gamma unwritten in the draft cache and acceptance decayed."""
+    pos+gamma unwritten in the draft cache and acceptance decayed.
+    Slow: a long-generation soak by construction; short-round parity
+    tests keep the draft-cache path tier-1."""
     t, _d = params
     srv = SpeculativeDecodeServer(CFG, CFG, t, t, n_slots=1, max_seq=128,
                                   max_new_tokens=41, gamma=3)

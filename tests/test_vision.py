@@ -58,7 +58,10 @@ def test_vit_flash_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_vit_train_step_learns_on_mesh():
+    # Slow: a real ViT train loop on a mesh; forward-parity + the
+    # synthetic-images learning test keep vision training tier-1.
     mesh = make_mesh({"dp": 2, "sp": 1, "tp": 2})
     state, opt = init_vit_state(jax.random.PRNGKey(0), CFG, mesh)
     # blocks tp-sharded via the shared spec tree
