@@ -49,7 +49,7 @@ test: tpuinfo gpuinfo dataio
 # still fails the round).
 .PHONY: chaos
 chaos: lint obs-check prefix-check spec-check router-check migrate-check \
-		disagg-check pack-check bench-gate-smoke
+		disagg-check pack-check tier-check bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py \
 		tests/test_race_soak.py -q
 
@@ -144,6 +144,16 @@ migrate-check:
 .PHONY: pack-check
 pack-check:
 	python scripts/pack_check.py
+
+# tiered-KV-cache oracle (Round-19): HBM -> host spill/fill parity on a
+# 3-family storm overflowing the HBM tree budget, cross-replica span
+# fetch under >=10% injected drop/503/partial on the /prefix_fetch leg
+# (parity always; the fetch ledger accounts for every attempt), and the
+# dark-peer / retry-budget degrade probes — tiering may only REMOVE
+# prefill work, never change a token
+.PHONY: tier-check
+tier-check:
+	python scripts/tier_check.py
 
 # disaggregated prefill/decode oracle (Round-17): router + 1 prefill +
 # 2 decode replicas under >=10% injected faults on the KV-stream leg —

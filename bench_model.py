@@ -734,6 +734,128 @@ def prefix_reuse_storm(cfg, n_slots=4, sys_len=192, tail_len=8,
     return run(0), run(cache_pages)
 
 
+def tiering_storm(cfg, n_families=4, sys_len=96, tail_len=8, rounds=3,
+                  max_new=6, page_size=16, prefill_budget=32, n_slots=2,
+                  host_budget=64 << 20,
+                  arms=("no_tier", "host", "host_peer")):
+    """Round-19 headline: a shared-prefix WORKING SET four times the HBM
+    prefix-tree budget — *n_families* system prompts round-robined for
+    *rounds*, with an HBM tree sized for ONE family. Without the tier
+    every arrival finds its family evicted and cold-prefills; with the
+    host tier LRU victims spill to host DRAM and fill back on return,
+    so steady-state arrivals prefill only their tail; the ``host_peer``
+    arm starts a COLD replica next to a warm one and pulls each
+    family's first span over ``/prefix_fetch`` (router-hinted peer
+    tier) before falling into the same host/HBM rhythm. Reports the
+    server's OWN ttft histogram (the peer arm's pre-admission fetch
+    rides outside it — its row carries the fetch ledger instead), hit
+    rate, and per-tier spill/fill/savings counts. Requests drive
+    serially so TTFT isolates prefill work, not slot scheduling."""
+    import dataclasses
+    import random as _random
+
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.paged import PagedDecodeServer
+    from kubetpu.router import ReplicaServer
+    from kubetpu.wire.httpcommon import request_json
+
+    dcfg = dataclasses.replace(cfg, remat=False)
+    params = init_params(jax.random.PRNGKey(0), dcfg)
+    rng = _random.Random(0)
+    families = [[rng.randrange(1, dcfg.vocab) for _ in range(sys_len)]
+                for _ in range(n_families)]
+    prompts = []
+    for _ in range(rounds):
+        for fam in families:
+            prompts.append(fam + [rng.randrange(1, dcfg.vocab)
+                                  for _ in range(tail_len)])
+    cache_pages = sys_len // page_size      # ONE family fits; the set doesn't
+    max_seq = -(-(sys_len + tail_len + max_new + 2)
+                // page_size) * page_size
+    n_pages = (n_slots * ((max_seq + page_size - 1) // page_size)
+               + cache_pages)
+
+    def make_server(host_bytes):
+        return PagedDecodeServer(
+            dcfg, params, n_slots=n_slots, max_seq=max_seq,
+            max_new_tokens=max_new, page_size=page_size, n_pages=n_pages,
+            prefill_budget=prefill_budget, prefix_cache_pages=cache_pages,
+            host_tier_bytes=host_bytes)
+
+    def row(arm, server, extra=None):
+        stats = server.metrics_summary()
+        reuse = server.prefix_cache_stats()
+        tier = server.tier_stats()
+        server.check_invariants()   # the pool oracle rides the bench
+        out = {
+            "metric": "tiering_storm",
+            "arm": arm,
+            "value": round(stats["ttft"]["p50_ms"], 3),
+            "unit": "server-recorded ttft p50 ms",
+            "ttft_p99_ms": round(stats["ttft"]["p99_ms"], 3),
+            "hit_rate": round(reuse.get("hit_rate", 0.0), 3),
+            "prefill_tokens_saved": reuse.get("prefill_tokens_saved", 0),
+            "working_set_pages": n_families * cache_pages,
+            "cache_pages": cache_pages,
+            "n_families": n_families,
+            "rounds": rounds,
+            "requests": len(prompts),
+        }
+        if tier.get("enabled"):
+            out["tier_spills"] = tier["spills"]
+            out["tier_fills"] = tier["fills"]
+            out["tier_tokens_saved"] = tier["tokens_saved"]
+        if extra:
+            out.update(extra)
+        return out
+
+    out_rows = []
+    for arm in arms:
+        if arm in ("no_tier", "host"):
+            server = make_server(0 if arm == "no_tier" else host_budget)
+            server.warmup()
+            for p in prompts:
+                rid = server.enqueue(p)
+                server.drain()
+                server.pop_result(rid)
+            out_rows.append(row(arm, server))
+            continue
+        # host_peer: a cold replica next to a warm one; every family's
+        # FIRST arrival pulls its span over the wire instead of cold-
+        # prefilling, later arrivals ride the local host/HBM tiers
+        warm_srv = make_server(host_budget)
+        cold_srv = make_server(host_budget)
+        warm_srv.warmup()
+        cold_srv.warmup()
+        ra = ReplicaServer(warm_srv, "tier-warm", idle_wait=0.002)
+        rb = ReplicaServer(cold_srv, "tier-cold", idle_wait=0.002)
+        ua = ra.start()
+        rb.start()
+        try:
+            for i, fam in enumerate(families):
+                request_json(ua + "/generate",
+                             {"prompt": fam + [1], "timeout": 120.0},
+                             idempotency_key=f"tiering-warm-{i}",
+                             timeout=120.0)
+            for i, p in enumerate(prompts):
+                request_json(rb.address + "/generate",
+                             {"prompt": p, "prefix_peer": ua,
+                              "timeout": 120.0},
+                             idempotency_key=f"tiering-peer-{i}",
+                             timeout=120.0)
+            fetches = {
+                result: int(cold_srv.obs.counter(
+                    "kubetpu_peer_prefix_fetch_total",
+                    result=result).value)
+                for result in ("hit", "miss", "degraded")}
+            out_rows.append(row(arm, cold_srv,
+                                extra={"peer_fetches": fetches}))
+        finally:
+            ra.shutdown(graceful=False)
+            rb.shutdown(graceful=False)
+    return tuple(out_rows)
+
+
 def _pooled_latency_ms(servers, op, pct):
     """Percentile over EVERY server's raw latency reservoir for *op*
     (exact below cap) — the fleet-wide number the router and migration
@@ -1762,6 +1884,20 @@ def main() -> int:
                 window_s=1.2 if args.smoke else 3.0,
                 n_slots=2,
                 pack=4):
+            emit(row)
+        # Round-19: tiered KV cache — a working set 4x the HBM tree
+        # budget; LRU victims spill to host DRAM and fill back on
+        # return (host arm) or arrive over /prefix_fetch from a warm
+        # peer (host_peer arm) instead of cold-prefilling
+        for row in tiering_storm(
+                cfg,
+                n_families=4,
+                sys_len=96 if args.smoke else 512,
+                tail_len=8 if args.smoke else 32,
+                rounds=3 if args.smoke else 4,
+                max_new=4 if args.smoke else 16,
+                page_size=16,
+                prefill_budget=32 if args.smoke else 256):
             emit(row)
         emit(spec_serving_throughput(cfg, n_slots=2 if args.smoke else 4,
                                      prompt_len=16 if args.smoke else 128,

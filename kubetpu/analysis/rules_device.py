@@ -90,6 +90,18 @@ HOT_BARRIERS = {
     "snapshot_pages",
     "_gather_page_span",
     "prefill_progress",
+    # Round-19 tiered KV cache: spill (device->host gather of evicted
+    # tree pages), fill (host->device upload on a host-tier match), and
+    # the peer import/export legs are all barrier legs — they run at
+    # admission / eviction / on the wire thread, never inside a steady-
+    # state step, and the gather/upload IS each leg's designed transfer.
+    "_tree_reclaim",
+    "_gather_phys_pages",
+    "_fill_host_prefix",
+    "_fill_host_node",
+    "_upload_host_pages",
+    "export_prefix_span",
+    "inject_prefix",
 }
 
 # host-sync / host-upload constructs (the same set the PR 5/6 runtime

@@ -256,6 +256,39 @@ def render_summary(metrics_text: str, source: str) -> str:
             f"ambiguous={handoffs.get('ambiguous', 0)}  "
             f"pages_streamed={int(streamed)} "
             f"overlap={overlap:.2f}")
+
+    # Round-19 tiered KV cache (present when any scraped replica has a
+    # host tier): per-tier admission hits summed across the fleet, host
+    # spill/fill traffic, resident host bytes, and the peer-fetch ledger
+    tier_hits: Dict[str, int] = {}
+    for labels, v in idx.get("kubetpu_prefix_tier_hits_total", []):
+        tier = labels.get("tier")
+        if tier:
+            tier_hits[tier] = tier_hits.get(tier, 0) + int(v)
+    if tier_hits:
+        spills = sum(int(v) for _labels, v in
+                     idx.get("kubetpu_prefix_tier_spills_total", []))
+        fills: Dict[str, int] = {}
+        for labels, v in idx.get("kubetpu_prefix_tier_fills_total", []):
+            tier = labels.get("tier")
+            if tier:
+                fills[tier] = fills.get(tier, 0) + int(v)
+        host_bytes = sum(v for _labels, v in
+                         idx.get("kubetpu_prefix_host_bytes", []))
+        fetches = {labels.get("result"): int(v) for labels, v in
+                   idx.get("kubetpu_peer_prefix_fetch_total", [])}
+        lines.append(
+            "tiering   hits " + "  ".join(
+                f"{t}={tier_hits.get(t, 0)}"
+                for t in ("hbm", "host", "peer"))
+            + f"  spills={spills} "
+            f"fills host={fills.get('host', 0)} peer={fills.get('peer', 0)} "
+            f"host_bytes={host_bytes / 1e6:.1f}MB")
+        if fetches:
+            lines.append(
+                f"tiering   peer_fetch hit={fetches.get('hit', 0)} "
+                f"miss={fetches.get('miss', 0)} "
+                f"degraded={fetches.get('degraded', 0)}")
     return "\n".join(lines)
 
 

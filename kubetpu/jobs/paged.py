@@ -508,11 +508,18 @@ class PagedDecodeServer(SlotServerBase):
         queue_ttl: Optional[float] = None,
         prefix_cache_pages: int = 0,
         pool_frac: float = 1.0,
+        host_tier_bytes: int = 0,
     ) -> None:
         if not 0.0 < pool_frac <= 1.0:
             raise ValueError("pool_frac must be in (0, 1]")
         if prefix_cache_pages < 0:
             raise ValueError("prefix_cache_pages must be >= 0 (0 = off)")
+        if host_tier_bytes < 0:
+            raise ValueError("host_tier_bytes must be >= 0 (0 = off)")
+        if host_tier_bytes and not prefix_cache_pages:
+            raise ValueError(
+                "host_tier_bytes needs prefix_cache_pages > 0 — the host "
+                "tier spills FROM the HBM prefix tree")
         if prefix_cache_pages and cfg.window > 0:
             raise ValueError(
                 "prefix_cache_pages is incompatible with windowed serving: "
@@ -599,8 +606,13 @@ class PagedDecodeServer(SlotServerBase):
         # leading table rows are shared (read-only) mappings, the pinned
         # deepest-match node, and the prompt to publish at retirement
         self.prefix_cache_pages = int(prefix_cache_pages)
+        # Round-19: byte budget for the eviction-to-host DRAM tier (0 =
+        # off) — LRU victims spill their stored-layout pages into host
+        # buffers instead of dropping, and a later match fills them back
+        self.host_tier_bytes = int(host_tier_bytes)
         self._prefix_cache = (
-            RadixPrefixCache(page_size, self.prefix_cache_pages)
+            RadixPrefixCache(page_size, self.prefix_cache_pages,
+                             host_budget_bytes=self.host_tier_bytes)
             if self.prefix_cache_pages else None
         )
         self._slot_shared = [0] * n_slots
@@ -608,7 +620,7 @@ class PagedDecodeServer(SlotServerBase):
         self._slot_prompt: List[Optional[List[int]]] = [None] * n_slots
         # (matched, start) from the slot's LAST _prefill_start, committed
         # to the reuse counters only when the admission completes
-        self._slot_pending_stats: List[Optional[Tuple[int, int]]] = (
+        self._slot_pending_stats: List[Optional[Tuple[int, int, int]]] = (
             [None] * n_slots)
         if self._prefix_cache is not None:
             self._c_hit_tokens = self.obs.counter(
@@ -630,6 +642,32 @@ class PagedDecodeServer(SlotServerBase):
                               lambda: self._prefix_cache.total_pages)
             self.obs.gauge_fn("kubetpu_prefix_tree_nodes",
                               lambda: self._prefix_cache.n_nodes())
+            # Round-19 tier counters: per-tier pages hit at admission,
+            # pages filled back into the pool, pages spilled out of it,
+            # and bytes moved across each tier boundary
+            self._c_tier_hits = {
+                t: self.obs.counter("kubetpu_prefix_tier_hits_total",
+                                    tier=t)
+                for t in ("hbm", "host", "peer")}
+            self._c_tier_fills = {
+                t: self.obs.counter("kubetpu_prefix_tier_fills_total",
+                                    tier=t)
+                for t in ("host", "peer")}
+            self._c_tier_spills = {
+                "host": self.obs.counter(
+                    "kubetpu_prefix_tier_spills_total", tier="host")}
+            self._c_tier_bytes = {
+                t: self.obs.counter("kubetpu_prefix_tier_bytes_total",
+                                    tier=t)
+                for t in ("hbm", "host", "peer")}
+            self._c_tier_saved = {
+                t: self.obs.counter(
+                    "kubetpu_prefix_tier_tokens_saved_total", tier=t)
+                for t in ("hbm", "host", "peer")}
+            self.obs.gauge_fn("kubetpu_prefix_host_bytes",
+                              lambda: self._prefix_cache.host_bytes)
+            self.obs.gauge_fn("kubetpu_prefix_host_nodes",
+                              lambda: len(self._prefix_cache.host_nodes()))
 
         # -- attention cores (Round-15): under use_kernel the decode step
         # AND the chunk paths (prefill, speculative verify) walk the page
@@ -734,12 +772,7 @@ class PagedDecodeServer(SlotServerBase):
         have = int((self._table[slot] >= 0).sum())
         short = (need - have) - len(self._free)
         if short > 0 and self._prefix_cache is not None:
-            reclaimed = self._prefix_cache.evict(short)
-            if reclaimed:
-                self._free.extend(reclaimed)
-                self._c_evicted.inc(len(reclaimed))
-                self.events.emit("prefix_evict", pages=len(reclaimed),
-                                 reason="pool_pressure")
+            self._tree_reclaim(short, reason="pool_pressure")
         if need - have > len(self._free):
             return False
         if need > have:
@@ -799,11 +832,19 @@ class PagedDecodeServer(SlotServerBase):
         # never actually skipped)
         pending = self._slot_pending_stats[slot]
         if pending is not None:
-            matched, start = pending
+            matched, start, host_tokens = pending
             if start > 0:
                 self._c_req_hit.inc()
                 self._c_hit_tokens.inc(matched)
                 self._c_saved_tokens.inc(start)
+                # Round-19 tier attribution: tokens promoted from the
+                # host tier DURING this admission are host-tier savings;
+                # the rest of the mapped prefix was already HBM-resident
+                ps = self.page_size
+                self._c_tier_hits["host"].inc(host_tokens // ps)
+                self._c_tier_saved["host"].inc(host_tokens)
+                self._c_tier_hits["hbm"].inc((start - host_tokens) // ps)
+                self._c_tier_saved["hbm"].inc(start - host_tokens)
             else:
                 self._c_req_miss.inc()
             self._slot_pending_stats[slot] = None
@@ -832,14 +873,20 @@ class PagedDecodeServer(SlotServerBase):
         the last prompt token must be FORWARDED (not just cached) to
         produce the logits that sample the first new token — its page, if
         cached, is recomputed into a private page instead of written into
-        (the COW boundary rule)."""
+        (the COW boundary rule).
+
+        Round-19: host-tier spans covering the prompt are FILLED back
+        into the pool first (``_fill_host_prefix``, a barrier leg), so
+        the HBM match below sees them — a warm-host admission starts at
+        the same ``pos`` a warm-HBM one would, token-exact vs cold."""
         if self._prefix_cache is None:
             return 0
         ps = self.page_size
+        host_tokens = self._fill_host_prefix(prompt)
         matched, pages, node = self._prefix_cache.match(prompt)
         start = min(matched, ((len(prompt) - 1) // ps) * ps)
         if start <= 0:
-            self._slot_pending_stats[slot] = (matched, 0)
+            self._slot_pending_stats[slot] = (matched, 0, 0)
             return 0
         use = start // ps
         self._table[slot, :use] = np.asarray(pages[:use], np.int32)
@@ -847,9 +894,11 @@ class PagedDecodeServer(SlotServerBase):
         self._slot_shared[slot] = use
         self._prefix_cache.pin(node)
         self._slot_pin[slot] = node
-        self._slot_pending_stats[slot] = (matched, start)
+        self._slot_pending_stats[slot] = (matched, start,
+                                          min(host_tokens, start))
         self.events.emit("prefix_hit", slot=slot, matched_tokens=matched,
-                         prefill_start=start, pages=use)
+                         prefill_start=start, pages=use,
+                         host_filled_tokens=min(host_tokens, start))
         return start
 
     def _prefix_unmap(self, slot: int) -> None:
@@ -884,18 +933,280 @@ class PagedDecodeServer(SlotServerBase):
         need = tree.missing_pages(tokens)
         over = tree.total_pages + need - tree.max_pages
         if over > 0:
-            reclaimed = tree.evict(over)
-            if reclaimed:
-                self._free.extend(reclaimed)
-                self._c_evicted.inc(len(reclaimed))
-                self.events.emit("prefix_evict", pages=len(reclaimed),
-                                 reason="budget")
+            self._tree_reclaim(over, reason="budget")
         consumed = tree.insert(tokens, pages)
         if consumed:
             self._c_inserted.inc(len(consumed))
             self.events.emit("prefix_publish", slot=slot,
                              pages=len(consumed))
         return consumed
+
+    # -- tiered KV cache: HBM -> host DRAM -> peer replicas (Round-19) -------
+
+    def _tree_reclaim(self, n_pages: int, reason: str) -> List[int]:
+        """Evict >= *n_pages* from the prefix tree into the free list —
+        the one reclaim path pool pressure and the publish budget share.
+        With the host tier on, victims SPILL: their stored-layout KV is
+        gathered into host buffers under ``host_tier_bytes`` before the
+        pages free, so the prefix survives eviction at host-DRAM cost.
+        A BARRIER leg — the spill gather is its designed device->host
+        sync; steady-state ``step()`` never reaches here."""
+        tree = self._prefix_cache
+        gather = None
+        if self.host_tier_bytes > 0:
+            def gather(phys):
+                payload = self._gather_phys_pages(phys)
+                self._c_tier_bytes["host"].inc(
+                    sum(a.nbytes for a in payload.values()))
+                return payload
+        before = tree.spilled_pages
+        reclaimed = tree.evict(n_pages, gather=gather)
+        spilled = tree.spilled_pages - before
+        if spilled:
+            self._c_tier_spills["host"].inc(spilled)
+            self.events.emit("prefix_spill", pages=spilled, reason=reason)
+        if reclaimed:
+            self._free.extend(reclaimed)
+            self._c_evicted.inc(len(reclaimed))
+            self.events.emit("prefix_evict", pages=len(reclaimed),
+                             reason=reason)
+        return reclaimed
+
+    def _fill_host_prefix(self, prompt: List[int]) -> int:
+        """Promote host-tier spans covering *prompt* back into the pool
+        (top-down along the match path, keeping the tier frontier) so
+        the ordinary HBM match that follows sees them. Best-effort: a
+        span that cannot get pool pages or tree budget stays host and
+        the match simply stops shorter — admission degrades to a colder
+        start, never deadlocks. Returns tokens promoted NOW (the host
+        tier's contribution to this admission). A BARRIER leg — each
+        fill pays its designed host->device upload."""
+        tree = self._prefix_cache
+        if tree is None or self.host_tier_bytes <= 0:
+            return 0
+        _, segs = tree.match_tiered(prompt)
+        filled_tokens = 0
+        for node, _jp in segs:
+            if node.host is None:
+                continue
+            if not self._fill_host_node(node):
+                break
+            filled_tokens += len(node.tokens)
+        return filled_tokens
+
+    def _fill_host_node(self, node) -> bool:
+        """Fill ONE host-tier node back into the pool: make tree budget
+        and pool-page room (the ``_alloc_pages`` reclaim discipline —
+        reclaim evictable tree pages before giving up, so a fill under
+        pool pressure converges instead of deadlocking admission), pop
+        pages, upload the stored-layout host buffers, and commit via
+        ``tree.promote``. The node is PINNED across the reclaim so the
+        reclaim can neither drop it nor spill its ancestors out from
+        under the path being rebuilt. False = no room; the node stays
+        host-tier, untouched."""
+        tree = self._prefix_cache
+        n = len(node.tokens) // self.page_size
+        tree.pin(node)
+        try:
+            over = tree.total_pages + n - tree.max_pages
+            if over > 0:
+                self._tree_reclaim(over, reason="fill_budget")
+            if tree.total_pages + n > tree.max_pages:
+                return False
+            if n > len(self._free):
+                self._tree_reclaim(n - len(self._free),
+                                   reason="fill_pressure")
+            if n > len(self._free):
+                return False
+            nbytes = sum(a.nbytes for a in node.host.values())
+            phys = [self._free.pop() for _ in range(n)]
+            self._upload_host_pages(node.host, phys)
+            tree.promote(node, phys)
+            self._c_tier_fills["host"].inc(n)
+            self._c_tier_bytes["host"].inc(nbytes)
+            self.events.emit("prefix_fill", tier="host", pages=n)
+            return True
+        finally:
+            tree.release(node)
+
+    def _upload_host_pages(self, pages: dict, phys_list) -> None:
+        """Upload a stored-layout page dict (page axis 1; kv_int8 ships
+        the quantized quadruple as stored — never dequantized) into the
+        pool at physical pages *phys_list*. The fill/inject commit's
+        designed host->device transfer (a barrier leg)."""
+        phys = np.asarray(phys_list, np.int64)
+
+        def put(pool, names):
+            if isinstance(pool, tuple):
+                q8, sc = pool
+                return (
+                    q8.at[:, phys].set(jnp.asarray(pages[names[0]])),
+                    sc.at[:, phys].set(jnp.asarray(pages[names[1]])),
+                )
+            return pool.at[:, phys].set(jnp.asarray(pages[names[0]]))
+
+        if self.kv_int8:
+            self.k_pages = put(self.k_pages, ("k_q", "k_s"))
+            self.v_pages = put(self.v_pages, ("v_q", "v_s"))
+        else:
+            self.k_pages = put(self.k_pages, ("k",))
+            self.v_pages = put(self.v_pages, ("v",))
+
+    def _page_field_names(self) -> Tuple[str, ...]:
+        return (("k_q", "k_s", "v_q", "v_s") if self.kv_int8
+                else ("k", "v"))
+
+    def prefix_local_pages(self, prompt: List[int]) -> int:
+        """Full pages of *prompt* this server covers across BOTH local
+        tiers (HBM + host) — the replica's peer-fetch gate: only a
+        genuinely cold prompt is worth a network round-trip. Host
+        bookkeeping only; no device work."""
+        if self._prefix_cache is None or not prompt:
+            return 0
+        matched, _segs = self._prefix_cache.match_tiered(prompt)
+        return matched // self.page_size
+
+    def export_prefix_span(self, prompt: List[int],
+                           from_page: int = 0) -> Optional[dict]:
+        """Gather this server's cached coverage of *prompt* for a PEER
+        replica (the cross-replica tier's read side). Host-tier spans
+        ship straight from their host buffers (no device work); HBM
+        spans pay the designed gather barrier. Read-only — the tree is
+        not mutated beyond LRU stamps — so a retried fetch is naturally
+        idempotent. Returns ``{matched_tokens, from_page, n_pages,
+        pages}`` (stored layout, page axis 1, pages ``[from_page,
+        n_pages)``) or None when coverage does not reach past
+        *from_page*."""
+        if self._prefix_cache is None or not prompt or from_page < 0:
+            return None
+        matched, segs = self._prefix_cache.match_tiered(prompt)
+        n_pages = matched // self.page_size
+        if n_pages <= from_page:
+            return None
+        parts = []
+        for node, jp in segs:
+            if node.host is not None:
+                parts.append({k: v[:, :jp] for k, v in node.host.items()})
+            else:
+                parts.append(self._gather_phys_pages(node.pages[:jp]))
+        full = {name: np.concatenate([p[name] for p in parts], axis=1)
+                for name in self._page_field_names()}
+        out = {name: np.ascontiguousarray(arr[:, from_page:n_pages])
+               for name, arr in full.items()}
+        self._c_tier_bytes["peer"].inc(
+            sum(a.nbytes for a in out.values()))
+        self.events.emit("prefix_export", pages=n_pages - from_page,
+                         from_page=from_page)
+        return {
+            "matched_tokens": n_pages * self.page_size,
+            "from_page": int(from_page),
+            "n_pages": int(n_pages),
+            "pages": out,
+        }
+
+    def inject_prefix(self, tokens: List[int], pages: dict,
+                      from_page: int = 0) -> int:
+        """Adopt a PEER-fetched stored-layout span into the local prefix
+        tree (the peer tier's fill commit): make tree budget and pool
+        room (the ``_alloc_pages`` reclaim discipline), upload the
+        uncovered pages, and insert — after which the requesting
+        admission maps them like any local hit. *pages* covers logical
+        pages ``[from_page, n)`` of *tokens* (the fetch skipped what
+        this server reported covered); local coverage that RECEDED
+        below *from_page* while the fetch was in flight leaves a hole —
+        refused (return 0, the caller cold-prefills), never inserted.
+        Idempotent at the tree level: spans the tree already covers
+        consume nothing, so a replayed fetch commits once. Returns
+        pages adopted. A BARRIER leg — the upload is its designed
+        host->device transfer."""
+        tree = self._prefix_cache
+        if tree is None or not tokens or from_page < 0:
+            return 0
+        ps = self.page_size
+        n = len(tokens) // ps
+        if n <= from_page:
+            return 0
+        tokens = [int(t) for t in tokens[:n * ps]]
+        for name in self._page_field_names():
+            arr = pages.get(name)
+            if arr is None or arr.shape[1] != n - from_page:
+                raise ValueError(
+                    f"injected span field {name!r} covers "
+                    f"{None if arr is None else arr.shape[1]} pages, "
+                    f"want {n - from_page}")
+        # promote local host-tier coverage FIRST: the insert below
+        # adopts host nodes by consuming donated pages, and a donated
+        # page below from_page carries no peer bytes — after the fill,
+        # every adoptable position is >= the HBM coverage mark
+        self._fill_host_prefix(tokens)
+        hbm_cov = tree.match(tokens)[0] // ps
+        if hbm_cov < from_page:
+            return 0            # coverage receded under the fetch: hole
+        need = tree.missing_pages(tokens)
+        if need <= 0:
+            return 0
+        over = tree.total_pages + need - tree.max_pages
+        if over > 0:
+            self._tree_reclaim(over, reason="inject_budget")
+        if tree.total_pages + need > tree.max_pages:
+            return 0
+        if need > len(self._free):
+            self._tree_reclaim(need - len(self._free),
+                               reason="inject_pressure")
+        if need > len(self._free):
+            return 0
+        # donate real pool pages only for positions past the local HBM
+        # coverage (the walk cannot consume covered-prefix donations);
+        # upload those columns, insert, free whatever was not consumed
+        alloc = [self._free.pop() for _ in range(n - hbm_cov)]
+        col0 = hbm_cov - from_page
+        if alloc:
+            self._upload_host_pages(
+                {name: np.ascontiguousarray(arr[:, col0:])
+                 for name, arr in pages.items()}, alloc)
+        donated = [-1] * hbm_cov + alloc
+        consumed = tree.insert(tokens, donated)
+        assert all(p >= 0 for p in consumed), \
+            "inject consumed a placeholder page"
+        for p in alloc:
+            if p not in consumed:
+                self._free.append(p)
+        if consumed:
+            self._c_inserted.inc(len(consumed))
+            self._c_tier_hits["peer"].inc(len(consumed))
+            self._c_tier_fills["peer"].inc(len(consumed))
+            self._c_tier_saved["peer"].inc(len(consumed) * ps)
+            self._c_tier_bytes["peer"].inc(sum(
+                arr[:, col0:].nbytes for arr in pages.values()))
+            self.events.emit("prefix_inject", pages=len(consumed),
+                             from_page=int(from_page))
+        return len(consumed)
+
+    def tier_stats(self) -> dict:
+        """Per-tier reuse stats (Round-19): pages hit / filled /
+        spilled, bytes moved, tokens saved per tier, and host-tier
+        occupancy — the ``kubetpu_prefix_tier_*`` series as a dict.
+        Host counters only; no device work."""
+        if self._prefix_cache is None:
+            return {"enabled": False}
+        tree = self._prefix_cache
+        return {
+            "enabled": True,
+            "host_tier_bytes": self.host_tier_bytes,
+            "host_bytes": tree.host_bytes,
+            "host_nodes": len(tree.host_nodes()),
+            "spilled_pages": tree.spilled_pages,
+            "hits": {t: int(c.value)
+                     for t, c in self._c_tier_hits.items()},
+            "fills": {t: int(c.value)
+                      for t, c in self._c_tier_fills.items()},
+            "spills": {t: int(c.value)
+                       for t, c in self._c_tier_spills.items()},
+            "bytes": {t: int(c.value)
+                      for t, c in self._c_tier_bytes.items()},
+            "tokens_saved": {t: int(c.value)
+                             for t, c in self._c_tier_saved.items()},
+        }
 
     def prefix_cache_stats(self) -> dict:
         """Host-side reuse stats (0s when the cache is off): requests
@@ -918,6 +1229,9 @@ class PagedDecodeServer(SlotServerBase):
             "tree_nodes": self._prefix_cache.n_nodes(),
             "evicted_pages": int(self._c_evicted.value),
             "inserted_pages": int(self._c_inserted.value),
+            "host_bytes": self._prefix_cache.host_bytes,
+            "host_nodes": len(self._prefix_cache.host_nodes()),
+            "spilled_pages": self._prefix_cache.spilled_pages,
         }
 
     def load_info(self) -> dict:
@@ -936,6 +1250,13 @@ class PagedDecodeServer(SlotServerBase):
             stats = self.prefix_cache_stats()
             info["prefix_hit_rate"] = stats["hit_rate"]
             info["prefix_tree_pages"] = stats["tree_pages"]
+            if self.host_tier_bytes > 0:
+                tier = self.tier_stats()
+                info["tier_host_bytes"] = tier["host_bytes"]
+                info["tier_host_nodes"] = tier["host_nodes"]
+                info["tier_hits"] = tier["hits"]
+                info["tier_fills"] = tier["fills"]
+                info["tier_spills"] = tier["spills"]
         return info
 
     def check_invariants(self) -> None:
@@ -943,9 +1264,16 @@ class PagedDecodeServer(SlotServerBase):
         serving sibling): every physical page is owned by exactly one of
         {free list, a slot's private mapping, the prefix tree}; shared
         table rows point only at tree-owned pages; node refcounts equal
-        the live pins; the tree's own structure checks out. AssertionError
-        on any violation — tests and the ``make prefix-check`` storm
-        assert it after every scenario."""
+        the live pins; the tree's own structure checks out — including
+        the Round-19 tier half (host bytes <= budget, pages-XOR-host
+        per node, host frontier). Fill-in-flight pages are counted
+        exactly once BY CONSTRUCTION: a fill pops pages from the free
+        list and commits them to the tree inside one synchronous
+        barrier leg, so at every point this oracle can observe, each
+        page sits in exactly one owner set and the pool equation below
+        catches any double-count. AssertionError on any violation —
+        tests and the ``make prefix-check`` storm assert it after every
+        scenario."""
         free = list(self._free)
         free_set = set(free)
         assert len(free) == len(free_set), "free list holds a page twice"
@@ -1021,7 +1349,14 @@ class PagedDecodeServer(SlotServerBase):
         streaming leg."""
         row = self._table[slot, from_page:to_page]
         assert (row >= 0).all(), "live pages unmapped under a gather"
-        phys = np.asarray(row, np.int64)
+        return self._gather_phys_pages(row)
+
+    def _gather_phys_pages(self, phys_list) -> dict:
+        """Host copies of arbitrary PHYSICAL pool pages in their stored
+        layout — the table-indirected ``_gather_page_span`` above and
+        the Round-19 spill/peer-export legs share this one designed
+        device->host sync."""
+        phys = np.asarray(phys_list, np.int64)
 
         def gather(pool):
             if isinstance(pool, tuple):
@@ -1143,10 +1478,13 @@ class PagedDecodeServer(SlotServerBase):
         (matched pages never cross the wire at all). A HINT, never a
         promise: eviction between begin and commit can shrink the real
         match, and ``restore_slot`` refuses a receded match instead of
-        restoring with holes (the source then resumes and re-ships)."""
+        restoring with holes (the source then resumes and re-ships).
+        Round-19: host-tier coverage counts — the restore-path
+        ``_prefill_start`` fills it before matching, and a fill that
+        fails is exactly the receded-match refusal."""
         if self._prefix_cache is None or not prompt:
             return 0
-        matched, _pages, _node = self._prefix_cache.match(prompt)
+        matched, _segs = self._prefix_cache.match_tiered(prompt)
         start = min(matched, ((len(prompt) - 1) // self.page_size)
                     * self.page_size)
         return max(0, start // self.page_size)
@@ -1424,7 +1762,10 @@ class PagedDecodeServer(SlotServerBase):
         prefill scribbles on pool pages a live sequence may have mapped —
         including tree-owned ones, so the prefix cache is FLUSHED first
         (idle server => nothing pinned; the pages return to the free
-        list and the tree repopulates from live traffic)."""
+        list and the tree repopulates from live traffic). The flush
+        takes the HOST TIER with it (Round-19): a host buffer surviving
+        a warmup would later fill KV computed under whatever state the
+        warmup scribbled over."""
         if self._prefix_cache is not None:
             self._free.extend(self._prefix_cache.clear())
         d_temp, d_tk, d_tp = self._default_sampling

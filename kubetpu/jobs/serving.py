@@ -548,6 +548,14 @@ class SlotServerBase:
                 "itl", 99) * 1e3,
         }
 
+    def tier_stats(self) -> dict:
+        """Tiered-KV-cache stats hook (Round-19): the base serving loop
+        has no cache tiers, so this reports disabled — the paged server
+        overrides with its per-tier hit/fill/spill counters and host
+        occupancy. Replica ``/load`` and the CLI read through this one
+        name regardless of server kind."""
+        return {"enabled": False}
+
     # -- Round-11 signal layer ------------------------------------------------
 
     def enable_profiler(self, sample_every: int = 16) -> ServingProfiler:

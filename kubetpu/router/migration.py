@@ -144,3 +144,42 @@ def assemble_spans(pages: Dict[str, "np.ndarray"],
         out[field] = (parts[0][1] if len(parts) == 1 else
                       np.concatenate([a for _s, a in parts], axis=1))
     return out
+
+
+# -- peer prefix fetch (Round-19 tiered KV cache) -----------------------------
+#
+# The cross-replica tier ships ONE page span per fetch — the requester
+# asks the ring's previous preference owner for its cached coverage of a
+# cold prompt before cold-prefilling. The span rides the same manifest +
+# b64-chunk machinery as a migration transfer (span-named entries,
+# length-checked decode, gap/overlap-refusing assembly), folded into a
+# single JSON body because a prefix fetch is read-only and at-most-once
+# by construction: the exporter mutates nothing, the importer's
+# tree-insert consumes nothing it already covers — so a retry (the
+# requester keys the POST idempotently anyway) can at worst repeat work,
+# never double-commit.
+
+
+def encode_span_payload(pages: Dict[str, "np.ndarray"], from_page: int,
+                        chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> dict:
+    """JSON-safe encoding of one stored-layout page-span dict (page axis
+    1): span-named manifest + base64 chunks of the concatenated blob.
+    ``decode_span_payload`` is the exact inverse."""
+    meta, blob = encode_snapshot({"pages": {
+        span_name(field, from_page): arr
+        for field, arr in pages.items()}})
+    return {
+        "arrays": meta["arrays"],
+        "from_page": int(from_page),
+        "chunks": [chunk_b64(c) for c in blob_chunks(blob, chunk_bytes)],
+    }
+
+
+def decode_span_payload(payload: dict) -> Dict[str, "np.ndarray"]:
+    """Rebuild the per-field page arrays from an ``encode_span_payload``
+    body. Raises ValueError when the chunks disagree with the manifest
+    (truncated/duplicated chunk) or spans gap/overlap — a bad fetch must
+    degrade to cold prefill, never inject garbage KV."""
+    blob = b"".join(chunk_unb64(c) for c in payload.get("chunks", ()))
+    snap = decode_snapshot({"arrays": payload.get("arrays", ())}, blob)
+    return assemble_spans(snap["pages"], int(payload.get("from_page", 0)))

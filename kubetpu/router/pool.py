@@ -371,6 +371,38 @@ class ReplicaPool:
             h = self._replicas.get(name)
             return h.state if h is not None else None
 
+    def tier_summary(self) -> dict:
+        """Fleet view of the tiered KV cache (Round-19), aggregated
+        from the cached ``/load`` snapshots: total host-tier bytes and
+        nodes, per-tier hit/fill/spill counts summed across replicas,
+        and how many replicas have the tier enabled. The cli's tiering
+        line and the operator's budget-sizing loop read this instead of
+        scraping N ``/metrics`` expositions."""
+        out = {
+            "replicas": 0,
+            "tiered_replicas": 0,
+            "host_bytes": 0,
+            "host_nodes": 0,
+            "hits": {"hbm": 0, "host": 0, "peer": 0},
+            "fills": {"host": 0, "peer": 0},
+            "spills": {"host": 0},
+        }
+        with self._lock:
+            loads = [dict(h.load) for h in self._replicas.values()
+                     if h.load]
+        out["replicas"] = len(loads)
+        for load in loads:
+            if "tier_host_bytes" not in load:
+                continue
+            out["tiered_replicas"] += 1
+            out["host_bytes"] += int(load.get("tier_host_bytes", 0))
+            out["host_nodes"] += int(load.get("tier_host_nodes", 0))
+            for key in ("hits", "fills", "spills"):
+                for tier, n in (load.get(f"tier_{key}") or {}).items():
+                    if tier in out[key]:
+                        out[key][tier] += int(n)
+        return out
+
     # -- federation ----------------------------------------------------------
 
     def federate_text(self, own: str) -> str:

@@ -110,12 +110,15 @@ from kubetpu.router.migration import (
     chunk_b64,
     chunk_unb64,
     decode_snapshot,
+    decode_span_payload,
     encode_snapshot,
+    encode_span_payload,
     span_name,
 )
 from kubetpu.wire.httpcommon import (
     IdempotencyCache,
     InflightTracker,
+    RetryPolicy,
     check_bearer,
     handle_guarded,
     request_json,
@@ -327,6 +330,13 @@ class ReplicaServer:
                         lambda: replica._migrate_in(req),
                     )
                     return
+                if self.path == "/prefix_fetch":
+                    run_idempotent(
+                        self, replica.idem,
+                        self.headers.get("Idempotency-Key"),
+                        lambda: replica._prefix_fetch(req),
+                    )
+                    return
                 if self.path != "/generate":
                     write_json(self, 404, {"error": f"no route {self.path}"})
                     return
@@ -367,6 +377,13 @@ class ReplicaServer:
                 or not all(isinstance(t, int) for t in prompt)):
             return 400, {"error": "prompt must be a non-empty list of "
                                   "token ids"}
+        # Round-19 peer prefix tier: before taking the serving lock for
+        # admission, try to pull this prompt's cached KV span from the
+        # router-named peer (the ring's previous preference owner). The
+        # HTTP leg runs OUTSIDE the condition — a slow or dark peer must
+        # never stall the step loop — and any failure degrades to cold
+        # prefill, so the admission below is untouched either way.
+        self._maybe_peer_prefetch(req, prompt, key)
         with self._cv:
             gone = self._migrated_keys.get(key) if key else None
             if gone is not None:
@@ -460,6 +477,128 @@ class ReplicaServer:
             "tokens": tokens,
             "emitted": tokens[len(prompt):],
         }
+
+    # -- Round-19: cross-replica prefix tier ---------------------------------
+    #
+    # The fleet tier of the tiered KV cache: a replica that misses
+    # locally on a routed prompt asks ONE peer — the ring's previous
+    # preference owner, named by the router in the generate payload —
+    # for the span it has cached, and adopts it before cold-prefilling.
+    # The exporter side is read-only (export under the condition, no
+    # serving-state mutation), so the exchange is naturally idempotent;
+    # the importer's tree-insert consumes nothing it already covers, so
+    # a replayed fetch commits at most once. A dark, slow, or faulted
+    # peer degrades to cold prefill — the tier can only remove work.
+
+    PEER_FETCH_RETRY = RetryPolicy(attempts=2, deadline=2.0)
+
+    def _prefix_fetch(self, req: dict):
+        """``POST /prefix_fetch`` — export this replica's cached
+        coverage of ``prompt`` from logical page ``from_page`` on, as an
+        ``encode_span_payload`` body -> (code, obj). 404 when the tree
+        covers nothing past ``from_page`` (the requester cold-prefills);
+        read-only either way."""
+        prompt = req.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            return 400, {"error": "prompt must be a non-empty list of "
+                                  "token ids"}
+        try:
+            from_page = int(req.get("from_page") or 0)
+        except (TypeError, ValueError):
+            return 400, {"error": "from_page must be an integer"}
+        if from_page < 0:
+            return 400, {"error": "from_page must be >= 0"}
+        export = getattr(self.server, "export_prefix_span", None)
+        if export is None:
+            return 404, {"error": "replica has no prefix tier"}
+        with self._cv:
+            span = export(prompt, from_page=from_page)
+        if span is None:
+            self.server.obs.counter("kubetpu_peer_prefix_export_total",
+                                    result="miss").inc()
+            return 404, {"error": "no cached coverage past from_page"}
+        self.server.obs.counter("kubetpu_peer_prefix_export_total",
+                                result="hit").inc()
+        self.events.emit("prefix_export", pages=int(span["n_pages"]),
+                         from_page=int(span["from_page"]))
+        return 200, {
+            "replica": self.name,
+            "matched_tokens": int(span["matched_tokens"]),
+            "from_page": int(span["from_page"]),
+            "n_pages": int(span["n_pages"]),
+            "span": encode_span_payload(span["pages"],
+                                        int(span["from_page"])),
+        }
+
+    def _maybe_peer_prefetch(self, req: dict, prompt: list,
+                             key: Optional[str]) -> None:
+        """Best-effort pull of *prompt*'s KV span from the peer the
+        router named (``prefix_peer`` in the generate payload). Probes
+        local coverage under the condition, runs the HTTP leg unlocked
+        (local coverage may move meanwhile — ``inject_prefix`` detects
+        the hole and refuses), injects under the condition. EVERY
+        failure path is a silent degrade to cold prefill."""
+        peer = req.get("prefix_peer")
+        if not isinstance(peer, str) or not peer:
+            return
+        inject = getattr(self.server, "inject_prefix", None)
+        local_fn = getattr(self.server, "prefix_local_pages", None)
+        if inject is None or local_fn is None:
+            return
+        ps = int(getattr(self.server, "page_size", 0) or 0)
+        if ps <= 0:
+            return
+        # full cached pages a prefill at pos=matched can ever use: the
+        # last prompt token is recomputed, hence the -1
+        full = (len(prompt) - 1) // ps
+        if full <= 0:
+            return
+        with self._cv:
+            local = int(local_fn(prompt))
+        if local >= full:
+            return                       # already covered locally
+
+        def count(result: str) -> None:
+            self.server.obs.counter("kubetpu_peer_prefix_fetch_total",
+                                    result=result).inc()
+
+        try:
+            resp = request_json(
+                peer.rstrip("/") + "/prefix_fetch",
+                {"prompt": [int(t) for t in prompt], "from_page": local},
+                token=self.token,
+                retry=self.PEER_FETCH_RETRY,
+                timeout=self.PEER_FETCH_RETRY.deadline,
+                idempotency_key=(
+                    f"prefix-fetch-{key or uuid.uuid4().hex[:12]}"),
+            )
+            pages = decode_span_payload(resp["span"])
+            matched = int(resp["matched_tokens"])
+            from_page = int(resp["from_page"])
+        except urllib.error.HTTPError as e:
+            count("miss" if e.code == 404 else "degraded")
+            if e.code != 404:
+                self.events.emit("prefix_fetch_degraded", peer=peer,
+                                 code=e.code)
+            return
+        except Exception as e:  # noqa: BLE001 — any wire/codec failure
+            count("degraded")
+            self.events.emit("prefix_fetch_degraded", peer=peer,
+                             error=str(e)[:120])
+            return
+        try:
+            with self._cv:
+                adopted = inject(prompt[:matched], pages,
+                                 from_page=from_page)
+        except (ValueError, AssertionError) as e:
+            count("degraded")
+            self.events.emit("prefix_fetch_degraded", peer=peer,
+                             error=str(e)[:120])
+            return
+        count("hit" if adopted else "miss")
+        self.events.emit("prefix_fetch", peer=peer, pages=int(adopted),
+                         matched_tokens=matched)
 
     # -- live KV migration (Round-16) ----------------------------------------
 

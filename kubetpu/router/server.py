@@ -431,6 +431,28 @@ class RouterServer:
             self._recent_decode.append((now, pick))
         return pick
 
+    def _prefix_peer(self, prompt: List[int],
+                     exclude: str) -> Optional[str]:
+        """The cross-replica prefix tier's peer hint (Round-19): the
+        first replica in this prompt's ring preference order that is
+        routable and not *exclude* — where the affinity policy sent (or
+        would have sent) this family's earlier traffic. None under the
+        random policy (no affinity structure to exploit) or a
+        one-replica fleet."""
+        routable = set(self.pool.routable())
+        routable.discard(exclude)
+        if not routable:
+            return None
+        with self._lock:
+            if self.policy == "random":
+                return None
+            prefs = self.ring.preference(prefix_head_key(
+                prompt, self.head_tokens, self.head_quantum))
+        for n in prefs:
+            if n in routable:
+                return n
+        return None
+
     def _route_request(self, req: dict, client_key: Optional[str] = None):
         """One routed generate -> (code, obj); runs under
         ``run_idempotent`` on the handler thread."""
@@ -498,6 +520,20 @@ class RouterServer:
                 if decode is not None:
                     payload["decode_target"] = self.pool.url(decode)
                     payload["decode_name"] = decode
+            # Round-19 peer prefix tier: name the ring's next preference
+            # owner for this prompt's head key — the replica most likely
+            # holding the family's cached KV when the chosen one is cold
+            # (an affinity fallback, a scale-out rebalance, a fresh
+            # node). Advisory: the replica probes its own tiers first,
+            # and a dark or faulted peer degrades to cold prefill. Never
+            # on a pinned (chasing) attempt — the stream already exists.
+            if pinned is None:
+                peer = self._prefix_peer(prompt, exclude=name)
+                if peer is not None:
+                    peer_url = self.pool.url(peer)
+                    if peer_url is not None:
+                        payload["prefix_peer"] = peer_url
+                        payload["prefix_peer_name"] = peer
             try:
                 tup = time.perf_counter()
                 body = request_json(

@@ -1,0 +1,221 @@
+#!/usr/bin/env python3
+"""``make tier-check`` — the tiered-KV-cache oracle (Round-19).
+
+Proves the three tiers move KV WITHOUT moving tokens, under faults:
+
+- HOST arm: a 3-family storm whose working set overflows a tiny HBM
+  tree budget, so LRU victims SPILL to host buffers and returning
+  families FILL them back — greedy tokens must equal the cold
+  (reuse-off) server on every request, spills/fills/savings must all
+  actually engage, and the pool + tree oracles must hold throughout;
+- PEER arm: two ReplicaServers; the cold one pulls each family's span
+  from the warm one over ``/prefix_fetch`` with >=10% injected
+  drop/503/partial on that leg — parity on every request, and the
+  fetch ledger (hit + miss + degraded) must account for every attempt;
+- degrade probes: a DARK peer (nothing listening), a scripted 503
+  absorbed by the retry budget, and a scripted double-drop that must
+  fall back to cold prefill — each token-exact.
+
+Runs in about a minute on the CPU backend; wired into ``make chaos`` so
+every fault-injection run also proves tiering can only remove work.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.router import ReplicaServer  # noqa: E402
+from kubetpu.wire.faults import FaultInjector, RoutePolicy  # noqa: E402
+from kubetpu.wire.httpcommon import request_json  # noqa: E402
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+BUDGET = 4          # HBM tree pages: two 2-page families fill it
+
+
+def fail(msg: str) -> None:
+    print(f"tier-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def fam(seed):
+    return [(i * seed) % 60 + 1 for i in range(2 * PS)]
+
+
+def make(params, host=1 << 22, budget=BUDGET):
+    return PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=6, page_size=PS,
+                             prefill_budget=PS,
+                             prefix_cache_pages=budget,
+                             host_tier_bytes=host)
+
+
+def run(server, prompts, check=False):
+    rids = [server.enqueue(p) for p in prompts]
+    server.drain()
+    outs = [server.pop_result(r) for r in rids]
+    if check:
+        server.check_invariants()
+    return outs
+
+
+def main() -> int:
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    cold = PagedDecodeServer(CFG, params, n_slots=2, max_seq=64,
+                             max_new_tokens=6, page_size=PS,
+                             prefill_budget=PS)
+
+    def ref(prompts):
+        return run(cold, prompts)
+
+    # -- HOST arm: 3 families cycling through a 2-family HBM budget ----------
+    fams = [fam(s) for s in (5, 7, 11)]
+    waves = []
+    for tail in range(3):
+        for f, head in enumerate(fams):
+            waves.append([head + [f * 10 + tail + 1]])
+    prompts = [p for w in waves for p in w]
+    want = ref(prompts)
+
+    warm = make(params)
+    got = []
+    try:
+        for wave in waves:
+            got.extend(run(warm, wave, check=True))
+    except AssertionError as e:
+        fail(f"HOST arm: pool oracle violated mid-storm: {e}")
+    if got != want:
+        bad = [i for i, (g, r) in enumerate(zip(got, want)) if g != r]
+        fail(f"HOST arm parity: requests {bad} diverged through the "
+             f"host tier")
+    ts = warm.tier_stats()
+    if ts["spills"]["host"] == 0:
+        fail(f"HOST arm never spilled: {ts}")
+    if ts["fills"]["host"] == 0:
+        fail(f"HOST arm never filled back: {ts}")
+    if ts["tokens_saved"]["host"] == 0:
+        fail(f"host tier saved no prefill tokens: {ts}")
+    if warm._prefix_cache.host_bytes > warm.host_tier_bytes:
+        fail("host tier past its byte budget")
+    try:
+        warm._prefix_cache.check()
+    except AssertionError as e:
+        fail(f"HOST arm tree oracle: {e}")
+
+    # -- PEER arm: cold replica pulls spans under injected faults ------------
+    inj = FaultInjector(seed=7, routes={
+        "/prefix_fetch": RoutePolicy(drop=0.05, error=0.05, partial=0.05),
+    })
+    ra = ReplicaServer(make(params), "tier-a", faults=inj, idle_wait=0.002)
+    rb = ReplicaServer(make(params), "tier-b", idle_wait=0.002)
+    ua = ra.start()
+    rb.start()
+    peer_fams = [fam(s) for s in (5, 7, 11, 13, 17, 19, 23, 29)]
+    try:
+        for i, head in enumerate(peer_fams):
+            body = request_json(ra.address + "/generate",
+                                {"prompt": head + [1]},
+                                idempotency_key=f"tc-warm-{i}", timeout=30)
+            if body["tokens"] != ref([head + [1]])[0]:
+                fail(f"PEER arm: warm-side family {i} diverged")
+        attempts = 0
+        for i, head in enumerate(peer_fams):
+            p = head + [2]
+            body = request_json(rb.address + "/generate",
+                                {"prompt": p, "prefix_peer": ua},
+                                idempotency_key=f"tc-peer-{i}", timeout=30)
+            attempts += 1
+            if body["tokens"] != ref([p])[0]:
+                fail(f"PEER arm parity: family {i} diverged through the "
+                     f"peer fetch (injected faults must degrade to cold, "
+                     f"never corrupt)")
+
+        def fetch_counts():
+            out = {"hit": 0, "miss": 0, "degraded": 0}
+            for line in rb.server.metrics_text().splitlines():
+                if line.startswith("kubetpu_peer_prefix_fetch_total"):
+                    for k in out:
+                        if f'result="{k}"' in line:
+                            out[k] = int(float(line.rsplit(" ", 1)[1]))
+            return out
+
+        counts = fetch_counts()
+        if sum(counts.values()) != attempts:
+            fail(f"fetch ledger leaks: {counts} over {attempts} attempts")
+        if counts["hit"] == 0:
+            fail(f"PEER arm never landed a fetch: {counts}")
+        if rb.server.tier_stats()["tokens_saved"]["peer"] == 0:
+            fail("peer tier saved no prefill tokens")
+
+        # dark peer: nothing listening — degrade within the retry
+        # deadline, cold-prefill token-exactly
+        p = fam(31) + [1]
+        body = request_json(rb.address + "/generate",
+                            {"prompt": p,
+                             "prefix_peer": "http://127.0.0.1:9"},
+                            idempotency_key="tc-dark", timeout=30)
+        if body["tokens"] != ref([p])[0]:
+            fail("dark-peer probe diverged")
+        if fetch_counts()["degraded"] <= counts["degraded"]:
+            fail("dark peer did not count as degraded")
+
+        # scripted single 503: the retry budget (2 attempts) absorbs it
+        request_json(ra.address + "/generate", {"prompt": fam(37) + [1]},
+                     idempotency_key="tc-warm-503", timeout=30)
+        inj.set_route("/prefix_fetch", RoutePolicy(error=1.0, times=1))
+        body = request_json(rb.address + "/generate",
+                            {"prompt": fam(37) + [2], "prefix_peer": ua},
+                            idempotency_key="tc-503", timeout=30)
+        if body["tokens"] != ref([fam(37) + [2]])[0]:
+            fail("retry-through-503 probe diverged")
+        if fetch_counts()["hit"] <= counts["hit"]:
+            fail("a single injected 503 defeated the retry budget")
+
+        # scripted double drop: past the retry budget — must fall back
+        # to cold prefill, not error the generate
+        request_json(ra.address + "/generate", {"prompt": fam(41) + [1]},
+                     idempotency_key="tc-warm-drop", timeout=30)
+        inj.set_route("/prefix_fetch", RoutePolicy(drop=1.0, times=2))
+        before = fetch_counts()["degraded"]
+        body = request_json(rb.address + "/generate",
+                            {"prompt": fam(41) + [2], "prefix_peer": ua},
+                            idempotency_key="tc-drop", timeout=30)
+        if body["tokens"] != ref([fam(41) + [2]])[0]:
+            fail("double-drop probe diverged")
+        if fetch_counts()["degraded"] <= before:
+            fail("a dropped fetch did not count as degraded")
+
+        injected = sum(inj.counts.values())
+        total_fetches = sum(fetch_counts().values())
+        try:
+            ra.server.check_invariants()
+            rb.server.check_invariants()
+        except AssertionError as e:
+            fail(f"PEER arm pool oracle: {e}")
+    finally:
+        ra.shutdown(graceful=False)
+        rb.shutdown(graceful=False)
+
+    print(f"tier-check: OK — host arm {len(prompts)} requests "
+          f"(spills {ts['spills']['host']}, fills {ts['fills']['host']}, "
+          f"saved {ts['tokens_saved']['host']} tokens); "
+          f"peer arm {total_fetches} fetches "
+          f"(hit {fetch_counts()['hit']}, "
+          f"degraded {fetch_counts()['degraded']}, "
+          f"{injected} injected faults), oracles clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

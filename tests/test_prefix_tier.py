@@ -1,0 +1,295 @@
+"""Tiered KV cache (Round-19): HBM -> host DRAM -> peer replica.
+
+The tier's whole contract is that it only moves WHERE cached KV lives,
+never what a request computes: every path here is judged against the
+cold (reuse-off) server token-for-token. Spill (LRU victims gathered to
+host buffers instead of dropped), fill (host buffers uploaded back and
+promoted before prefill starts), and the cross-replica fetch (a cold
+replica adopting a peer's exported span over the wire) each get a
+parity leg plus their accounting proofs; the fault paths (dark peer,
+receded coverage) must degrade to cold prefill, never corrupt."""
+
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from kubetpu.jobs import ModelConfig, init_params
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.router import ReplicaServer
+from kubetpu.wire.httpcommon import request_json
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+BUDGET = 4          # HBM tree pages: two 2-page families fill it exactly
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def fam(seed):
+    """One 2-page shared-prefix family head."""
+    return [(i * seed) % 60 + 1 for i in range(2 * PS)]
+
+
+def make(params, host=1 << 22, budget=BUDGET, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("page_size", PS)
+    return PagedDecodeServer(CFG, params, prefix_cache_pages=budget,
+                             host_tier_bytes=host, **kw)
+
+
+def run(server, prompts):
+    rids = [server.enqueue(p) for p in prompts]
+    server.drain()
+    return [server.pop_result(r) for r in rids]
+
+
+def spill_storm(server):
+    """famA warms, famB+famC evict it (budget 4 holds two families) —
+    famA's pages land in the host tier — then famA returns. Returns the
+    request list (run one wave at a time so LRU order is deterministic)
+    and the outputs."""
+    waves = [[fam(5) + [1], fam(5) + [2]],
+             [fam(7) + [1], fam(11) + [1]],
+             [fam(5) + [3], fam(5) + [4]]]
+    outs = []
+    for wave in waves:
+        outs.extend(run(server, wave))
+        server.check_invariants()
+    return [p for w in waves for p in w], outs
+
+
+def cold_reference(params, prompts, **kw):
+    cold = make(params, host=0, budget=0, **kw)
+    return run(cold, prompts)
+
+
+# -- spill -> fill token exactness --------------------------------------------
+
+
+@pytest.mark.parametrize("prefill_budget", [0, PS],
+                         ids=["monolithic", "chunked"])
+def test_spill_fill_token_exact_f32(params, prefill_budget):
+    """LRU victims spill to host instead of dropping; the returning
+    family fills them back and decodes token-exactly vs cold — for both
+    monolithic and chunked prefill."""
+    warm = make(params, prefill_budget=prefill_budget)
+    prompts, got = spill_storm(warm)
+    ref = cold_reference(params, prompts, prefill_budget=prefill_budget)
+    assert got == ref
+    ts = warm.tier_stats()
+    assert ts["spills"]["host"] > 0, "storm never spilled"
+    assert ts["fills"]["host"] > 0, "returning family never filled"
+    assert ts["tokens_saved"]["host"] > 0, "host tier saved nothing"
+    warm.check_invariants()
+
+
+def test_spill_fill_token_exact_kv_int8(params):
+    """The int8 path: spilled buffers hold the quantized pairs AS
+    STORED (int8 codes + f32 scales — never dequantized), and a fill
+    restores bit-identical pages: parity vs the cold int8 server."""
+    warm = make(params, kv_int8=True, prefill_budget=PS)
+    # warm famA, then force its spill so we can inspect the buffers
+    run(warm, [fam(5) + [1]])
+    run(warm, [fam(7) + [1], fam(11) + [1]])
+    hosts = warm._prefix_cache.host_nodes()
+    assert hosts, "famA never spilled"
+    for node in hosts:
+        assert set(node.host) == {"k_q", "k_s", "v_q", "v_s"}
+        assert node.host["k_q"].dtype == np.int8
+        assert node.host["k_s"].dtype == np.float32
+    prompts = [fam(5) + [2], fam(5) + [3]]
+    got = run(warm, prompts)
+    ref = cold_reference(params, prompts, kv_int8=True, prefill_budget=PS)
+    assert got == ref
+    assert warm.tier_stats()["fills"]["host"] > 0
+    warm.check_invariants()
+
+
+def test_fill_under_pool_pressure_no_deadlock(params):
+    """A fill that must RECLAIM pool pages for its own upload (pool
+    sized so free pages alone can't host the promoted span) completes
+    without deadlock and stays token-exact."""
+    # n_pages just above the two slots' worst case: the fill's upload
+    # has to push other cached pages out to make room
+    need = -(-(2 * PS + 1 + 6 + 1) // PS)     # pages per slot
+    warm = make(params, n_pages=2 * need + BUDGET, prefill_budget=PS)
+    prompts, got = spill_storm(warm)
+    ref = cold_reference(params, prompts, prefill_budget=PS)
+    assert got == ref
+    warm.check_invariants()
+
+
+def test_warmup_drops_host_tier(params):
+    """``warmup()`` flushes BOTH tiers — a stale host buffer surviving
+    a weight swap would fill poisoned KV — and the next visit re-warms
+    from cold, token-exactly."""
+    warm = make(params, prefill_budget=PS)
+    spill_storm(warm)
+    assert warm._prefix_cache.host_bytes > 0
+    warm.warmup()
+    assert warm._prefix_cache.host_bytes == 0
+    assert warm._prefix_cache.host_nodes() == []
+    assert warm._prefix_cache.total_pages == 0
+    prompts = [fam(5) + [1], fam(5) + [2]]
+    got = run(warm, prompts)
+    ref = cold_reference(params, prompts, prefill_budget=PS)
+    assert got == ref
+    assert warm.prefix_cache_stats()["requests_hit"] > 0
+    warm.check_invariants()
+
+
+# -- invariants ---------------------------------------------------------------
+
+
+def test_host_tier_invariants(params):
+    """The tree oracle holds mid-storm: host bytes within budget, every
+    node owns its span in exactly one tier, and the per-node byte
+    ledger is exact."""
+    warm = make(params, host=1 << 20, prefill_budget=PS)
+    spill_storm(warm)
+    tree = warm._prefix_cache
+    tree.check()
+    assert tree.host_bytes <= warm.host_tier_bytes
+    for node in tree.nodes():
+        assert not (node.pages and node.host is not None)
+    for node in tree.host_nodes():
+        assert node.host_bytes == sum(a.nbytes for a in node.host.values())
+
+
+def test_tiny_host_budget_degrades_to_drop(params):
+    """A budget too small for any span: eviction degrades to the
+    pre-Round-19 drop (no spill), and nothing breaks."""
+    warm = make(params, host=16, prefill_budget=PS)
+    prompts, got = spill_storm(warm)
+    ref = cold_reference(params, prompts, prefill_budget=PS)
+    assert got == ref
+    assert warm.tier_stats()["spills"]["host"] == 0
+    assert warm._prefix_cache.host_bytes == 0
+    warm.check_invariants()
+
+
+def test_inject_refuses_hole_and_replays_idempotently(params):
+    """``inject_prefix`` refuses a span whose from_page is ahead of
+    local coverage (the receded-coverage hole), and a replayed inject
+    of an adopted span commits nothing twice."""
+    a = make(params)
+    b = make(params)
+    prompt = fam(5)
+    run(a, [prompt + [1]])
+    span = a.export_prefix_span(prompt)
+    assert span is not None and span["n_pages"] == 2
+    # hole: b covers nothing, span claims to start at page 1
+    tail = a.export_prefix_span(prompt, from_page=1)
+    assert b.inject_prefix(prompt[:tail["matched_tokens"]], tail["pages"],
+                           from_page=1) == 0
+    # clean adopt, then replay
+    assert b.inject_prefix(prompt[:span["matched_tokens"]],
+                           span["pages"]) == 2
+    assert b.inject_prefix(prompt[:span["matched_tokens"]],
+                           span["pages"]) == 0
+    got = run(b, [prompt + [1]])
+    assert got == cold_reference(params, [prompt + [1]])
+    b.check_invariants()
+
+
+# -- the wire leg -------------------------------------------------------------
+
+
+@pytest.fixture()
+def replicas(params):
+    made = []
+
+    def build(n=2, **server_kw):
+        reps = []
+        for i in range(n):
+            rep = ReplicaServer(make(params, **server_kw), f"tier{i}",
+                                idle_wait=0.002)
+            rep.start()
+            reps.append(rep)
+        made.extend(reps)
+        return reps
+
+    yield build
+    for rep in made:
+        rep.shutdown(graceful=False)
+
+
+def _counter(rep, name, **want):
+    text = rep.server.metrics_text()
+    for line in text.splitlines():
+        if line.startswith(name) and all(
+                f'{k}="{v}"' in line for k, v in want.items()):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def test_peer_fetch_wire_parity(params, replicas):
+    """A cold replica handed a ``prefix_peer`` pulls the span over
+    /prefix_fetch before admission and decodes token-exactly; the
+    exporter stays read-only (its own storm keeps passing)."""
+    ra, rb = replicas()
+    prompt = fam(5) + [1]
+    ref = cold_reference(params, [prompt, fam(5) + [2]])
+    warm_a = request_json(ra.address + "/generate", {"prompt": prompt},
+                          idempotency_key="t-a", timeout=30)
+    assert warm_a["tokens"] == ref[0]
+    body = request_json(
+        rb.address + "/generate",
+        {"prompt": fam(5) + [2], "prefix_peer": ra.address},
+        idempotency_key="t-b", timeout=30)
+    assert body["tokens"] == ref[1]
+    assert _counter(rb, "kubetpu_peer_prefix_fetch_total",
+                    result="hit") == 1
+    assert _counter(ra, "kubetpu_peer_prefix_export_total",
+                    result="hit") == 1
+    assert rb.server.tier_stats()["tokens_saved"]["peer"] > 0
+    ra.server.check_invariants()
+    rb.server.check_invariants()
+
+
+def test_peer_fetch_dark_peer_degrades(params, replicas):
+    """A dark peer (nothing listening) costs the retry deadline at
+    worst and the request cold-prefills token-exactly."""
+    (rb,) = replicas(n=1)
+    prompt = fam(7) + [1]
+    ref = cold_reference(params, [prompt])
+    body = request_json(
+        rb.address + "/generate",
+        {"prompt": prompt, "prefix_peer": "http://127.0.0.1:9"},
+        idempotency_key="t-dark", timeout=30)
+    assert body["tokens"] == ref[0]
+    assert _counter(rb, "kubetpu_peer_prefix_fetch_total",
+                    result="degraded") == 1
+    rb.server.check_invariants()
+
+
+def test_peer_fetch_miss_and_skip(params, replicas):
+    """A peer with nothing cached answers 404 (counted as miss, cold
+    prefill); a LOCALLY covered prompt never fetches at all."""
+    ra, rb = replicas()
+    prompt = fam(11) + [1]
+    ref = cold_reference(params, [prompt])
+    body = request_json(
+        rb.address + "/generate",
+        {"prompt": prompt, "prefix_peer": ra.address},
+        idempotency_key="t-miss", timeout=30)
+    assert body["tokens"] == ref[0]
+    assert _counter(rb, "kubetpu_peer_prefix_fetch_total",
+                    result="miss") == 1
+    # now covered locally: the same family again must not re-fetch
+    request_json(rb.address + "/generate",
+                 {"prompt": fam(11) + [2], "prefix_peer": ra.address},
+                 idempotency_key="t-miss2", timeout=30)
+    assert _counter(rb, "kubetpu_peer_prefix_fetch_total",
+                    result="miss") == 1
+    assert _counter(rb, "kubetpu_peer_prefix_fetch_total",
+                    result="hit") == 0
