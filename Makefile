@@ -44,12 +44,14 @@ test: tpuinfo gpuinfo dataio
 # chaos means anything), then router-check (the data plane must route
 # token-exactly and never double-admit under the same faults), then
 # migrate-check (a live slot handoff must resume token-exactly and
-# at-most-once under faults on the transfer leg), then bench-gate in
-# smoke mode (a chaos pass that silently regressed serving throughput
-# still fails the round).
+# at-most-once under faults on the transfer leg), then crash-check
+# (a SIGKILLed controller or replica must recover to the exact
+# pre-crash state — journal replay, boot-nonce takeover, crash
+# replace), then bench-gate in smoke mode (a chaos pass that silently
+# regressed serving throughput still fails the round).
 .PHONY: chaos
 chaos: lint obs-check prefix-check spec-check router-check migrate-check \
-		disagg-check pack-check tier-check bench-gate-smoke
+		disagg-check pack-check tier-check crash-check bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py \
 		tests/test_race_soak.py -q
 
@@ -165,6 +167,17 @@ tier-check:
 .PHONY: disagg-check
 disagg-check:
 	python scripts/disagg_check.py
+
+# crash-tolerance oracle (Round-20): controller SIGKILL + cold restart
+# (journal replay to the exact pre-crash state, torn WAL tail dropped,
+# orphaned agent allocation freed, invariants clean before the wire
+# reports ready, idempotent second replay), replica SIGKILL mid-storm
+# with a same-name takeover (boot-nonce fencing, stale pins dropped,
+# token parity, admissions == logical requests), and the autoscaler's
+# crash-replace reap path (replacement booted despite cooldown)
+.PHONY: crash-check
+crash-check:
+	python scripts/crash_check.py
 
 # observability smoke oracle: controller + 2 fake agents, scrape the
 # federated /metrics, fail on malformed Prometheus text / missing

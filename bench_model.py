@@ -1110,6 +1110,121 @@ def migration_storm(cfg, n_replicas=2, n_streams=4, prompt_len=24,
     return tuple(run(a) for a in arms)
 
 
+def crash_storm(cfg, n_replicas=2, n_streams=3, prompt_len=16,
+                max_new=48, page_size=16, n_slots=2):
+    """Round-20 headline: SIGKILL a loaded replica mid-storm and measure
+    TIME-TO-RECOVER. Boots a router + *n_replicas* paged replicas,
+    launches *n_streams* long keyed decode streams, hard-kills the
+    most-loaded replica (no drain, no goodbye — its KV cache and slot
+    table vanish), then boots a SAME-NAME replacement at a new URL: the
+    fresh boot nonce makes the pool take the handle over and walk it
+    through probation. Reports ``crash_recovery_s`` — kill to the
+    replacement ROUTABLE again — plus streams preserved (every keyed
+    request must finish token-exact against a quiet run: in-flight work
+    on the victim re-drives on the survivor under the same idempotency
+    keys) and whether the victim actually held streams when it died
+    (an unloaded kill is a vacuous draw the gate retries)."""
+    import dataclasses
+    import random as _random
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.paged import PagedDecodeServer
+    from kubetpu.router import ReplicaServer, RouterServer
+    from kubetpu.wire.httpcommon import request_json
+
+    dcfg = dataclasses.replace(cfg, remat=False)
+    params = init_params(jax.random.PRNGKey(0), dcfg)
+    rng = _random.Random(0)
+    prompts = [[rng.randrange(1, dcfg.vocab) for _ in range(prompt_len)]
+               for _ in range(n_streams)]
+    max_seq = -(-(prompt_len + max_new + 2) // page_size) * page_size
+
+    def make_server():
+        return PagedDecodeServer(
+            dcfg, params, n_slots=n_slots, max_seq=max_seq,
+            max_new_tokens=max_new, page_size=page_size)
+
+    quiet = make_server()
+    expected = []
+    for p in prompts:
+        rid = quiet.enqueue(p)
+        quiet.drain()
+        expected.append(quiet.pop_result(rid))
+
+    replicas = [ReplicaServer(make_server(), f"crash{i}", idle_wait=0.002)
+                for i in range(n_replicas)]
+    router = RouterServer(load_refresh_s=0.05)
+    replacement = None
+    try:
+        router.start()
+        for rep in replicas:
+            rep.start()
+            router.register_replica(rep.address)
+
+        def one(item):
+            i, prompt = item
+            return request_json(
+                router.address + "/generate",
+                {"prompt": prompt, "timeout": 120.0},
+                idempotency_key=f"crash-storm-{i}", timeout=120.0)
+
+        ex = ThreadPoolExecutor(max_workers=n_streams)
+        futs = [ex.submit(one, (i, p)) for i, p in enumerate(prompts)]
+        victim = None
+        deadline = time.monotonic() + 20.0
+        while victim is None and time.monotonic() < deadline:
+            loads = []
+            for rep in replicas:
+                with rep._cv:
+                    loads.append(len(rep.server.migratable_rids()))
+            if max(loads) > 0:
+                victim = replicas[loads.index(max(loads))]
+            else:
+                time.sleep(0.002)
+        loaded = victim is not None
+        if victim is None:          # streams finished before the kill
+            victim = replicas[0]
+        victim.shutdown(graceful=False)
+        t0 = time.perf_counter()
+        replacement = ReplicaServer(make_server(), victim.name,
+                                    idle_wait=0.002)
+        replacement.start()
+        router.register_replica(replacement.address)
+        while victim.name not in router.pool.routable():
+            router.pool.refresh(0.0)
+            time.sleep(0.002)
+        recovery_s = time.perf_counter() - t0
+        bodies = [f.result() for f in futs]
+        ex.shutdown()
+        preserved = sum(1 for b, want in zip(bodies, expected)
+                        if b.get("tokens") == want)
+        takeovers = len(router.events.events(kind="replica_takeover"))
+        for rep in replicas:
+            if rep is not victim:
+                rep.server.check_invariants()
+        replacement.server.check_invariants()
+        return ({
+            "metric": "crash_storm",
+            "arm": "crash_replace",
+            "value": round(recovery_s, 4),
+            "unit": "kill-to-routable seconds",
+            "streams_preserved": preserved,
+            "requests": n_streams,
+            "takeovers": takeovers,
+            "loaded": loaded,
+            "n_replicas": n_replicas,
+            "max_new": max_new,
+        },)
+    finally:
+        router.shutdown()
+        for rep in replicas:
+            rep.shutdown(graceful=False)
+        if replacement is not None:
+            replacement.shutdown(graceful=False)
+
+
 def disagg_storm(cfg, n_long=2, long_len=96, n_short=6, short_len=8,
                  max_new=24, page_size=16, prefill_budget=16, n_slots=8,
                  n_prefill=1, n_decode=2, disagg_prefill_budget=None,
@@ -1898,6 +2013,19 @@ def main() -> int:
                 max_new=4 if args.smoke else 16,
                 page_size=16,
                 prefill_budget=32 if args.smoke else 256):
+            emit(row)
+        # Round-20: crash tolerance — SIGKILL a loaded replica
+        # mid-storm, boot a same-name replacement (boot-nonce
+        # takeover), measure kill-to-routable recovery with every
+        # keyed stream preserved token-exact
+        for row in crash_storm(
+                cfg,
+                n_replicas=2,
+                n_streams=2 if args.smoke else 4,
+                prompt_len=16 if args.smoke else 64,
+                max_new=48 if args.smoke else 128,
+                page_size=16,
+                n_slots=2 if args.smoke else 4):
             emit(row)
         emit(spec_serving_throughput(cfg, n_slots=2 if args.smoke else 4,
                                      prompt_len=16 if args.smoke else 128,

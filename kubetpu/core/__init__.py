@@ -9,12 +9,15 @@ from kubetpu.core.group_scheduler import (
     return_pod_resources,
     take_pod_resources,
 )
+from kubetpu.core.journal import Journal, JournalCorrupt
 from kubetpu.core.metrics import LatencyRecorder
 
 __all__ = [
     "Cluster",
     "ClusterNode",
     "SchedulingError",
+    "Journal",
+    "JournalCorrupt",
     "fill_allocate_from",
     "return_pod_resources",
     "take_pod_resources",

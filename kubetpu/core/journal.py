@@ -1,0 +1,332 @@
+"""``Journal`` — the controller's durable write-ahead log (Round-20).
+
+The scheduler is only as trustworthy as the cluster state it scores
+against, and until this round that state lived purely in controller
+memory: a SIGKILL stranded every agent-held allocation and forgot every
+placement, milli binding and pending pod. This module is the crash
+layer's foundation — an append-only, checksummed, torn-tail-tolerant
+JSONL WAL plus an atomically-replaced snapshot:
+
+- **Record format**: one JSON object per line,
+  ``{"seq": N, "kind": K, "data": {...}, "crc": C}`` where ``crc`` is
+  the CRC-32 of the canonical (sorted-key, tight-separator) encoding of
+  ``[seq, kind, data]``. The checksum makes torn writes and bit rot
+  DETECTABLE; canonical encoding makes it stable across writers.
+- **Torn tail**: a crash mid-``append`` can leave a partial or
+  corrupt LAST line. ``replay()`` drops it (counted in
+  ``torn_tail_dropped``) — a torn tail is the expected signature of the
+  very crash this journal exists to survive. A corrupt record anywhere
+  ELSE is real damage and raises ``JournalCorrupt``: silently skipping
+  mid-file records would replay a state that never existed.
+- **Snapshot + compaction**: ``snapshot(state)`` writes
+  ``<path>.snap`` via tmp + ``os.replace`` (atomic: readers see the old
+  complete snapshot or the new complete one, never a torn half), THEN
+  truncates the WAL. A crash between the two steps is safe because
+  replay skips WAL records with ``seq <= snapshot.seq`` — re-applying
+  the compaction is idempotent. The snapshot carries its own CRC.
+- **Replay**: ``replay()`` returns ``(snapshot_state, records)`` —
+  the caller reduces them into live state. Replaying the same journal
+  twice yields the same result (no side effects in this module).
+
+Durability is ``flush`` by default (the OS has the bytes — survives
+process SIGKILL, the failure mode this round models); pass
+``fsync=True`` for power-loss durability at a per-append ``fsync``
+cost. Stdlib only; one writer per path (the controller serializes
+appends under its own lock, and this module adds a lock of its own so
+journal stats never tear).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+
+class JournalCorrupt(Exception):
+    """A checksum/parse failure NOT at the tail — the journal holds
+    records that cannot be trusted and replay must not guess."""
+
+
+def _canonical(seq: int, kind: str, data: dict) -> bytes:
+    return json.dumps([seq, kind, data], sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def _crc(seq: int, kind: str, data: dict) -> int:
+    return zlib.crc32(_canonical(seq, kind, data)) & 0xFFFFFFFF
+
+
+class Journal:
+    """Append-only WAL + snapshot for one controller's durable state."""
+
+    def __init__(self, path: str, fsync: bool = False) -> None:
+        self.path = path
+        self.snap_path = path + ".snap"
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._fh = None
+        # stats surfaced by the controller's recovery gauges
+        self.records_appended = 0
+        self.bytes_appended = 0
+        self.torn_tail_dropped = 0
+        self.snapshots_written = 0
+        # resume the sequence where the existing journal left off — an
+        # append after restart must never reuse a seq (replay orders and
+        # dedups by it)
+        self._seq = self._scan_last_seq()
+
+    # -- write side ----------------------------------------------------------
+
+    def _open(self):
+        if self._fh is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            # only ever called from append(), inside `with self._lock:`
+            # — the lazy open shares append's critical section
+            # ktlint: disable=KTP003
+            self._fh = open(self.path, "a", encoding="utf-8")
+        return self._fh
+
+    def append(self, kind: str, data: Optional[dict] = None) -> int:
+        """Durably record one state-mutating op; returns its seq. The
+        record is flushed (and optionally fsynced) before this returns —
+        the controller calls this BEFORE acking the client, so an acked
+        op is never lost to a SIGKILL."""
+        data = data or {}
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            line = json.dumps(
+                {"seq": seq, "kind": kind, "data": data,
+                 "crc": _crc(seq, kind, data)},
+                sort_keys=True, separators=(",", ":")) + "\n"
+            fh = self._open()
+            fh.write(line)
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+            self.records_appended += 1
+            self.bytes_appended += len(line)
+        return seq
+
+    def snapshot(self, state: dict) -> int:
+        """Persist *state* as the new recovery baseline and compact the
+        WAL. Atomic: tmp + ``os.replace`` for the snapshot, then WAL
+        truncation; a crash between the two replays the (now-redundant)
+        WAL records onto the snapshot idempotently because replay skips
+        ``seq <= snapshot.seq``."""
+        with self._lock:
+            seq = self._seq
+            body = {"seq": seq, "state": state,
+                    "crc": _crc(seq, "snapshot", state)}
+            tmp = self.snap_path + ".tmp"
+            d = os.path.dirname(os.path.abspath(self.snap_path))
+            os.makedirs(d, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(body, fh, sort_keys=True, separators=(",", ":"))
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.snap_path)
+            # WAL truncation AFTER the snapshot landed: the baseline must
+            # exist before the records folded into it disappear
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            with open(self.path, "w", encoding="utf-8"):
+                pass
+            self.snapshots_written += 1
+        return seq
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- read side -----------------------------------------------------------
+
+    def _scan_last_seq(self) -> int:
+        """Highest trusted seq across snapshot + WAL (tolerating a torn
+        tail) — where appends resume after a restart."""
+        last = 0
+        snap = self._read_snapshot()
+        if snap is not None:
+            last = snap[0]
+        for rec in self._iter_wal(count_torn=False):
+            last = max(last, rec["seq"])
+        return last
+
+    def _read_snapshot(self) -> Optional[Tuple[int, dict]]:
+        try:
+            with open(self.snap_path, "r", encoding="utf-8") as fh:
+                body = json.load(fh)
+        except FileNotFoundError:
+            return None
+        except (ValueError, OSError) as e:
+            # the snapshot is written atomically (tmp + replace): a torn
+            # one cannot happen by crash, only by external damage
+            raise JournalCorrupt(
+                f"snapshot {self.snap_path} unreadable: {e}") from e
+        seq = int(body.get("seq", 0))
+        state = body.get("state", {})
+        if body.get("crc") != _crc(seq, "snapshot", state):
+            raise JournalCorrupt(
+                f"snapshot {self.snap_path} failed its checksum")
+        return seq, state
+
+    def _iter_wal(self, count_torn: bool = True) -> Iterator[dict]:
+        """Yield trusted WAL records in file order. A bad LAST line is a
+        torn tail (dropped, counted); a bad line with trusted records
+        AFTER it is corruption and raises."""
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                lines = fh.readlines()
+        except FileNotFoundError:
+            return
+        pending_bad: Optional[str] = None
+        for line in lines:
+            if not line.strip():
+                continue
+            rec = self._parse(line)
+            if rec is None:
+                if pending_bad is not None:
+                    raise JournalCorrupt(
+                        f"{self.path}: corrupt record mid-file "
+                        f"(not a torn tail): {pending_bad[:80]!r}")
+                pending_bad = line
+                continue
+            if pending_bad is not None:
+                raise JournalCorrupt(
+                    f"{self.path}: corrupt record mid-file "
+                    f"(not a torn tail): {pending_bad[:80]!r}")
+            yield rec
+        if pending_bad is not None and count_torn:
+            with self._lock:
+                self.torn_tail_dropped += 1
+
+    @staticmethod
+    def _parse(line: str) -> Optional[dict]:
+        try:
+            rec = json.loads(line)
+            seq = int(rec["seq"])
+            kind = rec["kind"]
+            data = rec["data"]
+        except (ValueError, KeyError, TypeError):
+            return None
+        if rec.get("crc") != _crc(seq, kind, data):
+            return None
+        return {"seq": seq, "kind": kind, "data": data}
+
+    def replay(self) -> Tuple[Dict[str, Any], List[dict]]:
+        """``(snapshot_state, records)``: the compacted baseline (``{}``
+        when none) plus every trusted WAL record newer than it, in seq
+        order. Pure read — calling it twice yields the same result."""
+        snap = self._read_snapshot()
+        snap_seq, state = snap if snap is not None else (0, {})
+        records = [r for r in self._iter_wal() if r["seq"] > snap_seq]
+        records.sort(key=lambda r: r["seq"])
+        return state, records
+
+    def replay_state(self) -> Dict[str, Any]:
+        """The reduced controller state this journal describes —
+        ``replay()`` folded through ``reduce_records``. What a cold
+        restart boots from."""
+        state, records = self.replay()
+        return reduce_records(state, records)
+
+    def stats(self) -> dict:
+        with self._lock:
+            try:
+                wal_bytes = os.path.getsize(self.path)
+            except OSError:
+                wal_bytes = 0
+            return {
+                "records_appended": self.records_appended,
+                "bytes_appended": self.bytes_appended,
+                "torn_tail_dropped": self.torn_tail_dropped,
+                "snapshots_written": self.snapshots_written,
+                "wal_bytes": wal_bytes,
+                "seq": self._seq,
+            }
+
+
+# -- the reducer ------------------------------------------------------------
+#
+# Journal records are LOGICAL controller ops; this pure function folds
+# them into the state a cold restart boots from. Keeping it here (not in
+# the controller) lets the boundary tests replay a truncated WAL without
+# a live control plane, and makes "replay is idempotent" a property of
+# plain data: reduce(reduce(s, r), []) == reduce(s, r).
+
+
+def empty_state() -> Dict[str, Any]:
+    return {
+        "agents": {},       # node name -> {"url": ..., "token": ...}
+        "placements": {},   # pod name -> {"pod": pod_json, "node": name}
+        "pending": [],      # pod_json, FIFO — queue order survives restart
+        "cordons": [],      # operator cordons (health cordons re-derive)
+        "gang_seq": 0,      # high-water gang id — new_gang_id must not collide
+    }
+
+
+def _drop_pending(state: Dict[str, Any], name: str) -> None:
+    state["pending"] = [
+        p for p in state["pending"] if p.get("name") != name]
+
+
+def _note_gang(state: Dict[str, Any], pod_json: dict) -> None:
+    gid = (pod_json.get("requests") or {}).get("kubetpu/gang")
+    try:
+        state["gang_seq"] = max(state["gang_seq"], int(gid))
+    except (TypeError, ValueError):
+        pass
+
+
+def reduce_records(state: Dict[str, Any],
+                   records: List[dict]) -> Dict[str, Any]:
+    """Fold WAL *records* into *state* (a snapshot or ``empty_state()``).
+    Mutates and returns *state*. Unknown kinds are ignored — an older
+    controller replaying a newer journal degrades instead of crashing."""
+    base = empty_state()
+    for key, dfl in base.items():
+        state.setdefault(key, dfl)
+    for rec in records:
+        kind, d = rec["kind"], rec["data"]
+        if kind == "node_register":
+            state["agents"][d["name"]] = {
+                "url": d["url"], "token": d.get("token")}
+        elif kind == "node_dead":
+            state["agents"].pop(d["name"], None)
+            # its placements fall to pending, the same motion the live
+            # reconcile pass makes on a breaker eviction
+            for pname in sorted(
+                    n for n, pl in state["placements"].items()
+                    if pl["node"] == d["name"]):
+                pl = state["placements"].pop(pname)
+                _drop_pending(state, pname)
+                state["pending"].append(pl["pod"])
+        elif kind == "pod_place":
+            _drop_pending(state, d["pod"]["name"])
+            state["placements"][d["pod"]["name"]] = {
+                "pod": d["pod"], "node": d["node"]}
+            _note_gang(state, d["pod"])
+        elif kind == "pod_pending":
+            name = d["pod"]["name"]
+            state["placements"].pop(name, None)
+            _drop_pending(state, name)
+            state["pending"].append(d["pod"])
+            _note_gang(state, d["pod"])
+        elif kind == "pod_delete":
+            state["placements"].pop(d["name"], None)
+            _drop_pending(state, d["name"])
+        elif kind == "cordon":
+            if d.get("on", True):
+                if d["name"] not in state["cordons"]:
+                    state["cordons"].append(d["name"])
+            else:
+                state["cordons"] = [
+                    c for c in state["cordons"] if c != d["name"]]
+    return state

@@ -96,6 +96,13 @@ class ScalePolicy:
     # DENSITY (packed fractional replicas) and not just replica count;
     # 1.0 keeps whole-chip replicas and the legacy launcher shapes
     vchip_frac: float = 1.0
+    # Round-20 crash tolerance: when the breaker confirms a replica
+    # DEAD and the reap removes it, immediately boot a replacement —
+    # bypassing cooldown and hysteresis, which exist to damp LOAD
+    # noise (a hard kill is not noise) — as long as the pool stays
+    # under max_replicas. The Round-19 peer prefix tier warms the
+    # newcomer from the survivors' caches, so it joins warm not cold.
+    crash_replace: bool = True
 
     def __post_init__(self):
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -317,6 +324,7 @@ class ReplicaAutoscaler:
         all of them: independent pools may both act in one pass)."""
         self.router.pool.refresh(0.0)
         self.router.evaluate_slos(0.0)
+        actions: List[str] = []
         with self._lock:
             cur_victims = set(self._victim.values())
         # reap DEAD replicas (breaker-confirmed gone): their streams
@@ -332,13 +340,15 @@ class ReplicaAutoscaler:
                 # floor-heal can restore it (crash-reap only — operator
                 # removals go through remove_replica directly and must
                 # not be fought)
+                role = self.router.pool.role(name) or "both"
                 with self._lock:
-                    self._known_pools.add(
-                        self.router.pool.role(name) or "both")
+                    self._known_pools.add(role)
                 self.router.remove_replica(name)
                 self.events.emit("reap", replica=name)
+                replaced = self._crash_replace(role, reaped=name)
+                if replaced is not None:
+                    actions.append(replaced)
         pools: Dict[str, dict] = {}
-        actions: List[str] = []
         now = time.monotonic()
         keys = self._pool_keys()
         for key in keys:
@@ -387,6 +397,26 @@ class ReplicaAutoscaler:
                 "cold": first["cold"], "pools": pools,
                 "action": actions[0] if actions else None,
                 "actions": actions}
+
+    def _crash_replace(self, key: str, reaped: str) -> Optional[str]:
+        """Reap follow-up (Round-20): a breaker-confirmed crash just
+        took a replica out of pool *key* — boot its replacement NOW
+        instead of waiting for the pool to reheat through hysteresis
+        or fall under the ``min_replicas`` floor. Bounded by
+        ``max_replicas``; a failed launch counts a ``scale_error`` and
+        the pool re-heals through the usual floor/heat paths. Returns
+        the ``scale_up:`` action so the poll reports it."""
+        p = self._policy_for(key)
+        if not p.crash_replace:
+            return None
+        sig = self.signals(role=key)
+        if sig["replicas"] >= p.max_replicas:
+            return None
+        action = self._scale_up(key, sig)
+        if action is not None:
+            self.events.emit("crash_replace", role=key, reaped=reaped,
+                             replacement=action.split(":", 1)[1])
+        return action
 
     def _scale_up(self, key: str, sig: dict) -> Optional[str]:
         if key not in ("both", None) and self._launcher_nargs < 1:

@@ -86,6 +86,11 @@ class ReplicaHandle:
         self.draining = False
         self.load: Optional[dict] = None
         self.last_seen = 0.0
+        # Round-20 boot-nonce fencing: the replica process's per-boot
+        # identity (from /healthz and /load). A changed nonce under the
+        # same name means the process restarted — its KV cache is gone
+        # and any mid-stream state with it.
+        self.nonce: Optional[str] = None
 
     def routable(self) -> bool:
         return self.state in (HEALTHY, PROBATION) and not self.draining
@@ -127,6 +132,16 @@ class ReplicaPool:
         self._lock = threading.Lock()
         self._replicas: Dict[str, ReplicaHandle] = {}
         self._last_refresh = 0.0
+        # Round-20: observers of hard-kill restarts (same name, new
+        # boot nonce) — the router drops its mid-stream pins here
+        self._restart_cbs: List = []
+        self._c_restarts = self.registry.counter(
+            "kubetpu_router_replica_restarts_total",
+            "replicas seen returning with a NEW boot nonce (cache-wiped)")
+        self._c_takeovers = self.registry.counter(
+            "kubetpu_router_replica_takeovers_total",
+            "same-name re-registrations that took over a dead/restarted "
+            "handle")
         for state in (HEALTHY, SUSPECT, PROBATION, DEAD):
             # state ranges over the fixed literal tuple above (KTP004's
             # bounded proof); closure binds the loop variable by default
@@ -139,38 +154,91 @@ class ReplicaPool:
             return sum(1 for h in self._replicas.values()
                        if h.state == state)
 
+    # -- restart observation (Round-20) --------------------------------------
+
+    def on_restart(self, cb) -> None:
+        """Register ``cb(name)`` to fire when a replica is recognized as
+        restarted (same name, new boot nonce) — takeover registrations
+        included. Callbacks run outside the pool lock; exceptions are
+        swallowed (an observer must not break breaker bookkeeping)."""
+        self._restart_cbs.append(cb)
+
+    def _fire_restart(self, name: str) -> None:
+        self._c_restarts.inc()
+        for cb in list(self._restart_cbs):
+            try:
+                cb(name)
+            except Exception:  # noqa: BLE001 — observers are best-effort
+                pass
+
     # -- membership ----------------------------------------------------------
 
     def add(self, url: str, name: Optional[str] = None,
             role: Optional[str] = None) -> str:
         """Register a replica by URL; probes ``/healthz`` for its name
-        (and serving ROLE — Round-17) unless given. Idempotent: the
-        same URL re-registers as the same handle (breaker state kept).
-        A DIFFERENT url under an existing name is refused — silently
-        swapping the handle would orphan the first replica (running,
-        unobserved, undrained) and repoint its ring arcs; remove the
-        old one first."""
+        (serving ROLE — Round-17 — and boot nonce — Round-20) unless
+        given. Idempotent: the same URL re-registers as the same handle
+        (breaker state kept). A DIFFERENT url under an existing name is
+        refused — silently swapping the handle would orphan the first
+        replica (running, unobserved, undrained) and repoint its ring
+        arcs — UNLESS the newcomer is a legitimate restart of the same
+        replica: the existing handle is breaker-DEAD, or the probe
+        returned a boot nonce the handle doesn't carry. A restart TAKES
+        OVER the handle in place (``replica_takeover`` event): the name
+        keeps its ring arcs, the breaker walks probation from suspect,
+        and restart observers fire so the router drops its mid-stream
+        pins."""
         url = url.rstrip("/")
+        probed_nonce = None
         if name is None:
             body = request_json(url + "/healthz",
                                 timeout=self.scrape_timeout)
             name = body.get("replica") or url
             role = role or body.get("role")
+            probed_nonce = body.get("boot_nonce")
         # explicit-name registration stays probe-free: the role
         # defaults to "both" and the replica's own /load word corrects
         # it on the first refresh (the router refreshes right after
         # registering, before granting ring arcs)
         role = role or "both"
+        takeover_from = None
         with self._lock:
             existing = self._replicas.get(name)
             if existing is not None:
                 if existing.url == url:
                     return name
-                raise ValueError(
-                    f"replica name {name!r} is already registered at "
-                    f"{existing.url}; remove it before registering "
-                    f"{url}")
-            self._replicas[name] = ReplicaHandle(name, url, role=role)
+                restarted = (
+                    existing.state == DEAD
+                    or (probed_nonce is not None
+                        and existing.nonce is not None
+                        and probed_nonce != existing.nonce))
+                if not restarted:
+                    raise ValueError(
+                        f"replica name {name!r} is already registered at "
+                        f"{existing.url}; remove it before registering "
+                        f"{url}")
+                takeover_from = existing.url
+                existing.url = url
+                existing.role = role if role in ROLES else existing.role
+                existing.nonce = probed_nonce
+                # the restarted process is cache-wiped and unproven: it
+                # re-earns routing through probation (the next clean
+                # /load probe moves SUSPECT -> PROBATION), and its old
+                # load snapshot is meaningless
+                existing.state = SUSPECT
+                existing.misses = 0
+                existing.passes = 0
+                existing.load = None
+            else:
+                h = ReplicaHandle(name, url, role=role)
+                h.nonce = probed_nonce
+                self._replicas[name] = h
+        if takeover_from is not None:
+            self._c_takeovers.inc()
+            self.events.emit("replica_takeover", replica=name, url=url,
+                             old_url=takeover_from)
+            self._fire_restart(name)
+            return name
         self.events.emit("replica_register", replica=name, url=url,
                          role=role)
         return name
@@ -266,10 +334,26 @@ class ReplicaPool:
             self.events.emit(transition, replica=name, misses=misses)
 
     def _record_ok(self, name: str, load: dict) -> None:
+        restarted = False
         with self._lock:
             h = self._replicas.get(name)
             if h is None:
                 return
+            # Round-20 boot-nonce fencing: a /load answering under the
+            # same name with a NEW nonce is a hard-killed-and-restarted
+            # process — its KV cache and in-flight streams are gone.
+            # Force the breaker to SUSPECT so the normal ok-path below
+            # walks it through probation (never straight back to
+            # healthy on the very probe that revealed the restart), and
+            # let the restart observers (the router's unpin hook) fire.
+            nonce = load.get("boot_nonce")
+            if (nonce is not None and h.nonce is not None
+                    and nonce != h.nonce):
+                restarted = True
+                h.state = SUSPECT
+                h.passes = 0
+            if nonce is not None:
+                h.nonce = nonce
             h.load = dict(load)
             if load.get("role") in ROLES:
                 h.role = load["role"]     # the replica's own word wins
@@ -292,6 +376,9 @@ class ReplicaPool:
                 h.passes += 1
                 if h.passes >= self.probation_passes:
                     h.state, transition = HEALTHY, "replica_recovered"
+        if restarted:
+            self.events.emit("replica_restart", replica=name)
+            self._fire_restart(name)
         if transition:
             self.events.emit(transition, replica=name)
 

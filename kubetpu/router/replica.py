@@ -184,6 +184,12 @@ class ReplicaServer:
         self.handoff_workers = int(handoff_workers)
         self.server = server
         self.name = name
+        # Round-20 boot-nonce fencing: a fresh identity every process
+        # boot, advertised in /healthz and /load. The pool compares it
+        # across probes — a same-name replica answering with a NEW nonce
+        # is a hard-killed-and-restarted (cache-wiped) process, and the
+        # router unpins its mid-stream rids for re-drive on survivors.
+        self.boot_nonce = uuid.uuid4().hex
         self.token = token or None
         self.faults = faults
         self.idem = IdempotencyCache(ttl=idem_window)
@@ -279,6 +285,7 @@ class ReplicaServer:
                         "replica": replica.name,
                         "role": replica.role,
                         "draining": replica.draining,
+                        "boot_nonce": replica.boot_nonce,
                     })
                 elif not self._authorized():
                     pass  # 401 already sent
@@ -1461,6 +1468,7 @@ class ReplicaServer:
         info["replica"] = self.name
         info["role"] = self.role
         info["draining"] = self.draining
+        info["boot_nonce"] = self.boot_nonce
         # GIL-atomic len reads, like the server's own host counters —
         # the load snapshot is advisory, never a synchronized view
         info["inflight_handoffs"] = len(self._handoffs)

@@ -187,6 +187,18 @@ class RouterServer:
         self._c_migrate_away = self.registry.counter(
             "kubetpu_router_migrate_away_total",
             "breaker-suspect migrate-away sweeps requested")
+        self._c_restart_unpins = self.registry.counter(
+            "kubetpu_router_restart_unpins_total",
+            "mid-stream pins dropped because their owner replica came "
+            "back with a new boot nonce")
+        # Round-20: a replica that returns with a NEW boot nonce was
+        # hard-killed — its slot table, KV pages, and stream epochs are
+        # gone, so any pin naming it points at state that no longer
+        # exists. Drop those pins so the keyed client retries re-enter
+        # the normal route path and land on a survivor (or the fresh
+        # boot); the idempotency key plus epoch fencing make the
+        # re-drive safe to replay.
+        self.pool.on_restart(self._on_replica_restart)
         self.registry.gauge_fn("kubetpu_router_burning",
                                lambda: 1.0 if self._burning() else 0.0)
         # SLO engine over the FEDERATED scrape (worst replica judged) —
@@ -610,6 +622,19 @@ class RouterServer:
     def _unpin(self, leg_key: str) -> None:
         with self._lock:
             self._pins.pop(leg_key, None)
+
+    def _on_replica_restart(self, name: str) -> None:
+        """Pool-detected hard restart of *name* (boot nonce changed):
+        unpin every mid-stream rid that was bound to it so re-drives
+        land on replicas that still hold (or can rebuild) the stream."""
+        with self._lock:
+            stale = [k for k, pin in self._pins.items() if pin[0] == name]
+            for k in stale:
+                self._pins.pop(k, None)
+        if stale:
+            self._c_restart_unpins.inc(len(stale))
+            self.events.emit("restart_unpin", replica=name,
+                             pins=len(stale))
 
     def _note_migrated(self, leg_key: str, mig: dict,
                        from_replica: Optional[str] = None) -> None:

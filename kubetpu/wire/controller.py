@@ -73,6 +73,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -80,6 +81,7 @@ from typing import Dict, List, Optional
 from kubetpu.api import utils
 from kubetpu.core import Cluster, SchedulingError
 from kubetpu.core.cluster import GangKey, _reset_for_reschedule, pod_priority
+from kubetpu.core.journal import Journal
 from kubetpu.obs import trace as obs_trace
 from kubetpu.obs.events import EventLog
 from kubetpu.obs.registry import Registry, federate, install_process_gauges
@@ -94,10 +96,12 @@ from kubetpu.wire.codec import (
 )
 from kubetpu.wire.httpcommon import (
     NO_RETRY,
+    TRANSIENT_ERRORS,
     IdempotencyCache,
     InflightTracker,
     check_bearer,
     handle_guarded,
+    request_json,
     request_text,
     run_idempotent,
     serve_events_jsonl,
@@ -150,13 +154,28 @@ class ControllerServer:
         agent_retry=None,
         idem_window: float = 300.0,
         slos: Optional[List[Objective]] = None,
+        journal_path: Optional[str] = None,
+        journal_fsync: bool = False,
+        journal_compact_bytes: int = 256 * 1024,
     ) -> None:
         """(Round-11 additions) *slos*: declarative fleet objectives
         (``obs.slo.fleet_slos(...)`` builds the standard set) evaluated
         over the controller's OWN federated ``/metrics`` after every
         reconcile pass — burn rates render as ``kubetpu_slo_*`` gauges
         and structured results serve at ``GET /slo``, the decision
-        surface the autoscaling roadmap item consumes."""
+        surface the autoscaling roadmap item consumes.
+
+        (Round-20 crash tolerance) *journal_path*: an append-only,
+        checksummed WAL of every state-mutating op, written BEFORE the
+        client is acked — on boot the journal replays, agents are
+        re-probed, placements re-pin through the normal scheduler, and
+        the agents' actual allocation ledgers are reconciled against the
+        replayed state (orphans freed, ghosts re-pended) before the
+        control plane accepts mutations again (``/healthz`` reports
+        ``recovering`` until then). *journal_fsync*: fsync per append
+        (power-loss durability; default survives process SIGKILL).
+        *journal_compact_bytes*: WAL size that triggers the periodic
+        snapshot + truncation on the reconcile loop."""
         self.cluster = cluster or Cluster()
         self.poll_interval = poll_interval
         self.token = token or None
@@ -204,6 +223,46 @@ class ControllerServer:
             "kubetpu_fractional_allocations_total",
             "vChip (fractional) pod placements")
         self._occ_seen: set = set()
+        # Round-20 durable control plane: replay the WAL (if any) into a
+        # recovered-state snapshot NOW; the actual re-probe/re-place/
+        # reconcile runs in _recover() from start(), with the wire
+        # answering 503 to mutations (healthz: "recovering") until the
+        # reconciled state passes check_invariants().
+        self.journal: Optional[Journal] = None
+        self.journal_compact_bytes = journal_compact_bytes
+        self._recovered_state: Optional[dict] = None
+        self.recovering = False
+        for key in ("orphans_freed", "ghosts_repended",
+                    "placements_restored", "agents_unreachable",
+                    "replays"):
+            # key ranges over the fixed literal tuple above — KTP004's
+            # bounded-f-string proof expands and validates every name
+            self.registry.counter(f"kubetpu_recovery_{key}_total")
+        if journal_path:
+            self.journal = Journal(journal_path, fsync=journal_fsync)
+            recovered = self.journal.replay_state()
+            if (recovered["agents"] or recovered["placements"]
+                    or recovered["pending"]):
+                self._recovered_state = recovered
+                self.recovering = True
+            journal = self.journal
+            self.registry.gauge_fn(
+                "kubetpu_journal_seq", lambda: journal.stats()["seq"])
+            self.registry.gauge_fn(
+                "kubetpu_journal_wal_bytes",
+                lambda: journal.stats()["wal_bytes"])
+            self.registry.gauge_fn(
+                "kubetpu_journal_records_appended",
+                lambda: journal.stats()["records_appended"])
+            self.registry.gauge_fn(
+                "kubetpu_journal_snapshots",
+                lambda: journal.stats()["snapshots_written"])
+            self.registry.gauge_fn(
+                "kubetpu_journal_torn_tails",
+                lambda: journal.stats()["torn_tail_dropped"])
+        self.registry.gauge_fn(
+            "kubetpu_controller_recovering",
+            lambda: 1.0 if self.recovering else 0.0)
         # circuit-breaker thresholds: ``suspect_after`` consecutive missed
         # probes health-cordon a node (pods kept, no new placements);
         # ``dead_after`` consecutive misses evict it. ``dead_after=1`` is
@@ -267,7 +326,8 @@ class ControllerServer:
                 # scheduling or reconciliation.
                 if self.path == "/healthz":
                     self._reply(200, {"ok": True,
-                                      "draining": controller.draining})
+                                      "draining": controller.draining,
+                                      "recovering": controller.recovering})
                     return
                 if not self._authorized():
                     return
@@ -336,6 +396,12 @@ class ControllerServer:
             def _do_post(self):
                 if not self._authorized():
                     return
+                if controller.recovering:
+                    # the wire stays closed to mutations until replay +
+                    # reconciliation pass check_invariants — a 503 so a
+                    # keyed client retry re-executes once we're open
+                    self._reply(503, {"error": "controller is recovering"})
+                    return
                 if controller.draining and self.path != "/pods":
                     self._reply(503, {"error": "controller is draining"})
                     return
@@ -381,6 +447,9 @@ class ControllerServer:
                                 with controller._lock:
                                     controller.cluster.cordon(
                                         name, on=action == "cordon")
+                                controller._journal(
+                                    "cordon", name=name,
+                                    on=action == "cordon")
                                 out = {action: name}
                             self._reply(200, out)
                         except KeyError:
@@ -398,11 +467,16 @@ class ControllerServer:
                     self._reply(400, {"error": str(e)})
                 except SchedulingError as e:
                     self._reply(409, {"error": str(e)})
-                except ConnectionError as e:
+                except TRANSIENT_ERRORS as e:
                     # an agent wire leg died mid-request (state rolled
                     # back): transient infra, answered 503 so a keyed
                     # client retry re-executes instead of surfacing a
-                    # dead-end 500
+                    # dead-end 500. The WHOLE transient family, not just
+                    # ConnectionError — during an agent's kill->restart
+                    # window the escape is as often a connection-reset
+                    # OSError, a TimeoutError or an httplib
+                    # RemoteDisconnected, and a plain 500 is terminal
+                    # for keyed retries (the client never re-executes)
                     self._reply(503, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — report, stay up
                     self._reply(500, {"error": str(e)})
@@ -413,6 +487,9 @@ class ControllerServer:
             def _do_delete(self):
                 if not self._authorized():
                     return
+                if controller.recovering:
+                    self._reply(503, {"error": "controller is recovering"})
+                    return
                 if controller.draining:
                     # DELETE mutates cluster state too: a draining control
                     # plane must be FROZEN, not merely not-placing
@@ -422,6 +499,7 @@ class ControllerServer:
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 name = self.path[len("/pods/"):]
+                release_target = None
                 with controller._lock:
                     try:
                         node_name = next(
@@ -435,6 +513,11 @@ class ControllerServer:
                             # next submit that happens to touch the node
                             controller._update_occupancy_gauges(
                                 only_nodes={node_name})
+                            url = controller._node_urls.get(node_name)
+                            if url is not None:
+                                release_target = (
+                                    url,
+                                    controller._agent_token(node_name))
                         out = {"released": name}
                     except KeyError:
                         # a preemption/eviction victim waiting in the
@@ -454,8 +537,26 @@ class ControllerServer:
                             out = None
                 if out is None:
                     self._reply(404, {"error": f"no pod {name!r}"})
-                else:
-                    self._reply(200, out)
+                    return
+                # journal BEFORE the ack (the durable-control-plane
+                # contract), then tell the agent to forget its ledger
+                # entry — best-effort and OUTSIDE the lock: the ledger
+                # is reconciliation metadata, and a dark agent's entry
+                # is freed as an orphan at the next cold restart anyway
+                controller._journal("pod_delete", name=name)
+                if release_target is not None:
+                    url, tok = release_target
+                    try:
+                        # deliberately unkeyed single attempt: the
+                        # retry path for a lost release is the orphan
+                        # reconcile at the next cold restart
+                        # ktlint: disable=KTP002
+                        request_json(url + "/release", {"pod": name},
+                                     token=tok, timeout=5.0,
+                                     retry=NO_RETRY)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                self._reply(200, out)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
 
@@ -497,7 +598,61 @@ class ControllerServer:
             )
             self._node_urls[info.name] = url
             self._health[info.name] = NodeHealth()
+            self._journal("node_register", name=info.name, url=url,
+                          token=token)
             return info.name
+
+    # -- durable journal (Round-20) ------------------------------------------
+
+    def _journal(self, kind: str, **data) -> None:
+        """Durably record one state-mutating op BEFORE its ack — a no-op
+        without ``journal_path``. The journal has its own lock; callers
+        may hold the cluster lock or not."""
+        if self.journal is not None:
+            self.journal.append(kind, data)
+
+    def _journal_state_locked(self) -> dict:
+        """The live state as a journal snapshot — caller holds the lock.
+        Built from the AUTHORITATIVE structures (cluster + queues), so a
+        compaction self-heals any drift an unjournaled corner left in
+        the WAL's reduced view."""
+        placements = {}
+        for nn, node in self.cluster.nodes.items():
+            for pname, placed in node.pods.items():
+                placements[pname] = {
+                    "pod": pod_info_to_json(_reset_for_reschedule(placed)),
+                    "node": nn,
+                }
+        agents = {}
+        for name, url in self._node_urls.items():
+            node = self.cluster.nodes.get(name)
+            agents[name] = {
+                "url": url,
+                "token": getattr(
+                    getattr(node, "device", None), "token", None),
+            }
+        return {
+            "agents": agents,
+            "placements": placements,
+            "pending": [pod_info_to_json(p) for p in self._pending],
+            # health cordons re-derive from live probes after a restart;
+            # persisting them would leave a node cordoned with no breaker
+            # state to ever lift it
+            "cordons": sorted(
+                self.cluster.cordoned - self._health_cordoned),
+            "gang_seq": self.cluster._gang_seq,
+        }
+
+    def compact_journal(self) -> None:
+        """Snapshot the live state and truncate the WAL (the reconcile
+        loop calls this when the WAL crosses ``journal_compact_bytes``;
+        operators can force it)."""
+        if self.journal is None:
+            return
+        with self._lock:
+            state = self._journal_state_locked()
+        seq = self.journal.snapshot(state)
+        self.events.emit("journal_compact", seq=seq)
 
     # -- circuit-breaker node health -----------------------------------------
 
@@ -648,6 +803,9 @@ class ControllerServer:
                     "node": placed.node_name,
                     "containers": self._run_allocations(device, pod_copy),
                 })
+                self._journal("pod_place",
+                              pod=pod_info_to_json(template),
+                              node=placed.node_name)
             except Exception as e:  # noqa: BLE001 — allocate leg died
                 utils.errorf("allocate failed for %s: %s", placed.name, e)
                 rollbacks.append((template, placed))
@@ -656,6 +814,8 @@ class ControllerServer:
                 for template, placed in rollbacks:
                     if self._release_if_current(placed):
                         self._pending.append(template)
+                        self._journal("pod_pending",
+                                      pod=pod_info_to_json(template))
         return done
 
     def _drain(self, name: str) -> dict:
@@ -689,6 +849,11 @@ class ControllerServer:
             ]
         self.events.emit("drain", node=name, migrated=len(migrated),
                          unplaced=len(unplaced))
+        # the drain cordoned the node and pended what fit nowhere; the
+        # migrated re-placements journal from _allocate_batch below
+        self._journal("cordon", name=name, on=True)
+        for p in unplaced:
+            self._journal("pod_pending", pod=pod_info_to_json(p))
         out = {"drained": name,
                "migrated": self._allocate_batch(snapshots)}
         with self._lock:
@@ -772,6 +937,8 @@ class ControllerServer:
             for p in pods:
                 p.requests[GangKey] = gid
         self._pending.extend(pods)
+        for p in pods:
+            self._journal("pod_pending", pod=pod_info_to_json(p))
         return {"queued": [p.name for p in pods]}
 
     def _submit(self, req: dict) -> dict:
@@ -874,6 +1041,19 @@ class ControllerServer:
             # monotonic counter — it re-pends and is counted when its
             # allocation actually lands
             self._count_fractional(placed)
+            # journal BEFORE the ack, AFTER the wire phase survived: a
+            # rolled-back submit writes nothing (the journal never saw
+            # it), a crash after these appends replays the committed
+            # placements. Victims journal as pending — replay moves them
+            # out of their recorded placements the same way the live
+            # path did.
+            for p in placed:
+                self._journal(
+                    "pod_place",
+                    pod=pod_info_to_json(_reset_for_reschedule(p)),
+                    node=p.node_name)
+            for v in evicted:
+                self._journal("pod_pending", pod=pod_info_to_json(v))
         except Exception:
             # all-or-nothing INCLUDING preemption: release what this request
             # placed, then put the victims back where they were — a failed
@@ -957,11 +1137,21 @@ class ControllerServer:
             out["moved"] = [
                 {"pod": p.name, "node": p.node_name} for p in moved
             ]
+            for p in moved:
+                self._journal(
+                    "pod_place",
+                    pod=pod_info_to_json(_reset_for_reschedule(p)),
+                    node=p.node_name)
             if placed_pending is not None:
                 out["pending_pod"] = {
                     "pod": placed_pending.name,
                     "node": placed_pending.node_name,
                 }
+                self._journal(
+                    "pod_place",
+                    pod=pod_info_to_json(
+                        _reset_for_reschedule(placed_pending)),
+                    node=placed_pending.node_name)
         return out
 
     # -- observability (Round-8) ---------------------------------------------
@@ -1120,6 +1310,12 @@ class ControllerServer:
             "kubetpu_controller_reconcile_passes_total").inc()
         with obs_trace.span("controller.reconcile", component="controller"):
             out = self._poll_once()
+        if (self.journal is not None
+                and self.journal.stats()["wal_bytes"]
+                >= self.journal_compact_bytes):
+            # periodic snapshot + compaction rides the reconcile cadence:
+            # replay cost stays bounded by the knob, not by uptime
+            self.compact_journal()
         if self.slo is not None:
             try:
                 self.slo.evaluate(self._metrics_text())
@@ -1181,6 +1377,9 @@ class ControllerServer:
                     self._pending.extend(self.cluster.fail_node(name))
                     failed.append(name)
                     self.events.emit("node_dead", node=name)
+                    # replay moves the dead node's journaled placements
+                    # to pending, mirroring the fail_node motion above
+                    self._journal("node_dead", name=name)
                 elif self._health_state(name) != HEALTHY:
                     # transient so far: pods stay placed, node is health-
                     # cordoned — a blip shorter than the threshold costs
@@ -1339,6 +1538,155 @@ class ControllerServer:
         with self._lock:
             return [p.name for p in self._pending]
 
+    # -- cold-restart recovery (Round-20) ------------------------------------
+
+    def _recover(self) -> dict:
+        """Rebuild the control plane from the replayed journal, then
+        reconcile it against what the agents ACTUALLY hold. Ordering:
+
+        1. re-probe each journaled agent (its pre-crash allocation
+           ledger is scraped FIRST — the diff baseline must be what the
+           agent believed before we start re-allocating);
+        2. re-pin journaled placements through the NORMAL scheduler
+           (``schedule(pod, node_filter)``) — a placement whose node
+           didn't return or no longer fits is a ghost and re-enters the
+           pending queue like any evicted pod;
+        3. re-run the wire allocations for restored placements (launcher
+           env re-derivable; failures roll back to pending via the
+           shared ``_allocate_batch``);
+        4. free agent-ledger ORPHANS — pods an agent still holds that no
+           surviving placement explains;
+        5. re-apply operator cordons (AFTER placement: a cordon keeps
+           its pods, it only blocks new ones);
+        6. gate on ``check_invariants()`` — only a clean cluster opens
+           the wire (``recovering`` flips false); a dirty one raises and
+           leaves mutations refused.
+
+        Every diff surfaces as a ``kubetpu_recovery_*`` counter and an
+        event; the wall-clock cost lands in
+        ``kubetpu_recovery_last_replay_seconds``."""
+        from kubetpu.wire.client import probe_remote_agent
+
+        state = self._recovered_state or {}
+        t0 = time.monotonic()
+        self.registry.counter("kubetpu_recovery_replays_total").inc()
+        reachable: Dict[str, tuple] = {}
+        agent_allocs: Dict[str, set] = {}
+        for name, info in sorted(state.get("agents", {}).items()):
+            url, tok = info["url"], info.get("token")
+            try:
+                dev, ninfo = probe_remote_agent(
+                    url, name=name, token=tok, retry=self.agent_retry)
+            except Exception as e:  # noqa: BLE001 — a dark agent's pods
+                # fall to pending below; the agent re-registers itself
+                # (or the operator does) when it returns
+                self.registry.counter(
+                    "kubetpu_recovery_agents_unreachable_total").inc()
+                self.events.emit("recovery_agent_unreachable",
+                                 node=name, url=url, error=str(e))
+                continue
+            try:
+                body = json.loads(request_text(
+                    url + "/allocations", token=tok, timeout=5.0,
+                    retry=NO_RETRY))
+                agent_allocs[name] = set(body.get("allocations", {}))
+            except Exception:  # noqa: BLE001 — pre-ledger agents have
+                agent_allocs[name] = set()  # nothing to reconcile
+            with self._lock:
+                self.cluster.register_node(
+                    ninfo.name, device=dev, node_info=ninfo, probe=False)
+                self._node_urls[ninfo.name] = url
+                self._health[ninfo.name] = NodeHealth()
+            self.events.emit("recovery_agent", node=name, url=url)
+            reachable[name] = (url, tok)
+        restored: List = []
+        with self._lock:
+            # gang ids must not collide with replayed stamps
+            self.cluster._gang_seq = max(
+                self.cluster._gang_seq, int(state.get("gang_seq", 0)))
+            for pname, pl in sorted(state.get("placements", {}).items()):
+                pod = pod_info_from_json(pl["pod"])
+                node = pl["node"]
+                try:
+                    if node not in self.cluster.nodes:
+                        raise SchedulingError(
+                            f"node {node!r} did not return")
+                    placed = self.cluster.schedule(
+                        pod, lambda n, node=node: n == node)
+                    restored.append(placed)
+                    self.registry.counter(
+                        "kubetpu_recovery_placements_restored_total").inc()
+                except SchedulingError as e:
+                    # ghost placement: journaled but unrealizable — back
+                    # through the pending queue, the normal path
+                    self.registry.counter(
+                        "kubetpu_recovery_ghosts_repended_total").inc()
+                    self.events.emit("recovery_ghost_pod", pod=pname,
+                                     node=node, error=str(e))
+                    self._pending.append(pod)
+            for pj in state.get("pending", []):
+                pod = pod_info_from_json(pj)
+                if not self._pod_name_in_use(pod.name):
+                    self._pending.append(pod)
+            snapshots = [
+                (_reset_for_reschedule(p), p,
+                 *self._snapshot_placed(p.name, p.node_name))
+                for p in restored
+            ]
+        self._allocate_batch(snapshots)
+        # orphans: agent-ledger pods no surviving placement explains
+        with self._lock:
+            orphans = []
+            for node, pods in sorted(agent_allocs.items()):
+                held = self.cluster.nodes.get(node)
+                mine = set(held.pods) if held is not None else set()
+                orphans.extend((node, p) for p in sorted(pods - mine))
+        for node, pname in orphans:
+            url, tok = reachable[node]
+            try:
+                # deliberately unkeyed single attempt: a failed free is
+                # re-diffed (and re-freed) by the next cold restart
+                # ktlint: disable=KTP002
+                request_json(url + "/release", {"pod": pname},
+                             token=tok, timeout=5.0, retry=NO_RETRY)
+                self.registry.counter(
+                    "kubetpu_recovery_orphans_freed_total").inc()
+                self.events.emit("recovery_orphan_freed", node=node,
+                                 pod=pname)
+            except Exception as e:  # noqa: BLE001 — retried next restart
+                self.events.emit("recovery_release_failed", node=node,
+                                 pod=pname, error=str(e))
+        with self._lock:
+            for name in state.get("cordons", []):
+                if name in self.cluster.nodes:
+                    self.cluster.cordon(name)
+            problems = self.cluster.check_invariants()
+            pending_n = len(self._pending)
+        if problems:
+            self.events.emit("recovery_invariants_failed",
+                             problems=problems[:5])
+            raise RuntimeError(
+                f"recovery reconciliation left a dirty cluster; the "
+                f"wire stays closed to mutations: {problems[:5]}")
+        # true-up the journal to the reconciled state: a second restart
+        # replays this snapshot instead of the pre-crash WAL
+        if self.journal is not None:
+            with self._lock:
+                snap = self._journal_state_locked()
+            self.journal.snapshot(snap)
+        dt = time.monotonic() - t0
+        self.registry.gauge(
+            "kubetpu_recovery_last_replay_seconds",
+            "wall-clock cost of the last journal replay + "
+            "reconciliation").set(dt)
+        self.recovering = False
+        out = {"agents": len(reachable), "placements": len(restored),
+               "pending": pending_n, "orphans_freed": len(orphans),
+               "seconds": round(dt, 4)}
+        self.events.emit("recovered", **out)
+        utils.logf(0, "recovered: %s", out)
+        return out
+
     # -- lifecycle -----------------------------------------------------------
 
     def drain_server(self) -> None:
@@ -1358,10 +1706,16 @@ class ControllerServer:
         return f"http://{host}:{port}"
 
     def start(self) -> str:
+        # the wire opens FIRST so liveness probes can watch the
+        # "recovering" flag, but mutations answer 503 until _recover()
+        # reconciles and check_invariants passes; only then does the
+        # reconcile loop start moving pods
         threading.Thread(
             target=self._httpd.serve_forever, name="kubetpu-controller",
             daemon=True,
         ).start()
+        if self.recovering:
+            self._recover()
         self._poll_thread = threading.Thread(
             target=self._poll_loop, name="kubetpu-reconcile", daemon=True
         )
@@ -1385,6 +1739,10 @@ class ControllerServer:
         self._httpd.server_close()
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=self.poll_interval + 5)
+        if self.journal is not None:
+            # every append already flushed before its ack — closing the
+            # handle loses nothing even on the abrupt path
+            self.journal.close()
 
 
 def pod_to_json(pod) -> dict:

@@ -104,7 +104,7 @@ class NodeAgentServer:
         self.registry = Registry()
         install_process_gauges(self.registry, self.obs_component)
         for key in ("nodeinfo_requests", "allocate_requests",
-                    "allocate_replays", "errors"):
+                    "allocate_replays", "releases", "errors"):
             # key ranges over the fixed literal tuple above — KTP004's
             # bounded-f-string proof expands and validates every name
             self.registry.counter(f"kubetpu_agent_{key}_total")
@@ -128,6 +128,14 @@ class NodeAgentServer:
         # interval must not defeat the manager's probe-cache bound). None =
         # never probed (an EMPTY capacity is a valid snapshot).
         self.last_capacity: Optional[dict] = None
+        # Round-20 allocation ledger: which pods this agent has handed
+        # env/devices to (pod -> container names). Device allocation
+        # itself is a stateless env derivation, so this ledger is the
+        # agent's ONLY memory of who holds what — the surface a crashed
+        # controller re-scrapes (GET /allocations) to diff its replayed
+        # journal against, and frees orphans through (POST /release).
+        self._alloc_lock = threading.Lock()
+        self.allocations: dict = {}
         agent = self
 
         def bump(key: str) -> None:
@@ -196,6 +204,15 @@ class NodeAgentServer:
                     })
                 elif self.path.split("?")[0] == "/events":
                     serve_events_jsonl(self, agent.events.to_jsonl)
+                elif self.path == "/allocations":
+                    # the recovery scrape: every pod this agent believes
+                    # it allocated for, so a cold-restarted controller
+                    # can diff its replayed journal against AGENT truth
+                    with agent._alloc_lock:
+                        out = {p: sorted(c)
+                               for p, c in agent.allocations.items()}
+                    self._reply(200, {"node": agent.node_name,
+                                      "allocations": out})
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -224,6 +241,9 @@ class NodeAgentServer:
                     if cont is None:
                         return 400, {"error": f"pod has no container {cname!r}"}
                     result = agent.device.allocate(pod, cont)
+                    with agent._alloc_lock:
+                        agent.allocations.setdefault(pod.name, set()).add(
+                            cname)
                     agent.events.emit("allocate", pod=pod.name,
                                       container=cname)
                     return 200, allocate_result_to_json(result)
@@ -233,6 +253,24 @@ class NodeAgentServer:
 
             def _do_post(self):
                 if not self._authorized():  # auth before routing, like GET
+                    return
+                if self.path == "/release":
+                    # forget a pod's ledger entry (controller DELETE
+                    # propagation + recovery orphan cleanup). Idempotent
+                    # and allowed mid-drain: releasing touches only the
+                    # ledger, and an unknown pod is already the goal
+                    # state — a retried release must not 404 into a
+                    # dead-end for the reconciling controller.
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    name = req.get("pod", "")
+                    with agent._alloc_lock:
+                        conts = sorted(agent.allocations.pop(name, ()))
+                    if conts:
+                        bump("releases")
+                        agent.events.emit("release", pod=name)
+                    self._reply(200, {"released": name,
+                                      "containers": conts})
                     return
                 if self.path != "/allocate":
                     self._reply(404, {"error": f"no route {self.path}"})

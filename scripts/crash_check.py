@@ -1,0 +1,379 @@
+#!/usr/bin/env python3
+"""``make crash-check`` — the crash-tolerance oracle (Round-20).
+
+Three hard-kill scenarios, all in-process on the CPU backend, each with
+an exact oracle; any miss fails (exit 1):
+
+1. **Controller SIGKILL + cold restart.** A journaled controller places
+   pods across 2 fake agents, an out-of-band allocation is planted on
+   one agent (the orphan), and the controller dies abruptly
+   (``shutdown(graceful=False)`` — no final snapshot, no goodbye). A
+   torn partial record is appended to the WAL to simulate the kill
+   landing mid-write. The restarted controller (same ``journal_path``)
+   must replay to the EXACT pre-crash placement/pending state, free the
+   orphan, drop (and count) the torn tail, pass ``check_invariants``
+   before the wire reports ``recovering: false``, and surface the diff
+   as ``kubetpu_recovery_*`` series. A SECOND restart must converge to
+   the same state (replay is idempotent), and a fresh submit must place
+   — the fleet is live, not just restored.
+
+2. **Replica SIGKILL mid-storm + same-name takeover.** A router + 2
+   paged replicas serve a keyed shared-prefix storm; halfway through,
+   one replica is hard-killed and a NEW process re-registers under the
+   SAME name at a new URL. The boot nonce exposes it as cache-wiped:
+   the pool takes the handle over (``replica_takeover``), mid-stream
+   pins naming it are dropped (``restart_unpin``), and the storm
+   finishes with greedy-token PARITY against a quiet serial run and
+   admissions == logical requests — the crash re-drives keyed work, it
+   never re-admits or corrupts it.
+
+3. **Autoscaler crash-replace.** The breaker confirms the killed
+   replica DEAD; the reap pass must immediately boot a replacement
+   through the launcher (``crash_replace`` event), bypassing cooldown
+   — a crash is not load noise.
+
+Runs in well under a minute with no accelerator; wired into
+``make chaos``.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.api.types import ContainerInfo, PodInfo  # noqa: E402
+from kubetpu.device import (  # noqa: E402
+    make_fake_tpus_info,
+    new_fake_tpu_dev_manager,
+)
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.obs import validate_prometheus_text  # noqa: E402
+from kubetpu.plugintypes import ResourceTPU  # noqa: E402
+from kubetpu.router import ReplicaServer, RouterServer  # noqa: E402
+from kubetpu.router.autoscaler import ReplicaAutoscaler, ScalePolicy  # noqa: E402
+from kubetpu.wire import ControllerServer, NodeAgentServer  # noqa: E402
+from kubetpu.wire.controller import pod_to_json  # noqa: E402
+from kubetpu.wire.httpcommon import request_json  # noqa: E402
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+PS = 8
+MAX_NEW = 5
+
+
+def fail(msg: str) -> None:
+    print(f"crash-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+# -- scenario 1: controller SIGKILL + cold restart ---------------------------
+
+
+def placements(ctrl: ControllerServer) -> dict:
+    out = {}
+    for nname, node in ctrl.cluster.nodes.items():
+        for pname in node.pods:
+            out[pname] = nname
+    return out
+
+
+def submit(ctrl_addr: str, name: str, key: str) -> None:
+    request_json(
+        ctrl_addr + "/pods",
+        {"pod": pod_to_json(PodInfo(
+            name=name,
+            running_containers={"main": ContainerInfo(
+                requests={ResourceTPU: 4})},
+        ))},
+        idempotency_key=key,
+    )
+
+
+def controller_scenario(tmp: str) -> float:
+    journal_path = os.path.join(tmp, "controller.journal")
+    agents = [
+        NodeAgentServer(
+            new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-64", host_index=h)),
+            f"crash-h{h}",
+        )
+        for h in range(2)
+    ]
+    for a in agents:
+        a.start()
+    c1 = ControllerServer(poll_interval=3600, journal_path=journal_path)
+    c1.start()
+    for a in agents:
+        request_json(c1.address + "/nodes", {"url": a.address},
+                     idempotency_key=f"crash-check-reg-{a.node_name}")
+    for i in range(3):
+        submit(c1.address, f"crash-p{i}", f"crash-check-p{i}")
+    c1.poll_once()
+    pre_place = placements(c1)
+    pre_pending = sorted(c1.pending_pods)
+    if len(pre_place) != 3:
+        fail(f"seed run placed {len(pre_place)}/3 pods: {pre_place}")
+
+    # the allocation the control plane never knew about: an orphan the
+    # reconcile diff must free
+    agents[0].allocations["crash-orphan"] = {"main"}
+
+    # SIGKILL: no drain, no final snapshot — and the kill lands
+    # mid-write, leaving a torn partial record at the WAL tail
+    c1.shutdown(graceful=False)
+    with open(journal_path, "ab") as f:
+        f.write(b'{"seq": 9999, "kind": "pod_place", "da')
+
+    t0 = time.monotonic()
+    c2 = ControllerServer(poll_interval=3600, journal_path=journal_path)
+    c2.start()
+    recovery_s = time.monotonic() - t0
+    try:
+        hz = request_json(c2.address + "/healthz", None, timeout=10)
+        if hz.get("recovering"):
+            fail("healthz still 'recovering' after start() returned")
+        if c2.journal.stats()["torn_tail_dropped"] < 1:
+            fail("torn WAL tail was not detected/dropped")
+        got_place = placements(c2)
+        if got_place != pre_place:
+            fail(f"replayed placements {got_place} != pre-crash "
+                 f"{pre_place}")
+        if sorted(c2.pending_pods) != pre_pending:
+            fail(f"replayed pending {sorted(c2.pending_pods)} != "
+                 f"pre-crash {pre_pending}")
+        if "crash-orphan" in agents[0].allocations:
+            fail("orphaned agent allocation survived reconciliation")
+        problems = c2.cluster.check_invariants()
+        if problems:
+            fail("post-recovery invariants dirty: " + "; ".join(problems))
+        text = c2._metrics_text()
+        mproblems = validate_prometheus_text(text)
+        if mproblems:
+            fail("post-recovery /metrics malformed: " + mproblems[0])
+        for needle in ("kubetpu_recovery_replays_total 1",
+                       "kubetpu_recovery_orphans_freed_total 1",
+                       "kubetpu_recovery_placements_restored_total 3",
+                       "kubetpu_controller_recovering 0"):
+            if needle not in text:
+                fail(f"missing recovery series: {needle!r}")
+        # the recovered fleet is LIVE, not just restored
+        submit(c2.address, "crash-p3", "crash-check-p3")
+        if "crash-p3" not in placements(c2):
+            fail("post-recovery submit did not place")
+    finally:
+        c2.shutdown(graceful=False)
+
+    # replay is idempotent: a second cold restart (after the first
+    # recovery trued-up the snapshot) converges to the same state
+    c3 = ControllerServer(poll_interval=3600, journal_path=journal_path)
+    c3.start()
+    try:
+        want = dict(pre_place, **{"crash-p3": placements(c3)["crash-p3"]}) \
+            if "crash-p3" in placements(c3) else pre_place
+        got = placements(c3)
+        if sorted(got) != sorted(want):
+            fail(f"second replay diverged: {sorted(got)} != "
+                 f"{sorted(want)}")
+        if c3.cluster.check_invariants():
+            fail("second replay left dirty invariants")
+    finally:
+        c3.shutdown()
+        for a in agents:
+            a.shutdown()
+    print(f"crash-check: controller recovered in {recovery_s * 1e3:.0f}ms "
+          f"(3 placements + 1 orphan freed + torn tail dropped), "
+          f"second replay converged")
+    return recovery_s
+
+
+# -- scenario 2: replica SIGKILL mid-storm + takeover ------------------------
+
+
+def make_server(params):
+    return PagedDecodeServer(
+        CFG, params, n_slots=2, max_seq=64, max_new_tokens=MAX_NEW,
+        page_size=PS, prefill_budget=PS, prefix_cache_pages=16)
+
+
+def storm_prompts():
+    prompts = []
+    for f, seed in enumerate((5, 7, 11)):
+        fam = [(i * seed) % 60 + 1 for i in range(2 * PS)]
+        for tail in range(3):
+            prompts.append(fam + [f * 10 + tail + 1])
+    prompts.append([63] * 3)
+    return prompts
+
+
+def replica_scenario(params, prompts, expected) -> None:
+    replicas = [ReplicaServer(make_server(params), f"crash-r{i}",
+                              idle_wait=0.002) for i in range(2)]
+    for rep in replicas:
+        rep.start()
+    router = RouterServer(load_refresh_s=0.05)
+    router.start()
+    replacement = None
+    try:
+        for rep in replicas:
+            router.register_replica(rep.address)
+        half = len(prompts) // 2
+        results = []
+        for i, p in enumerate(prompts[:half]):
+            results.append(request_json(
+                router.address + "/generate",
+                {"prompt": p, "timeout": 30.0},
+                idempotency_key=f"crash-check-gen-{i}", timeout=30.0))
+
+        # plant a mid-stream pin naming the doomed replica: the restart
+        # hook must drop it so the keyed re-drive re-picks fresh
+        with router._lock:
+            router._pins["crash-check-pin"] = ("crash-r0", 1)
+
+        # SIGKILL the first replica, then re-register the SAME name at
+        # a NEW url — a fresh boot nonce proves the cache is gone
+        replicas[0].shutdown(graceful=False)
+        replacement = ReplicaServer(make_server(params), "crash-r0",
+                                    idle_wait=0.002)
+        replacement.start()
+        taken = router.register_replica(replacement.address)
+        if taken != "crash-r0":
+            fail(f"takeover registered as {taken!r}, not 'crash-r0'")
+        if not router.events.events(kind="replica_takeover"):
+            fail("no replica_takeover event for the same-name restart")
+        with router._lock:
+            pin = router._pins.get("crash-check-pin")
+        if pin is not None:
+            fail(f"stale pin to the killed replica survived: {pin}")
+        if not router.events.events(kind="restart_unpin"):
+            fail("no restart_unpin event when the pinned owner restarted")
+
+        # the replacement must walk probation back to routable
+        deadline = time.monotonic() + 10
+        while "crash-r0" not in router.pool.routable():
+            if time.monotonic() > deadline:
+                fail("takeover replica never became routable "
+                     f"(state {router.pool.state('crash-r0')!r})")
+            router.pool.refresh(0.0)
+            time.sleep(0.02)
+
+        for i, p in enumerate(prompts[half:], start=half):
+            results.append(request_json(
+                router.address + "/generate",
+                {"prompt": p, "timeout": 30.0},
+                idempotency_key=f"crash-check-gen-{i}", timeout=30.0))
+
+        for i, (body, want) in enumerate(zip(results, expected)):
+            if body["tokens"] != want:
+                fail(f"request {i}: tokens {body['tokens']} != quiet-run "
+                     f"{want} (replica {body.get('replica')}) — the "
+                     f"crash changed generation semantics")
+        execs = sum(
+            int(rep.server.obs.counter(
+                "kubetpu_replica_generate_requests_total").value)
+            for rep in (replicas[0], replicas[1], replacement))
+        if execs != len(prompts):
+            fail(f"{execs} generate executions for {len(prompts)} "
+                 f"logical requests — the crash double-admitted or "
+                 f"dropped keyed work")
+        for rep in (replicas[1], replacement):
+            rep.server.check_invariants()
+    finally:
+        router.shutdown()
+        replicas[1].shutdown(graceful=False)
+        if replacement is not None:
+            replacement.shutdown(graceful=False)
+    print(f"crash-check: replica takeover kept token parity "
+          f"({len(prompts)} requests, {execs} executions), stale pin "
+          f"dropped")
+
+
+# -- scenario 3: autoscaler crash-replace ------------------------------------
+
+
+def autoscaler_scenario(params) -> None:
+    live = []
+
+    def launcher(role):
+        rep = ReplicaServer(make_server(params), f"crash-a{len(live)}",
+                            idle_wait=0.002)
+        rep.start()
+        live.append(rep)
+        return rep.address
+
+    for _ in range(2):
+        launcher("both")
+    router = RouterServer(load_refresh_s=0.05, suspect_after=1,
+                          dead_after=2)
+    router.start()
+    scaler = ReplicaAutoscaler(
+        router, launcher,
+        policy=ScalePolicy(min_replicas=1, max_replicas=3, up_after=99,
+                           down_after=99, cooldown_s=3600.0))
+    try:
+        for rep in live:
+            router.register_replica(rep.address)
+        victim = live[0]
+        victim.shutdown(graceful=False)
+        deadline = time.monotonic() + 10
+        while router.pool.state(victim.name) != "dead":
+            if time.monotonic() > deadline:
+                fail("killed replica never reached DEAD "
+                     f"(state {router.pool.state(victim.name)!r})")
+            router.pool.refresh(0.0)
+            time.sleep(0.02)
+        scaler.poll_once()
+        if not router.events.events(kind="reap"):
+            fail("DEAD replica was not reaped")
+        if not router.events.events(kind="crash_replace"):
+            fail("reap did not crash-replace (cooldown_s=3600 would "
+                 "otherwise block any scale-up — the bypass is the "
+                 "point)")
+        alive = router.pool.alive()
+        if victim.name in alive or len(alive) != 2:
+            fail(f"fleet after crash-replace is {alive}, want 2 alive "
+                 f"without {victim.name!r}")
+    finally:
+        router.shutdown()
+        for rep in live[1:]:
+            rep.shutdown(graceful=False)
+    print(f"crash-check: crash_replace rebooted the pool to "
+          f"{len(alive)} replicas despite an hour of cooldown")
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="kubetpu-crash-check-")
+    try:
+        controller_scenario(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = storm_prompts()
+    direct = make_server(params)
+    expected = []
+    for p in prompts:
+        rid = direct.enqueue(p)
+        direct.drain()
+        expected.append(direct.pop_result(rid))
+
+    replica_scenario(params, prompts, expected)
+    autoscaler_scenario(params)
+    print("crash-check OK: journal replay + reconcile exact, takeover "
+          "kept parity with no double admission, crash_replace healed "
+          "the pool")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

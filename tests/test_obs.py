@@ -429,7 +429,7 @@ def test_agent_counters_compat_property(fleet):
     controller, agents = fleet
     c = agents[0].counters
     assert set(c) == {"nodeinfo_requests", "allocate_requests",
-                      "allocate_replays", "errors"}
+                      "allocate_replays", "releases", "errors"}
     assert c["nodeinfo_requests"] >= 1  # the registration probe
 
 

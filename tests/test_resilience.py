@@ -594,3 +594,48 @@ def test_reregister_resets_breaker_state():
     finally:
         controller.shutdown()
         agent.shutdown()
+
+
+def test_keyed_submit_classifies_dead_agent_as_503_until_restart():
+    """Round-20 retry-classification pin: a keyed submit whose agent
+    wire leg dies at the CONNECTION level (the agent was hard-killed)
+    must surface as 503 infra-transient — never a deterministic 500,
+    which would poison the client's idempotent retry budget. After the
+    agent restarts at the SAME address (the kill-then-restart window:
+    refused turns into reset/torn responses as the port rebinds), the
+    SAME keyed submit must succeed cleanly — the rolled-back first
+    attempt left no placement behind."""
+    from kubetpu.wire.httpcommon import NO_RETRY
+
+    agent = NodeAgentServer(
+        new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")), "n0")
+    agent.start()
+    host, port = agent.address.rsplit("//", 1)[1].rsplit(":", 1)
+    controller = ControllerServer(poll_interval=3600)
+    controller.start()
+    agent2 = None
+    try:
+        controller.register_agent(agent.address)
+        agent.shutdown(graceful=False)  # SIGKILL analog: port goes dark
+
+        body = {"pod": pod_to_json(tpu_pod("p-503", 4))}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            request_json(controller.address + "/pods", body,
+                         idempotency_key="k-503", retry=NO_RETRY)
+        assert e.value.code == 503  # retryable infra verdict, not 500
+        # all-or-nothing: the rolled-back submit left nothing placed
+        assert "p-503" not in controller.cluster.nodes["n0"].pods
+        assert "p-503" not in controller.pending_pods
+
+        agent2 = NodeAgentServer(
+            new_fake_tpu_dev_manager(make_fake_tpus_info("v5e-8")), "n0",
+            host=host, port=int(port))
+        agent2.start()
+        out = request_json(controller.address + "/pods", body,
+                           idempotency_key="k-503")
+        assert out["placements"][0]["pod"] == "p-503"
+        assert "p-503" in controller.cluster.nodes["n0"].pods
+    finally:
+        controller.shutdown()
+        if agent2 is not None:
+            agent2.shutdown()
