@@ -13,11 +13,15 @@ JSONL WAL plus an atomically-replaced snapshot:
   ``[seq, kind, data]``. The checksum makes torn writes and bit rot
   DETECTABLE; canonical encoding makes it stable across writers.
 - **Torn tail**: a crash mid-``append`` can leave a partial or
-  corrupt LAST line. ``replay()`` drops it (counted in
-  ``torn_tail_dropped``) — a torn tail is the expected signature of the
-  very crash this journal exists to survive. A corrupt record anywhere
-  ELSE is real damage and raises ``JournalCorrupt``: silently skipping
-  mid-file records would replay a state that never existed.
+  corrupt LAST line. ``Journal`` REPAIRS it at init — the file is
+  truncated back to the last trusted newline-terminated record (counted
+  in ``torn_tail_dropped``) before any new append, so a post-restart
+  record can never merge onto the fragment and be lost with it; a torn
+  tail is the expected signature of the very crash this journal exists
+  to survive. ``replay()`` additionally drops an unrepaired tail (a
+  read-only replay of a foreign WAL). A corrupt record anywhere ELSE is
+  real damage and raises ``JournalCorrupt``: silently skipping mid-file
+  records would replay a state that never existed.
 - **Snapshot + compaction**: ``snapshot(state)`` writes
   ``<path>.snap`` via tmp + ``os.replace`` (atomic: readers see the old
   complete snapshot or the new complete one, never a torn half), THEN
@@ -31,9 +35,12 @@ JSONL WAL plus an atomically-replaced snapshot:
 Durability is ``flush`` by default (the OS has the bytes — survives
 process SIGKILL, the failure mode this round models); pass
 ``fsync=True`` for power-loss durability at a per-append ``fsync``
-cost. Stdlib only; one writer per path (the controller serializes
-appends under its own lock, and this module adds a lock of its own so
-journal stats never tear).
+cost. All files are created owner-only (0600) — journaled
+``node_register`` records and snapshots carry agent bearer tokens, and
+the journal must not become a world-readable credential artifact.
+Stdlib only; one writer per path (the controller serializes appends
+under its own lock, and this module adds a lock of its own so journal
+stats never tear).
 """
 
 from __future__ import annotations
@@ -73,12 +80,34 @@ class Journal:
         self.bytes_appended = 0
         self.torn_tail_dropped = 0
         self.snapshots_written = 0
+        # journal files carry agent bearer tokens — pre-existing files
+        # (created by an older writer, or with a looser umask) are
+        # tightened to owner-only; new ones are born 0600 in _open_private
+        for p in (self.path, self.snap_path):
+            try:
+                os.chmod(p, 0o600)
+            except OSError:
+                pass
+        # repair a torn tail BEFORE the first append: a crash mid-append
+        # leaves a partial last line, and appending onto it would merge
+        # two records into one corrupt line — losing an acked op
+        self._repair_tail()
         # resume the sequence where the existing journal left off — an
         # append after restart must never reuse a seq (replay orders and
         # dedups by it)
         self._seq = self._scan_last_seq()
 
     # -- write side ----------------------------------------------------------
+
+    @staticmethod
+    def _open_private(path: str, append: bool):
+        """Open *path* for writing, created owner-only (0600): the WAL
+        and snapshot carry agent bearer tokens and must never be born
+        world-readable. ``append=False`` truncates."""
+        flags = os.O_WRONLY | os.O_CREAT | (
+            os.O_APPEND if append else os.O_TRUNC)
+        fd = os.open(path, flags, 0o600)
+        return os.fdopen(fd, "a" if append else "w", encoding="utf-8")
 
     def _open(self):
         if self._fh is None:
@@ -87,8 +116,66 @@ class Journal:
             # only ever called from append(), inside `with self._lock:`
             # — the lazy open shares append's critical section
             # ktlint: disable=KTP003
-            self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh = self._open_private(self.path, append=True)
         return self._fh
+
+    def _repair_tail(self) -> None:
+        """Truncate the WAL to the end of its last trusted,
+        newline-terminated record — run once at init, BEFORE any append.
+        A crash mid-append leaves a partial last line; without this
+        repair the next append would land ON that fragment, merging two
+        records into one corrupt line: the acked post-crash record is
+        then lost at the next replay (the merged line reads as a torn
+        tail), and a second such append turns it into mid-file
+        corruption that refuses to boot. Only a *tail* is repaired — a
+        bad line with a trusted record after it is real damage, left in
+        place for replay to raise ``JournalCorrupt`` on rather than
+        guessed away here. A final record that is valid but missing its
+        terminator (the crash hit between the JSON and the newline) is
+        an acked op: it gets its newline instead of being dropped."""
+        try:
+            fh = open(self.path, "r+b")
+        except OSError:
+            return
+        with fh:
+            data = fh.read()
+            if not data:
+                return
+            pos = 0          # byte offset of the current line's start
+            good = 0         # offset just past the last trusted record
+            tail_bad = False  # an untrusted line pending as torn-tail
+            for line in data.splitlines(keepends=True):
+                end = pos + len(line)
+                text = line.decode("utf-8", "replace")
+                if tail_bad:
+                    if text.strip():
+                        # trusted-or-not content AFTER a bad line: this
+                        # is not a torn tail — leave the file for replay
+                        # to judge (JournalCorrupt, never a guess)
+                        return
+                elif not text.strip():
+                    good = end
+                elif self._parse(text) is None:
+                    tail_bad = True
+                elif line.endswith(b"\n"):
+                    good = end
+                else:
+                    # valid record, missing only its newline: terminate
+                    # it so the next append starts a fresh line
+                    fh.seek(0, os.SEEK_END)
+                    fh.write(b"\n")
+                    fh.flush()
+                    if self.fsync:
+                        os.fsync(fh.fileno())
+                    return
+                pos = end
+            if tail_bad and good < len(data):
+                fh.truncate(good)
+                fh.flush()
+                if self.fsync:
+                    os.fsync(fh.fileno())
+                with self._lock:
+                    self.torn_tail_dropped += 1
 
     def append(self, kind: str, data: Optional[dict] = None) -> int:
         """Durably record one state-mutating op; returns its seq. The
@@ -125,7 +212,7 @@ class Journal:
             tmp = self.snap_path + ".tmp"
             d = os.path.dirname(os.path.abspath(self.snap_path))
             os.makedirs(d, exist_ok=True)
-            with open(tmp, "w", encoding="utf-8") as fh:
+            with self._open_private(tmp, append=False) as fh:
                 json.dump(body, fh, sort_keys=True, separators=(",", ":"))
                 fh.flush()
                 os.fsync(fh.fileno())
@@ -135,7 +222,7 @@ class Journal:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
-            with open(self.path, "w", encoding="utf-8"):
+            with self._open_private(self.path, append=False):
                 pass
             self.snapshots_written += 1
         return seq

@@ -145,9 +145,20 @@ def run_gang_worker(
             if config is not None and config.local_device_ids:
                 # one CPU device per allocated chip: the worker sees the
                 # same local device count a real TPU worker would
-                jax.config.update(
-                    "jax_num_cpu_devices", len(config.local_device_ids)
-                )
+                n = len(config.local_device_ids)
+                try:
+                    jax.config.update("jax_num_cpu_devices", n)
+                except AttributeError:
+                    # older jax spells this knob as an XLA flag; a gang
+                    # worker is a fresh process whose backend is not
+                    # initialized yet, so the env var still takes effect
+                    import os
+
+                    flags = os.environ.get("XLA_FLAGS", "")
+                    if "xla_force_host_platform_device_count" not in flags:
+                        os.environ["XLA_FLAGS"] = (
+                            flags + " --xla_force_host_platform_device_"
+                            f"count={n}").strip()
     initialize_distributed(config)
 
     import jax.numpy as jnp

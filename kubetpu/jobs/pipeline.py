@@ -34,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubetpu.jobs import model as model_lib
 from kubetpu.jobs.model import ModelConfig, Params
-from kubetpu.jobs.ring_attention import make_ring_local
+from kubetpu.jobs.ring_attention import make_ring_local, shard_map_compat
 from kubetpu.jobs.train import (
     TrainState,
     _filter_spec,
@@ -122,7 +122,7 @@ def make_pipeline_forward(
         mask = (my_idx == last).astype(out_stack.dtype)
         return jax.lax.psum(out_stack * mask, axis_name)
 
-    region_sm = jax.shard_map(
+    region_sm = shard_map_compat(
         region,
         mesh=mesh,
         in_specs=(

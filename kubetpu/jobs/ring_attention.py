@@ -32,6 +32,30 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, axis_names,
+                     check_vma=False):
+    """``jax.shard_map`` across jax versions: the promoted API where it
+    exists, else ``jax.experimental.shard_map`` (``check_vma`` ->
+    ``check_rep``). The legacy fallback runs FULL-manual rather than
+    mapping ``axis_names`` onto the partial-auto ``auto=`` complement:
+    old XLA fatally aborts (``IsManualSubgroup`` check) on ``ppermute``
+    inside a partial-auto region, and our regions only ever reference
+    their manual axes in the specs — unnamed axes are replicated either
+    way, so the result is identical and merely loses the GSPMD
+    auto-sharding of the replicated dims."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=axis_names,
+                             check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy
+    if mesh is None:
+        raise ValueError(
+            "mesh=None (use the context mesh) needs jax.shard_map; "
+            "this jax version's shard_map requires an explicit mesh")
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
 def _ring_attention_local(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -335,7 +359,7 @@ def make_ring_attention(
     specs = P(None, axis_name, None, None)
     local = make_ring_local(impl, axis_name, block_q, block_k, interpret,
                             causal, window=window)
-    return jax.shard_map(
+    return shard_map_compat(
         lambda q, k, v: local(q, k, v),
         mesh=mesh,
         in_specs=(specs, specs, specs),

@@ -241,8 +241,14 @@ class ControllerServer:
         if journal_path:
             self.journal = Journal(journal_path, fsync=journal_fsync)
             recovered = self.journal.replay_state()
-            if (recovered["agents"] or recovered["placements"]
-                    or recovered["pending"]):
+            # EVERY journaled facet triggers recovery — a WAL whose
+            # reduced state carries only operator cordons or a nonzero
+            # gang_seq still has state to restore (dropping a cordon
+            # silently, or re-issuing a replayed gang-id stamp, is as
+            # much a crash-amnesia bug as a lost placement)
+            if any(recovered[k] for k in
+                   ("agents", "placements", "pending",
+                    "cordons", "gang_seq")):
                 self._recovered_state = recovered
                 self.recovering = True
             journal = self.journal
@@ -447,9 +453,13 @@ class ControllerServer:
                                 with controller._lock:
                                     controller.cluster.cordon(
                                         name, on=action == "cordon")
-                                controller._journal(
-                                    "cordon", name=name,
-                                    on=action == "cordon")
+                                    # journaled inside the same critical
+                                    # section that flipped the cordon:
+                                    # WAL order must match apply order
+                                    # when a concurrent un/cordon races
+                                    controller._journal(
+                                        "cordon", name=name,
+                                        on=action == "cordon")
                                 out = {action: name}
                             self._reply(200, out)
                         except KeyError:
@@ -535,15 +545,23 @@ class ControllerServer:
                             out = {"released": name, "was_pending": True}
                         else:
                             out = None
+                    if out is not None:
+                        # journal BEFORE the ack AND inside the same
+                        # critical section that applied the release: a
+                        # keyed submit reusing the name the instant the
+                        # lock drops must journal its pod_place AFTER
+                        # this record, or a replay deletes the NEW
+                        # placement (WAL order must match apply order;
+                        # the journal's own lock makes holding ours
+                        # across the append safe)
+                        controller._journal("pod_delete", name=name)
                 if out is None:
                     self._reply(404, {"error": f"no pod {name!r}"})
                     return
-                # journal BEFORE the ack (the durable-control-plane
-                # contract), then tell the agent to forget its ledger
-                # entry — best-effort and OUTSIDE the lock: the ledger
-                # is reconciliation metadata, and a dark agent's entry
-                # is freed as an orphan at the next cold restart anyway
-                controller._journal("pod_delete", name=name)
+                # tell the agent to forget its ledger entry —
+                # best-effort and OUTSIDE the lock: the ledger is
+                # reconciliation metadata, and a dark agent's entry is
+                # freed as an orphan at the next cold restart anyway
                 if release_target is not None:
                     url, tok = release_target
                     try:
@@ -847,13 +865,16 @@ class ControllerServer:
                  *self._snapshot_placed(p.name, p.node_name))
                 for p in migrated
             ]
+            # the drain cordoned the node and pended what fit nowhere —
+            # journaled inside the same critical section that applied
+            # them, so WAL order matches apply order under concurrent
+            # mutations; the migrated re-placements journal from
+            # _allocate_batch below
+            self._journal("cordon", name=name, on=True)
+            for p in unplaced:
+                self._journal("pod_pending", pod=pod_info_to_json(p))
         self.events.emit("drain", node=name, migrated=len(migrated),
                          unplaced=len(unplaced))
-        # the drain cordoned the node and pended what fit nowhere; the
-        # migrated re-placements journal from _allocate_batch below
-        self._journal("cordon", name=name, on=True)
-        for p in unplaced:
-            self._journal("pod_pending", pod=pod_info_to_json(p))
         out = {"drained": name,
                "migrated": self._allocate_batch(snapshots)}
         with self._lock:

@@ -72,6 +72,74 @@ def test_torn_tail_dropped_and_counted(tmp_path):
     assert j2.append("pod_pending", {"pod": {"name": "p0"}}) == 2
 
 
+def test_append_after_torn_tail_never_merges(tmp_path):
+    """THE torn-tail repair contract: a restarted Journal must truncate
+    the partial last line BEFORE its first append. Without the repair,
+    post-crash records land ON the fragment — one acked append is then
+    silently lost at the next replay (the merged line reads as a torn
+    tail), and two or more turn into mid-file corruption that refuses
+    to boot."""
+    path = str(tmp_path / "j.journal")
+    j = Journal(path)
+    j.append("node_register", {"name": "n0", "url": "http://x"})
+    j.close()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"seq": 2, "kind": "pod_place", "da')  # the SIGKILL cut
+
+    j2 = Journal(path)  # repair happens here, before any append
+    assert j2.stats()["torn_tail_dropped"] == 1
+    j2.append("pod_pending", {"pod": {"name": "p0"}})
+    j2.append("pod_pending", {"pod": {"name": "p1"}})
+    j2.close()
+
+    # BOTH acked post-crash appends survive the next restart
+    _state, records = Journal(path).replay()
+    assert [(r["seq"], r["kind"]) for r in records] == [
+        (1, "node_register"), (2, "pod_pending"), (3, "pod_pending")]
+
+
+def test_valid_unterminated_tail_kept_and_terminated(tmp_path):
+    """A crash BETWEEN the record's JSON and its newline leaves a valid
+    but unterminated last line — that op was acked, so the repair must
+    finish the line (not drop it) and the next append must start fresh."""
+    path = str(tmp_path / "j.journal")
+    j = Journal(path)
+    j.append("node_register", {"name": "n0", "url": "http://x"})
+    j.append("pod_pending", {"pod": {"name": "p0"}})
+    j.close()
+    raw = open(path, encoding="utf-8").read()
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(raw.rstrip("\n"))  # strip ONLY the final terminator
+
+    j2 = Journal(path)
+    assert j2.stats()["torn_tail_dropped"] == 0
+    assert j2.append("pod_pending", {"pod": {"name": "p1"}}) == 3
+    j2.close()
+    _state, records = Journal(path).replay()
+    assert [r["seq"] for r in records] == [1, 2, 3]
+
+
+def test_journal_files_owner_only(tmp_path):
+    """The WAL and snapshot carry agent bearer tokens: both must be
+    created 0600, and a pre-existing looser file is tightened at init."""
+    import os as _os
+    import stat
+    import sys
+    if sys.platform == "win32":
+        pytest.skip("posix permissions")
+    path = str(tmp_path / "j.journal")
+    j = Journal(path)
+    j.append("node_register",
+             {"name": "n0", "url": "http://x", "token": "secret"})
+    j.snapshot(j.replay_state())
+    j.close()
+    for p in (path, path + ".snap"):
+        assert stat.S_IMODE(_os.stat(p).st_mode) == 0o600, p
+    _os.chmod(path, 0o644)
+    Journal(path).close()
+    assert stat.S_IMODE(_os.stat(path).st_mode) == 0o600
+
+
 def test_bad_crc_tail_dropped(tmp_path):
     path = str(tmp_path / "j.journal")
     j = Journal(path)
@@ -162,6 +230,32 @@ def test_reducer_semantics():
 
     # idempotence as a property of plain data
     assert reduce_records(dict(st), []) == st
+
+
+def test_gang_seq_only_journal_still_recovers(tmp_path):
+    """A WAL whose reduced state carries ONLY a gang_seq high-water
+    (every pod deleted, every node dead) must still trigger recovery:
+    a restarted controller that skips the restore would re-issue
+    already-replayed gang-id stamps."""
+    path = str(tmp_path / "j.journal")
+    j = Journal(path)
+    j.append("pod_pending",
+             {"pod": {"name": "g0", "requests": {"kubetpu/gang": 7}}})
+    j.append("pod_delete", {"name": "g0"})
+    j.close()
+    state = Journal(path).replay_state()
+    assert (state["agents"], state["placements"], state["pending"],
+            state["cordons"]) == ({}, {}, [], [])
+    assert state["gang_seq"] == 7
+
+    c = ControllerServer(poll_interval=3600, journal_path=path)
+    assert c.recovering
+    c.start()
+    try:
+        assert not c.recovering  # recovery ran and opened the wire
+        assert c.cluster.new_gang_id() == 8  # high-water restored
+    finally:
+        c.shutdown(graceful=False)
 
 
 # -- every-crash-point replay boundary sweep ---------------------------------
