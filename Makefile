@@ -47,11 +47,15 @@ test: tpuinfo gpuinfo dataio
 # at-most-once under faults on the transfer leg), then crash-check
 # (a SIGKILLed controller or replica must recover to the exact
 # pre-crash state — journal replay, boot-nonce takeover, crash
-# replace), then bench-gate in smoke mode (a chaos pass that silently
-# regressed serving throughput still fails the round).
+# replace), then sched-check (the fit index must never change a
+# placement decision — cross-checked churn, a pure-sweep twin replay,
+# and a deliberate-desync audit probe), then bench-gate in smoke mode
+# (a chaos pass that silently regressed serving throughput still fails
+# the round).
 .PHONY: chaos
 chaos: lint obs-check prefix-check spec-check router-check migrate-check \
-		disagg-check pack-check tier-check crash-check bench-gate-smoke
+		disagg-check pack-check tier-check crash-check sched-check \
+		bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py \
 		tests/test_race_soak.py -q
 
@@ -178,6 +182,17 @@ disagg-check:
 .PHONY: crash-check
 crash-check:
 	python scripts/crash_check.py
+
+# fit-index equivalence oracle (Round-21): 128-host fake-fleet churn
+# (whole-chip + vChip + gangs + preemption + cordon/drain/refresh/
+# remove) with the cross-check oracle armed — every index-pruned sweep
+# shadowed by the reference full sweep; a pure-sweep twin cluster
+# replays the identical op stream and must place identically; a
+# deliberately desynced index entry must be caught by
+# check_invariants and repaired by the dirty path
+.PHONY: sched-check
+sched-check:
+	python scripts/sched_check.py
 
 # observability smoke oracle: controller + 2 fake agents, scrape the
 # federated /metrics, fail on malformed Prometheus text / missing

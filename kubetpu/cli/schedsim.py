@@ -11,6 +11,7 @@ the single-headline-number version of config 4 scaled to v5e-256.
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import sys
 import time
@@ -580,10 +581,163 @@ def config14(rounds=None):
     }
 
 
+def churn_fleet(n_nodes, chips_per_node=8):
+    """A fleet of *n_nodes* single-host v5e-8 slices (8 chips each) —
+    the Round-21 fleet-churn substrate. Distinct slice uids: placement
+    never straddles hosts, so per-op cost isolates the per-POD schedule
+    path the fit index accelerates."""
+    c = Cluster()
+    for i in range(n_nodes):
+        c.register_node(
+            f"n{i:04d}",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-8", slice_uid=f"s{i}")
+            ),
+        )
+    return c
+
+
+def sched_churn(cluster, rounds, seed=1234, preempt_every=150,
+                prefill_util=0.60):
+    """Sustained submit/release/preempt churn at ~70% fleet utilization;
+    returns per-op schedule-latency percentiles. The op mix: whole-chip
+    pods (1/2/4/8), vChip (fractional) pods (~30%), a high-priority
+    preemptor every *preempt_every* ops, and random releases draining
+    the fleet back under 70% — the steady-state a busy control plane
+    actually sees, as opposed to the empty-fleet happy path. An UNTIMED
+    prefill first loads the fleet to *prefill_util*, so arms of different
+    fleet sizes are measured at the same operating point (a 16x-larger
+    fleet would otherwise spend the whole run filling from empty while
+    the small arm churns saturated — apples to oranges)."""
+    import random
+
+    from kubetpu.core.cluster import PriorityKey
+    from kubetpu.scheduler import meshstate
+
+    rng = random.Random(seed)
+    cap_milli = sum(
+        n.info.capacity.get(ResourceTPU, 0) for n in cluster.nodes.values()
+    ) * meshstate.MILLI_PER_CHIP
+    held = 0
+    sizes = {}  # pod name -> milli held
+    names = []  # same pods, O(1) random-victim pick (swap-pop)
+    lat, failures, preemptions = [], 0, 0
+    k = 0
+    while cap_milli and held < prefill_util * cap_milli:
+        k += 1
+        if rng.random() < 0.3:
+            need = rng.choice([125, 250, 500])
+            pod = PodInfo(
+                name=f"w{k}",
+                requests={meshstate.FracKey: need},
+                running_containers={"main": ContainerInfo()},
+            )
+        else:
+            chips = rng.choice([1, 1, 2, 2, 4, 8])
+            need = chips * meshstate.MILLI_PER_CHIP
+            pod = _tpu_pod(f"w{k}", chips)
+        try:
+            placed = cluster.schedule(pod)
+        except SchedulingError:
+            break  # fragmented short of the target: measure from here
+        sizes[placed.name] = need
+        names.append(placed.name)
+        held += need
+    for i in range(rounds):
+        if preempt_every and i and i % preempt_every == 0:
+            pod = _tpu_pod(f"hi{i}", 8)
+            pod.requests[PriorityKey] = 10
+            t0 = time.perf_counter()
+            try:
+                placed, evicted = cluster.schedule_preempting(pod)
+            except SchedulingError:
+                failures += 1
+            else:
+                preemptions += 1
+                sizes[placed.name] = 8 * meshstate.MILLI_PER_CHIP
+                names.append(placed.name)
+                held += sizes[placed.name]
+                for v in evicted:
+                    freed = sizes.pop(v.name, 0)
+                    held -= freed
+                    if freed:
+                        names.remove(v.name)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        else:
+            if rng.random() < 0.3:
+                need = rng.choice([125, 250, 500])
+                pod = PodInfo(
+                    name=f"v{i}",
+                    requests={meshstate.FracKey: need},
+                    running_containers={"main": ContainerInfo()},
+                )
+            else:
+                chips = rng.choice([1, 1, 2, 2, 4, 8])
+                need = chips * meshstate.MILLI_PER_CHIP
+                pod = _tpu_pod(f"c{i}", chips)
+            t0 = time.perf_counter()
+            try:
+                placed = cluster.schedule(pod)
+            except SchedulingError:
+                failures += 1
+            else:
+                sizes[placed.name] = need
+                names.append(placed.name)
+                held += need
+            lat.append((time.perf_counter() - t0) * 1e3)
+        while held > 0.70 * cap_milli and names:
+            j = rng.randrange(len(names))
+            names[j], names[-1] = names[-1], names[j]
+            victim = names.pop()
+            held -= sizes.pop(victim)
+            cluster.release(victim)
+    return {
+        **_percentiles(lat),
+        "failures": failures,
+        "preemptions": preemptions,
+        "final_util": round(held / cap_milli, 2) if cap_milli else 0.0,
+    }
+
+
+def config15(rounds=None):
+    """Round-21 fleet-scale churn: per-op schedule p50/p99 on a 4096-chip fleet (512 v5e-8 hosts) vs the identical churn at 256 chips — the incremental fit index must keep the ratio sub-linear (< 3x for a 16x fleet)"""
+    rounds = rounds or 600
+    out = {}
+    for label, n_nodes in (("chips256", 32), ("chips4096", 512)):
+        t0 = time.perf_counter()
+        c = churn_fleet(n_nodes)
+        setup_s = time.perf_counter() - t0
+        out[label] = {
+            **sched_churn(c, rounds),
+            "nodes": n_nodes,
+            "setup_s": round(setup_s, 2),
+        }
+        problems = c.check_invariants()
+        assert not problems, problems[:3]
+        # the fleet graph is cyclic (cluster <-> index <-> hook state);
+        # collect eagerly so a caller embedding this comparison in a
+        # longer run (bench_gate --record) isn't left churning gen-2 GC
+        # over two dead 512-node fleets during its OWN measurements
+        del c
+        gc.collect()
+    ratio = (
+        out["chips4096"]["p99_ms"] / out["chips256"]["p99_ms"]
+        if out["chips256"]["p99_ms"] else float("inf")
+    )
+    out["p99_ratio_4096_vs_256"] = round(ratio, 2)
+    out["sched_p99_ms"] = out["chips4096"]["p99_ms"]
+    # the acceptance bar: 16x the fleet must cost < 3x the tail latency
+    assert ratio < 3.0, (
+        f"4096-chip p99 is {ratio:.2f}x the 256-chip p99 (want < 3x)"
+    )
+    return out
+
+
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13, 14: config14}
-TAKES_ROUNDS = {4, 8, 9, 10, 11, 12, 13, 14}
+           11: config11, 12: config12, 13: config13, 14: config14,
+           15: config15}
+TAKES_ROUNDS = {4, 8, 9, 10, 11, 12, 13, 14, 15}
 
 
 def main(argv=None) -> int:

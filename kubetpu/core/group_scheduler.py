@@ -145,13 +145,8 @@ def _fill_fractional(
     gangs — the anti-fragmentation policy. Every container shares the
     pod's single vChip (the pod-level request grammar); the binding is
     key -> key because the fractional grammar has no translation stage."""
-    cands = []
-    for local, mkey in state.milli_key.items():
-        coord = state.chip_coord[local]
-        free = state.frac_free.get(coord, 0)
-        if free >= milli:
-            cands.append((free, local, mkey))
-    if not cands:
+    best = state.best_fit_milli(milli)
+    if best is None:
         return False
     conts = list(pod_info.running_containers.values()) + list(
         pod_info.init_containers.values()
@@ -160,7 +155,7 @@ def _fill_fractional(
         # nothing to bind the share to — a container-less pod placed
         # "successfully" would hold no /milli key and corrupt the books
         return False
-    _free, _local, mkey = min(cands)
+    _free, _local, mkey = best
     for cont in conts:
         # strip stale /milli bindings from a PREVIOUS placement first (a
         # re-scheduled pod — preemption re-pend, dead-node reconcile —

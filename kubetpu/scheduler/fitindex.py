@@ -1,0 +1,305 @@
+"""Incremental fit index: flat-latency candidate generation at fleet scale.
+
+``Cluster._schedule_inner`` is a resumable predicate sweep — correct, but
+O(fleet) per pod, and at 4096+ chips the control plane becomes the
+bottleneck before the data plane does. This module keeps per-node summaries
+of exactly the quantities the schedulers' *cheapest* predicate pre-filters
+read, bucketed for range queries:
+
+- ``free_tpu``: the advertised TPU scalar (``allocatable[ResourceTPU]``) —
+  the quantity ``TpuScheduler.pod_fits_device`` compares against ``want``
+  before doing any geometry. NOTE: this counts whole-held chips only; a
+  fractionally-occupied chip still reads free here, exactly as the
+  predicate sees it.
+- ``whole_free``: the count of WHOLE-free chips (``NodeMeshState.free``) —
+  for mesh nodes the geometry search can only place ``n`` whole chips if
+  ``n`` whole-free chips exist, so the whole-chip bucket key
+  (``tpu_key``) is ``whole_free`` there (a strictly tighter sound prune
+  than the scalar on nodes carrying vChip occupants) and ``free_tpu`` on
+  non-mesh nodes, where the scalar is the predicate's only check.
+- ``free_gpu``: the advertised GPU scalar, mirroring the GpuScheduler
+  pre-filter.
+- ``fracs``: a remainder -> chip-count multiset over
+  ``NodeMeshState.frac_free`` — ``_frac_fit`` rejects a node iff no chip
+  has ``frac_free >= frac``, so a node is vChip-eligible iff it has a
+  bucket at or above the request.
+- ``free_milli``: the node's total fractional capacity
+  (``NodeMeshState.free_milli()``), consumed by the gang milli pre-filter.
+
+Soundness contract (the equivalence argument, ARCHITECTURE.md §Round-21):
+the index is used ONLY to discard nodes that *provably fail* one of those
+pre-filters. The surviving candidates flow through the unchanged sweep
+machinery — same sorted order, same ``pod_fits_device`` calls, same
+early-exit bound, same fill-failure demotion — so the placement decision
+(node, score, tie-break) is identical to the full sweep by construction.
+A node the index drops would have been rejected by the predicate's first
+comparison; a node the index keeps is re-checked from scratch. The index
+can therefore be stale-conservative but never stale-optimistic, which is
+why invalidation granularity is "mark the node dirty, recompute lazily at
+the next query" rather than incremental deltas.
+
+Invalidation rides the existing choke points: every in-place mutator of an
+advertised ResourceList already calls ``meshstate.invalidate_mesh_state``
+(the parse-memo contract), and the cluster registers a dirty hook there per
+live dict. Lifecycle paths that *replace* the dict (register/refresh/
+remove) re-register explicitly. No accounting code gained new call sites.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from kubetpu.api.types import ResourceList
+from kubetpu.scheduler import meshstate
+from kubetpu.scheduler.deviceclass import GPU, TPU
+
+
+@dataclass
+class NodeFitEntry:
+    """One node's summary of the cheap predicate pre-filters."""
+
+    free_tpu: int
+    free_gpu: int
+    # free-milli remainder -> number of chips at that remainder (>=1 only:
+    # a 0-remainder chip cannot host any vChip). Pristine vChip-capable
+    # chips appear at MILLI_PER_CHIP.
+    fracs: Dict[int, int] = field(default_factory=dict)
+    free_milli: int = 0
+    # chips that are WHOLE-free (no whole hold, no fractional occupant) —
+    # the size of NodeMeshState.free. A contiguous n-chip placement needs
+    # n whole-free chips, so for mesh nodes this is a tighter sound prune
+    # key than the scalar (which still counts fractionally-occupied chips).
+    whole_free: int = 0
+    has_mesh: bool = False
+
+    @property
+    def tpu_key(self) -> int:
+        """The whole-chip bucket key: an upper bound on how many whole
+        chips a placement could possibly take from this node. For mesh
+        nodes the geometry search draws only from whole-free chips; for
+        non-mesh nodes the scalar is the predicate's only check."""
+        return self.whole_free if self.has_mesh else self.free_tpu
+
+
+def _compute_entry(alloc: ResourceList) -> NodeFitEntry:
+    """Recompute a node's summary from its advertised list — the single
+    definition of what the index believes, shared by refresh and audit."""
+    state = meshstate.parse_mesh_state(alloc)
+    fracs: Dict[int, int] = {}
+    free_milli = 0
+    whole_free = 0
+    if state is not None:
+        for rem in state.frac_free.values():
+            if rem >= 1:
+                fracs[rem] = fracs.get(rem, 0) + 1
+        free_milli = state.free_milli()
+        whole_free = len(state.free)
+    return NodeFitEntry(
+        free_tpu=int(alloc.get(TPU.resource_name, 0)),
+        free_gpu=int(alloc.get(GPU.resource_name, 0)),
+        fracs=fracs,
+        free_milli=free_milli,
+        whole_free=whole_free,
+        has_mesh=state is not None,
+    )
+
+
+class FitIndex:
+    """Bucket indexes over NodeFitEntry, with lazy dirty refresh.
+
+    Buckets map an exact value (free count / frac remainder) to the set of
+    node names at that value; an "at least n" query unions the buckets with
+    key >= n. Key cardinality is tiny in practice (free counts bounded by
+    chips-per-host, remainders by the distinct vChip sizes in flight), so
+    the union is far cheaper than touching every node.
+    """
+
+    def __init__(self) -> None:
+        self.entries: Dict[str, NodeFitEntry] = {}
+        self.dirty: Set[str] = set()
+        self.tpu_buckets: Dict[int, Set[str]] = {}
+        self.gpu_buckets: Dict[int, Set[str]] = {}
+        self.frac_buckets: Dict[int, Set[str]] = {}
+        self.stats = {"refreshes": 0, "queries": 0}
+
+    # -- membership maintenance ------------------------------------------
+
+    def _bucket_add(self, name: str, entry: NodeFitEntry) -> None:
+        self.tpu_buckets.setdefault(entry.tpu_key, set()).add(name)
+        self.gpu_buckets.setdefault(entry.free_gpu, set()).add(name)
+        for rem in entry.fracs:
+            self.frac_buckets.setdefault(rem, set()).add(name)
+
+    def _bucket_remove(self, name: str, entry: NodeFitEntry) -> None:
+        for buckets, key in ((self.tpu_buckets, entry.tpu_key),
+                             (self.gpu_buckets, entry.free_gpu)):
+            members = buckets.get(key)
+            if members is not None:
+                members.discard(name)
+                if not members:
+                    del buckets[key]
+        for rem in entry.fracs:
+            members = self.frac_buckets.get(rem)
+            if members is not None:
+                members.discard(name)
+                if not members:
+                    del self.frac_buckets[rem]
+
+    def register(self, name: str, alloc: ResourceList) -> None:
+        """(Re)compute and insert a node's entry eagerly — lifecycle path
+        (node registered / allocatable dict replaced)."""
+        old = self.entries.pop(name, None)
+        if old is not None:
+            self._bucket_remove(name, old)
+        entry = _compute_entry(alloc)
+        self.entries[name] = entry
+        self._bucket_add(name, entry)
+        self.dirty.discard(name)
+        self.stats["refreshes"] += 1
+
+    def unregister(self, name: str) -> None:
+        old = self.entries.pop(name, None)
+        if old is not None:
+            self._bucket_remove(name, old)
+        self.dirty.discard(name)
+
+    def mark_dirty(self, name: str) -> None:
+        """Accounting mutated this node's advertised list — recompute at
+        the next query (O(1) now, one parse later)."""
+        if name in self.entries:
+            self.dirty.add(name)
+
+    def ensure_fresh(
+        self, resolver: Callable[[str], Optional[ResourceList]]
+    ) -> None:
+        """Refresh every dirty entry from ground truth. ``resolver`` maps a
+        name to its CURRENT allocatable dict (the dict object may have been
+        replaced since the entry was built); None drops the entry."""
+        if not self.dirty:
+            return
+        for name in list(self.dirty):
+            alloc = resolver(name)
+            if alloc is None:
+                self.unregister(name)
+            else:
+                self.register(name, alloc)
+        self.dirty.clear()
+
+    # -- queries ----------------------------------------------------------
+
+    @staticmethod
+    def _at_least(buckets: Dict[int, Set[str]], minimum: int) -> Set[str]:
+        out: Set[str] = set()
+        for key, members in buckets.items():
+            if key >= minimum:
+                out |= members
+        return out
+
+    def eligible(
+        self, want_tpu: int, want_gpu: int, frac: int
+    ) -> Optional[Set[str]]:
+        """Names that can possibly pass the schedulers' cheap pre-filters
+        for these needs; None when the pod is unconstrained (nothing to
+        prune on — caller must sweep). Callers must ensure_fresh first."""
+        self.stats["queries"] += 1
+        result: Optional[Set[str]] = None
+        if frac > 0:
+            result = self._at_least(self.frac_buckets, frac)
+        if want_tpu > 0:
+            names = self._at_least(self.tpu_buckets, want_tpu)
+            result = names if result is None else (result & names)
+        if want_gpu > 0:
+            names = self._at_least(self.gpu_buckets, want_gpu)
+            result = names if result is None else (result & names)
+        return result
+
+    def frac_ordered(self, frac: int) -> List[Tuple[str, float]]:
+        """vChip candidates as ``(name, score)`` in the EXACT order the
+        best-first sweep should visit them: descending score, name-ascending
+        within a score. For a pure-frac pod the TpuScheduler score is a
+        strictly decreasing function of the node's minimal fitting remainder
+        — which is precisely the smallest ``frac_buckets`` key >= *frac*
+        that holds the node — so the index can hand the sweep not just the
+        candidate set but each candidate's exact score as a visit cap.
+        ``_schedule_inner`` then settles as soon as its best evaluated node
+        meets the cap of the next unvisited one: O(1) predicate
+        evaluations per placement attempt instead of O(eligible nodes).
+        Soundness requires the caps to be EXACT (score == cap for every
+        fitting node) — Cluster gates this path on the stock scheduler set
+        (Tpu+Gpu only, where every non-frac contribution is 0.0)."""
+        self.stats["queries"] += 1
+        keys = sorted(r for r in self.frac_buckets if r >= frac)
+        seen: Set[str] = set()
+        out: List[Tuple[str, float]] = []
+        milli = meshstate.MILLI_PER_CHIP
+        for rem in keys:
+            # ascending remainder == descending score; a node's FIRST
+            # appearance is at its minimal fitting remainder = its score
+            score = (milli - (rem - frac)) / float(milli)
+            for name in sorted(self.frac_buckets[rem] - seen):
+                seen.add(name)
+                out.append((name, score))
+        return out
+
+    # -- consistency ------------------------------------------------------
+
+    def audit(self, allocs: Dict[str, ResourceList]) -> List[str]:
+        """Compare the index against ground truth; returns human-readable
+        problems (empty = consistent). Dirty entries are exempt from the
+        value comparison — lazy staleness is the design, they refresh at
+        the next query — but registry membership and bucket structure must
+        always agree. Feeds Cluster.check_invariants."""
+        problems: List[str] = []
+        for name in allocs:
+            if name not in self.entries:
+                problems.append(f"fit index: registered node {name!r} has no entry")
+        for name in self.entries:
+            if name not in allocs:
+                problems.append(f"fit index: phantom entry {name!r} (node not registered)")
+        for name, entry in sorted(self.entries.items()):
+            alloc = allocs.get(name)
+            if alloc is not None and name not in self.dirty:
+                expected = _compute_entry(alloc)
+                if entry != expected:
+                    problems.append(
+                        f"fit index: clean entry for {name!r} drifted from "
+                        f"accounting: {entry} != {expected}"
+                    )
+            # bucket membership must mirror the entry regardless of dirt
+            if name not in self.tpu_buckets.get(entry.tpu_key, ()):
+                problems.append(
+                    f"fit index: {name!r} missing from tpu bucket {entry.tpu_key}"
+                )
+            if name not in self.gpu_buckets.get(entry.free_gpu, ()):
+                problems.append(
+                    f"fit index: {name!r} missing from gpu bucket {entry.free_gpu}"
+                )
+            for rem in entry.fracs:
+                if name not in self.frac_buckets.get(rem, ()):
+                    problems.append(
+                        f"fit index: {name!r} missing from frac bucket {rem}"
+                    )
+        for label, buckets in (("tpu", self.tpu_buckets),
+                               ("gpu", self.gpu_buckets),
+                               ("frac", self.frac_buckets)):
+            for key, members in buckets.items():
+                for name in members:
+                    entry = self.entries.get(name)
+                    if entry is None:
+                        problems.append(
+                            f"fit index: {label} bucket {key} holds "
+                            f"unregistered node {name!r}"
+                        )
+                        continue
+                    owned = (
+                        entry.fracs if label == "frac"
+                        else {entry.tpu_key} if label == "tpu"
+                        else {entry.free_gpu}
+                    )
+                    if key not in owned:
+                        problems.append(
+                            f"fit index: {label} bucket {key} holds {name!r} "
+                            f"whose entry says {sorted(owned)}"
+                        )
+        return problems

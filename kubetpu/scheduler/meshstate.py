@@ -19,6 +19,7 @@ gang placement a global coordinate frame.
 from __future__ import annotations
 
 import re
+import weakref
 from dataclasses import dataclass
 from typing import Dict, Optional, Set
 
@@ -181,6 +182,22 @@ class NodeMeshState:
             1 for c in self.free if c not in covered)
         return total
 
+    def best_fit_milli(self, milli: int):
+        """THE best-fit rule for a vChip share, in one place: the fitting
+        chip with the least remaining capacity wins, ties to the lowest
+        local id — so fractional confetti concentrates on already-broken
+        chips and pristine chips stay whole for future gangs. Both the
+        fit score (TpuScheduler._frac_fit) and the binding fill
+        (group_scheduler._fill_fractional) consult this, which is what
+        makes the predicate's score and the fill's chip choice provably
+        agree. Returns ``(free_milli, local_id, milli_key)`` or None."""
+        best = None
+        for local, mkey in self.milli_key.items():
+            free = self.frac_free.get(self.chip_coord[local], 0)
+            if free >= milli and (best is None or (free, local) < best[:2]):
+                best = (free, local, mkey)
+        return best
+
     @property
     def slice_name(self) -> str:
         """Identity of the physical slice this host belongs to: hosts share
@@ -207,10 +224,60 @@ def _fingerprint(node_resources: ResourceList):
     return (len(node_resources), node_resources.get(ResourceTPU, -1))
 
 
+# Round-21 dirty hooks: the incremental fit index (scheduler/fitindex.py)
+# needs to know *which node's* advertised list changed, and the memo
+# contract above already forces every in-place mutator through
+# invalidate_mesh_state — so that call IS the index's invalidation choke
+# point. The cluster registers one hook per live allocatable dict
+# (id-keyed, like the memo, with the same strong-reference guard against
+# id recycling) and re-registers when a lifecycle path replaces the dict
+# object. Hooks must be cheap and must not touch mesh state (they fire
+# mid-mutation): marking a name dirty is the intended body.
+#
+# The hook OWNER is held weakly (WeakMethod): the registry must never be
+# the thing keeping a dropped Cluster's whole node graph alive. Entries
+# whose owner died are purged on the next fire that touches them, plus a
+# bulk sweep when the registry grows past a high-water mark (covers
+# entries for dicts that are never mutated again — the common case after
+# a cluster is discarded, e.g. benches building large throwaway fleets).
+_DIRTY_HOOKS: "dict[int, tuple]" = {}
+_DIRTY_SWEEP_AT = 4096
+_dirty_sweep_at = _DIRTY_SWEEP_AT
+
+
+def register_dirty_hook(node_resources: ResourceList, method, arg) -> None:
+    """Call ``method(arg)`` whenever this exact dict object is invalidated
+    (i.e. mutated in place by accounting). One hook per dict; re-register
+    replaces. ``method`` must be a bound method — only a weak reference
+    to its owner is kept (see the registry comment above)."""
+    global _dirty_sweep_at
+    if len(_DIRTY_HOOKS) >= _dirty_sweep_at:
+        dead = [k for k, v in _DIRTY_HOOKS.items() if v[1]() is None]
+        for k in dead:
+            del _DIRTY_HOOKS[k]
+        _dirty_sweep_at = max(_DIRTY_SWEEP_AT, 2 * len(_DIRTY_HOOKS))
+    _DIRTY_HOOKS[id(node_resources)] = (
+        node_resources, weakref.WeakMethod(method), arg)
+
+
+def unregister_dirty_hook(node_resources: ResourceList) -> None:
+    _DIRTY_HOOKS.pop(id(node_resources), None)
+
+
 def invalidate_mesh_state(node_resources: ResourceList) -> None:
     """Drop the memoized geometry for a ResourceList about to be (or just)
-    mutated in place. Required by the memo contract above."""
+    mutated in place. Required by the memo contract above. Also fires the
+    registered dirty hook, which is how the fit index and the occupancy
+    gauge tracker learn about accounting mutations without any new call
+    sites in the accounting code."""
     _PARSE_MEMO.pop(id(node_resources), None)
+    hit = _DIRTY_HOOKS.get(id(node_resources))
+    if hit is not None and hit[0] is node_resources:
+        method = hit[1]()
+        if method is None:
+            del _DIRTY_HOOKS[id(node_resources)]
+        else:
+            method(hit[2])
 
 
 def parse_mesh_state(node_resources: ResourceList) -> Optional[NodeMeshState]:

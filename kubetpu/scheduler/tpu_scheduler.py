@@ -143,11 +143,8 @@ class TpuScheduler(DeviceScheduler):
             )
             return False, [reason], 0.0
         state = meshstate.parse_mesh_state(node_info.allocatable)
-        fits = (
-            [f for f in state.frac_free.values() if f >= frac]
-            if state is not None else []
-        )
-        if not fits:
+        best = state.best_fit_milli(frac) if state is not None else None
+        if best is None:
             reason = PredicateFailureReason(
                 resource_name=meshstate.FracKey,
                 requested=frac,
@@ -158,8 +155,9 @@ class TpuScheduler(DeviceScheduler):
                 else "vChips need mesh geometry (no tpu-slice advertised)",
             )
             return False, [reason], 0.0
-        best = min(fits)
-        score = (meshstate.MILLI_PER_CHIP - (best - frac)) / float(
+        # score from the SAME chip the fill will bind (best_fit_milli is
+        # the shared best-fit rule): its post-placement occupancy.
+        score = (meshstate.MILLI_PER_CHIP - (best[0] - frac)) / float(
             meshstate.MILLI_PER_CHIP)
         return True, [], score
 

@@ -222,7 +222,10 @@ class ControllerServer:
         self._c_frac_allocs = self.registry.counter(
             "kubetpu_fractional_allocations_total",
             "vChip (fractional) pod placements")
-        self._occ_seen: set = set()
+        # node -> set of chip labels currently rendered (Round-21: keyed
+        # per node so the incremental reconcile can retire one node's
+        # chips without reconstructing the fleet view)
+        self._occ_seen: Dict[str, set] = {}
         # Round-20 durable control plane: replay the WAL (if any) into a
         # recovered-state snapshot NOW; the actual re-probe/re-place/
         # reconcile runs in _recover() from start(), with the wire
@@ -512,10 +515,7 @@ class ControllerServer:
                 release_target = None
                 with controller._lock:
                     try:
-                        node_name = next(
-                            (nn for nn, node
-                             in controller.cluster.nodes.items()
-                             if name in node.pods), None)
+                        node_name = controller.cluster.pod_node(name)
                         controller.cluster.release(name)
                         if node_name is not None:
                             # a released vChip share must leave the
@@ -1196,30 +1196,51 @@ class ControllerServer:
         """Refresh ``kubetpu_chip_occupancy_frac{node,chip}`` from the
         cluster's per-chip milli accounting — caller holds the lock.
         *only_nodes* scopes the refresh to the nodes a placement just
-        touched (the submit hot path must not pay a fleet-wide sweep);
-        the reconcile pass runs the FULL sweep, where chips seen before
-        but absent now (node died/removed) are pinned to 0.0 ONCE and
-        dropped from the tracking set — a gauge cannot un-render, and a
-        stale last-good occupancy would fake fragmentation on dead
-        hardware, but re-zeroing departed chips every pass forever
-        would be an unbounded tax on node churn."""
-        occ = self.cluster.chip_occupancy(nodes=only_nodes)
-        fresh = set()
+        touched (the submit hot path must not pay a fleet-wide sweep).
+
+        Round-21: the reconcile pass (only_nodes=None) is INCREMENTAL
+        too — it drains the cluster's dirty-node set (fed by the same
+        accounting choke point the fit index uses) and touches only
+        chips whose books changed since the last pass, so gauge upkeep
+        stays flat at 4096+ chips instead of re-walking the fleet.
+        Chips seen before but absent from a dirty node's fresh view
+        (node died/removed, chip gone from a re-probe) are pinned to
+        0.0 ONCE and dropped from the tracking map — a gauge cannot
+        un-render, and a stale last-good occupancy would fake
+        fragmentation on dead hardware, but re-zeroing departed chips
+        every pass forever would be an unbounded tax on node churn."""
+        if only_nodes is None:
+            dirty = self.cluster.pop_dirty_occupancy()
+            if not dirty:
+                return
+            occ = self.cluster.chip_occupancy(nodes=sorted(dirty))
+        else:
+            dirty = None
+            occ = self.cluster.chip_occupancy(nodes=only_nodes)
         for node, per in occ.items():
+            fresh = set()
             for chip, frac in per.items():
-                key = (node, str(chip))
-                fresh.add(key)
+                fresh.add(str(chip))
                 self.registry.gauge(
                     "kubetpu_chip_occupancy_frac",
                     node=node, chip=str(chip)).set(frac)
-        if only_nodes is None:
-            for node, chip in self._occ_seen - fresh:
-                self.registry.gauge(
-                    "kubetpu_chip_occupancy_frac",
-                    node=node, chip=chip).set(0.0)
-            self._occ_seen = fresh
-        else:
-            self._occ_seen |= fresh
+            if dirty is not None:
+                # a re-probe can shrink a live node's chip set
+                for chip in self._occ_seen.get(node, set()) - fresh:
+                    self.registry.gauge(
+                        "kubetpu_chip_occupancy_frac",
+                        node=node, chip=chip).set(0.0)
+                self._occ_seen[node] = fresh
+            else:
+                self._occ_seen.setdefault(node, set()).update(fresh)
+        if dirty is not None:
+            # dirty nodes with no occupancy view anymore: removed/dead
+            # (or lost their vChip advertisement) — zero their chips once
+            for node in dirty - set(occ):
+                for chip in self._occ_seen.pop(node, set()):
+                    self.registry.gauge(
+                        "kubetpu_chip_occupancy_frac",
+                        node=node, chip=chip).set(0.0)
 
     def _chip_totals(self, resource: str):
         """(free, held) chips of *resource* across the fleet. "Free"

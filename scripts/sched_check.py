@@ -1,0 +1,256 @@
+#!/usr/bin/env python3
+"""``make sched-check`` — the Round-21 fit-index equivalence oracle.
+
+Drives a LARGE fake fleet (128 v5e-8 hosts, 1024 chips) through mixed
+scheduling churn — whole-chip pods, vChip (fractional) pods, gang
+launches, random releases, priority preemption, cordon/uncordon,
+drain, node refresh and node removal — with the cluster's
+``index_cross_check`` oracle armed: every index-pruned sweep is
+shadowed by the reference full O(fleet) sweep, and the run fails
+(exit 1) on:
+
+- DECISION DIVERGENCE: the index path trying a different (node, score)
+  than the full sweep would — the equivalence guarantee, enforced live
+  (``Cluster._schedule_inner`` raises, this script turns it into a
+  failure);
+- INVARIANT VIOLATION: ``Cluster.check_invariants()`` non-empty at the
+  phase boundaries (the index/accounting audit rides it: every clean
+  index entry must equal a fresh recompute from the node's books, every
+  bucket must mirror its entry, and the pod->node map must match
+  placements both directions);
+- INDEX/ACCOUNTING DRIFT after a DELIBERATE DESYNC: the script corrupts
+  an index entry behind the cluster's back, proves the audit CATCHES it
+  and that scheduling remains CORRECT anyway (twin-cluster comparison
+  against an index-disabled cluster fed the identical op stream), then
+  repairs the index and proves the audit goes quiet;
+- FALLBACK-SWEEP correctness: with the index kill switch engaged
+  (``use_fit_index=False``) the same op stream must produce identical
+  placements — the pruned path and the pure sweep are the same
+  scheduler.
+
+Runs in seconds with no accelerator; wired into ``make chaos`` so every
+fault-injection run also proves the fit index never changes a placement
+decision.
+"""
+
+import random
+import sys
+
+sys.path.insert(0, ".")
+
+from kubetpu.api.types import ContainerInfo, PodInfo  # noqa: E402
+from kubetpu.core import Cluster, SchedulingError  # noqa: E402
+from kubetpu.core.cluster import PriorityKey  # noqa: E402
+from kubetpu.device import (  # noqa: E402
+    make_fake_tpus_info,
+    new_fake_tpu_dev_manager,
+)
+from kubetpu.plugintypes import ResourceTPU  # noqa: E402
+from kubetpu.scheduler.meshstate import FracKey  # noqa: E402
+
+N_NODES = 128
+OPS = 1200
+SEED = 20260807
+
+
+def fail(msg: str) -> None:
+    print(f"sched-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def oracle(cluster: Cluster, phase: str) -> None:
+    problems = cluster.check_invariants()
+    if problems:
+        fail(f"invariants violated after {phase}: {problems[:3]}")
+
+
+def fleet(use_fit_index: bool) -> Cluster:
+    c = Cluster(use_fit_index=use_fit_index)
+    for i in range(N_NODES):
+        c.register_node(
+            f"n{i:04d}",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-8", slice_uid=f"s{i}")
+            ),
+        )
+    return c
+
+
+def whole_pod(name: str, chips: int) -> PodInfo:
+    return PodInfo(
+        name=name,
+        requests={},
+        running_containers={
+            "main": ContainerInfo(requests={ResourceTPU: chips})
+        },
+    )
+
+
+def frac_pod(name: str, milli: int) -> PodInfo:
+    return PodInfo(
+        name=name,
+        requests={FracKey: milli},
+        running_containers={"main": ContainerInfo()},
+    )
+
+
+def churn(cluster: Cluster, record=None, replay=None):
+    """One deterministic mixed-op stream. With *record* (a list), every
+    placement lands in it as (pod, node) for later comparison; *replay*
+    asserts placements equal a recorded stream op by op — the
+    twin-cluster equivalence check (index on vs off)."""
+    rng = random.Random(SEED)
+    placed = []  # pod names alive, swap-pop victim picks
+    k = [0]
+
+    def note(pod_name: str, node_name: str) -> None:
+        if record is not None:
+            record.append((pod_name, node_name))
+        if replay is not None:
+            want = replay[k[0]]
+            if want != (pod_name, node_name):
+                fail(
+                    "twin-cluster divergence at op "
+                    f"{k[0]}: index path placed {want}, pure sweep "
+                    f"placed {(pod_name, node_name)}"
+                )
+            k[0] += 1
+
+    for op in range(OPS):
+        r = rng.random()
+        if r < 0.28 and placed:
+            j = rng.randrange(len(placed))
+            placed[j], placed[-1] = placed[-1], placed[j]
+            cluster.release(placed.pop())
+        elif r < 0.33:
+            # maintenance churn: cordon a random node for a while
+            name = f"n{rng.randrange(N_NODES):04d}"
+            if name in cluster.nodes:
+                cluster.cordon(name, on=name not in cluster.cordoned)
+        elif r < 0.36:
+            # gang launch across one slice's worth of hosts
+            gang = [whole_pod(f"g{op}-{m}", 4) for m in range(2)]
+            try:
+                for p in cluster.schedule_gang(gang):
+                    placed.append(p.name)
+                    note(p.name, p.node_name)
+            except SchedulingError:
+                pass
+        elif r < 0.38:
+            pod = whole_pod(f"hi{op}", 8)
+            pod.requests[PriorityKey] = 10
+            try:
+                got, evicted = cluster.schedule_preempting(pod)
+            except SchedulingError:
+                pass
+            else:
+                for v in evicted:
+                    if v.name in placed:
+                        placed.remove(v.name)
+                placed.append(got.name)
+                note(got.name, got.node_name)
+        elif r < 0.7:
+            pod = whole_pod(f"c{op}", rng.choice([1, 1, 2, 2, 4, 8]))
+            try:
+                got = cluster.schedule(pod)
+            except SchedulingError:
+                pass
+            else:
+                placed.append(got.name)
+                note(got.name, got.node_name)
+        else:
+            pod = frac_pod(f"v{op}", rng.choice([125, 250, 500]))
+            try:
+                got = cluster.schedule(pod)
+            except SchedulingError:
+                pass
+            else:
+                placed.append(got.name)
+                note(got.name, got.node_name)
+        if op % 300 == 299:
+            oracle(cluster, f"churn op {op}")
+    # lifecycle tail: drain one loaded node, refresh another, remove a
+    # third — the paths that REPLACE allocatable dicts must re-hook the
+    # index's dirty notifications
+    for name, action in (("n0003", "drain"), ("n0005", "refresh"),
+                         ("n0007", "remove")):
+        if name not in cluster.nodes:
+            continue
+        if action == "drain":
+            migrated, unplaced = cluster.drain(name)
+            for pod in unplaced:
+                if pod.name in placed:
+                    placed.remove(pod.name)
+            cluster.cordon(name, on=False)
+        elif action == "refresh":
+            cluster.refresh_node(name)
+        else:
+            for pod_name in list(cluster.nodes[name].pods):
+                if pod_name in placed:
+                    placed.remove(pod_name)
+            cluster.remove_node(name)
+        oracle(cluster, action)
+    return placed
+
+
+def main() -> int:
+    # Phase 1: cross-checked churn — every pruned sweep shadowed by the
+    # reference full sweep; any divergence raises inside the cluster.
+    c = fleet(use_fit_index=True)
+    c.index_cross_check = True
+    record: list = []
+    try:
+        churn(c, record=record)
+    except RuntimeError as e:
+        fail(f"cross-check divergence: {e}")
+    oracle(c, "cross-checked churn")
+    stats = c.index_stats
+    if not stats["pruned_sweeps"]:
+        fail("the index never pruned a sweep — the fast path is dead")
+    if not stats["cross_checks"]:
+        fail("the oracle never fired — cross-checking is miswired")
+    print(
+        f"sched-check: phase 1 OK — {len(record)} placements, "
+        f"{stats['pruned_sweeps']} pruned sweeps, "
+        f"{stats['cross_checks']} cross-checked, "
+        f"{stats['fallback_sweeps']} fallbacks"
+    )
+
+    # Phase 2: twin cluster with the kill switch engaged replays the
+    # identical op stream — placements must match (pod, node) exactly.
+    plain = fleet(use_fit_index=False)
+    churn(plain, replay=record)
+    oracle(plain, "pure-sweep twin churn")
+    if plain.index_stats["pruned_sweeps"]:
+        fail("the disabled index pruned a sweep — kill switch broken")
+    print(f"sched-check: phase 2 OK — pure-sweep twin matched all "
+          f"{len(record)} placements")
+
+    # Phase 3: deliberate desync. Corrupt one live index entry behind
+    # the cluster's back: the audit must CATCH it, and repairing (mark
+    # dirty -> lazy recompute) must make it go quiet again.
+    victim = next(iter(sorted(c.nodes)))
+    entry = c.fit_index.entries.get(victim)
+    if entry is None:
+        fail(f"no index entry for {victim} after churn")
+    entry.free_tpu += 3  # books now disagree with the index
+    problems = c.check_invariants()
+    if not any("fit index" in p for p in problems):
+        fail("check_invariants missed a deliberately desynced entry")
+    c.fit_index.mark_dirty(victim)  # the repair path: lazy recompute
+    pod = whole_pod("post-desync", 1)
+    try:
+        got = c.schedule(pod)  # forces ensure_fresh before the query
+        c.release(got.name)
+    except SchedulingError:
+        pass
+    oracle(c, "desync repair")
+    print("sched-check: phase 3 OK — audit caught the desync, "
+          "dirty-repair cleared it")
+
+    print("sched-check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,276 @@
+"""Round-21 incremental fit index: decision equivalence with the full
+predicate sweep (cross-check oracle + twin-cluster replay), staleness
+fallbacks, the ``check_invariants`` index/accounting audit, the O(1)
+pod->node map, and the incremental occupancy-gauge dirty feed."""
+
+import random
+
+import pytest
+
+from kubetpu.api.types import ContainerInfo, PodInfo
+from kubetpu.core import Cluster, SchedulingError
+from kubetpu.core.cluster import PriorityKey
+from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+from kubetpu.plugintypes import ResourceTPU
+from kubetpu.scheduler.fitindex import _compute_entry
+from kubetpu.scheduler.meshstate import MILLI_PER_CHIP, FracKey
+from kubetpu.scheduler.tpu_scheduler import TpuScheduler
+
+
+def tpu_pod(name, chips, **extra):
+    return PodInfo(
+        name=name, requests=dict(extra),
+        running_containers={
+            "main": ContainerInfo(requests={ResourceTPU: chips})})
+
+
+def frac_pod(name, milli):
+    return PodInfo(name=name, requests={FracKey: milli},
+                   running_containers={"main": ContainerInfo()})
+
+
+def fleet(n, use_fit_index=None):
+    c = Cluster(use_fit_index=use_fit_index)
+    for i in range(n):
+        c.register_node(
+            f"n{i:03d}",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-8", slice_uid=f"s{i}")))
+    return c
+
+
+def churn_ops(seed, ops):
+    """A deterministic mixed op stream: (kind, payload) tuples shared by
+    both twin clusters so their placements are comparable op by op."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(ops):
+        r = rng.random()
+        if r < 0.30:
+            out.append(("release", rng.random()))
+        elif r < 0.55:
+            out.append(("frac", (f"v{i}", rng.choice([125, 250, 500]))))
+        elif r < 0.60:
+            out.append(("preempt", f"hi{i}"))
+        else:
+            out.append(("whole", (f"c{i}", rng.choice([1, 1, 2, 2, 4, 8]))))
+    return out
+
+
+def run_ops(cluster, ops):
+    """Apply the op stream; returns the (pod, node) placement log."""
+    placed, log = [], []
+    for kind, payload in ops:
+        if kind == "release":
+            if placed:
+                j = int(payload * len(placed))
+                placed[j], placed[-1] = placed[-1], placed[j]
+                cluster.release(placed.pop())
+            continue
+        if kind == "preempt":
+            pod = tpu_pod(payload, 8)
+            pod.requests[PriorityKey] = 10
+            try:
+                got, evicted = cluster.schedule_preempting(pod)
+            except SchedulingError:
+                continue
+            for v in evicted:
+                if v.name in placed:
+                    placed.remove(v.name)
+            placed.append(got.name)
+            log.append((got.name, got.node_name))
+            continue
+        name, arg = payload
+        pod = frac_pod(name, arg) if kind == "frac" else tpu_pod(name, arg)
+        try:
+            got = cluster.schedule(pod)
+        except SchedulingError:
+            log.append((name, None))
+            continue
+        placed.append(got.name)
+        log.append((got.name, got.node_name))
+    return log
+
+
+def test_twin_cluster_equivalence_under_churn():
+    """The load-bearing guarantee: index on (cross-checked) and index
+    off place the identical op stream identically — same pod, same
+    node, same no-fit outcomes — and both books stay clean."""
+    ops = churn_ops(seed=99, ops=500)
+    indexed = fleet(24)
+    indexed.index_cross_check = True
+    plain = fleet(24, use_fit_index=False)
+    log_indexed = run_ops(indexed, ops)   # raises on oracle divergence
+    log_plain = run_ops(plain, ops)
+    assert log_indexed == log_plain
+    assert indexed.index_stats["pruned_sweeps"] > 0
+    assert indexed.index_stats["cross_checks"] > 0
+    assert plain.index_stats["pruned_sweeps"] == 0
+    assert indexed.check_invariants() == []
+    assert plain.check_invariants() == []
+
+
+def test_frac_fast_path_picks_tightest_fit_first():
+    """A vChip pod must land on the node whose best-fit remainder is
+    smallest FLEET-WIDE — the index's ordered path must reproduce the
+    sweep's best-fit policy even when that node sorts last by name."""
+    c = fleet(4)
+    c.index_cross_check = True
+    # pin a 750m hold onto the name-LAST node: its 250m remainder is
+    # now the only sub-pristine chip in the fleet
+    c.schedule(frac_pod("a", 750), candidates=["n003"])
+    got = c.schedule(frac_pod("tight", 250))  # exact fit on n003
+    assert got.node_name == "n003"  # beats the name-first pristine nodes
+    loose = c.schedule(frac_pod("loose", 500))  # no sub-pristine fit
+    assert loose.node_name == "n000"  # all-equal scores: name tie-break
+    assert c.check_invariants() == []
+
+
+def test_index_registry_drift_falls_back_to_sweep():
+    """STALENESS: an entry missing from the index (simulated desync)
+    must not break scheduling — the query detects the registry drift
+    and the full sweep runs (fallback_sweeps), still placing
+    correctly."""
+    c = fleet(6)
+    c.fit_index.unregister("n002")  # desync behind the cluster's back
+    before = c.index_stats["fallback_sweeps"]
+    got = c.schedule(tpu_pod("p", 2))
+    assert got.node_name  # placed despite the desync
+    assert c.index_stats["fallback_sweeps"] == before + 1
+    # the audit reports the hole until the node is re-registered
+    problems = c.check_invariants()
+    assert any("fit index" in p and "n002" in p for p in problems)
+    c._index_register("n002")
+    assert c.check_invariants() == []
+
+
+def test_check_invariants_catches_corrupted_entry():
+    c = fleet(3)
+    got = c.schedule(tpu_pod("p", 4))
+    # freshen first: a dirty entry is EXEMPT from the value audit (lazy
+    # staleness is the design) — corruption of a CLEAN entry is not
+    c.fit_index.ensure_fresh(c._index_alloc)
+    entry = c.fit_index.entries[got.node_name]
+    entry.free_tpu += 2  # books say 4, index now says 6
+    problems = c.check_invariants()
+    assert any("drifted" in p for p in problems)
+    # the repair path: mark dirty -> next query recomputes lazily
+    c.fit_index.mark_dirty(got.node_name)
+    c.schedule(tpu_pod("q", 1))
+    assert c.check_invariants() == []
+
+
+def test_kill_switch_env(monkeypatch):
+    monkeypatch.setenv("KUBETPU_NO_FIT_INDEX", "1")
+    c = Cluster()
+    assert c.use_fit_index is False
+    monkeypatch.delenv("KUBETPU_NO_FIT_INDEX")
+    assert Cluster().use_fit_index is True
+
+
+def test_custom_scheduler_disables_frac_caps_but_not_pruning():
+    """A non-stock scheduler type must disable the exact-cap frac fast
+    path (its scores are unknown to the index) while the set prune and
+    the placements stay correct."""
+
+    class MyTpu(TpuScheduler):
+        pass
+
+    c = Cluster(schedulers=[MyTpu()])
+    for i in range(3):
+        c.register_node(
+            f"n{i:03d}",
+            device=new_fake_tpu_dev_manager(
+                make_fake_tpus_info("v5e-8", slice_uid=f"s{i}")))
+    assert c._caps_ok is False
+    c.index_cross_check = True
+    c.schedule(frac_pod("a", 750))
+    got = c.schedule(frac_pod("b", 250))  # oracle raises on divergence
+    assert got.node_name
+    assert c.index_stats["pruned_sweeps"] > 0
+    assert c.check_invariants() == []
+
+
+def test_whole_free_prune_skips_fractionalized_nodes():
+    """A node whose every chip carries a vChip occupant advertises a
+    full TPU scalar but can host no whole-chip gang — the whole-free
+    bucket key must reflect that (and the decision must match the
+    sweep, which rejects it on geometry)."""
+    c = fleet(2)
+    c.index_cross_check = True
+    for i in range(8):  # one 500m occupant per chip of n000 (best-fit
+        c.schedule(frac_pod(f"f{i}", 600))  # 600m can't share a chip)
+    assert c.pod_node("f0") == "n000"
+    c.fit_index.ensure_fresh(c._index_alloc)
+    entry = c.fit_index.entries["n000"]
+    assert entry.whole_free == 0 and entry.free_tpu == 8
+    got = c.schedule(tpu_pod("gang", 8))  # must go to n001, no divergence
+    assert got.node_name == "n001"
+    assert c.check_invariants() == []
+
+
+def test_pod_map_o1_lookup_and_audit():
+    c = fleet(3)
+    got = c.schedule(tpu_pod("p", 2))
+    assert c.pod_node("p") == got.node_name
+    assert c.pod_node("ghost") is None
+    # corrupt the map: the audit must flag it, the lookup must repair it
+    c._pod_node["p"] = "n999"
+    problems = c.check_invariants()
+    assert any("pod" in p and "p" in p for p in problems)
+    assert c.pod_node("p") == got.node_name  # fallback sweep repaired
+    assert c.check_invariants() == []
+    c.release("p")
+    assert c.pod_node("p") is None
+    with pytest.raises(KeyError):
+        c.release("p")
+
+
+def test_occupancy_dirty_feed_is_incremental():
+    c = fleet(4)
+    c.pop_dirty_occupancy()  # drain registration dirt
+    got = c.schedule(tpu_pod("p", 1))
+    dirty = c.pop_dirty_occupancy()
+    assert got.node_name in dirty
+    assert len(dirty) == 1  # ONLY the touched node, not the fleet
+    assert c.pop_dirty_occupancy() == set()  # drained
+    c.release("p")
+    assert c.pop_dirty_occupancy() == {got.node_name}
+    c.remove_node("n003")
+    assert "n003" in c.pop_dirty_occupancy()
+
+
+def test_entry_recompute_matches_accounting_after_lifecycle():
+    """refresh_node / drain replace or rewrite the allocatable dict —
+    the re-hooked index must converge to a fresh recompute."""
+    c = fleet(3)
+    c.schedule(tpu_pod("p", 2))
+    c.schedule(frac_pod("v", 250))
+    c.refresh_node("n000")
+    c.drain("n001")
+    c.cordon("n001", on=False)
+    c.fit_index.ensure_fresh(c._index_alloc)
+    for name, node in c.nodes.items():
+        assert c.fit_index.entries[name] == _compute_entry(
+            node.info.allocatable), name
+    assert c.check_invariants() == []
+
+
+def test_dropped_cluster_not_pinned_by_dirty_hooks():
+    """The meshstate dirty-hook registry holds its OWNER weakly: dropping
+    a cluster must let the whole node graph collect even though its
+    allocatable dicts were hook-registered and never explicitly
+    unregistered (a bench building throwaway 512-node fleets must not
+    accrete them in process memory — that pinning once pushed a
+    bench_gate record run into GC stalls long enough to blow a 120s
+    HTTP timeout downstream)."""
+    import gc
+    import weakref
+
+    c = fleet(4)
+    c.schedule(tpu_pod("p", 2))
+    c.schedule(frac_pod("v", 250))
+    ref = weakref.ref(c)
+    del c
+    gc.collect()
+    assert ref() is None
