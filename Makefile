@@ -43,7 +43,9 @@ test: tpuinfo gpuinfo dataio
 # (speculative rounds must be invisible in the output stream before
 # chaos means anything), then router-check (the data plane must route
 # token-exactly and never double-admit under the same faults), then
-# migrate-check (a live slot handoff must resume token-exactly and
+# lora-check (every packed tenant must decode token-exactly vs its
+# merged model while adapters hot-load and LRU-evict under the same
+# faults), then migrate-check (a live slot handoff must resume token-exactly and
 # at-most-once under faults on the transfer leg), then crash-check
 # (a SIGKILLed controller or replica must recover to the exact
 # pre-crash state — journal replay, boot-nonce takeover, crash
@@ -53,9 +55,9 @@ test: tpuinfo gpuinfo dataio
 # (a chaos pass that silently regressed serving throughput still fails
 # the round).
 .PHONY: chaos
-chaos: lint obs-check prefix-check spec-check router-check migrate-check \
-		disagg-check pack-check tier-check crash-check sched-check \
-		bench-gate-smoke
+chaos: lint obs-check prefix-check spec-check router-check lora-check \
+		migrate-check disagg-check pack-check tier-check crash-check \
+		sched-check bench-gate-smoke
 	python -m pytest tests/test_chaos.py tests/test_resilience.py \
 		tests/test_race_soak.py -q
 
@@ -129,6 +131,16 @@ prefix-check:
 .PHONY: router-check
 router-check:
 	python scripts/router_check.py
+
+# multi-tenant adapter oracle (Round-22): router + 2 packed multi-LoRA
+# replicas under >=10% injected drop/503/partial on the adapter
+# hot-load leg — per-tenant greedy parity vs merge_lora through
+# hot-load churn and LRU eviction under pressure, replays never
+# double-resident, evicted names refuse (never serve stale factors),
+# and the adapter-directory oracle (check_invariants) per drain
+.PHONY: lora-check
+lora-check:
+	python scripts/lora_check.py
 
 # live-KV-migration oracle (Round-16): router + 2 paged replicas,
 # rolling /migrate_out sweeps under >=10% injected faults on the

@@ -1560,6 +1560,233 @@ def packing_storm(cfg, n_tenants=4, n_adapters=2, prompt_len=10,
     return tuple(run(a) for a in arms)
 
 
+def multilora_storm(cfg, n_tenants=4, n_resident=16, prompt_len=10,
+                    max_new=12, n_slots=4, pack=4, window_s=1.5,
+                    think_s=0.05, page_size=8, topology="v5e-1",
+                    arms=("per_tenant", "packed")):
+    """Round-22 headline: ONE packed ``PagedMultiLoraDecodeServer``
+    (every tenant's adapter resident in the stacked device tree, one
+    compiled paged leg serving any tenant mix) vs the per-tenant-replica
+    arm (each tenant its own merged-model paged replica on a Round-18
+    fractional vChip — the best the fleet could do before this round),
+    at EQUAL hardware. Placement runs through the REAL ``Cluster``:
+    the per-tenant arm requests ``1000//pack`` milli-chips per replica,
+    so only ``pack`` tenants per chip get served and every served
+    tenant decodes alone in a batch of one; the packed arm requests the
+    whole chip for ONE replica holding *n_resident* adapters and
+    serves ALL *n_tenants* closed-loop streams from shared slots —
+    cross-tenant continuous batching is exactly the capacity the
+    merged-weights design forfeits. Reports aggregate fleet tok/s per
+    chip (the ``multilora_fleet_toks_s`` gate metric) and resident
+    adapters per replica (``adapters_per_replica``, the scheduler-
+    visible density count — deterministic, NOT normalized), plus a
+    greedy parity rider per driven tenant against an independent quiet
+    merged reference — packing tenants must change THROUGHPUT, never
+    tokens."""
+    import dataclasses
+    import random as _random
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kubetpu.api.types import ContainerInfo, PodInfo
+    from kubetpu.core import Cluster, SchedulingError
+    from kubetpu.device import make_fake_tpus_info, new_fake_tpu_dev_manager
+    from kubetpu.jobs import init_params
+    from kubetpu.jobs.lora import LoraConfig, init_lora_params, merge_lora
+    from kubetpu.jobs.multi_lora import PagedMultiLoraDecodeServer
+    from kubetpu.jobs.paged import PagedDecodeServer
+    from kubetpu.plugintypes import ResourceTPU
+    from kubetpu.plugintypes.mesh import TOPOLOGIES
+    from kubetpu.scheduler.meshstate import MILLI_PER_CHIP, FracKey
+
+    assert n_resident >= n_tenants
+    dcfg = dataclasses.replace(cfg, remat=False)
+    params = init_params(jax.random.PRNGKey(0), dcfg)
+    lcfg = LoraConfig(rank=4, alpha=8.0)
+
+    def tenant_adapter(t):
+        lora = init_lora_params(jax.random.PRNGKey(500 + t), dcfg, lcfg)
+        keys = jax.random.split(jax.random.PRNGKey(900 + t),
+                                len(lcfg.targets))
+        for i, tgt in enumerate(lcfg.targets):
+            b = lora["blocks"][f"{tgt}_b"]
+            lora["blocks"][f"{tgt}_b"] = (
+                jax.random.normal(keys[i], b.shape, b.dtype) * 0.05)
+        return lora
+
+    adapters = [tenant_adapter(t) for t in range(n_resident)]
+    rng = _random.Random(0)
+    prompts = [[[rng.randrange(1, dcfg.vocab) for _ in range(prompt_len)]
+                for _ in range(8)] for _ in range(n_tenants)]
+    max_seq = -(-(prompt_len + max_new + 2) // page_size) * page_size
+    n_chips = len(TOPOLOGIES[topology].host_coords(0))
+
+    def merged_server(t, n_slots_=1):
+        return PagedDecodeServer(
+            dcfg, merge_lora(params, adapters[t], lcfg),
+            n_slots=n_slots_, max_seq=max_seq, max_new_tokens=max_new,
+            page_size=page_size,
+            n_pages=n_slots_ * (max_seq // page_size + 1))
+
+    def packed_server():
+        return PagedMultiLoraDecodeServer(
+            dcfg, params, lcfg, adapters, n_slots=n_slots,
+            max_seq=max_seq, max_new_tokens=max_new, page_size=page_size,
+            n_pages=n_slots * (max_seq // page_size + 1))
+
+    # the parity oracle, per compute path: an independent QUIET
+    # reference per driven tenant, so a single-arm invocation (the
+    # bench-gate smoke runs only "packed") still compares against a
+    # real reference instead of vacuously against itself
+    def seed_packed():
+        out = {}
+        ref = packed_server()
+        for t in range(n_tenants):
+            rid = ref.enqueue(prompts[t][0], adapter=t)
+            ref.drain()
+            out[t] = ref.pop_result(rid)
+        return out
+
+    def seed_merged():
+        out = {}
+        for t in range(n_tenants):
+            ref = merged_server(t)
+            rid = ref.enqueue(prompts[t][0])
+            ref.drain()
+            out[t] = ref.pop_result(rid)
+        return out
+
+    def place(arm):
+        """One pod per replica through the real scheduler; returns the
+        tenants that got a replica (per-tenant arm) or all tenants
+        behind the one packed replica."""
+        cluster = Cluster()
+        cluster.register_node(
+            "bench-n0",
+            device=new_fake_tpu_dev_manager(make_fake_tpus_info(topology)))
+        placed = []
+        if arm == "packed":
+            pod = PodInfo(
+                name="packed0",
+                running_containers={
+                    "main": ContainerInfo(requests={ResourceTPU: 1})})
+            cluster.schedule(pod)
+            placed = list(range(n_tenants))
+        else:
+            for t in range(n_tenants):
+                pod = PodInfo(
+                    name=f"tenant{t}",
+                    requests={FracKey: MILLI_PER_CHIP // pack},
+                    running_containers={"main": ContainerInfo()})
+                try:
+                    cluster.schedule(pod)
+                    placed.append(t)
+                except SchedulingError:
+                    continue   # this tenant is not served in this arm
+        oracle = cluster.check_invariants()
+        assert not oracle, oracle
+        return placed
+
+    def run_per_tenant():
+        expected = seed_merged()
+        placed = place("per_tenant")
+        servers = {t: merged_server(t) for t in placed}
+        for srv in servers.values():
+            srv.warmup()
+
+        def client(t):
+            srv = servers[t]
+            emitted, k, ok = 0, 0, True
+            deadline = time.perf_counter() + window_s
+            while time.perf_counter() < deadline:
+                rid = srv.enqueue(prompts[t][k % len(prompts[t])])
+                srv.drain()
+                toks = srv.pop_result(rid)
+                emitted += len(toks) - prompt_len
+                if k == 0 and toks != expected[t]:
+                    ok = False
+                k += 1
+                time.sleep(think_s)
+            return emitted, ok
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=max(1, len(placed))) as ex:
+            results = list(ex.map(client, placed))
+        wall = time.perf_counter() - t0
+        emitted = sum(e for e, _ok in results)
+        return (emitted, wall, all(ok for _e, ok in results),
+                len(placed), len(placed), 1.0)
+
+    def run_packed():
+        expected = seed_packed()
+        placed = place("packed")
+        srv = packed_server()
+        srv.warmup()
+        # one driver loop, every tenant closed-loop: a tenant fires its
+        # next request *think_s* after its last one finished, and the
+        # shared slots batch whatever mix is in flight
+        pending, inflight = {}, set()
+        k = [0] * n_tenants
+        next_fire = [0.0] * n_tenants
+        emitted, parity = 0, True
+        t0 = time.perf_counter()
+        deadline = t0 + window_s
+        while True:
+            now = time.perf_counter()
+            if now >= deadline and not pending:
+                break
+            if now < deadline:
+                for t in placed:
+                    if t not in inflight and now >= next_fire[t]:
+                        rid = srv.enqueue(
+                            prompts[t][k[t] % len(prompts[t])], adapter=t)
+                        pending[rid] = t
+                        inflight.add(t)
+            if pending:
+                srv.step()
+            else:
+                time.sleep(min(think_s, 0.002))
+            for rid in [r for r in list(pending) if srv.finished(r)]:
+                t = pending.pop(rid)
+                inflight.discard(t)
+                toks = srv.pop_result(rid)
+                emitted += len(toks) - prompt_len
+                if k[t] == 0 and toks != expected[t]:
+                    parity = False
+                k[t] += 1
+                next_fire[t] = time.perf_counter() + think_s
+        wall = time.perf_counter() - t0
+        srv.check_invariants()
+        return (emitted, wall, parity, len(placed), 1,
+                float(len(srv.resident_adapters())))
+
+    def run(arm):
+        emitted, wall, parity, served, replicas, density = (
+            run_packed() if arm == "packed" else run_per_tenant())
+        toks_s = (emitted / wall) if wall else 0.0
+        return {
+            "metric": "multilora_storm",
+            "arm": arm,
+            "value": round(toks_s / n_chips, 1),
+            "unit": "aggregate fleet tok/s per chip",
+            "fleet_toks_s": round(toks_s, 1),
+            "tenants_served": served,
+            "n_tenants": n_tenants,
+            "replicas": replicas,
+            "adapters_per_replica": density,
+            "n_resident": n_resident,
+            "n_chips": n_chips,
+            "pack": pack,
+            "parity": parity,
+            "n_slots": n_slots,
+            "max_new": max_new,
+            "window_s": window_s,
+            "think_s": think_s,
+        }
+
+    return tuple(run(a) for a in arms)
+
+
 def spec_serving_throughput(cfg, n_slots, prompt_len, rounds):
     """Continuous batching WITH speculation: tokens per round under churn
     (the round replaces the one-token step; acceptance sets the speedup

@@ -102,6 +102,13 @@ HOT_BARRIERS = {
     "_upload_host_pages",
     "export_prefix_span",
     "inject_prefix",
+    # Round-22 multi-LoRA: adapter hot-load (one host->device factor
+    # upload into the packed stack) and evict (directory bookkeeping)
+    # are barrier legs — they run on the wire thread between steps,
+    # never inside one; the per-step adapter-id upload rides the _dev
+    # cache at the admission invalidation points instead.
+    "load_adapter",
+    "evict_adapter",
 }
 
 # host-sync / host-upload constructs (the same set the PR 5/6 runtime

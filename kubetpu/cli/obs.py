@@ -290,6 +290,46 @@ def render_summary(metrics_text: str, source: str) -> str:
                 f"miss={fetches.get('miss', 0)} "
                 f"degraded={fetches.get('degraded', 0)}")
 
+    # Round-22 multi-LoRA tenants (present when any scraped replica
+    # serves the stacked-adapter path): per-adapter request/token
+    # traffic summed across the fleet (the exporter already bounds
+    # cardinality to top-K + the overflow bucket), and the residency
+    # gauges behind tenant-affine routing
+    tenants: Dict[str, Dict[str, int]] = {}
+
+    def by_adapter(metric, key):
+        for labels, v in idx.get(metric, []):
+            adapter = labels.get("adapter")
+            if adapter:
+                t = tenants.setdefault(adapter, {})
+                t[key] = t.get(key, 0) + int(v)
+
+    by_adapter("kubetpu_tenant_requests_total", "req")
+    by_adapter("kubetpu_tenant_decode_tokens_total", "tok")
+    by_adapter("kubetpu_tenant_prefill_tokens_saved_total", "saved")
+    if tenants or idx.get("kubetpu_adapter_capacity"):
+        resident = sum(int(v) for _labels, v in
+                       idx.get("kubetpu_adapters_resident", []))
+        capacity = sum(int(v) for _labels, v in
+                       idx.get("kubetpu_adapter_capacity", []))
+        loads = sum(int(v) for _labels, v in
+                    idx.get("kubetpu_adapter_loads_total", []))
+        evicts = sum(int(v) for _labels, v in
+                     idx.get("kubetpu_adapter_evicts_total", []))
+        lines.append(
+            f"tenants   adapters={len(tenants)} "
+            f"resident={resident}/{capacity} "
+            f"loads={loads} evicts={evicts}  "
+            f"requests={sum(t.get('req', 0) for t in tenants.values())} "
+            f"tokens={sum(t.get('tok', 0) for t in tenants.values())} "
+            f"saved={sum(t.get('saved', 0) for t in tenants.values())}")
+        top = sorted(tenants, key=lambda a: -tenants[a].get("tok", 0))[:5]
+        for adapter in top:
+            t = tenants[adapter]
+            lines.append(
+                f"tenants   {adapter}: req={t.get('req', 0)} "
+                f"tok={t.get('tok', 0)} saved={t.get('saved', 0)}")
+
     # Round-20 crash tolerance (present when the controller journals /
     # the router saw a restart): journal volume and compaction state,
     # the last cold-restart replay, the reconciliation diff, and the

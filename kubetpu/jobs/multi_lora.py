@@ -12,14 +12,42 @@ hundreds fit where a second model replica would not.
 Prefill runs through the same chunk path (``decode.forward_chunk`` with
 the stack), so the prompt pass applies the adapter too: the greedy output
 of every slot EXACTLY equals single-request decoding of
-``lora.merge_lora(base, adapter_i)`` — pinned by test. The device legs are
-``DecodeServer``'s own (its jitted prefill/step already thread the
-(lora, adapter) pair); this class only supplies them.
+``lora.merge_lora(base, adapter_i)`` — pinned by test.
+
+Round-22 extends the pattern to the PRODUCTION paged stack:
+``PagedMultiLoraDecodeServer`` threads the per-slot adapter ids through
+the page-pool legs (``paged.paged_forward_one/_chunk`` — the deltas wrap
+AROUND the attention core, so the fused Pallas kernel is untouched) and
+``SpecMultiLoraDecodeServer`` through the speculative verify chunk, so
+chunked prefill, kv_int8 pools, prefix-cache hits and draft+verify rounds
+all serve every tenant mix greedy-token-exact vs the merged single-tenant
+decode. Two multi-tenant-specific rules ride along:
+
+- PREFIX ISOLATION: the radix tree's keys are SALTED with the slot's
+  adapter id AND that index's eviction generation (``_prefix_tokens``:
+  token -> (gen * capacity + aid + 1) << 32 | token, length-preserving
+  so all page math is unchanged) at every tree touchpoint — match,
+  publish, host-tier fill. Adapter A's cached KV can never warm-start
+  adapter B (their K/V differ under different wk/wv deltas), and a
+  tenant hot-loaded into a RECYCLED index can never warm-start from
+  the evicted occupant's pages (the generation bumps on evict); the
+  isolation tests pin both via hit counters. Cross-replica peer fetch
+  degrades to a miss against unsalted peers — colder, never wrong.
+- HOT LOAD/EVICT: the stack is a fixed-capacity device tree (capacity
+  from ``max_adapters`` / the ``adapter_hbm_bytes`` budget — a shape
+  change would recompile the legs); ``load_adapter`` writes a new
+  adapter's factors into a free or LRU-evicted index (content-hashed
+  identity — a replayed load is a no-op), ``evict_adapter`` refuses
+  while any live request references the index, and requests resolve
+  adapters BY NAME at enqueue, so an evicted name can never silently
+  serve a stale or recycled index. ``load_info`` advertises the resident
+  set for tenant-affine routing.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import hashlib
+from typing import Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -27,10 +55,23 @@ import numpy as np
 
 from kubetpu.jobs.lora import _MLP_TARGETS, LoraConfig
 from kubetpu.jobs.model import ModelConfig, Params
-from kubetpu.jobs.serving import DecodeServer
+from kubetpu.jobs.paged import PagedDecodeServer
+from kubetpu.jobs.serving import DEFAULT_N_SLOTS, DecodeServer
+from kubetpu.jobs.spec_serving import PagedSpeculativeDecodeServer
 
 # the targets _decode_block can apply per-example
 _DECODE_TARGETS = ("wq", "wk", "wv", "wo")
+
+# bounded tenant-series cardinality: the first K distinct adapters get
+# their own {adapter=} label; the rest aggregate under the overflow bucket
+_TENANT_TOPK = 32
+_TENANT_OVERFLOW = "_overflow"
+
+_TENANT_METRICS = {
+    "req": "kubetpu_tenant_requests_total",
+    "tok": "kubetpu_tenant_decode_tokens_total",
+    "saved": "kubetpu_tenant_prefill_tokens_saved_total",
+}
 
 
 def stack_adapters(lcfg: LoraConfig, adapters: Sequence[Params]) -> Params:
@@ -65,52 +106,90 @@ def stack_adapters(lcfg: LoraConfig, adapters: Sequence[Params]) -> Params:
     }
 
 
-class MultiLoraDecodeServer(DecodeServer):
-    """``DecodeServer`` where every request picks an adapter from a shared
-    stack: ``submit(prompt, adapter=i)`` / ``enqueue(prompt, adapter=i)``
-    (default adapter 0). The per-slot adapter ids are a traced array of
-    the compiled step — admission writes an integer, never a recompile."""
+def adapter_fingerprint(adapter: Params) -> str:
+    """Content hash of one adapter tree (``init_lora_params`` layout) —
+    the registry/hot-load identity: two byte-identical adapters hash the
+    same wherever they were trained, so a replayed or re-routed load is
+    recognized as already-resident instead of double-loading."""
+    h = hashlib.sha256()
+    for k in sorted(adapter["blocks"]):
+        arr = np.asarray(adapter["blocks"][k])
+        h.update(k.encode())
+        h.update(repr((arr.shape, str(arr.dtype))).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
 
-    def __init__(self, cfg: ModelConfig, params: Params, lcfg: LoraConfig,
-                 lora_stack: Params, **kw) -> None:
+
+class _MultiLoraHostMixin:
+    """The HOST half of multi-tenant serving, shared by every cache
+    layout: per-request adapter plumbing (submit/enqueue -> ``_rid_adapter``
+    -> ``_bind_slot`` -> the per-slot id array the compiled legs trace),
+    the ``_admit_lora``/``_step_lora`` hooks the device legs consume, and
+    the bounded-cardinality per-tenant observability series. Subclasses
+    call ``_init_multi_lora`` BEFORE their ``super().__init__`` (the base
+    constructor may exercise the lora hooks) and ``_init_tenant_obs``
+    after it (the obs registry exists then)."""
+
+    def _init_multi_lora(self, lcfg: LoraConfig, lora_stack: Params,
+                         n_slots: int) -> None:
         self.n_adapters = next(iter(lora_stack["blocks"].values())).shape[0]
         self._lora_scale = lcfg.scale  # read by the base legs at build time
         self.lora_stack = lora_stack
         self._rid_adapter: dict = {}
         self._submit_adapter: Optional[int] = None
-        # before super().__init__: the _admit_lora/_step_lora hooks it may
-        # exercise during construction read this array (ADVICE r4). n_slots
-        # rides kw (this signature has no positional for it).
-        from kubetpu.jobs.serving import DEFAULT_N_SLOTS
+        self._slot_adapter = np.zeros((n_slots,), np.int32)
 
-        self._slot_adapter = np.zeros(
-            (kw.get("n_slots", DEFAULT_N_SLOTS),), np.int32
-        )
-        super().__init__(cfg, params, **kw)
-        assert self._slot_adapter.shape == (self.n_slots,)
+    def _init_tenant_obs(self) -> None:
+        # {kind: {label: counter}} — one series per top-K adapter plus
+        # the shared overflow bucket, so a thousand-tenant fleet cannot
+        # blow up the scrape with unbounded label cardinality
+        self._tenant_counters: Dict[str, dict] = {k: {}
+                                                  for k in _TENANT_METRICS}
 
     # -- request surface ------------------------------------------------------
 
-    def _check_adapter(self, adapter: int) -> int:
+    def _check_adapter(self, adapter) -> int:
+        if not isinstance(adapter, (int, np.integer)):
+            raise ValueError(f"adapter must be an index, got {adapter!r}")
         if not 0 <= adapter < self.n_adapters:
             raise ValueError(
                 f"adapter {adapter} out of range [0, {self.n_adapters})"
             )
         return int(adapter)
 
+    def _adapter_label(self, aid: int) -> str:
+        return str(int(aid))
+
+    def _tenant_counter(self, kind: str, aid: int):
+        cache = self._tenant_counters[kind]
+        label = self._adapter_label(aid)
+        if label not in cache and len(cache) >= _TENANT_TOPK:
+            label = _TENANT_OVERFLOW
+        if label not in cache:
+            # facade over the literal _TENANT_METRICS table above — the
+            # names ARE auditable there # ktlint: disable=KTP004
+            cache[label] = self.obs.counter(_TENANT_METRICS[kind],
+                                            adapter=label)
+        return cache[label]
+
     def submit(self, prompt: List[int], sampling: Optional[dict] = None,
-               adapter: int = 0) -> Optional[int]:
-        self._submit_adapter = self._check_adapter(adapter)
+               adapter=0) -> Optional[int]:
+        aid = self._check_adapter(adapter)
+        self._submit_adapter = aid
         try:
-            return super().submit(prompt, sampling)
+            rid = super().submit(prompt, sampling)
         finally:
             self._submit_adapter = None
+        if rid is not None:
+            self._tenant_counter("req", aid).inc()
+        return rid
 
     def enqueue(self, prompt: List[int], sampling: Optional[dict] = None,
-                adapter: int = 0) -> int:
+                adapter=0, ttl: Optional[float] = None) -> int:
         aid = self._check_adapter(adapter)  # validate BEFORE any bookkeeping
-        rid = super().enqueue(prompt, sampling)
+        rid = super().enqueue(prompt, sampling, ttl=ttl)
         self._rid_adapter[rid] = aid
+        self._tenant_counter("req", aid).inc()
         return rid
 
     def _bind_slot(self, rid: int, slot: int) -> None:
@@ -125,16 +204,24 @@ class MultiLoraDecodeServer(DecodeServer):
         self._invalidate_dev("adapter")
         super()._bind_slot(rid, slot)
 
-    def cancel(self, rid: int) -> bool:
-        out = super().cancel(rid)
-        if out:
-            self._rid_adapter.pop(rid, None)
-        return out
-
-    def pop_result(self, rid: int):
-        out = super().pop_result(rid)  # raises for unfinished rids FIRST
+    def _drop_request_state(self, rid: int) -> None:
+        # THE adapter-map reclamation point: the base class calls this
+        # from pop_result, cancel AND the queue-TTL expiry, so an entry
+        # can no longer outlive its request on the paths that never reach
+        # pop_result (the Round-22 leak fix; also what makes the paged
+        # server's in-use eviction guard sound — a dead rid cannot pin an
+        # adapter index forever)
         self._rid_adapter.pop(rid, None)
-        return out
+        super()._drop_request_state(rid)
+
+    def _note_emitted(self, slot: int) -> None:
+        super()._note_emitted(slot)
+        self._tenant_counter("tok", int(self._slot_adapter[slot])).inc()
+
+    def adapters_in_use(self) -> set:
+        """Adapter indices referenced by any live (queued, active, or
+        finished-but-unpopped) request — the eviction guard's read."""
+        return set(int(a) for a in self._rid_adapter.values())
 
     # -- the lora hooks the base legs consume ---------------------------------
 
@@ -144,3 +231,332 @@ class MultiLoraDecodeServer(DecodeServer):
     def _step_lora(self):
         return self.lora_stack, self._dev(
             "adapter", lambda: self._slot_adapter)
+
+
+class MultiLoraDecodeServer(_MultiLoraHostMixin, DecodeServer):
+    """``DecodeServer`` where every request picks an adapter from a shared
+    stack: ``submit(prompt, adapter=i)`` / ``enqueue(prompt, adapter=i)``
+    (default adapter 0). The per-slot adapter ids are a traced array of
+    the compiled step — admission writes an integer, never a recompile."""
+
+    def __init__(self, cfg: ModelConfig, params: Params, lcfg: LoraConfig,
+                 lora_stack: Params, **kw) -> None:
+        # before super().__init__: the _admit_lora/_step_lora hooks it may
+        # exercise during construction read this state (ADVICE r4). n_slots
+        # rides kw (this signature has no positional for it).
+        self._init_multi_lora(lcfg, lora_stack,
+                              kw.get("n_slots", DEFAULT_N_SLOTS))
+        super().__init__(cfg, params, **kw)
+        assert self._slot_adapter.shape == (self.n_slots,)
+        self._init_tenant_obs()
+
+
+class _PagedMultiLoraMixin(_MultiLoraHostMixin):
+    """The PAGED half of multi-tenant serving, shared by the plain paged
+    and the speculative multi-LoRA servers: the fixed-capacity hot-load/
+    evict adapter directory, adapter-salted prefix-tree keys, per-tenant
+    prefill-savings attribution, and the router-facing residency
+    advertisement. Subclasses call ``_init_paged_lora`` BEFORE their
+    ``super().__init__``."""
+
+    def _init_paged_lora(self, lcfg: LoraConfig, adapters: Sequence[Params],
+                         n_slots: int, max_adapters: Optional[int],
+                         adapter_hbm_bytes: int) -> None:
+        stack = stack_adapters(lcfg, adapters)
+        n = len(adapters)
+        self._adapter_bytes_each = (
+            sum(np.asarray(v).nbytes for v in jax.tree.leaves(stack)) // n)
+        cap = int(max_adapters) if max_adapters else n
+        if adapter_hbm_bytes > 0:
+            by_budget = max(1, int(adapter_hbm_bytes
+                                   // max(self._adapter_bytes_each, 1)))
+            cap = min(cap, by_budget) if max_adapters else by_budget
+        if cap < n:
+            raise ValueError(
+                f"adapter capacity {cap} (max_adapters/adapter_hbm_bytes) "
+                f"cannot hold the {n} initial adapters")
+        if cap > n:
+            # pad to capacity with zero factors (B == 0 -> zero delta ->
+            # the base model): capacity is a SHAPE of the compiled legs,
+            # so it is fixed here once — hot-load writes into an index,
+            # never reshapes
+            stack = {"blocks": {
+                k: jnp.concatenate(
+                    [v, jnp.zeros((cap - n,) + v.shape[1:], v.dtype)])
+                for k, v in stack["blocks"].items()
+            }}
+        self._init_multi_lora(lcfg, stack, n_slots)
+        self._adapter_names: List[Optional[str]] = [None] * cap
+        self._resident: Dict[str, int] = {}
+        self._adapter_lru = [0] * cap
+        # per-index generation, bumped on evict: prefix keys are salted
+        # with (gen, index), so a tenant hot-loaded into a RECYCLED index
+        # can never warm-start from the previous occupant's cached pages
+        self._adapter_gen = [0] * cap
+        self._lru_tick = 0
+        for i, a in enumerate(adapters):
+            name = adapter_fingerprint(a)
+            self._adapter_names[i] = name
+            self._resident[name] = i
+
+    def _init_adapter_obs(self) -> None:
+        self._init_tenant_obs()
+        self.obs.gauge_fn("kubetpu_adapters_resident",
+                          lambda: len(self._resident))
+        self.obs.gauge_fn("kubetpu_adapter_capacity",
+                          lambda: self.n_adapters)
+        self.obs.gauge_fn("kubetpu_adapter_stack_bytes",
+                          lambda: self._adapter_bytes_each * self.n_adapters)
+        self._c_adapter_loads = self.obs.counter(
+            "kubetpu_adapter_loads_total",
+            "adapters hot-loaded into the device stack (replayed loads "
+            "of a resident adapter are NOT counted — idempotent)")
+        self._c_adapter_evicts = self.obs.counter(
+            "kubetpu_adapter_evicts_total",
+            "adapters evicted from the device stack (explicit + LRU)")
+
+    # -- adapter directory: hot load / evict ----------------------------------
+
+    def _check_adapter(self, adapter) -> int:
+        if isinstance(adapter, str):
+            idx = self._resident.get(adapter)
+            if idx is None:
+                raise ValueError(f"adapter {adapter!r} is not resident")
+            return idx
+        idx = super()._check_adapter(adapter)
+        if self._adapter_names[idx] is None:
+            raise ValueError(
+                f"adapter index {idx} is empty (never loaded, or evicted)")
+        return idx
+
+    def _adapter_label(self, aid: int) -> str:
+        name = self._adapter_names[int(aid)]
+        return name if name is not None else str(int(aid))
+
+    def _touch_adapter(self, idx: int) -> None:
+        self._lru_tick += 1
+        self._adapter_lru[idx] = self._lru_tick
+
+    def _bind_slot(self, rid: int, slot: int) -> None:
+        super()._bind_slot(rid, slot)
+        self._touch_adapter(int(self._slot_adapter[slot]))
+
+    def load_adapter(self, adapter: Params,
+                     name: Optional[str] = None) -> str:
+        """Hot-load one adapter tree into the device stack and return its
+        name (default: the content fingerprint — the wire identity).
+        IDEMPOTENT: loading a resident name is a no-op returning the same
+        name, so a replayed wire request can never double-load. Under a
+        full stack the least-recently-BOUND adapter not referenced by any
+        live request is evicted to make room; with every index in use the
+        load refuses (RuntimeError — the wire layer's retryable 503).
+        A BARRIER-class leg (one host->device factor upload), never
+        called from inside ``step()``."""
+        name = name or adapter_fingerprint(adapter)
+        if name in self._resident:
+            self._touch_adapter(self._resident[name])
+            return name
+        keys = sorted(self.lora_stack["blocks"])
+        if sorted(adapter["blocks"]) != keys:
+            raise ValueError(
+                f"adapter targets {sorted(adapter['blocks'])} do not match "
+                f"the stack's {keys}")
+        for k in keys:
+            want = self.lora_stack["blocks"][k].shape[1:]
+            got = np.asarray(adapter["blocks"][k]).shape
+            if got != want:
+                raise ValueError(
+                    f"adapter leaf {k!r} shape {got} != stack's {want}")
+        idx = self._free_adapter_index()
+        for k in keys:
+            self.lora_stack["blocks"][k] = (
+                self.lora_stack["blocks"][k]
+                .at[idx].set(jnp.asarray(adapter["blocks"][k])))
+        self._adapter_names[idx] = name
+        self._resident[name] = idx
+        self._touch_adapter(idx)
+        self._c_adapter_loads.inc()
+        self.events.emit("adapter_load", name=name, index=idx,
+                         resident=len(self._resident))
+        return name
+
+    def _free_adapter_index(self) -> int:
+        for i, nm in enumerate(self._adapter_names):
+            if nm is None:
+                return i
+        in_use = self.adapters_in_use()
+        in_use.update(int(self._slot_adapter[s])
+                      for s in range(self.n_slots) if self.active[s])
+        evictable = [i for i, nm in enumerate(self._adapter_names)
+                     if nm is not None and i not in in_use]
+        if not evictable:
+            raise RuntimeError(
+                "adapter stack full and every index is referenced by a "
+                "live request — retry after requests drain")
+        victim = min(evictable, key=lambda i: self._adapter_lru[i])
+        self._evict_index(victim, reason="lru")
+        return victim
+
+    def _evict_index(self, idx: int, reason: str) -> None:
+        name = self._adapter_names[idx]
+        self._adapter_names[idx] = None
+        self._resident.pop(name, None)
+        # retire every prefix key this index ever published: the next
+        # occupant salts under gen+1, so the old tenant's cached pages
+        # are unreachable (they age out of the tree via its own LRU)
+        self._adapter_gen[idx] += 1
+        self._c_adapter_evicts.inc()
+        self.events.emit("adapter_evict", name=name, index=idx,
+                         reason=reason)
+
+    def evict_adapter(self, name: str) -> bool:
+        """Evict *name* from the directory (the factors stay in HBM until
+        the index is reused — unreachable, since requests resolve names
+        through the directory at enqueue). False when not resident (a
+        replayed evict is a no-op); RuntimeError while any live request
+        references the index (the wire layer's 409 — eviction must never
+        yank an adapter out from under an admitted stream)."""
+        idx = self._resident.get(name)
+        if idx is None:
+            return False
+        in_use = self.adapters_in_use()
+        in_use.update(int(self._slot_adapter[s])
+                      for s in range(self.n_slots) if self.active[s])
+        if idx in in_use:
+            raise RuntimeError(
+                f"adapter {name!r} is referenced by a live request")
+        self._evict_index(idx, reason="explicit")
+        return True
+
+    def resident_adapters(self) -> List[str]:
+        """Names of the adapters currently loaded — what ``load_info``
+        advertises for tenant-affine routing."""
+        return sorted(self._resident)
+
+    def load_info(self) -> dict:
+        info = super().load_info()
+        info["resident_adapters"] = self.resident_adapters()
+        info["adapter_capacity"] = self.n_adapters
+        return info
+
+    def check_invariants(self) -> None:
+        """Pool oracle + the adapter-directory oracle: every resident
+        name owns exactly one stack index, every named index is
+        resident, and no live slot points at an unnamed (evicted)
+        index — a replayed load that double-occupied the stack, or an
+        evict that yanked an admitted stream, fails here."""
+        super().check_invariants()
+        named = {i for i, n in enumerate(self._adapter_names)
+                 if n is not None}
+        assert len(self._resident) == len(named), (
+            f"directory skew: {len(self._resident)} resident names over "
+            f"{len(named)} named indices")
+        for name, idx in self._resident.items():
+            assert self._adapter_names[idx] == name, (
+                f"adapter {name!r} maps to index {idx} which is named "
+                f"{self._adapter_names[idx]!r}")
+        assert len(set(self._resident.values())) == len(self._resident), (
+            "two resident names share a stack index")
+        for s in range(self.n_slots):
+            if self.active[s]:
+                aid = int(self._slot_adapter[s])
+                assert 0 <= aid < self.n_adapters
+                assert self._adapter_names[aid] is not None, (
+                    f"live slot {s} decodes under evicted index {aid}")
+
+    # -- adapter-keyed prefix isolation ---------------------------------------
+
+    def _prefix_tokens(self, prompt: List[int], slot: int) -> List[int]:
+        """Salt the prompt with the slot's (generation, adapter id) for
+        every prefix-tree touchpoint. Length-preserving (page math
+        unchanged); aid+1 keeps even adapter 0 disjoint from any
+        unsalted key a peer replica might ship, and the eviction
+        generation keeps a RECYCLED index disjoint from its previous
+        occupant's keys (gen 0 reduces to the plain aid+1 salt)."""
+        aid = int(self._slot_adapter[slot])
+        salt = (self._adapter_gen[aid] * self.n_adapters + aid + 1) << 32
+        return [salt | (int(t) & 0xFFFFFFFF) for t in prompt]
+
+    def _prefill_start(self, prompt: List[int], slot: int) -> int:
+        # match (and host-tier fill) under the ADAPTER-SALTED key: a hit
+        # can only map pages whose KV was computed under this adapter's
+        # wk/wv deltas — adapter A never warm-starts adapter B
+        return super()._prefill_start(self._prefix_tokens(prompt, slot),
+                                      slot)
+
+    def _note_admitted(self, slot: int, prompt: List[int]) -> None:
+        pending = self._slot_pending_stats[slot]
+        super()._note_admitted(slot, prompt)
+        # publication key: the tree must file this slot's pages under the
+        # adapter that computed them
+        self._slot_prompt[slot] = self._prefix_tokens(prompt, slot)
+        if pending is not None and pending[1] > 0:
+            self._tenant_counter(
+                "saved", int(self._slot_adapter[slot])).inc(pending[1])
+
+    # -- live migration -------------------------------------------------------
+
+    def snapshot_slot(self, rid: int, from_page: int = 0,
+                      allow_frozen: bool = False) -> dict:
+        # the snapshot carries no adapter identity and the target's
+        # directory may not hold this tenant — a resumed stream decoding
+        # under the WRONG adapter would be a silent cross-tenant leak.
+        # The wire layer treats NotImplementedError as a per-stream skip
+        # (wait-drain), same as the dense servers.
+        raise NotImplementedError(
+            "multi-LoRA slots do not migrate — the snapshot carries no "
+            "adapter identity; drain instead")
+
+    def restore_slot(self, snap: dict, reason: str = "migrate"):
+        # symmetric refusal: an inbound snapshot has no adapter identity,
+        # and the landing slot's stale ``_slot_adapter`` entry would
+        # silently retarget the stream
+        raise NotImplementedError(
+            "multi-LoRA replicas do not accept migrated slots — the "
+            "snapshot carries no adapter identity")
+
+
+class PagedMultiLoraDecodeServer(_PagedMultiLoraMixin, PagedDecodeServer):
+    """``PagedDecodeServer`` serving N tenants from one packed replica:
+    ``submit/enqueue(prompt, adapter=i_or_name)`` picks from the stacked
+    device tree; the per-slot ids ride the Round-10 ``_dev`` upload cache
+    into the paged legs, so one compiled step (per bucket) serves every
+    tenant mix — chunked prefill, kv_int8, prefix hits and the fused
+    kernel included, greedy-token-exact vs ``merge_lora`` single-tenant
+    decode (pinned by test). See ``_PagedMultiLoraMixin`` for hot-load/
+    evict and the adapter-salted prefix-tree rule."""
+
+    def __init__(self, cfg: ModelConfig, params: Params, lcfg: LoraConfig,
+                 adapters: Sequence[Params],
+                 max_adapters: Optional[int] = None,
+                 adapter_hbm_bytes: int = 0, **kw) -> None:
+        self._init_paged_lora(lcfg, adapters,
+                              kw.get("n_slots", DEFAULT_N_SLOTS),
+                              max_adapters, adapter_hbm_bytes)
+        super().__init__(cfg, params, **kw)
+        assert self._slot_adapter.shape == (self.n_slots,)
+        self._init_adapter_obs()
+
+
+class SpecMultiLoraDecodeServer(_PagedMultiLoraMixin,
+                                PagedSpeculativeDecodeServer):
+    """Speculative draft+verify rounds over the packed multi-LoRA pool:
+    the TARGET's verify chunk applies each slot's adapter (the compiled
+    round traces the same (stack, ids) pair as the one-token step), the
+    draft stays adapterless — base-model drafts can only lower acceptance,
+    never change output, because verification is greedy-exact per tenant.
+    Output is token-identical to ``PagedMultiLoraDecodeServer``'s greedy
+    stream (pinned by test)."""
+
+    def __init__(self, target_cfg: ModelConfig, draft_cfg: ModelConfig,
+                 target_params: Params, draft_params: Params,
+                 lcfg: LoraConfig, adapters: Sequence[Params],
+                 max_adapters: Optional[int] = None,
+                 adapter_hbm_bytes: int = 0, **kw) -> None:
+        self._init_paged_lora(lcfg, adapters,
+                              kw.get("n_slots", DEFAULT_N_SLOTS),
+                              max_adapters, adapter_hbm_bytes)
+        super().__init__(target_cfg, draft_cfg, target_params, draft_params,
+                         **kw)
+        assert self._slot_adapter.shape == (self.n_slots,)
+        self._init_adapter_obs()

@@ -47,7 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubetpu.jobs import model as model_lib
-from kubetpu.jobs.decode import forward_chunk_io
+from kubetpu.jobs.decode import _lora_in_delta, forward_chunk_io
 from kubetpu.jobs.model import ModelConfig, Params
 from kubetpu.jobs.prefix_cache import RadixPrefixCache
 from kubetpu.jobs.quant import maybe_dequantize, quantize_kv_chunk
@@ -147,7 +147,8 @@ def _write_token_kv(pages_l, new, phys_page, offset):
 
 def paged_forward_one(
     cfg: ModelConfig, params: Params, token, k_pages, v_pages, table, pos,
-    attend=_attend_paged, write_enable=None,
+    attend=_attend_paged, write_enable=None, lora=None, adapter_ids=None,
+    lora_scale=1.0,
 ):
     """One decode step for all slots through the page pool.
     token: (B,) int32; pos: (B,) per-slot position of this token;
@@ -157,7 +158,15 @@ def paged_forward_one(
     the write/gather helpers branch, the layer scan carries either.
     *write_enable* (B,) bool drops the K/V write for masked slots — the
     serving step passes ``active`` so an inactive slot never scribbles
-    on pages a mid-prefill neighbor has already filled."""
+    on pages a mid-prefill neighbor has already filled.
+
+    ``lora`` + ``adapter_ids`` (B,): STACKED adapters (leaves (N, L, ...),
+    ``multi_lora.stack_adapters``) with a per-example adapter choice — the
+    multi-tenant paged serving path. The base matmuls stay batched; each
+    example adds its own rank-r delta via two skinny einsums around them
+    (``decode._lora_in_delta``'s math, applied OUTSIDE the attention core
+    so the fused Pallas kernel path is untouched). The (N, ...) gather
+    happens once per call, then per-layer factors ride the scan."""
     vals = k_pages[0] if isinstance(k_pages, tuple) else k_pages
     ps = vals.shape[2]
     n_pool = vals.shape[1]
@@ -168,27 +177,49 @@ def paged_forward_one(
     offset = pos % ps
     x = params["embed"][token][:, None]                       # (B, 1, D)
 
+    # per-example factor selection, exactly forward_chunk_io's: (N, L, ...)
+    # -> (L, B, ...) so the factors ride the scan with the blocks; an empty
+    # dict is a valid leafless scan xs, so the no-lora path shares the body
+    sel = {} if lora is None else {
+        k: jnp.moveaxis(v[adapter_ids], 1, 0)
+        for k, v in lora["blocks"].items()
+    }
+
+    def proj(name, hh, base, lora_l):
+        out = jnp.einsum("bsd,dhk->bshk", hh, base)
+        if lora_l is not None and f"{name}_a" in lora_l:
+            out = out + _lora_in_delta(
+                hh, lora_l[f"{name}_a"], lora_l[f"{name}_b"], lora_scale
+            ).astype(out.dtype)
+        return out
+
     def layer_body(carry, inputs):
         x = carry
-        layer, k_l, v_l = inputs
+        layer, k_l, v_l, lora_l = inputs
+        lora_l = lora_l or None
         layer = maybe_dequantize(layer)   # per-layer int8 dequant (see quant.py)
         h = model_lib.rms_norm(x, layer["ln1"])
-        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+        q = proj("wq", h, layer["wq"], lora_l)
+        k = proj("wk", h, layer["wk"], lora_l)
+        v = proj("wv", h, layer["wv"], lora_l)
         positions = pos[:, None]
         q = model_lib.rope(q, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
         k = model_lib.rope(k, positions, cfg.rope_theta, cfg.rope_llama3_scaling)
         k_l = _write_token_kv(k_l, k[:, 0], phys, offset)
         v_l = _write_token_kv(v_l, v[:, 0], phys, offset)
         attn = attend(q[:, 0], k_l, v_l, table, pos)
-        x = x + jnp.einsum("bhk,hkd->bd", attn, layer["wo"])[:, None]
+        o = jnp.einsum("bhk,hkd->bd", attn, layer["wo"])
+        if lora_l is not None and "wo_a" in lora_l:
+            t = jnp.einsum("bhk,bhkr->br", attn, lora_l["wo_a"])
+            o = o + (jnp.einsum("br,brd->bd", t, lora_l["wo_b"])
+                     * lora_scale).astype(o.dtype)
+        x = x + o[:, None]
         h2 = model_lib.rms_norm(x, layer["ln2"])
         delta, _aux = model_lib._mlp(cfg, h2, layer)
         return x + delta, (k_l, v_l)
 
     x, (k_pages, v_pages) = jax.lax.scan(
-        layer_body, x, (params["blocks"], k_pages, v_pages)
+        layer_body, x, (params["blocks"], k_pages, v_pages, sel)
     )
     x = model_lib.rms_norm(x, params["ln_f"])
     head = maybe_dequantize(params["head"])
@@ -232,7 +263,8 @@ def _attend_paged_chunk(q, k_pages_l, v_pages_l, table, pos):
 
 def paged_forward_chunk(
     cfg: ModelConfig, params: Params, tokens, k_pages, v_pages, table, pos,
-    write_enable=None, attend_chunk=None,
+    write_enable=None, attend_chunk=None, lora=None, adapter_ids=None,
+    lora_scale=1.0,
 ):
     """T-token chunk forward per slot through the page pool at PER-SLOT
     positions ``pos..pos+T-1`` — the speculative VERIFY leg (T = gamma+1;
@@ -253,7 +285,12 @@ def paged_forward_chunk(
     pages like the decode step does. *attend_chunk* swaps the chunk
     attention core (``ops.paged_attention_chunk`` plugs in here — same
     write-then-read order, so the kernel reads the committed in-chunk
-    entries exactly as the gather core does)."""
+    entries exactly as the gather core does).
+
+    ``lora`` + ``adapter_ids`` (B,): per-example stacked-adapter deltas,
+    exactly ``paged_forward_one``'s — applied around the attention core,
+    so the Pallas verify kernel is untouched and the multi-tenant verify
+    chunk stays token-exact against multi-tenant one-token decode."""
     if attend_chunk is None:
         attend_chunk = _attend_paged_chunk
     vals = k_pages[0] if isinstance(k_pages, tuple) else k_pages
@@ -268,26 +305,45 @@ def paged_forward_chunk(
     offset = tpos % ps
     x = params["embed"][tokens]                                # (B, T, D)
 
+    sel = {} if lora is None else {
+        k: jnp.moveaxis(v[adapter_ids], 1, 0)
+        for k, v in lora["blocks"].items()
+    }
+
+    def proj(name, hh, base, lora_l):
+        out = jnp.einsum("bsd,dhk->bshk", hh, base)
+        if lora_l is not None and f"{name}_a" in lora_l:
+            out = out + _lora_in_delta(
+                hh, lora_l[f"{name}_a"], lora_l[f"{name}_b"], lora_scale
+            ).astype(out.dtype)
+        return out
+
     def layer_body(carry, inputs):
         x = carry
-        layer, k_l, v_l = inputs
+        layer, k_l, v_l, lora_l = inputs
+        lora_l = lora_l or None
         layer = maybe_dequantize(layer)
         h = model_lib.rms_norm(x, layer["ln1"])
-        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"])
+        q = proj("wq", h, layer["wq"], lora_l)
+        k = proj("wk", h, layer["wk"], lora_l)
+        v = proj("wv", h, layer["wv"], lora_l)
         q = model_lib.rope(q, tpos, cfg.rope_theta, cfg.rope_llama3_scaling)
         k = model_lib.rope(k, tpos, cfg.rope_theta, cfg.rope_llama3_scaling)
         k_l = _write_token_kv(k_l, k, phys, offset)   # (B, T) scatter
         v_l = _write_token_kv(v_l, v, phys, offset)
         attn = attend_chunk(q, k_l, v_l, table, pos)
-        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
+        o = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"])
+        if lora_l is not None and "wo_a" in lora_l:
+            tt = jnp.einsum("bshk,bhkr->bsr", attn, lora_l["wo_a"])
+            o = o + (jnp.einsum("bsr,brd->bsd", tt, lora_l["wo_b"])
+                     * lora_scale).astype(o.dtype)
+        x = x + o
         h2 = model_lib.rms_norm(x, layer["ln2"])
         delta, _aux = model_lib._mlp(cfg, h2, layer)
         return x + delta, (k_l, v_l)
 
     x, (k_pages, v_pages) = jax.lax.scan(
-        layer_body, x, (params["blocks"], k_pages, v_pages)
+        layer_body, x, (params["blocks"], k_pages, v_pages, sel)
     )
     x = model_lib.rms_norm(x, params["ln_f"])
     head = maybe_dequantize(params["head"])
@@ -396,12 +452,16 @@ def _paged_prefill_io(write_phys, gather_row, ps: int, window: int,
     return io
 
 
-def _build_paged_legs(cfg_, page_size, attend, attend_chunk=None):
+def _build_paged_legs(cfg_, page_size, attend, attend_chunk=None,
+                      lora_scale=1.0):
     """(prefill_chunk, step_all) jits for the page-pool server — shared
     across same-key servers via ``serving._cached_legs`` (the legs are
     pure functions of their arguments). *attend_chunk* (use_kernel,
     non-windowed) fuses the prefill chunk's attention through the page
-    table too."""
+    table too. The trailing (lora, aid/aids) pair is the multi-LoRA hook
+    (``multi_lora.PagedMultiLoraDecodeServer``): None/zeros for the plain
+    server — an empty pytree arg, zero trace cost — mirroring
+    ``serving._build_dense_legs``."""
     from kubetpu.jobs.sampling import make_slot_sampler
 
     sampler = make_slot_sampler()
@@ -410,10 +470,11 @@ def _build_paged_legs(cfg_, page_size, attend, attend_chunk=None):
 
     @partial(jax.jit, donate_argnums=(1, 2))
     def step_all(params, k_pages, v_pages, table, last, pos, active,
-                 reqkeys, temp, tk, tp):
+                 reqkeys, temp, tk, tp, lora, aids):
         logits, k_pages, v_pages = paged_forward_one(
             cfg_, params, last, k_pages, v_pages, table, pos,
             attend=attend, write_enable=active,
+            lora=lora, adapter_ids=aids, lora_scale=lora_scale,
         )
         keys = jax.vmap(jax.random.fold_in)(reqkeys, pos)
         nxt = sampler(logits, keys, temp, tk, tp)
@@ -424,14 +485,16 @@ def _build_paged_legs(cfg_, page_size, attend, attend_chunk=None):
 
     @partial(jax.jit, donate_argnums=(1, 2))
     def prefill_chunk(params, k_pages, v_pages, chunk, write_phys, row,
-                      pos, last_idx, reqkey, temp, tk, tp):
+                      pos, last_idx, reqkey, temp, tk, tp, lora, aid):
         # the chunk forward THROUGH the pool: forward_chunk_io over
         # the paged cache strategy (module docstring) — one compile
         # per chunk length serves every offset and every slot
         io = _paged_prefill_io(write_phys, row, ps_, window_,
                                attend_chunk=attend_chunk)
         logits, (k_pages, v_pages) = forward_chunk_io(
-            cfg_, params, chunk[None], (k_pages, v_pages), pos, io
+            cfg_, params, chunk[None], (k_pages, v_pages), pos, io,
+            lora=lora, adapter_ids=None if lora is None else aid[None],
+            lora_scale=lora_scale,
         )
         r = jnp.take(logits[0], last_idx, axis=0)
         tok = sampler(r, jax.random.fold_in(reqkey, pos + last_idx),
@@ -716,10 +779,12 @@ class PagedDecodeServer(SlotServerBase):
                 "gathered-KV materialization bytes the kernel did not "
                 "write+read (f32 gather buffer per attention leg)")
 
+        lora_scale = getattr(self, "_lora_scale", 1.0)
         self._prefill_chunk, self._step_all = _cached_legs(
             ("paged", cfg, page_size, kv_int8, use_kernel, interpret,
-             self.pages_per_block),
-            lambda: _build_paged_legs(cfg, page_size, attend, attend_chunk),
+             self.pages_per_block, float(lora_scale)),
+            lambda: _build_paged_legs(cfg, page_size, attend, attend_chunk,
+                                      lora_scale),
         )
 
     # -- page accounting -----------------------------------------------------
@@ -1715,6 +1780,7 @@ class PagedDecodeServer(SlotServerBase):
         # compilations serves every offset — not the slot's whole
         # max_seq view (a ~max_seq/bucket x cost on every admission)
         n_gather = self._gather_prefix(pos + bucket)
+        lora, aid = self._admit_lora(slot)
         self.k_pages, self.v_pages, first, first_lp = self._prefill_chunk(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(chunk, jnp.int32),
@@ -1724,6 +1790,7 @@ class PagedDecodeServer(SlotServerBase):
             jnp.float32(self._slot_temp[slot]),
             jnp.int32(self._slot_topk[slot]),
             jnp.float32(self._slot_topp[slot]),
+            lora, aid,
         )
         return (first, first_lp) if final else True
 
@@ -1743,6 +1810,7 @@ class PagedDecodeServer(SlotServerBase):
         # unmapped pages. Table and slot state ride the device-resident
         # upload cache: a steady-state step re-uploads nothing.
         self._note_kernel_step()
+        lora, aids = self._step_lora()
         self.k_pages, self.v_pages, nxt, self.pos, lp = self._step_all(
             self.params, self.k_pages, self.v_pages,
             self._dev("table", lambda: self._table),
@@ -1752,6 +1820,7 @@ class PagedDecodeServer(SlotServerBase):
             self._dev("temp", lambda: self._slot_temp),
             self._dev("topk", lambda: self._slot_topk),
             self._dev("topp", lambda: self._slot_topp),
+            lora, aids,
         )
         self.last = nxt
         return nxt, lp
@@ -1778,6 +1847,7 @@ class PagedDecodeServer(SlotServerBase):
             n_write = (len(padded) + self.page_size - 1) // self.page_size
             if n_gather is None:
                 n_gather = self._gather_prefix(len(padded))
+            lora, aid = self._admit_lora(0)
             self.k_pages, self.v_pages, _f, _lp = self._prefill_chunk(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(padded, jnp.int32),
@@ -1785,6 +1855,7 @@ class PagedDecodeServer(SlotServerBase):
                 jnp.int32(0), jnp.int32(0),
                 jnp.asarray(self._slot_reqkey[0]),
                 jnp.float32(d_temp), jnp.int32(d_tk), jnp.float32(d_tp),
+                lora, aid,
             )
 
         self._warmup_buckets(prefill_dummy)
@@ -1804,6 +1875,7 @@ class PagedDecodeServer(SlotServerBase):
                     g = min(g * 2, self.max_pages_per_slot)
                     prefill_dummy([0] * b, n_gather=g)
                 b *= 2
+        lora, aids = self._step_lora()
         self.k_pages, self.v_pages, _n, _p, _lps = self._step_all(
             self.params, self.k_pages, self.v_pages,
             jnp.asarray(self._table), self.last, self.pos,
@@ -1811,6 +1883,7 @@ class PagedDecodeServer(SlotServerBase):
             jnp.asarray(self._slot_reqkey),
             jnp.asarray(self._slot_temp), jnp.asarray(self._slot_topk),
             jnp.asarray(self._slot_topp),
+            lora, aids,
         )
         # drain the dispatch queue so the first live admission doesn't pay
         # (and record) the queued warmup executions as admission stall —

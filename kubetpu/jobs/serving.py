@@ -300,6 +300,27 @@ class SlotServerBase:
         self._slot_reqkey[slot] = self._request_key(rid)
         self._invalidate_dev("reqkey", "temp", "topk", "topp")
 
+    # -- multi-LoRA hooks (overridden by the multi_lora servers) --------------
+    # On the BASE class so both cache layouts (DecodeServer's contiguous
+    # cache AND PagedDecodeServer's pool) thread the same (stack, ids)
+    # pair into their compiled legs — None/zeros is an empty pytree arg
+    # with zero trace cost for the plain servers.
+
+    def _admit_lora(self, slot: int):
+        """(adapter stack, adapter id) for an admission — base: none."""
+        return None, jnp.int32(0)
+
+    def _step_lora(self):
+        """(adapter stack, per-slot adapter ids) for a step — base: none."""
+        return None, jnp.zeros((self.n_slots,), jnp.int32)
+
+    def _drop_request_state(self, rid: int) -> None:
+        """Subclass hook: drop any per-request bookkeeping keyed by *rid*
+        (the multi-LoRA servers' adapter map). Called at EVERY path a
+        request's bookkeeping dies through — ``pop_result``, ``cancel``
+        (queued or active), and the queue-TTL expiry — so subclass state
+        cannot leak on the paths that never reach ``pop_result``."""
+
     def _free_slots(self) -> List[int]:
         """Slots holding neither an active decode nor an in-flight
         prefill (nor a stream frozen mid-migration — inactive for the
@@ -466,6 +487,7 @@ class SlotServerBase:
                 self._expired[rid] = "queue_ttl"
                 self._rid_sampling.pop(rid, None)
                 self._arrive.pop(rid, None)  # no tokens ever: no TTFT
+                self._drop_request_state(rid)  # never reaches pop_result
                 self._metrics.record("queue_expired", now - deadline)
                 self.events.emit("queue_expired", rid=rid)
             else:
@@ -927,6 +949,7 @@ class SlotServerBase:
                 self._queue.pop(i)
                 self._done[rid] = True
                 self._rid_sampling.pop(rid, None)
+                self._drop_request_state(rid)
                 self.events.emit("cancel", rid=rid, queued=True)
                 return True
         for slot in range(self.n_slots):
@@ -945,6 +968,7 @@ class SlotServerBase:
                 self.events.emit("cancel", rid=rid, queued=False)
                 self._retire(slot)
                 self._rid_sampling.pop(rid, None)
+                self._drop_request_state(rid)
                 return True
         return False
 
@@ -1194,6 +1218,7 @@ class SlotServerBase:
         self._migrated.pop(rid, None)
         self._stream_epoch.pop(rid, None)
         self._stream_origin.pop(rid, None)
+        self._drop_request_state(rid)
         return out
 
     def _runnable(self) -> bool:
@@ -1421,16 +1446,6 @@ class DecodeServer(SlotServerBase):
                 "kv_int8 server: no dense v_cache array — use self.cache"
             )
         return self.cache[1]
-
-    # -- multi-LoRA hooks (overridden by MultiLoraDecodeServer) ---------------
-
-    def _admit_lora(self, slot: int):
-        """(adapter stack, adapter id) for an admission — base: none."""
-        return None, jnp.int32(0)
-
-    def _step_lora(self):
-        """(adapter stack, per-slot adapter ids) for a step — base: none."""
-        return None, jnp.zeros((self.n_slots,), jnp.int32)
 
     # -- device legs ---------------------------------------------------------
 

@@ -331,7 +331,8 @@ class SpeculativeDecodeServer(_SpecRoundsMixin, SlotServerBase):
         jax.block_until_ready((self.k_cache, self.v_cache))
 
 
-def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos, attend_chunk=None):
+def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos, attend_chunk=None,
+                            lora_scale=1.0):
     """The jitted paged speculative ROUND for one static *gamma*: draft
     ``gamma`` greedy tokens through the (dense, per-slot) draft cache at
     per-slot positions (``speculative.draft_propose`` — the same
@@ -352,14 +353,21 @@ def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos, attend_chunk=None):
     *attend_chunk* (``use_kernel``): the fused Pallas chunk kernel
     (``ops.paged_attention_chunk``) replaces the verify chunk's gather
     core — one compiled round per (gamma, kernel) signature, all warmed
-    by ``warmup()`` through the profiler's per-gamma watch."""
+    by ``warmup()`` through the profiler's per-gamma watch.
+
+    The trailing (lora, aids) pair is the multi-LoRA hook: the TARGET's
+    verify chunk applies each slot's adapter (``paged_forward_chunk``'s
+    per-example deltas), so acceptance compares drafts against the
+    TENANT's greedy stream. The draft stays adapterless — a base-model
+    draft can only lower acceptance, never change output, because
+    verification is greedy-exact (the prefix-hit argument, per tenant)."""
 
     # built lazily per gamma on first use, then cached (and warmup()
     # pre-compiles every gamma); the profiler's round[gamma=G] watch
     # counts any recompile this misses # ktlint: disable=KTP006
     @partial(jax.jit, donate_argnums=(2, 3, 4))
     def round_all(t_params, d_params, k_pages, v_pages, dcache,
-                  table, last, pos, active, slot_gamma):
+                  table, last, pos, active, slot_gamma, lora, aids):
         dk, dv = dcache
         pos_d = jnp.where(active, pos, dead_pos)
         dk, dv, drafts = draft_propose(
@@ -368,6 +376,7 @@ def _build_paged_spec_round(tcfg, dcfg, gamma, dead_pos, attend_chunk=None):
         t_logits, k_pages, v_pages = paged_forward_chunk(
             tcfg, t_params, chunk, k_pages, v_pages, table, pos,
             write_enable=active, attend_chunk=attend_chunk,
+            lora=lora, adapter_ids=aids, lora_scale=lora_scale,
         )
         target_tok = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
         agree = (drafts == target_tok[:, :gamma]).astype(jnp.int32)
@@ -514,13 +523,14 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
         return self.gamma_max
 
     def _round_leg(self, gamma: int):
+        lora_scale = getattr(self, "_lora_scale", 1.0)
         return _cached_legs(
             ("paged_spec", self.cfg, self.draft_cfg, self.page_size,
              self.kv_int8, gamma, self._draft_len - 1, self.use_kernel,
-             self.interpret, self.pages_per_block),
+             self.interpret, self.pages_per_block, float(lora_scale)),
             lambda: _build_paged_spec_round(
                 self.cfg, self.draft_cfg, gamma, self._draft_len - 1,
-                attend_chunk=self._attend_chunk),
+                attend_chunk=self._attend_chunk, lora_scale=lora_scale),
         )
 
     def _note_admitted(self, slot: int, prompt: List[int]) -> None:
@@ -618,6 +628,7 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
             # unwarmed gamma reads as a recompile on ITS leg, not a
             # mystery stall (watch is idempotent per leg name)
             round_all = prof.watch(f"round[gamma={g}]", round_all)
+        lora, aids = self._step_lora()
         (self.k_pages, self.v_pages, self.dcache, self.last, self.pos,
          toks_d, n_emit_d, lps_d) = round_all(
             self.params, self.draft_params, self.k_pages, self.v_pages,
@@ -625,6 +636,7 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
             self._dev("table", lambda: self._table), self.last, self.pos,
             self._dev("active", lambda: self.active),
             self._dev("gamma", lambda: self._gamma),
+            lora, aids,
         )
         if rec is not None:
             rec.mark("dispatch")
@@ -718,6 +730,7 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
         gammas = (range(1, self.gamma_max + 1) if self.adaptive_gamma
                   else (self.gamma_max,))
         idle = jnp.asarray(np.zeros((self.n_slots,), bool))
+        lora, aids = self._step_lora()
         for g in gammas:
             round_all = self._round_leg(g)
             if self._profiler is not None:
@@ -733,5 +746,6 @@ class PagedSpeculativeDecodeServer(_SpecRoundsMixin, PagedDecodeServer):
                 self.dcache,
                 self._dev("table", lambda: self._table), self.last, self.pos,
                 idle, self._dev("gamma", lambda: self._gamma),
+                lora, aids,
             )
         jax.block_until_ready((self.k_pages, self.v_pages))

@@ -1,0 +1,164 @@
+"""Adapter registry + distribution — the multi-LoRA control plane
+(Round-22).
+
+The data-plane half of thousand-tenant serving lives in
+``kubetpu.jobs.multi_lora`` (one packed replica, stacked adapter tree,
+per-slot integer retargeting). This module is the fleet half: where
+adapter weights LIVE before any replica holds them, and how they move.
+
+- ``AdapterRegistry`` — the controller-side source of truth, shipped
+  like checkpoint shards: content-hashed (``adapter_fingerprint``), so
+  an adapter's name IS its bytes — registering the same tree twice
+  under different paths dedupes, and two registries trained from the
+  same artifact agree on every name with no coordination;
+- ``encode_adapter``/``decode_adapter`` — the wire codec (per-leaf
+  dtype + shape + base64 bytes; at rank 8 an adapter is ~0.1% of the
+  base model, so JSON transport is fine and keeps the leg debuggable);
+- ``push_adapter``/``evict_adapter`` — the replica legs over
+  ``POST /adapters``, idempotency-keyed per (adapter, replica): a
+  retried push whose first response was lost REPLAYS, and the replica's
+  own load is content-idempotent besides — a replay can never
+  double-load (pinned by ``make lora-check`` under injected faults).
+
+The router reads each replica's advertised ``resident_adapters`` (from
+the ``/load`` snapshot) for tenant-affine routing — see
+``RouterServer._pick``.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from kubetpu.jobs.multi_lora import adapter_fingerprint
+from kubetpu.wire.httpcommon import request_json
+
+DEFAULT_PUSH_TIMEOUT = 10.0
+
+
+def encode_adapter(adapter) -> dict:
+    """One adapter tree (``init_lora_params`` layout) -> a JSON-safe
+    wire object: {"blocks": {leaf: {dtype, shape, data(b64)}}}."""
+    out = {}
+    for k, v in adapter["blocks"].items():
+        arr = np.ascontiguousarray(np.asarray(v))
+        out[k] = {
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+        }
+    return {"blocks": out}
+
+
+def decode_adapter(obj: dict) -> dict:
+    """Inverse of ``encode_adapter``; raises ``ValueError`` on any
+    malformed leaf (the wire handler's 400)."""
+    blocks = obj.get("blocks")
+    if not isinstance(blocks, dict) or not blocks:
+        raise ValueError("adapter payload needs a non-empty blocks map")
+    out = {}
+    for k, leaf in blocks.items():
+        try:
+            raw = base64.b64decode(leaf["data"])
+            arr = np.frombuffer(raw, dtype=np.dtype(leaf["dtype"]))
+            out[k] = arr.reshape([int(d) for d in leaf["shape"]])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"adapter leaf {k!r} malformed: {e}") from e
+    return {"blocks": out}
+
+
+class AdapterRegistry:
+    """Content-hashed adapter store — the fleet's source of truth.
+
+    ``register`` names an adapter by its fingerprint (or an explicit
+    alias); the SAME bytes re-register as a no-op, the same alias over
+    DIFFERENT bytes refuses (an alias must never silently retarget —
+    tenants route by it). Encoded wire payloads are cached per name, so
+    pushing one adapter to N replicas encodes once."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._adapters: Dict[str, dict] = {}     # name -> tree
+        self._encoded: Dict[str, dict] = {}      # name -> wire payload
+        self._digest: Dict[str, str] = {}        # name -> fingerprint
+
+    def register(self, adapter, name: Optional[str] = None) -> str:
+        fp = adapter_fingerprint(adapter)
+        name = name or fp
+        with self._lock:
+            have = self._digest.get(name)
+            if have is not None:
+                if have != fp:
+                    raise ValueError(
+                        f"adapter name {name!r} is already registered "
+                        f"with different content")
+                return name
+            self._adapters[name] = adapter
+            self._digest[name] = fp
+        return name
+
+    def get(self, name: str):
+        with self._lock:
+            a = self._adapters.get(name)
+        if a is None:
+            raise KeyError(f"no registered adapter {name!r}")
+        return a
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._adapters)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._adapters)
+
+    def encoded(self, name: str) -> dict:
+        with self._lock:
+            enc = self._encoded.get(name)
+            if enc is None:
+                a = self._adapters.get(name)
+                if a is None:
+                    raise KeyError(f"no registered adapter {name!r}")
+                enc = encode_adapter(a)
+                self._encoded[name] = enc
+        return enc
+
+    # -- replica legs --------------------------------------------------------
+
+    def push_adapter(self, replica_url: str, name: str,
+                     token: Optional[str] = None,
+                     timeout: float = DEFAULT_PUSH_TIMEOUT) -> dict:
+        """Hot-load the registered adapter *name* into one replica over
+        ``POST /adapters``. The idempotency key is per ATTEMPT (the
+        ``migrate_rid`` spelling): retries inside ``request_json`` reuse
+        it, so a lost response REPLAYS the committed answer — while a
+        later, separate push after an intervening evict re-executes
+        under a fresh key instead of replaying a stale verdict.
+        At-most-once residency is the replica's job either way (its
+        load is content-idempotent), not the key's. Raises on a
+        definitive wire refusal."""
+        return request_json(
+            replica_url.rstrip("/") + "/adapters",
+            {"action": "load", "name": name,
+             "adapter": self.encoded(name)},
+            token=token, timeout=timeout,
+            idempotency_key=f"adapter-load-{name}-{uuid.uuid4().hex[:8]}")
+
+    def evict_adapter(self, replica_url: str, name: str,
+                      token: Optional[str] = None,
+                      timeout: float = DEFAULT_PUSH_TIMEOUT) -> dict:
+        """Evict *name* from one replica. 409 (adapter pinned by a live
+        request) raises ``urllib.error.HTTPError`` — eviction under
+        pressure must wait for the stream, never yank it. Per-attempt
+        key, like the push leg — the replica's evict is name-idempotent
+        (False when already gone), so a re-executed retry is
+        harmless."""
+        return request_json(
+            replica_url.rstrip("/") + "/adapters",
+            {"action": "evict", "name": name},
+            token=token, timeout=timeout,
+            idempotency_key=f"adapter-evict-{name}-{uuid.uuid4().hex[:8]}")

@@ -344,6 +344,13 @@ class ReplicaServer:
                         lambda: replica._prefix_fetch(req),
                     )
                     return
+                if self.path == "/adapters":
+                    run_idempotent(
+                        self, replica.idem,
+                        self.headers.get("Idempotency-Key"),
+                        lambda: replica._adapters(req),
+                    )
+                    return
                 if self.path != "/generate":
                     write_json(self, 404, {"error": f"no route {self.path}"})
                     return
@@ -420,9 +427,21 @@ class ReplicaServer:
                     return 503, {"error": "replica step loop is not "
                                           "running"}
                 self.events.emit("generate", prompt_tokens=len(prompt))
+                # Round-22 multi-tenant rider: a routed generate may name
+                # its adapter (resident name or stack index). Refused
+                # up-front on single-tenant servers — a silent drop would
+                # serve the base model to a tenant expecting their
+                # adapter.
+                extra = {}
+                if req.get("adapter") is not None:
+                    if not hasattr(self.server, "lora_stack"):
+                        return 400, {"error": "replica does not serve "
+                                              "multi-LoRA"}
+                    extra["adapter"] = req["adapter"]
                 try:
                     rid = self.server.enqueue(prompt,
-                                              sampling=req.get("sampling"))
+                                              sampling=req.get("sampling"),
+                                              **extra)
                 except ValueError as e:
                     return 400, {"error": str(e)}
                 except Exception as e:  # noqa: BLE001 — report, stay up
@@ -537,6 +556,69 @@ class ReplicaServer:
             "span": encode_span_payload(span["pages"],
                                         int(span["from_page"])),
         }
+
+    # -- Round-22: adapter hot-load/evict ------------------------------------
+
+    def _adapters(self, req: dict):
+        """``POST /adapters`` — the multi-LoRA control-plane leg ->
+        (code, obj); runs under ``run_idempotent`` (a lost response
+        replays). Actions:
+
+        - ``load``: decode the wire adapter + hot-load it into the
+          serving stack (content-idempotent — a replayed or re-keyed
+          load of a resident adapter is a no-op). 503 when the stack is
+          full of in-use adapters (retryable: requests drain), 400 on a
+          malformed or mismatched payload;
+        - ``evict``: drop the named adapter from the directory. 409
+          while a live request references it (eviction must never yank
+          an adapter out from under an admitted stream); ``evicted:
+          false`` when already gone (replay-idempotent).
+
+        Both answers carry the post-action resident set, so the caller
+        (and the router's next /load scrape) sees residency without a
+        second round trip."""
+        load_fn = getattr(self.server, "load_adapter", None)
+        evict_fn = getattr(self.server, "evict_adapter", None)
+        if load_fn is None or evict_fn is None:
+            return 404, {"error": "replica does not serve multi-LoRA"}
+        action = str(req.get("action") or "load")
+        if action == "load":
+            from kubetpu.router.adapters import decode_adapter
+            try:
+                adapter = decode_adapter(req.get("adapter") or {})
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            name = req.get("name")
+            if name is not None and not isinstance(name, str):
+                return 400, {"error": "adapter name must be a string"}
+            try:
+                with self._cv:
+                    out = load_fn(adapter, name=name)
+                    resident = self.server.resident_adapters()
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            except RuntimeError as e:
+                # every index pinned by live requests: transient — the
+                # keyed retry lands after streams drain
+                return 503, {"error": str(e)}
+            self.events.emit("adapter_wire_load", name=out)
+            return 200, {"name": out, "resident": resident,
+                         "replica": self.name}
+        if action == "evict":
+            name = req.get("name")
+            if not isinstance(name, str) or not name:
+                return 400, {"error": "evict needs an adapter name"}
+            try:
+                with self._cv:
+                    evicted = evict_fn(name)
+                    resident = self.server.resident_adapters()
+            except RuntimeError as e:
+                return 409, {"error": str(e)}
+            self.events.emit("adapter_wire_evict", name=name,
+                             evicted=bool(evicted))
+            return 200, {"evicted": bool(evicted), "resident": resident,
+                         "replica": self.name}
+        return 400, {"error": f"unknown adapter action {action!r}"}
 
     def _maybe_peer_prefetch(self, req: dict, prompt: list,
                              key: Optional[str]) -> None:

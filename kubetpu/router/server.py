@@ -110,6 +110,8 @@ class RouterServer:
         shed_classes: Tuple[str, ...] = ("batch",),
         queue_classes: Tuple[str, ...] = ("standard",),
         queue_timeout_s: float = 2.0,
+        tenant_slo_classes: Optional[Dict[str, str]] = None,
+        adapters=None,
         idem_window: float = 300.0,
         suspect_after: int = 2,
         dead_after: int = 5,
@@ -130,6 +132,13 @@ class RouterServer:
         self.shed_classes = tuple(shed_classes)
         self.queue_classes = tuple(queue_classes)
         self.queue_timeout_s = float(queue_timeout_s)
+        # Round-22 multi-tenant control plane: per-tenant SLO classes
+        # (adapter name -> class; a request naming an adapter but no
+        # slo_class inherits its tenant's) and an optional
+        # AdapterRegistry (the content-hashed source of truth behind
+        # POST /adapters distribution)
+        self.tenant_slo_classes = dict(tenant_slo_classes or {})
+        self.adapters = adapters
         self.obs_component = "router"
         self.registry = Registry()
         install_process_gauges(self.registry, "router")
@@ -162,6 +171,10 @@ class RouterServer:
         self._c_queued = self.registry.counter(
             "kubetpu_router_queued_total",
             "requests parked by SLO-class admission while burning")
+        self._c_tenant_affine = self.registry.counter(
+            "kubetpu_router_tenant_affine_total",
+            "routing decisions narrowed to replicas advertising the "
+            "request's adapter resident")
         # -- live migration (Round-16): the mid-stream rid -> replica
         # RE-PIN map. A source replica answering 409-migrated names the
         # new owner; the pin (keyed by the request's downstream
@@ -251,6 +264,8 @@ class RouterServer:
                 elif path == "/replicas":
                     write_json(self, 200,
                                {"replicas": router.pool.to_json()})
+                elif path == "/adapters":
+                    write_json(self, 200, router.adapter_summary())
                 elif path.startswith("/trace/"):
                     write_json(self, 200,
                                router.trace(path[len("/trace/"):]))
@@ -277,6 +292,15 @@ class RouterServer:
                     except Exception as e:  # noqa: BLE001 — report
                         write_json(self, 502,
                                    {"error": f"registration failed: {e}"})
+                    return
+                if self.path == "/adapters":
+                    try:
+                        req = self._body()
+                    except ValueError:
+                        write_json(self, 400,
+                                   {"error": "body is not JSON"})
+                        return
+                    write_json(self, *router._adapters_post(req))
                     return
                 if self.path != "/generate":
                     write_json(self, 404, {"error": f"no route {self.path}"})
@@ -350,7 +374,8 @@ class RouterServer:
         free = load.get("pages_free")
         return free is not None and int(free) < self.min_free_pages
 
-    def _pick(self, prompt: List[int]) -> Tuple[Optional[str], bool]:
+    def _pick(self, prompt: List[int],
+              adapter=None) -> Tuple[Optional[str], bool]:
         """(replica name, was_affinity_target) — the routing decision.
         Affinity: walk the key's preference order, skipping unroutable
         and overloaded replicas; everyone overloaded -> least-queued
@@ -359,12 +384,28 @@ class RouterServer:
         replicas (role prefill/both) — decode workers receive their
         streams over the handoff wire, not the prompt path. A fleet
         with nothing prefill-capable (a misconfiguration) degrades to
-        routing anywhere rather than going dark."""
+        routing anywhere rather than going dark. Round-22: a request
+        naming an *adapter* narrows to TENANT-AFFINE replicas — those
+        whose last /load snapshot advertises the adapter resident
+        (``resident_adapters``) — so a tenant's requests land where
+        their factors (and their salted prefix pages) already live; no
+        replica advertising it degrades to the normal walk (the landing
+        replica answers 400 unless the adapter is pushed, or the
+        request named a stack index)."""
         routable = set(self.pool.routable())
         capable = {n for n in routable
                    if self.pool.role(n) != "decode"}
         if capable:
             routable = capable
+        if adapter is not None:
+            affine = {
+                n for n in routable
+                if str(adapter) in ((self.pool.snapshot(n) or {})
+                                    .get("resident_adapters") or ())}
+            if affine:
+                if affine != routable:
+                    self._c_tenant_affine.inc()
+                routable = affine
         if not routable:
             return None, False
         with self._lock:
@@ -473,7 +514,14 @@ class RouterServer:
                 or not all(isinstance(t, int) for t in prompt)):
             return 400, {"error": "prompt must be a non-empty list of "
                                   "token ids"}
-        slo_class = str(req.get("slo_class") or "interactive")
+        adapter = req.get("adapter")
+        # per-tenant SLO classes (Round-22): a request naming an adapter
+        # but no explicit class inherits its tenant's declared class —
+        # an explicit slo_class always wins (the operator's override)
+        slo_class = req.get("slo_class")
+        if slo_class is None and adapter is not None:
+            slo_class = self.tenant_slo_classes.get(str(adapter))
+        slo_class = str(slo_class or "interactive")
         deadline = time.monotonic() + float(
             req.get("timeout") or DEFAULT_ROUTE_TIMEOUT)
         code, obj = self._admit(slo_class)
@@ -509,7 +557,7 @@ class RouterServer:
             if pinned is not None:
                 name, affinity = pinned, False
             else:
-                name, affinity = self._pick(prompt)
+                name, affinity = self._pick(prompt, adapter=adapter)
             if name is None:
                 self._c_norep.inc()
                 return 503, {"error": "no routable replica"}
@@ -521,6 +569,8 @@ class RouterServer:
                        "timeout": max(0.1, deadline - time.monotonic())}
             if req.get("sampling") is not None:
                 payload["sampling"] = req["sampling"]
+            if adapter is not None:
+                payload["adapter"] = adapter
             # Round-17 disaggregated placement: a prompt landing on a
             # DEDICATED prefill replica names its decode target NOW —
             # picked from the decode pool by load/free pages — so the
@@ -597,7 +647,9 @@ class RouterServer:
             self._metrics.record("route", time.perf_counter() - t0)
             self.events.emit("route", replica=name, slo_class=slo_class,
                              affinity=affinity,
-                             prompt_tokens=len(prompt))
+                             prompt_tokens=len(prompt),
+                             **({"adapter": str(adapter)}
+                                if adapter is not None else {}))
             self._unpin(leg_key)     # the stream completed: pin done
             body = dict(body)
             body["replica"] = name
@@ -737,6 +789,65 @@ class RouterServer:
             with self._lock:
                 for n in stale:
                     self.ring.remove(n)
+
+    # -- Round-22: adapter distribution (the control-plane surface) ----------
+
+    def adapter_summary(self) -> dict:
+        """Registry names + per-replica residency (from the cached
+        /load snapshots — no scrape on this path): what ``GET
+        /adapters`` serves and ``cli.obs``'s tenants section renders."""
+        resident = {}
+        for name in self.pool.names():
+            load = self.pool.snapshot(name) or {}
+            if "resident_adapters" in load:
+                resident[name] = list(load.get("resident_adapters") or ())
+        return {
+            "registered": (self.adapters.names()
+                           if self.adapters is not None else []),
+            "resident": resident,
+        }
+
+    def _adapters_post(self, req: dict):
+        """``POST /adapters`` on the router: distribute a REGISTERED
+        adapter to replicas ({"name": ..., "replicas"?: [names]} —
+        default: every routable multi-LoRA replica), or evict it
+        ({"action": "evict", ...}). Per-replica outcomes are reported,
+        never collapsed: a partial push is a fact the operator acts on
+        (retry the failures), not an error that hides the successes."""
+        if self.adapters is None:
+            return 404, {"error": "router has no adapter registry"}
+        name = req.get("name")
+        if not isinstance(name, str) or not name:
+            return 400, {"error": "adapter name required"}
+        action = str(req.get("action") or "load")
+        if action == "load" and name not in self.adapters.names():
+            return 404, {"error": f"no registered adapter {name!r}"}
+        want = req.get("replicas")
+        targets = ([n for n in want if self.pool.url(n) is not None]
+                   if isinstance(want, list) else self.pool.routable())
+        results = {}
+        for rep in targets:
+            url = self.pool.url(rep)
+            if url is None:
+                continue
+            try:
+                if action == "evict":
+                    body = self.adapters.evict_adapter(url, name,
+                                                       token=self.token)
+                else:
+                    body = self.adapters.push_adapter(url, name,
+                                                      token=self.token)
+                results[rep] = {"ok": True,
+                                "resident": body.get("resident")}
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    continue         # not a multi-LoRA replica: skip
+                results[rep] = {"ok": False, "code": e.code}
+            except Exception as e:  # noqa: BLE001 — per-replica degrade
+                results[rep] = {"ok": False, "error": str(e)[:120]}
+        self.events.emit("adapter_distribute", name=name, action=action,
+                         replicas=len(results))
+        return 200, {"name": name, "action": action, "results": results}
 
     def _admit(self, slo_class: str):
         """The SLO-class gate: (None, None) to proceed; a (code, obj)

@@ -1,0 +1,251 @@
+#!/usr/bin/env python3
+"""``make lora-check`` — the multi-tenant adapter-serving oracle.
+
+Boots a router + 2 PACKED multi-LoRA paged replicas IN-PROCESS on the
+CPU backend, injects >=10% wire faults (drop / injected 503 / truncated
+response) on the adapter hot-load leg (``/adapters``) plus a lighter
+mix on ``/generate``, drives a per-tenant storm through keyed,
+retrying client POSTs — including hot-loads past the replica HBM
+budget so LRU eviction fires under pressure — and fails (exit 1) on:
+
+- PARITY: any tenant's routed greedy tokens differing from a quiet
+  single-tenant run on ``merge_lora(base, adapter)`` — the packed
+  stack, per-slot retargeting, adapter-salted prefix keys, retries
+  and hot-load churn must all be invisible in the token stream;
+- DOUBLE RESIDENCY: a replayed / retried push occupying two stack
+  indices, or directory bookkeeping skewing from the stack
+  (``check_invariants``' adapter-directory oracle, run per drain);
+- STALE SERVING: a request naming an evicted adapter being served at
+  all (it must refuse — names resolve through the directory at
+  enqueue, never through a cached index);
+- the accounting identity ``resident == initial + loads - evicts`` on
+  every replica (a double-load breaks it without an extra evict);
+- faults that never actually fired (a chaos run that injected nothing
+  proves nothing).
+
+Runs in well under a minute with no accelerator; wired into
+``make chaos`` so every fault-injection run also proves thousand-tenant
+packing serves each tenant exactly.
+"""
+
+import os
+import sys
+import urllib.error
+
+sys.path.insert(0, ".")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 — backend already initialized
+    pass
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.lora import (  # noqa: E402
+    LoraConfig, init_lora_params, merge_lora)
+from kubetpu.jobs.multi_lora import (  # noqa: E402
+    PagedMultiLoraDecodeServer, adapter_fingerprint)
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.router import ReplicaServer, RouterServer  # noqa: E402
+from kubetpu.router.adapters import AdapterRegistry  # noqa: E402
+from kubetpu.wire.faults import FaultInjector, RoutePolicy  # noqa: E402
+from kubetpu.wire.httpcommon import request_json  # noqa: E402
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+LCFG = LoraConfig(rank=4, alpha=8.0)
+PS = 8
+MAX_NEW = 4
+N_ADAPTERS = 6          # tenants in the registry...
+CAPACITY = 4            # ...over a 4-deep replica stack: pressure
+# >=10% total injection on the adapter hot-load leg (the round's new
+# wire surface), plus a lighter mix on generate to keep the data plane
+# honest while adapters churn
+ADAPTER_FAULTS = RoutePolicy(drop=0.05, error=0.04, partial=0.04)
+GEN_FAULTS = RoutePolicy(drop=0.03, error=0.03, partial=0.03)
+
+
+def fail(msg: str) -> None:
+    print(f"lora-check: FAIL: {msg}")
+    sys.exit(1)
+
+
+def _adapter(seed: int):
+    a = init_lora_params(jax.random.PRNGKey(seed), CFG, LCFG)
+    keys = jax.random.split(jax.random.PRNGKey(seed + 100),
+                            len(a["blocks"]))
+    for i, (k, v) in enumerate(sorted(a["blocks"].items())):
+        if k.endswith("_b"):
+            a["blocks"][k] = jax.random.normal(
+                keys[i], v.shape, v.dtype) * 0.05
+    return a
+
+
+def make_server(base, adapters):
+    return PagedMultiLoraDecodeServer(
+        CFG, base, LCFG, adapters, max_adapters=CAPACITY, n_slots=2,
+        max_seq=64, max_new_tokens=MAX_NEW, page_size=PS,
+        prefill_budget=PS, prefix_cache_pages=16)
+
+
+def tenant_prompts(tenant: int):
+    """Two prompts per tenant sharing a one-page prefix (so the salted
+    prefix tree engages) plus a short loner."""
+    fam = [(i * (tenant + 3)) % 60 + 1 for i in range(PS)]
+    return [fam + [tenant + 1], fam + [tenant + 11], [tenant + 20, 2, 3]]
+
+
+def main() -> int:
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    adapters = [_adapter(s) for s in range(1, N_ADAPTERS + 1)]
+    names = [adapter_fingerprint(a) for a in adapters]
+
+    # the quiet oracle: each tenant alone on the merged model
+    expected = {}
+    for t, a in enumerate(adapters):
+        ref = PagedDecodeServer(
+            CFG, merge_lora(base, a, LCFG), n_slots=1, max_seq=64,
+            max_new_tokens=MAX_NEW, page_size=PS, prefill_budget=PS,
+            prefix_cache_pages=16)
+        for p in tenant_prompts(t):
+            rid = ref.enqueue(p)
+            ref.drain()
+            expected[(t, tuple(p))] = ref.pop_result(rid)
+
+    registry = AdapterRegistry()
+    for a in adapters:
+        registry.register(a)
+
+    injector = FaultInjector(seed=23, routes={
+        "/adapters": ADAPTER_FAULTS, "/generate": GEN_FAULTS})
+    replicas = []
+    for i in range(2):
+        # both replicas boot with the first two tenants resident
+        rep = ReplicaServer(make_server(base, adapters[:2]), f"ml{i}",
+                            faults=injector, idle_wait=0.002)
+        rep.start()
+        replicas.append(rep)
+    router = RouterServer(load_refresh_s=0.05, adapters=registry)
+    router.start()
+    try:
+        for rep in replicas:
+            router.register_replica(rep.address)
+
+        def audit():
+            for rep in replicas:
+                rep.server.check_invariants()
+                res = rep.server.resident_adapters()
+                if len(set(res)) != len(res):
+                    fail(f"{rep.name}: duplicate residency {res}")
+
+        def generate(t: int, prompt, key: str):
+            body = request_json(
+                router.address + "/generate",
+                {"prompt": prompt, "adapter": names[t], "timeout": 30.0},
+                idempotency_key=key, timeout=30.0)
+            want = expected[(t, tuple(prompt))]
+            if body["tokens"] != want:
+                fail(f"tenant {t} prompt {prompt[:3]}...: routed "
+                     f"{body['tokens']} != merged oracle {want} "
+                     f"(replica {body['replica']})")
+            return body
+
+        # phase 1 — hot-load tenants 2..3 everywhere (stack now full),
+        # then a per-tenant storm across all four resident tenants
+        for name in names[2:CAPACITY]:
+            for rep in replicas:
+                registry.push_adapter(rep.address, name, timeout=30.0)
+        audit()
+        n_gen = 0
+        for t in range(CAPACITY):
+            for j, p in enumerate(tenant_prompts(t)):
+                generate(t, p, f"lora-check-p1-{t}-{j}")
+                n_gen += 1
+        audit()
+
+        # replayed pushes are no-ops: same content, fresh keys
+        before = [tuple(rep.server.resident_adapters())
+                  for rep in replicas]
+        for name in names[:CAPACITY]:
+            registry.push_adapter(replicas[0].address, name, timeout=30.0)
+        if tuple(replicas[0].server.resident_adapters()) != before[0]:
+            fail("replayed pushes changed residency: "
+                 f"{before[0]} -> {replicas[0].server.resident_adapters()}")
+        audit()
+
+        # phase 2 — pressure: tenants 4..5 displace LRU residents
+        evicted = set()
+        for name in names[CAPACITY:]:
+            for rep in replicas:
+                was = set(rep.server.resident_adapters())
+                registry.push_adapter(rep.address, name, timeout=30.0)
+                now = set(rep.server.resident_adapters())
+                evicted |= was - now
+                if name not in now:
+                    fail(f"{rep.name}: pushed {name} not resident")
+        if not evicted:
+            fail("no LRU eviction under pressure — capacity not binding")
+        audit()
+        for t in range(CAPACITY, N_ADAPTERS):
+            for j, p in enumerate(tenant_prompts(t)):
+                generate(t, p, f"lora-check-p2-{t}-{j}")
+                n_gen += 1
+        audit()
+
+        # an evicted tenant must REFUSE, never serve stale factors
+        gone = sorted(evicted)[0]
+        t_gone = names.index(gone)
+        stale_served = 0
+        try:
+            request_json(
+                router.address + "/generate",
+                {"prompt": [1, 2, 3], "adapter": gone, "timeout": 10.0},
+                idempotency_key="lora-check-stale", timeout=10.0)
+            stale_served = 1
+        except urllib.error.HTTPError:
+            pass
+        except Exception:  # noqa: BLE001 — drop/partial surface as URLError
+            pass
+        if stale_served:
+            fail(f"evicted adapter {gone} was served")
+
+        # ...and hot-loading it back restores exact parity
+        for rep in replicas:
+            registry.push_adapter(rep.address, gone, timeout=30.0)
+        audit()
+        for j, p in enumerate(tenant_prompts(t_gone)):
+            generate(t_gone, p, f"lora-check-p3-{t_gone}-{j}")
+            n_gen += 1
+        audit()
+
+        # accounting identity per replica: a replay that double-loaded
+        # would bump loads without a matching evict
+        for rep in replicas:
+            srv = rep.server
+            loads = int(srv.obs.counter(
+                "kubetpu_adapter_loads_total").value)
+            evicts = int(srv.obs.counter(
+                "kubetpu_adapter_evicts_total").value)
+            res = len(srv.resident_adapters())
+            if res != 2 + loads - evicts:
+                fail(f"{rep.name}: residency identity broken — "
+                     f"{res} resident != 2 initial + {loads} loads "
+                     f"- {evicts} evicts")
+
+        fired = dict(injector.counts)
+        if sum(fired.values()) == 0:
+            fail("no faults fired — the soak proved nothing; raise rates")
+    finally:
+        router.shutdown()
+        for rep in replicas:
+            rep.shutdown(graceful=False)
+
+    print(f"lora-check OK: {n_gen} routed per-tenant generations "
+          f"token-exact vs merged, {len(evicted)} LRU evictions under "
+          f"pressure, stale names refused, faults fired {fired}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1250,6 +1250,26 @@ def test_disagg_handoff_legs_are_barrier_legs(tmp_path):
     assert res.active == []
 
 
+def test_adapter_hot_load_legs_are_barrier_legs():
+    """Round-22 pin: the multi-LoRA adapter legs — ``load_adapter``
+    (one host->device factor upload into the packed stack) and
+    ``evict_adapter`` (directory bookkeeping) — are classified KTP001
+    BARRIER legs: they run on the wire thread between steps, never
+    inside one, and the closure traversal stops at them. The per-step
+    adapter-id upload rides the ``_dev`` cache instead, so neither may
+    ever become reachable from ``step()``."""
+    from kubetpu.analysis.core import load_project
+    from kubetpu.analysis.rules_device import HOT_BARRIERS, hot_closure
+
+    for leg in ("load_adapter", "evict_adapter"):
+        assert leg in HOT_BARRIERS, leg
+    project = load_project(REPO_ROOT, ["kubetpu"])
+    quals = {qual.split(".")[-1] if "." in qual else qual
+             for _, qual, _ in hot_closure(project).values()}
+    assert "load_adapter" not in quals
+    assert "evict_adapter" not in quals
+
+
 def test_repo_lints_clean_against_committed_baseline():
     """`make lint` green is a merge gate; this pins it in tier-1. Any
     new violation of KTP001–KTP006 in kubetpu/ or scripts/ fails here

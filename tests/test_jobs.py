@@ -190,6 +190,7 @@ def test_mesh_insufficient_devices():
         make_mesh({"dp": 16, "sp": 1, "tp": 1})
 
 
+@pytest.mark.slow
 def test_moe_forward_and_gspmd_step():
     cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64, n_experts=4)
     mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
@@ -218,6 +219,7 @@ def test_pipeline_forward_matches_reference():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_chunked_loss_matches_unchunked():
     """The pipelined step honors cfg.loss_chunk (head runs outside the
     manual region): one update from the same state must produce the same
@@ -248,6 +250,7 @@ def test_pipeline_chunked_loss_matches_unchunked():
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_train_step_five_axes():
     """The full five-axis composition: dp data, pp stages, sp ring, tp
     heads, ep experts — one program, loss decreases."""
@@ -445,6 +448,7 @@ def test_ring_flash_gradients_match_dense_ring():
                                    rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_train_step_ring_flash():
     """Full sharded train step with attention='ring_flash_interpret' on a
     dp x sp x tp mesh: loss finite and close to the dense-ring step."""
@@ -498,6 +502,7 @@ def test_ring_flash_gradients_finite_with_outlier_logits():
                                    rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_train_step_ring_flash():
     """The five-axis pipeline step with flash kernels inside the ring
     (the {pp, sp}-manual region takes the flash-ring local body directly):
@@ -522,6 +527,7 @@ def test_pipeline_train_step_ring_flash():
     np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_gradient_accumulation_matches_full_batch():
     """accum_steps=2 over one batch must produce the SAME update as the
     unaccumulated step (equal-size chunks: mean of chunk means == full
@@ -699,6 +705,7 @@ def test_moe_top2_primary_outranks_secondary_under_tight_capacity():
     assert not np.allclose(np.asarray(tight), np.asarray(roomy))
 
 
+@pytest.mark.slow
 def test_moe_top2_trains_on_ep_mesh():
     cfg = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
                       n_experts=2, moe_capacity_factor=2.0, moe_top_k=2,
@@ -760,6 +767,7 @@ def test_skip_nonfinite_guards_the_update():
                            np.asarray(state.params["head"]))
 
 
+@pytest.mark.slow
 def test_label_smoothing_and_z_loss_formulas():
     """Hand-check both regularizers against their definitions, and pin
     chunked/materialized parity with both active."""
@@ -801,6 +809,7 @@ def test_label_smoothing_and_z_loss_formulas():
         ModelConfig(z_loss=-0.1)
 
 
+@pytest.mark.slow
 def test_windowed_training_learns_with_dense_and_banded_ring():
     import dataclasses
 
@@ -951,6 +960,7 @@ def test_make_multislice_mesh_rejects_oversupply():
         make_multislice_mesh({"dcn": 2, "tp": 2}, devices=fat)
 
 
+@pytest.mark.slow
 def test_multislice_train_step_matches_single_slice_dp():
     """{dcn:2, dp:1, sp:2, tp:2} training must be numerically the same
     computation as {dp:2, sp:2, tp:2}: dcn and dp are both pure data axes

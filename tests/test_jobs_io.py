@@ -12,6 +12,7 @@ from kubetpu.jobs.data import SyntheticCorpus, prefetch_to_mesh
 CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip_preserves_state_and_shardings(tmp_path):
     mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
     state, opt = init_state(jax.random.PRNGKey(0), CFG, mesh)
@@ -70,6 +71,7 @@ def test_prefetch_shards_batches():
     assert tokens.shape == (4, 32)
 
 
+@pytest.mark.slow
 def test_end_to_end_training_on_corpus():
     """Model learns the synthetic corpus' transition structure: loss drops
     well below uniform (ln 64 ~ 4.16)."""
@@ -91,6 +93,7 @@ def test_end_to_end_training_on_corpus():
     assert losses[-1] < 2.8 < losses[0]
 
 
+@pytest.mark.slow
 def test_checkpoint_restores_across_different_mesh():
     """The resume-on-a-new-slice claim: a state saved under one mesh layout
     restores into a DIFFERENT layout's shardings and keeps training."""
@@ -117,6 +120,7 @@ def test_checkpoint_restores_across_different_mesh():
     assert jnp.isfinite(loss) and int(cont.step) == 2
 
 
+@pytest.mark.slow
 def test_checkpoint_pipeline_state_roundtrip(tmp_path):
     """pp-sharded (layer-axis) states checkpoint and restore too."""
     from kubetpu.jobs.pipeline import init_pipeline_state, make_pipeline_train_step
@@ -179,6 +183,7 @@ def test_evaluate_reports_loss_and_perplexity():
     assert abs(r["loss"] - np.log(cfg.vocab)) < 1.0
 
 
+@pytest.mark.slow
 def test_async_checkpointer_overlaps_and_restores(tmp_path):
     """AsyncCheckpointer.save returns before I/O completes, training
     continues, and the flushed checkpoint restores exactly."""

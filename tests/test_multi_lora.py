@@ -138,3 +138,308 @@ def test_adapter_out_of_range_rejected():
     with pytest.raises(KeyError):
         srv.pop_result(rid)
     assert srv._rid_adapter[rid] == 0
+
+
+# ---------------------------------------------------------------------------
+# Round-22: the packed paged replica (PagedMultiLoraDecodeServer)
+# ---------------------------------------------------------------------------
+
+from kubetpu.jobs.multi_lora import (  # noqa: E402
+    PagedMultiLoraDecodeServer, SpecMultiLoraDecodeServer,
+    adapter_fingerprint)
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+
+PS = 8
+
+
+def _paged_multi(base, adapters, **kw):
+    kw.setdefault("n_slots", 3)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("page_size", PS)
+    kw.setdefault("eos_id", None)
+    return PagedMultiLoraDecodeServer(CFG, base, LCFG, adapters, **kw)
+
+
+def _merged_ref(base, adapter, prompt, **kw):
+    kw.setdefault("n_slots", 1)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("page_size", PS)
+    kw.setdefault("eos_id", None)
+    srv = PagedDecodeServer(CFG, merge_lora(base, adapter, LCFG), **kw)
+    rid = srv.enqueue(prompt)
+    srv.drain()
+    return srv.pop_result(rid)
+
+
+@pytest.mark.parametrize("kv_int8", [False, True])
+@pytest.mark.parametrize("chunked", [False, True])
+def test_paged_parity_matrix(chunked, kv_int8):
+    """The tentpole exactness claim, across the leg matrix: a packed
+    mixed-tenant batch through {monolithic, chunked} x {f32, kv_int8}
+    paged decode equals single-tenant merged decode per stream."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    adapters = [_adapter(1), _adapter(2), _adapter(3)]
+    kw = dict(kv_int8=kv_int8, prefill_budget=PS if chunked else 0)
+    srv = _paged_multi(base, adapters, **kw)
+    prompts = [[5, 6, 7, 9, 11], list(range(1, 2 * PS + 2)), [9, 10]]
+    picks = [0, 2, 1]
+    rids = [srv.submit(p, adapter=a) for p, a in zip(prompts, picks)]
+    assert None not in rids
+    srv.drain()
+    srv.check_invariants()
+    for rid, prompt, a in zip(rids, prompts, picks):
+        got = srv.pop_result(rid)
+        want = _merged_ref(base, adapters[a], prompt, **kw)
+        assert got == want, (a, got, want)
+
+
+def test_paged_prefix_hit_parity_and_cross_tenant_isolation():
+    """Adapter-salted prefix keys: a same-tenant replay HITS the warm
+    tree (and still matches merged decode); the SAME prompt under a
+    different adapter must MISS — adapter A's KV pages encode A's wk/wv
+    deltas and may never warm-start adapter B."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    adapters = [_adapter(1), _adapter(2)]
+    srv = _paged_multi(base, adapters, prefix_cache_pages=16)
+    prompt = list(range(1, 2 * PS + 2))  # two full pages + a tail
+
+    r0 = srv.submit(prompt, adapter=0)
+    srv.drain()
+    assert srv.prefix_cache_stats()["requests_hit"] == 0
+    r1 = srv.submit(prompt, adapter=0)
+    srv.drain()
+    assert srv.prefix_cache_stats()["requests_hit"] == 1  # warm replay
+    r2 = srv.submit(prompt, adapter=1)
+    srv.drain()
+    # the cross-tenant request found pages under A's salt — and ignored
+    # them: the hit counter must NOT move
+    assert srv.prefix_cache_stats()["requests_hit"] == 1
+    srv.check_invariants()
+
+    want = {a: _merged_ref(base, adapters[a], prompt,
+                           prefix_cache_pages=16) for a in (0, 1)}
+    assert srv.pop_result(r0) == want[0]
+    assert srv.pop_result(r1) == want[0]  # the hit changed no token
+    assert srv.pop_result(r2) == want[1]
+
+
+def test_spec_multilora_greedy_parity():
+    """Speculative rounds over the packed pool: draft is adapterless,
+    verify applies each slot's adapter — output must equal plain merged
+    greedy decode per tenant (speculation may only change latency)."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    draft = init_params(jax.random.PRNGKey(7), CFG)
+    adapters = [_adapter(1), _adapter(2)]
+    srv = SpecMultiLoraDecodeServer(
+        CFG, CFG, base, draft, LCFG, adapters, n_slots=2, max_seq=64,
+        max_new_tokens=6, page_size=PS, eos_id=None, gamma_max=2)
+    prompts = [[5, 6, 7, 9], [9, 10, 4]]
+    rids = [srv.submit(p, adapter=a) for p, a in zip(prompts, (0, 1))]
+    assert None not in rids
+    srv.drain()
+    srv.check_invariants()
+    for rid, prompt, a in zip(rids, prompts, (0, 1)):
+        assert srv.pop_result(rid) == _merged_ref(base, adapters[a], prompt)
+
+
+def test_64_adapters_one_packed_server():
+    """The acceptance bar: ONE packed replica serving 64 resident
+    adapters through the paged path, spot-checked token-exact against
+    merged single-tenant decode at both ends and the middle."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    adapters = [_adapter(s) for s in range(1, 65)]
+    srv = _paged_multi(base, adapters, n_slots=2, max_new_tokens=4)
+    assert srv.n_adapters == 64
+    assert len(srv.resident_adapters()) == 64
+    prompt = [5, 6, 7, 9]
+    for t in (0, 17, 40, 63):
+        rid = srv.submit(prompt, adapter=t)
+        srv.drain()
+        got = srv.pop_result(rid)
+        want = _merged_ref(base, adapters[t], prompt, max_new_tokens=4)
+        assert got == want, (t, got, want)
+    srv.check_invariants()
+
+
+def test_hot_load_evict_directory():
+    """The residency life cycle: content-idempotent load, shape
+    validation, LRU eviction when the stack is full, in-use eviction
+    refusal, and stale names refusing at enqueue."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    a0, a1, a2, a3 = (_adapter(s) for s in (1, 2, 3, 4))
+    srv = _paged_multi(base, [a0, a1], max_adapters=3, n_slots=1)
+    n0 = adapter_fingerprint(a0)
+
+    # idempotency is by NAME (the tenant identity — wire pushes name by
+    # fingerprint, so replays dedupe): re-loading a resident name is a
+    # no-op; an explicit alias is a distinct tenant and takes an index
+    assert srv.load_adapter(a0) == n0
+    assert len(srv.resident_adapters()) == 2
+    assert srv.load_adapter(a0, name="alias") == "alias"
+    assert len(srv.resident_adapters()) == 3
+    assert srv.evict_adapter("alias") is True
+
+    # malformed trees refuse before touching the stack
+    bad = {"blocks": {k: v for k, v in a2["blocks"].items()
+                      if not k.endswith("wq_b")}}
+    with pytest.raises(ValueError):
+        srv.load_adapter(bad)
+
+    n2 = srv.load_adapter(a2, name="t2")      # fills the free index
+    assert n2 == "t2"
+    assert len(srv.resident_adapters()) == 3
+
+    # stack full + everything idle: the 4th load LRU-evicts
+    n3 = srv.load_adapter(a3, name="t3")
+    assert n3 == "t3"
+    res = srv.resident_adapters()
+    assert len(res) == 3 and "t3" in res
+    evicted = ({n0, adapter_fingerprint(a1), "t2"} - set(res)).pop()
+    srv.check_invariants()
+
+    # the evicted name refuses at enqueue — never a stale index
+    with pytest.raises(ValueError):
+        srv.enqueue([1, 2, 3], adapter=evicted)
+
+    # a live stream pins its adapter against explicit eviction
+    rid = srv.enqueue([5, 6, 7], adapter="t3")
+    srv.step()  # admit it
+    with pytest.raises(RuntimeError):
+        srv.evict_adapter("t3")
+    srv.drain()
+    srv.pop_result(rid)
+    assert srv.evict_adapter("t3") is True    # idle now: clean evict
+    assert srv.evict_adapter("t3") is False   # replayed evict: no-op
+    srv.check_invariants()
+
+    # loaded-by-name parity: the hot-loaded tenant decodes exactly
+    rid = srv.enqueue([5, 6, 7], adapter="t2")
+    srv.drain()
+    assert srv.pop_result(rid) == _merged_ref(base, a2, [5, 6, 7],
+                                              n_slots=1)
+
+
+def test_recycled_index_never_serves_stale_prefix():
+    """Eviction bumps the index's prefix-salt generation: a tenant
+    hot-loaded into a RECYCLED stack index must not warm-start from the
+    evicted occupant's cached pages (same prompt, same index — without
+    the generation term the salted keys collide and the new tenant
+    decodes from the old tenant's KV)."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    a0, a1, a2 = (_adapter(s) for s in (1, 2, 3))
+    srv = _paged_multi(base, [a0, a1], max_adapters=2, n_slots=1,
+                       prefix_cache_pages=16)
+    prompt = list(range(5, 14))
+    rid = srv.enqueue(prompt, adapter=0)      # a0 publishes the prefix
+    srv.drain()
+    srv.pop_result(rid)
+    hits0 = srv.prefix_cache_stats()["requests_hit"]
+    srv.load_adapter(a2, name="t2")           # LRU-evicts an idle index
+    recycled = ({adapter_fingerprint(a0), adapter_fingerprint(a1)}
+                - set(srv.resident_adapters())).pop()
+    rid = srv.enqueue(prompt, adapter="t2")
+    srv.drain()
+    out = srv.pop_result(rid)
+    assert srv.prefix_cache_stats()["requests_hit"] == hits0, (
+        f"t2 warm-started from {recycled}'s cached pages")
+    assert out == _merged_ref(base, a2, prompt, n_slots=1)
+    srv.check_invariants()
+
+
+def test_adapter_hbm_budget_caps_capacity():
+    """``adapter_hbm_bytes`` is the real bound: capacity (a compiled
+    SHAPE) is min(max_adapters, budget // per-adapter bytes), and a
+    budget that can't hold the initial set refuses at construction."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    a0, a1 = _adapter(1), _adapter(2)
+    probe = _paged_multi(base, [a0], n_slots=1)
+    per = probe._adapter_bytes_each
+    assert per > 0
+
+    srv = _paged_multi(base, [a0], max_adapters=8, n_slots=1,
+                       adapter_hbm_bytes=2 * per)
+    assert srv.n_adapters == 2              # budget bound max_adapters
+    srv.load_adapter(a1, name="t1")
+    res = set(srv.resident_adapters())
+    srv.load_adapter(_adapter(3), name="t2")    # full: LRU evicts
+    assert len(srv.resident_adapters()) == 2
+    srv.check_invariants()
+
+    with pytest.raises(ValueError):
+        _paged_multi(base, [a0, a1], n_slots=1, adapter_hbm_bytes=per)
+    del res
+
+
+def test_rid_adapter_map_never_leaks():
+    """The Round-22 leak fix, pinned at every request exit: pop_result,
+    cancel (queued AND admitted), and queue-TTL expiry all reclaim the
+    rid->adapter entry through ``_drop_request_state``."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    srv = _paged_multi(base, [_adapter(1), _adapter(2)], n_slots=1,
+                       max_new_tokens=3)
+
+    rid = srv.submit([5, 6, 7], adapter=1)     # normal completion
+    srv.drain()
+    srv.pop_result(rid)
+    assert srv._rid_adapter == {}
+
+    r0 = srv.enqueue([5, 6, 7], adapter=0)     # admitted then canceled
+    r1 = srv.enqueue([9, 10], adapter=1)       # canceled while queued
+    srv.step()
+    assert srv.cancel(r0) and srv.cancel(r1)
+    srv.drain()
+    assert srv._rid_adapter == {}
+
+    r2 = srv.enqueue([5, 6], adapter=1, ttl=0.0)   # expires in queue
+    r3 = srv.enqueue([7, 8], adapter=0)
+    import time as _t
+    _t.sleep(0.01)
+    srv.drain()
+    assert srv.expire_reason(r2) == "queue_ttl"
+    srv.pop_result(r3)
+    assert srv._rid_adapter == {}, srv._rid_adapter
+    srv.check_invariants()
+
+
+def test_multilora_slots_refuse_migration():
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    srv = _paged_multi(base, [_adapter(1)], n_slots=1)
+    rid = srv.submit([5, 6, 7], adapter=0)
+    srv.step()
+    with pytest.raises(NotImplementedError):
+        srv.snapshot_slot(rid)
+    with pytest.raises(NotImplementedError):
+        srv.restore_slot({"rid": rid})
+    srv.drain()
+    srv.pop_result(rid)
+
+
+def test_tenant_counters_track_requests_and_tokens():
+    """Per-tenant observability: requests and decode tokens land on the
+    adapter's label; past the top-K the overflow bucket absorbs new
+    labels (bounded cardinality)."""
+    from kubetpu.jobs.multi_lora import _TENANT_OVERFLOW, _TENANT_TOPK
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    srv = _paged_multi(base, [_adapter(1), _adapter(2)], n_slots=1,
+                       max_new_tokens=3)
+    names = srv.resident_adapters()
+    rid = srv.submit([5, 6, 7], adapter=0)
+    srv.drain()
+    out = srv.pop_result(rid)
+    req = srv.obs.counter("kubetpu_tenant_requests_total",
+                          adapter=srv._adapter_label(0))
+    tok = srv.obs.counter("kubetpu_tenant_decode_tokens_total",
+                          adapter=srv._adapter_label(0))
+    assert int(req.value) == 1
+    # decode steps only: the first emitted token is prefill's product
+    assert int(tok.value) == len(out) - 3 - 1
+    assert len(names) == 2
+
+    # cardinality bound: hammer one metric with many fake labels
+    for aid in range(200):
+        srv._tenant_counter("req", aid % srv.n_adapters)
+    labels = srv._tenant_counters["req"]
+    assert len(labels) <= _TENANT_TOPK + 1
+    assert _TENANT_OVERFLOW not in labels or len(labels) == _TENANT_TOPK + 1

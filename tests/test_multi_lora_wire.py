@@ -1,0 +1,197 @@
+"""Round-22 adapter control plane over the wire: registry push/evict
+round-trips against a REAL packed replica, idempotency-window replay of
+a hot-load, the router's tenant-affine routing + per-tenant SLO
+classes, and the non-LoRA-replica refusals.
+
+The fault-injected contract (parity under drop/503/partial on the
+hot-load leg) runs in ``make lora-check``."""
+
+import urllib.error
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from kubetpu.jobs import ModelConfig, init_params  # noqa: E402
+from kubetpu.jobs.lora import (  # noqa: E402
+    LoraConfig, init_lora_params, merge_lora)
+from kubetpu.jobs.multi_lora import (  # noqa: E402
+    PagedMultiLoraDecodeServer, adapter_fingerprint)
+from kubetpu.jobs.paged import PagedDecodeServer  # noqa: E402
+from kubetpu.router import ReplicaServer, RouterServer  # noqa: E402
+from kubetpu.router.adapters import (  # noqa: E402
+    AdapterRegistry, decode_adapter, encode_adapter)
+from kubetpu.wire.httpcommon import request_json  # noqa: E402
+
+CFG = ModelConfig(vocab=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+LCFG = LoraConfig(rank=4, alpha=8.0)
+PS = 8
+MAX_NEW = 4
+
+
+def _adapter(seed):
+    a = init_lora_params(jax.random.PRNGKey(seed), CFG, LCFG)
+    keys = jax.random.split(jax.random.PRNGKey(seed + 100), len(a["blocks"]))
+    for i, (k, v) in enumerate(sorted(a["blocks"].items())):
+        if k.endswith("_b"):
+            a["blocks"][k] = jax.random.normal(keys[i], v.shape, v.dtype) * 0.05
+    return a
+
+
+def test_adapter_codec_round_trip():
+    a = _adapter(5)
+    back = decode_adapter(encode_adapter(a))
+    assert adapter_fingerprint(back) == adapter_fingerprint(a)
+    with pytest.raises(ValueError):
+        decode_adapter({"blocks": {}})
+    wire = encode_adapter(a)
+    wire["blocks"]["wq_a"] = {"dtype": "float32", "shape": [3], "data": "!!"}
+    with pytest.raises(ValueError):
+        decode_adapter(wire)
+
+
+def test_registry_content_identity():
+    reg = AdapterRegistry()
+    a, b = _adapter(1), _adapter(2)
+    n = reg.register(a)
+    assert n == adapter_fingerprint(a)
+    assert reg.register(a) == n                  # same bytes: no-op
+    reg.register(b, name="tenant-b")
+    with pytest.raises(ValueError):
+        reg.register(a, name="tenant-b")         # alias never retargets
+    assert reg.names() == sorted([n, "tenant-b"])
+    assert reg.encoded("tenant-b") is reg.encoded("tenant-b")  # cached
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Router (registry attached, per-tenant SLO classes) + one packed
+    multi-LoRA replica + one plain paged replica."""
+    base = init_params(jax.random.PRNGKey(0), CFG)
+    adapters = [_adapter(1), _adapter(2)]
+    packed = PagedMultiLoraDecodeServer(
+        CFG, base, LCFG, adapters, max_adapters=3, n_slots=2, max_seq=64,
+        max_new_tokens=MAX_NEW, page_size=PS, prefill_budget=PS,
+        prefix_cache_pages=16)
+    plain = PagedDecodeServer(
+        CFG, base, n_slots=2, max_seq=64, max_new_tokens=MAX_NEW,
+        page_size=PS, prefill_budget=PS)
+    reps = [ReplicaServer(packed, "packed0", idle_wait=0.002),
+            ReplicaServer(plain, "plain0", idle_wait=0.002)]
+    for rep in reps:
+        rep.start()
+    registry = AdapterRegistry()
+    names = [registry.register(a) for a in adapters]
+    extra = _adapter(3)
+    registry.register(extra, name="tenant-extra")
+    router = RouterServer(
+        load_refresh_s=0.05, adapters=registry,
+        tenant_slo_classes={"tenant-extra": "standard"})
+    router.start()
+    for rep in reps:
+        router.register_replica(rep.address)
+    yield {"router": router, "reps": reps, "registry": registry,
+           "base": base, "adapters": adapters, "extra": extra,
+           "names": names}
+    router.shutdown()
+    for rep in reps:
+        rep.shutdown(graceful=False)
+
+
+def test_wire_hot_load_replay_and_routed_parity(fleet):
+    """POST /adapters load round-trip; a replay under the SAME
+    idempotency key returns the committed answer without re-executing;
+    a routed generate naming the tenant is token-exact vs merged."""
+    router, (packed_rep, _), reg = (fleet["router"], fleet["reps"],
+                                    fleet["registry"])
+    srv = packed_rep.server
+    loads0 = int(srv.obs.counter("kubetpu_adapter_loads_total").value)
+    payload = {"action": "load", "name": "tenant-extra",
+               "adapter": reg.encoded("tenant-extra")}
+    out1 = request_json(packed_rep.address + "/adapters", payload,
+                        idempotency_key="wire-load-1", timeout=30.0)
+    out2 = request_json(packed_rep.address + "/adapters", payload,
+                        idempotency_key="wire-load-1", timeout=30.0)
+    assert out1 == out2                       # the replay window answered
+    assert "tenant-extra" in out1["resident"]
+    assert int(srv.obs.counter(
+        "kubetpu_adapter_loads_total").value) == loads0 + 1
+    # ...and a FRESH key re-executes but is content/name-idempotent
+    out3 = request_json(packed_rep.address + "/adapters", payload,
+                        idempotency_key="wire-load-2", timeout=30.0)
+    assert "tenant-extra" in out3["resident"]
+    assert int(srv.obs.counter(
+        "kubetpu_adapter_loads_total").value) == loads0 + 1
+    srv.check_invariants()
+
+    import time
+    time.sleep(0.15)  # the router's /load poll picks up residency
+    body = request_json(router.address + "/generate",
+                        {"prompt": [5, 6, 7], "adapter": "tenant-extra",
+                         "timeout": 30.0},
+                        idempotency_key="wire-gen-1", timeout=30.0)
+    assert body["replica"] == "packed0"       # tenant-affine routing
+    ref = PagedDecodeServer(
+        CFG, merge_lora(fleet["base"], fleet["extra"], LCFG), n_slots=1,
+        max_seq=64, max_new_tokens=MAX_NEW, page_size=PS,
+        prefill_budget=PS)
+    rid = ref.enqueue([5, 6, 7])
+    ref.drain()
+    assert body["tokens"] == ref.pop_result(rid)
+
+
+def test_wire_evict_and_stale_refusal(fleet):
+    """Evict round-trip; an evicted tenant refuses at the replica (400
+    through the router, never a stale index)."""
+    router, (packed_rep, _), reg = (fleet["router"], fleet["reps"],
+                                    fleet["registry"])
+    reg.push_adapter(packed_rep.address, "tenant-extra", timeout=30.0)
+    out = reg.evict_adapter(packed_rep.address, "tenant-extra",
+                            timeout=30.0)
+    assert out["evicted"] is True
+    assert "tenant-extra" not in packed_rep.server.resident_adapters()
+    out2 = reg.evict_adapter(packed_rep.address, "tenant-extra",
+                             timeout=30.0)
+    assert out2["evicted"] is False           # replayed evict: no-op
+    with pytest.raises(urllib.error.HTTPError) as e:
+        request_json(packed_rep.address + "/generate",
+                     {"prompt": [1, 2], "adapter": "tenant-extra",
+                      "timeout": 10.0},
+                     idempotency_key="wire-stale-1", timeout=10.0)
+    assert e.value.code == 400
+    packed_rep.server.check_invariants()
+
+
+def test_non_lora_replica_refuses_adapter_legs(fleet):
+    """A plain paged replica 404s the hot-load leg and 400s a generate
+    that names an adapter — the router's distribute skips it."""
+    router, (_, plain_rep), reg = (fleet["router"], fleet["reps"],
+                                   fleet["registry"])
+    with pytest.raises(urllib.error.HTTPError) as e:
+        reg.push_adapter(plain_rep.address, "tenant-extra", timeout=10.0)
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        request_json(plain_rep.address + "/generate",
+                     {"prompt": [1, 2], "adapter": 0, "timeout": 10.0},
+                     idempotency_key="wire-plain-1", timeout=10.0)
+    assert e.value.code == 400
+
+
+def test_router_distribute_and_summary(fleet):
+    """POST /adapters on the ROUTER fans the registered adapter out to
+    every capable replica (the plain one is skipped, not failed) and
+    the summary reflects registry + residency."""
+    router, reps, _ = fleet["router"], fleet["reps"], fleet["registry"]
+    out = request_json(router.address + "/adapters",
+                       {"action": "load", "name": "tenant-extra"},
+                       idempotency_key="wire-dist-1", timeout=30.0)
+    assert out["results"]["packed0"]["ok"] is True
+    assert "packed0" in out["results"]
+    assert "tenant-extra" in reps[0].server.resident_adapters()
+    summ = request_json(router.address + "/adapters", None, timeout=10.0)
+    assert "tenant-extra" in summ["registered"]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        request_json(router.address + "/adapters",
+                     {"action": "load", "name": "no-such"},
+                     idempotency_key="wire-dist-2", timeout=10.0)
+    assert e.value.code == 404

@@ -91,6 +91,7 @@ def test_missing_file_and_bad_dtype(tmp_path):
         TokenFile(str(tmp_path / "x"), dtype_bytes=3)
 
 
+@pytest.mark.slow
 def test_feeds_the_train_step(corpus):
     """End to end: native batches drive the real sharded train step."""
     import jax

@@ -5,6 +5,7 @@ generation — the same contract bar the other families pin."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubetpu.jobs import ModelConfig, make_mesh
 from kubetpu.jobs.seq2seq import (
@@ -45,6 +46,7 @@ def test_decoder_is_causal_and_cross_attends():
     assert float(jnp.max(jnp.abs(logits3 - logits))) > 1e-4
 
 
+@pytest.mark.slow
 def test_seq2seq_trains_on_copy_task():
     """Loss falls markedly on 'output = the source sequence' — only
     solvable through cross-attention (target inputs alone don't determine
@@ -131,6 +133,7 @@ def test_cached_generate_matches_recompute_reference():
             err_msg=f"eos={eos}")
 
 
+@pytest.mark.slow
 def test_seq2seq_chunked_loss_matches_unchunked():
     """cfg.loss_chunk streams the decoder CE tail — value and grads must
     match the materialized-logits path (tgt len 8, chunk 4)."""

@@ -105,6 +105,7 @@ def test_vit_moe_aux_and_config_validation():
     assert plus > plain  # the aux term was added
 
 
+@pytest.mark.slow
 def test_synthetic_images_feed_training_and_learn():
     from kubetpu.jobs.data import SyntheticImages
 
